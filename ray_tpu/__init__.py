@@ -1,0 +1,67 @@
+"""ray_tpu — a TPU-native distributed AI runtime.
+
+Task/actor/object core (reference capability: ray-project/ray) rebuilt
+TPU-first: JAX/XLA/Pallas compute path, pod-slice-aware scheduling, GSPMD
+parallelism presets, and a library stack (data, train, tune, serve, rllib)
+on top.
+"""
+
+from ray_tpu._version import version as __version__
+from ray_tpu import exceptions
+from ray_tpu.api import (
+    ActorClass,
+    ActorHandle,
+    ActorMethod,
+    PlacementGroup,
+    RemoteFunction,
+    RuntimeContext,
+    actor_exit,
+    available_resources,
+    cancel,
+    cluster_resources,
+    get,
+    get_actor,
+    get_runtime_context,
+    init,
+    is_initialized,
+    kill,
+    method,
+    nodes,
+    placement_group,
+    put,
+    remote,
+    remove_placement_group,
+    shutdown,
+    wait,
+)
+from ray_tpu.core.object_ref import ObjectRef
+
+__all__ = [
+    "ActorClass",
+    "ActorHandle",
+    "ActorMethod",
+    "ObjectRef",
+    "PlacementGroup",
+    "RemoteFunction",
+    "RuntimeContext",
+    "__version__",
+    "actor_exit",
+    "available_resources",
+    "cancel",
+    "cluster_resources",
+    "exceptions",
+    "get",
+    "get_actor",
+    "get_runtime_context",
+    "init",
+    "is_initialized",
+    "kill",
+    "method",
+    "nodes",
+    "placement_group",
+    "put",
+    "remote",
+    "remove_placement_group",
+    "shutdown",
+    "wait",
+]

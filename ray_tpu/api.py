@@ -1,0 +1,548 @@
+"""Public API.
+
+Reference surface: python/ray/_private/worker.py (init:1229, get:2557,
+put/wait/kill/cancel), python/ray/remote_function.py:262 (RemoteFunction),
+python/ray/actor.py:830 (ActorClass._remote), actor.py:1193 (ActorHandle).
+
+``init()`` starts the head services in-process (single "head node" with
+auto-detected CPU/TPU/memory resources, or a fake multi-node cluster for
+tests) and creates the driver's CoreWorker. ``remote`` wraps functions into
+``RemoteFunction`` and classes into ``ActorClass``.
+"""
+
+from __future__ import annotations
+
+import functools
+import inspect
+import logging
+import threading
+from typing import Any, Dict, List, Optional, Sequence, Union
+
+from ray_tpu import exceptions as exc
+from ray_tpu.core import object_ref as object_ref_mod
+from ray_tpu.core import rpc
+from ray_tpu.core.config import Config, get_config, reset_config
+from ray_tpu.core.core_worker import CoreWorker, HeadClient
+from ray_tpu.core.gcs import LocalPeer
+from ray_tpu.core.ids import ActorID, JobID, WorkerID
+from ray_tpu.core.node import HeadNode, detect_node_resources
+from ray_tpu.core.object_ref import ObjectRef
+from ray_tpu.core.task_spec import (
+    DefaultSchedulingStrategy,
+    NodeAffinitySchedulingStrategy,
+    PlacementGroupSchedulingStrategy,
+    SpreadSchedulingStrategy,
+)
+
+logger = logging.getLogger(__name__)
+
+_init_lock = threading.Lock()
+_global_node: Optional[HeadNode] = None
+_global_worker: Optional[CoreWorker] = None
+
+
+def is_initialized() -> bool:
+    return object_ref_mod.get_core_worker() is not None
+
+
+def _require_worker() -> CoreWorker:
+    cw = object_ref_mod.get_core_worker()
+    if cw is None:
+        raise RuntimeError(
+            "ray_tpu is not initialized; call ray_tpu.init() first"
+        )
+    return cw
+
+
+def init(num_cpus: Optional[float] = None, num_tpus: Optional[float] = None,
+         resources: Optional[Dict[str, float]] = None,
+         object_store_memory: Optional[int] = None,
+         system_config: Optional[dict] = None,
+         namespace: str = "",
+         logging_level: int = logging.INFO,
+         ignore_reinit_error: bool = False) -> "RuntimeContext":
+    """Start the runtime (head node + driver core worker)."""
+    global _global_node, _global_worker
+    with _init_lock:
+        if is_initialized():
+            if ignore_reinit_error:
+                return get_runtime_context()
+            raise RuntimeError("ray_tpu.init() called twice")
+        reset_config()
+        config = get_config()
+        config.apply_system_config(system_config)
+        if object_store_memory:
+            config.object_store_memory = object_store_memory
+
+        node_resources = detect_node_resources(num_cpus, num_tpus, resources)
+        node = HeadNode(config, node_resources)
+        worker = _connect_driver(node, config, namespace)
+        _global_node = node
+        _global_worker = worker
+        return get_runtime_context()
+
+
+def _connect_driver(node: HeadNode, config: Config, namespace: str
+                    ) -> CoreWorker:
+    worker_id = WorkerID.from_random()
+    # The driver shares the head's event loop; control-plane calls are
+    # direct async dispatch (no socket hop for the in-process head).
+    cw = CoreWorker(
+        config=config,
+        loop_thread=node.loop_thread,
+        head=None,
+        job_id=JobID.from_int(0),
+        worker_id=worker_id,
+        mode="driver",
+    )
+    peer = LocalPeer()
+
+    async def notify_handler(method, payload):
+        if method == "pubsub":
+            await cw.h_pubsub(peer, payload)
+
+    peer._notify_handler = notify_handler
+    cw.head = HeadClient(local_service=node.service, local_peer=peer)
+    cw.namespace = namespace
+
+    async def boot():
+        await cw.start_server()
+        reply = await cw.head.call("register_driver", {
+            "host": cw.host, "port": cw.port, "worker_id": worker_id.hex(),
+        })
+        return reply
+
+    reply = node.loop_thread.run(boot())
+    cw.job_id = JobID.from_hex(reply["job_id"])
+    # Rebuild the root task id under the real job id.
+    from ray_tpu.core.ids import TaskID
+
+    cw._root_task_id = TaskID.for_normal_task(cw.job_id)
+    object_ref_mod.set_core_worker(cw)
+    return cw
+
+
+def shutdown():
+    global _global_node, _global_worker
+    with _init_lock:
+        cw = object_ref_mod.get_core_worker()
+        if cw is not None and _global_node is not None:
+            try:
+                _global_node.loop_thread.run(cw.stop(), timeout=5)
+            except Exception:
+                pass
+        if _global_node is not None:
+            _global_node.shutdown()
+        object_ref_mod.set_core_worker(None)
+        _global_node = None
+        _global_worker = None
+
+
+def put(value: Any) -> ObjectRef:
+    if isinstance(value, ObjectRef):
+        raise TypeError("put() of an ObjectRef is not allowed")
+    return _require_worker().put(value)
+
+
+def get(refs: Union[ObjectRef, Sequence[ObjectRef]],
+        timeout: Optional[float] = None):
+    cw = _require_worker()
+    single = isinstance(refs, ObjectRef)
+    ref_list = [refs] if single else list(refs)
+    for r in ref_list:
+        if not isinstance(r, ObjectRef):
+            raise TypeError(f"get() expects ObjectRef, got {type(r)}")
+    values = cw.get(ref_list, timeout)
+    return values[0] if single else values
+
+
+def wait(refs: Sequence[ObjectRef], *, num_returns: int = 1,
+         timeout: Optional[float] = None, fetch_local: bool = True):
+    cw = _require_worker()
+    if num_returns > len(refs):
+        raise ValueError("num_returns exceeds number of refs")
+    return cw.wait(list(refs), num_returns, timeout, fetch_local)
+
+
+def kill(actor: "ActorHandle", *, no_restart: bool = True):
+    _require_worker().kill_actor(actor._actor_id, no_restart)
+
+
+def cancel(ref: ObjectRef, *, force: bool = False):
+    _require_worker().cancel_task(ref, force)
+
+
+def actor_exit():
+    """Gracefully exit the current actor (reference: ray.actor.exit_actor)."""
+    raise exc.ActorExitSignal()
+
+
+# ---------------------------------------------------------------------------
+# options handling
+# ---------------------------------------------------------------------------
+
+_TASK_DEFAULTS = dict(
+    num_cpus=1.0, num_tpus=0.0, resources=None, num_returns=1,
+    max_retries=3, retry_exceptions=False, name="",
+    scheduling_strategy=None, runtime_env=None, memory=None,
+)
+
+_ACTOR_DEFAULTS = dict(
+    num_cpus=0.0, num_tpus=0.0, resources=None, max_restarts=0,
+    max_task_retries=0, max_concurrency=None, name="", namespace="",
+    lifetime=None, scheduling_strategy=None, runtime_env=None,
+    get_if_exists=False, memory=None,
+)
+
+
+def _build_resources(opts: dict) -> Dict[str, float]:
+    out: Dict[str, float] = {}
+    if opts.get("num_cpus"):
+        out["CPU"] = float(opts["num_cpus"])
+    if opts.get("num_tpus"):
+        from ray_tpu.core.accelerators import TPUAcceleratorManager
+
+        TPUAcceleratorManager.validate_chip_request(opts["num_tpus"])
+        out["TPU"] = float(opts["num_tpus"])
+    if opts.get("memory"):
+        out["memory"] = float(opts["memory"])
+    if opts.get("resources"):
+        out.update({k: float(v) for k, v in opts["resources"].items()})
+    return out
+
+
+def _build_strategy(opts: dict):
+    strategy = opts.get("scheduling_strategy")
+    if strategy is None or strategy == "DEFAULT":
+        return DefaultSchedulingStrategy()
+    if strategy == "SPREAD":
+        return SpreadSchedulingStrategy()
+    if isinstance(strategy, (DefaultSchedulingStrategy,
+                             SpreadSchedulingStrategy,
+                             NodeAffinitySchedulingStrategy,
+                             PlacementGroupSchedulingStrategy)):
+        return strategy
+    raise ValueError(f"unknown scheduling strategy: {strategy!r}")
+
+
+# ---------------------------------------------------------------------------
+# remote functions
+# ---------------------------------------------------------------------------
+
+
+class RemoteFunction:
+    def __init__(self, fn, options: Optional[dict] = None):
+        if inspect.iscoroutinefunction(fn):
+            raise TypeError(
+                "async functions can't be remote tasks; use an async actor"
+            )
+        self._fn = fn
+        self._options = dict(_TASK_DEFAULTS)
+        self._options.update(options or {})
+        functools.update_wrapper(self, fn)
+
+    def options(self, **opts) -> "RemoteFunction":
+        merged = dict(self._options)
+        merged.update(opts)
+        return RemoteFunction(self._fn, merged)
+
+    def remote(self, *args, **kwargs) -> Union[ObjectRef, List[ObjectRef]]:
+        cw = _require_worker()
+        opts = self._options
+        function_key = cw.export_function(self._fn)
+        task_args = cw.serialize_args(args, kwargs)
+        refs = cw.submit_task(
+            function_key,
+            task_args,
+            name=opts["name"] or getattr(self._fn, "__name__", "task"),
+            num_returns=opts["num_returns"],
+            resources=_build_resources(opts),
+            max_retries=opts["max_retries"],
+            retry_exceptions=opts["retry_exceptions"],
+            scheduling_strategy=_build_strategy(opts),
+            runtime_env=opts["runtime_env"],
+        )
+        n = opts["num_returns"]
+        if n == 0:
+            return None
+        if n == 1:
+            return refs[0]
+        return refs
+
+    def __call__(self, *args, **kwargs):
+        raise TypeError(
+            f"Remote function '{getattr(self._fn, '__name__', '?')}' cannot "
+            "be called directly; use .remote()"
+        )
+
+
+# ---------------------------------------------------------------------------
+# actors
+# ---------------------------------------------------------------------------
+
+
+class ActorMethod:
+    def __init__(self, handle: "ActorHandle", method_name: str,
+                 num_returns: int = 1):
+        self._handle = handle
+        self._method_name = method_name
+        self._num_returns = num_returns
+
+    def options(self, num_returns: int = 1, **_ignored) -> "ActorMethod":
+        return ActorMethod(self._handle, self._method_name, num_returns)
+
+    def remote(self, *args, **kwargs):
+        cw = _require_worker()
+        task_args = cw.serialize_args(args, kwargs)
+        refs = cw.submit_actor_task(
+            self._handle._actor_id,
+            self._method_name,
+            task_args,
+            num_returns=self._num_returns,
+        )
+        if self._num_returns == 0:
+            return None
+        if self._num_returns == 1:
+            return refs[0]
+        return refs
+
+    def __call__(self, *args, **kwargs):
+        raise TypeError(
+            f"Actor method '{self._method_name}' cannot be called directly; "
+            "use .remote()"
+        )
+
+
+class ActorHandle:
+    def __init__(self, actor_id: ActorID,
+                 method_meta: Optional[Dict[str, int]] = None):
+        self._actor_id = actor_id
+        self._method_meta = method_meta or {}
+
+    def __getattr__(self, name: str) -> ActorMethod:
+        if name.startswith("_"):
+            raise AttributeError(name)
+        return ActorMethod(self, name,
+                           num_returns=self._method_meta.get(name, 1))
+
+    def __reduce__(self):
+        return (_rebuild_actor_handle,
+                (self._actor_id.binary(), self._method_meta))
+
+    def __repr__(self):
+        return f"ActorHandle({self._actor_id.hex()})"
+
+    def __hash__(self):
+        return hash(self._actor_id)
+
+    def __eq__(self, other):
+        return (isinstance(other, ActorHandle)
+                and other._actor_id == self._actor_id)
+
+
+def _rebuild_actor_handle(actor_id_bytes: bytes,
+                          method_meta: Optional[dict] = None) -> ActorHandle:
+    return ActorHandle(ActorID(actor_id_bytes), method_meta)
+
+
+class ActorClass:
+    def __init__(self, cls, options: Optional[dict] = None):
+        self._cls = cls
+        self._options = dict(_ACTOR_DEFAULTS)
+        self._options.update(options or {})
+
+    def options(self, **opts) -> "ActorClass":
+        merged = dict(self._options)
+        merged.update(opts)
+        return ActorClass(self._cls, merged)
+
+    def remote(self, *args, **kwargs) -> ActorHandle:
+        cw = _require_worker()
+        opts = self._options
+        if opts.get("get_if_exists") and opts.get("name"):
+            try:
+                return get_actor(opts["name"],
+                                 opts.get("namespace", "") or
+                                 getattr(cw, "namespace", ""))
+            except ValueError:
+                pass
+        is_async = _class_is_async(self._cls)
+        max_concurrency = opts.get("max_concurrency")
+        if max_concurrency is None:
+            max_concurrency = 1000 if is_async else 1
+        class_key = cw.export_function(self._cls)
+        task_args = cw.serialize_args(args, kwargs)
+        actor_id = cw.create_actor(
+            class_key,
+            task_args,
+            name=f"{self._cls.__name__}.__init__",
+            actor_name=opts.get("name", ""),
+            namespace=opts.get("namespace", "") or getattr(cw, "namespace", ""),
+            resources=_build_resources(opts),
+            max_restarts=opts["max_restarts"],
+            max_task_retries=opts["max_task_retries"],
+            max_concurrency=max_concurrency,
+            is_async=is_async,
+            scheduling_strategy=_build_strategy(opts),
+            runtime_env=opts["runtime_env"],
+            detached=(opts.get("lifetime") == "detached"),
+        )
+        # Honor @method(num_returns=N) declarations on the class.
+        method_meta = {
+            name: getattr(member, "__ray_tpu_num_returns__")
+            for name, member in inspect.getmembers(self._cls)
+            if hasattr(member, "__ray_tpu_num_returns__")
+        }
+        return ActorHandle(actor_id, method_meta)
+
+    def __call__(self, *args, **kwargs):
+        raise TypeError(
+            f"Actor class '{self._cls.__name__}' cannot be instantiated "
+            "directly; use .remote()"
+        )
+
+
+def _class_is_async(cls) -> bool:
+    for name, member in inspect.getmembers(cls):
+        if name.startswith("__"):
+            continue
+        if inspect.iscoroutinefunction(member):
+            return True
+    return False
+
+
+def remote(*args, **options):
+    """``@remote`` / ``@remote(**options)`` for functions and classes."""
+    if len(args) == 1 and not options and callable(args[0]):
+        target = args[0]
+        if inspect.isclass(target):
+            return ActorClass(target)
+        return RemoteFunction(target)
+    if args:
+        raise TypeError("remote() takes keyword options only")
+
+    def decorator(target):
+        if inspect.isclass(target):
+            return ActorClass(target, options)
+        return RemoteFunction(target, options)
+
+    return decorator
+
+
+def method(num_returns: int = 1):
+    """Decorator recording per-method defaults (subset of the reference's
+    @ray.method)."""
+
+    def decorator(fn):
+        fn.__ray_tpu_num_returns__ = num_returns
+        return fn
+
+    return decorator
+
+
+def get_actor(name: str, namespace: str = "") -> ActorHandle:
+    cw = _require_worker()
+    reply = cw.loop_thread.run(cw.head.call("get_named_actor", {
+        "name": name,
+        "namespace": namespace or getattr(cw, "namespace", ""),
+    }))
+    if not reply.get("found"):
+        raise ValueError(f"named actor {name!r} not found")
+    actor_id = ActorID.from_hex(reply["actor_id"])
+    cw._on_actor_state_threadsafe(reply)
+    return ActorHandle(actor_id)
+
+
+# ---------------------------------------------------------------------------
+# cluster introspection
+# ---------------------------------------------------------------------------
+
+
+def nodes() -> List[dict]:
+    cw = _require_worker()
+    return cw.loop_thread.run(cw.head.call("get_nodes", {}))
+
+
+def cluster_resources() -> Dict[str, float]:
+    cw = _require_worker()
+    return cw.loop_thread.run(cw.head.call("cluster_resources", {}))
+
+
+def available_resources() -> Dict[str, float]:
+    cw = _require_worker()
+    return cw.loop_thread.run(cw.head.call("available_resources", {}))
+
+
+class RuntimeContext:
+    def __init__(self, cw: CoreWorker):
+        self._cw = cw
+
+    @property
+    def job_id(self) -> str:
+        return self._cw.job_id.hex()
+
+    @property
+    def worker_id(self) -> str:
+        return self._cw.worker_id.hex()
+
+    @property
+    def current_task_id(self) -> str:
+        return self._cw.current_task_id().hex()
+
+    def get_actor_id(self) -> Optional[str]:
+        ex = getattr(self._cw, "executor", None)
+        if ex is not None and ex.actor_spec is not None:
+            return ex.actor_spec.actor_id.hex()
+        return None
+
+    @property
+    def namespace(self) -> str:
+        return getattr(self._cw, "namespace", "")
+
+
+def get_runtime_context() -> RuntimeContext:
+    return RuntimeContext(_require_worker())
+
+
+# ---------------------------------------------------------------------------
+# placement groups
+# ---------------------------------------------------------------------------
+
+
+class PlacementGroup:
+    def __init__(self, pg_id_hex: str):
+        self.id_hex = pg_id_hex
+
+    def ready(self, timeout: Optional[float] = None) -> bool:
+        cw = _require_worker()
+        reply = cw.loop_thread.run(cw.head.call(
+            "pg_ready", {"pg_id": self.id_hex, "timeout": timeout}
+        ))
+        return reply.get("ready", False)
+
+    def wait(self, timeout: Optional[float] = None) -> bool:
+        return self.ready(timeout)
+
+    @property
+    def bundle_specs(self) -> List[dict]:
+        cw = _require_worker()
+        reply = cw.loop_thread.run(cw.head.call("get_pg",
+                                                {"pg_id": self.id_hex}))
+        return [b["resources"] for b in reply.get("bundles", [])]
+
+    def __reduce__(self):
+        return (PlacementGroup, (self.id_hex,))
+
+
+def placement_group(bundles: List[Dict[str, float]], strategy: str = "PACK",
+                    name: str = "") -> PlacementGroup:
+    cw = _require_worker()
+    reply = cw.loop_thread.run(cw.head.call("create_pg", {
+        "bundles": bundles, "strategy": strategy, "name": name,
+    }))
+    return PlacementGroup(reply["pg_id"])
+
+
+def remove_placement_group(pg: PlacementGroup):
+    cw = _require_worker()
+    cw.loop_thread.run(cw.head.call("remove_pg", {"pg_id": pg.id_hex}))

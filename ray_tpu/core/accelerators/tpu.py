@@ -1,0 +1,105 @@
+"""TPU detection and node resource shaping.
+
+Behavioral equivalent of the reference's TPUAcceleratorManager
+(reference: python/ray/_private/accelerators/tpu.py:75): detect chips on the
+host, expose the ``TPU`` resource, and — when the host is part of a pod
+slice — add the synthetic gang resource ``TPU-<topology>-head`` on worker 0
+of the slice so slice-wide workloads can anchor one gang per slice
+(reference: tpu.py:335,382).
+
+Detection order: JAX runtime (authoritative when importable), then GCE/GKE
+environment variables (reference: tpu.py:52,101), then nothing.
+"""
+
+from __future__ import annotations
+
+import logging
+import os
+from typing import Dict, Optional
+
+logger = logging.getLogger(__name__)
+
+# Chip counts that can be claimed by a single task (reference: tpu.py:13,144
+# — single-host TPU VMs expose 1, 2, 4, or 8 chips).
+VALID_CHIP_COUNTS = (1, 2, 4, 8)
+
+
+class TPUAcceleratorManager:
+    resource_name = "TPU"
+
+    @staticmethod
+    def detect_num_chips() -> int:
+        # Prefer the live JAX runtime.
+        try:
+            import jax
+
+            devices = jax.local_devices()
+            n = sum(1 for d in devices if d.platform != "cpu")
+            if n > 0:
+                return n
+        except Exception:
+            pass
+        # GCE metadata env (set on TPU VMs).
+        chips = os.environ.get("TPU_CHIPS_PER_HOST_BOUNDS")
+        if chips:
+            try:
+                dims = [int(x) for x in chips.split(",")]
+                n = 1
+                for d in dims:
+                    n *= d
+                return n
+            except ValueError:
+                pass
+        visible = os.environ.get("TPU_VISIBLE_CHIPS")
+        if visible:
+            return len([c for c in visible.split(",") if c.strip()])
+        return 0
+
+    @staticmethod
+    def detect_pod_type() -> Optional[str]:
+        """E.g. 'v5litepod-64' when this host is part of a pod slice."""
+        accel_type = os.environ.get("TPU_ACCELERATOR_TYPE")
+        if accel_type:
+            return accel_type
+        return None
+
+    @staticmethod
+    def detect_worker_id() -> int:
+        for var in ("TPU_WORKER_ID", "CLOUD_TPU_TASK_ID"):
+            raw = os.environ.get(var)
+            if raw is not None:
+                try:
+                    return int(raw)
+                except ValueError:
+                    pass
+        return 0
+
+    @classmethod
+    def node_resources(cls) -> Dict[str, float]:
+        """Resources this host contributes to the cluster."""
+        out: Dict[str, float] = {}
+        num_chips = cls.detect_num_chips()
+        if num_chips <= 0:
+            return out
+        out[cls.resource_name] = float(num_chips)
+        pod_type = cls.detect_pod_type()
+        if pod_type and cls.detect_worker_id() == 0:
+            # Gang anchor: exactly one per slice, on worker 0
+            # (reference: tpu.py get_current_node_additional_resources :335).
+            out[f"TPU-{pod_type}-head"] = 1.0
+        return out
+
+    @staticmethod
+    def validate_chip_request(num_chips: float) -> None:
+        if num_chips != int(num_chips) or int(num_chips) not in VALID_CHIP_COUNTS:
+            raise ValueError(
+                f"TPU requests must be one of {VALID_CHIP_COUNTS} chips, "
+                f"got {num_chips} (use a placement group for multi-host "
+                "slices)"
+            )
+
+    @staticmethod
+    def set_visible_chips_env(chip_ids) -> None:
+        """Per-worker chip isolation (reference: tpu.py:158-192
+        TPU_VISIBLE_CHIPS)."""
+        os.environ["TPU_VISIBLE_CHIPS"] = ",".join(str(c) for c in chip_ids)

@@ -1,0 +1,113 @@
+"""Runtime configuration flags.
+
+Equivalent of the reference's RAY_CONFIG X-macro table
+(reference: src/ray/common/ray_config_def.h — 219 entries; ray_config.h:60):
+every flag has a typed default, can be overridden per-process with a
+``RAY_TPU_<NAME>`` environment variable, and can be overridden at init time
+with a ``system_config`` dict passed to ``ray_tpu.init``.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+from dataclasses import dataclass, fields
+
+
+def _env_override(name: str, default):
+    raw = os.environ.get(f"RAY_TPU_{name.upper()}")
+    if raw is None:
+        return default
+    ty = type(default)
+    if ty is bool:
+        return raw.lower() in ("1", "true", "yes")
+    if ty in (int, float):
+        return ty(raw)
+    if ty in (dict, list):
+        return json.loads(raw)
+    return raw
+
+
+@dataclass
+class Config:
+    # --- object store ---
+    # Objects larger than this are stored in the node-wide shared-memory
+    # store instead of the owner's in-process store (reference:
+    # memory_store promotion threshold).
+    max_direct_call_object_size: int = 100 * 1024
+    # Shared-memory store capacity (bytes). 0 = auto (30% of system memory,
+    # mirroring the reference's default_object_store_memory_proportion).
+    object_store_memory: int = 0
+    object_store_memory_proportion: float = 0.3
+    # Directory for shared-memory segments and spill files.
+    object_spilling_dir: str = ""
+    # Spill to disk when the shm store exceeds this fraction of capacity.
+    object_spilling_threshold: float = 0.8
+
+    # --- scheduler ---
+    # Max worker leases requested in parallel per scheduling key
+    # (reference: direct_task_transport.h:63 LeaseRequestRateLimiter).
+    max_pending_lease_requests_per_scheduling_category: int = 10
+    # Seconds an idle leased worker is kept before the lease is returned.
+    idle_worker_lease_timeout_s: float = 0.25
+    # Hybrid scheduling policy threshold (reference:
+    # hybrid_scheduling_policy.cc spread_threshold).
+    scheduler_spread_threshold: float = 0.5
+    # Number of idle workers to keep prestarted per node.
+    num_prestart_workers: int = 2
+    # Max workers per node (0 = num_cpus).
+    max_workers_per_node: int = 0
+
+    # --- health / failure detection ---
+    health_check_period_s: float = 1.0
+    health_check_timeout_s: float = 5.0
+    health_check_failure_threshold: int = 5
+
+    # --- tasks ---
+    task_default_max_retries: int = 3
+    actor_default_max_restarts: int = 0
+    # Max lineage entries retained per owner for object reconstruction
+    # (reference: task_manager.h:202 max_lineage_bytes).
+    max_lineage_entries: int = 10_000
+
+    # --- rpc ---
+    rpc_connect_timeout_s: float = 10.0
+    rpc_max_message_size: int = 512 * 1024 * 1024
+    # Long-poll pubsub batch window.
+    pubsub_poll_timeout_s: float = 30.0
+
+    # --- metrics ---
+    metrics_report_interval_s: float = 5.0
+    # Task-event buffer flush (reference: task_event_buffer.h).
+    task_events_report_interval_s: float = 1.0
+    task_events_max_buffer_size: int = 10_000
+
+    # --- logging ---
+    log_dir: str = ""
+
+    def __post_init__(self):
+        for f in fields(self):
+            setattr(self, f.name, _env_override(f.name, getattr(self, f.name)))
+
+    def apply_system_config(self, system_config: dict | None):
+        if not system_config:
+            return
+        for key, value in system_config.items():
+            if not hasattr(self, key):
+                raise ValueError(f"Unknown system config key: {key}")
+            setattr(self, key, value)
+
+
+_global_config: Config | None = None
+
+
+def get_config() -> Config:
+    global _global_config
+    if _global_config is None:
+        _global_config = Config()
+    return _global_config
+
+
+def reset_config():
+    global _global_config
+    _global_config = None

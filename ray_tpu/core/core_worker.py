@@ -1,0 +1,1158 @@
+"""CoreWorker — the per-process runtime embedded in every driver and worker.
+
+Reference: src/ray/core_worker/core_worker.h:290. Responsibilities:
+
+- task submission with lease-based scheduling (reference:
+  transport/direct_task_transport.h:75 — queue per SchedulingKey, lease a
+  worker from the head, pipeline pushes onto leased workers, return the
+  lease after an idle timeout)
+- actor task submission with per-actor ordered queues and state machine
+  (reference: transport/direct_actor_task_submitter.h:74)
+- ownership: every created object is owned by this worker; the in-process
+  memory store serves small objects to borrowers; large objects live in the
+  node's shared-memory store (reference: reference_count.h, memory_store.h)
+- task manager with retries and error-object fallout (reference:
+  task_manager.h)
+- get/put/wait and the object-resolution protocol.
+
+The public API module (`ray_tpu/api.py`) is a thin veneer over this class.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import logging
+import threading
+import time
+from collections import deque
+from dataclasses import dataclass, field
+from typing import Any, Callable, Dict, List, Optional, Tuple
+
+from ray_tpu import exceptions as exc
+from ray_tpu.core import object_store, rpc, serialization
+from ray_tpu.core.config import Config
+from ray_tpu.core.ids import (
+    ActorID,
+    IndexCounter,
+    JobID,
+    ObjectID,
+    TaskID,
+    WorkerID,
+)
+from ray_tpu.core.object_ref import ObjectRef
+from ray_tpu.core.object_store import MemoryStore, ShmStore
+from ray_tpu.core.serialization import SerializedObject
+from ray_tpu.core.task_spec import Address, TaskArg, TaskSpec, TaskType
+
+logger = logging.getLogger(__name__)
+
+IN_PLASMA = b"P"  # metadata marker: value lives in the shm store
+
+
+def make_plasma_marker() -> SerializedObject:
+    return SerializedObject(metadata=IN_PLASMA, inband=b"", buffers=[])
+
+
+class HeadClient:
+    """Async client to the head service; remote (socket) or local."""
+
+    def __init__(self, conn: Optional[rpc.Connection] = None,
+                 local_service=None, local_peer=None):
+        self._conn = conn
+        self._local = local_service
+        self._local_peer = local_peer
+        if (conn is None) == (local_service is None):
+            raise ValueError("exactly one of conn/local_service required")
+        if local_service is not None:
+            self._handlers = local_service.handlers()
+
+    async def call(self, method: str, payload=None, timeout=None):
+        if self._conn is not None:
+            return await self._conn.call(method, payload, timeout=timeout)
+        handler = self._handlers[method]
+        if timeout is not None:
+            return await asyncio.wait_for(
+                handler(self._local_peer, payload), timeout
+            )
+        return await handler(self._local_peer, payload)
+
+    @property
+    def closed(self):
+        return self._conn.closed if self._conn is not None else False
+
+
+class ReferenceCounter:
+    """Tracks local and borrowed references (reference: reference_count.h).
+
+    Owned objects are freed when local refs and known borrows reach zero.
+    Borrowed refs notify the owner on destruction. Borrow accounting is
+    conservative: a ref serialized into a task's args counts as a borrow
+    until the consumer's interpreter drops it.
+    """
+
+    def __init__(self, core_worker: "CoreWorker"):
+        self.cw = core_worker
+        self._lock = threading.Lock()
+        # object hex -> {"local": n, "borrows": n, "owned": bool, "shm": bool}
+        self._refs: Dict[str, dict] = {}
+        self._disabled = False
+
+    def disable(self):
+        self._disabled = True
+
+    def _entry(self, hex_id: str) -> dict:
+        return self._refs.setdefault(
+            hex_id, {"local": 0, "borrows": 0, "owned": False, "shm": False}
+        )
+
+    def register_owned(self, object_id: ObjectID, in_shm: bool):
+        if self._disabled:
+            return
+        with self._lock:
+            entry = self._entry(object_id.hex())
+            entry["owned"] = True
+            entry["shm"] = in_shm
+
+    def add_local_ref(self, ref: ObjectRef):
+        if self._disabled:
+            return
+        with self._lock:
+            self._entry(ref.hex())["local"] += 1
+
+    def remove_local_ref(self, ref: ObjectRef):
+        if self._disabled:
+            return
+        to_free = None
+        notify_owner = None
+        with self._lock:
+            entry = self._refs.get(ref.hex())
+            if entry is None:
+                return
+            entry["local"] -= 1
+            if entry["local"] <= 0 and entry["borrows"] <= 0:
+                if entry["owned"]:
+                    to_free = (ref.id, entry["shm"])
+                elif ref.owner_address is not None:
+                    notify_owner = ref.owner_address
+                self._refs.pop(ref.hex(), None)
+        if to_free is not None:
+            self.cw._free_owned_object(to_free[0], to_free[1])
+        elif notify_owner is not None:
+            self.cw._notify_owner_ref_removed(ref.id, notify_owner)
+
+    def on_ref_serialized(self, ref: ObjectRef):
+        """The serializer registers the borrow (+1 on the owner); the
+        eventual consumer's ref destruction sends the matching -1
+        (remove_ref). This keeps increments and decrements one-to-one."""
+        if self._disabled:
+            return
+        notify_owner = None
+        with self._lock:
+            entry = self._refs.get(ref.hex())
+            if entry is not None and entry["owned"]:
+                entry["borrows"] += 1
+            elif ref.owner_address is not None:
+                notify_owner = ref.owner_address
+        if notify_owner is not None:
+            self.cw._notify_owner_add_borrow(ref.id, notify_owner)
+
+    def on_ref_deserialized(self, ref: ObjectRef):
+        # Borrow already counted by the serializer; nothing to do beyond
+        # the local-ref tracking done in ObjectRef.__init__.
+        pass
+
+    def on_borrow_added(self, object_id: ObjectID):
+        with self._lock:
+            self._entry(object_id.hex())["borrows"] += 1
+
+    def on_borrow_removed(self, object_id: ObjectID):
+        to_free = None
+        with self._lock:
+            entry = self._refs.get(object_id.hex())
+            if entry is None:
+                return
+            entry["borrows"] -= 1
+            if entry["local"] <= 0 and entry["borrows"] <= 0 and entry["owned"]:
+                to_free = (object_id, entry["shm"])
+                self._refs.pop(object_id.hex(), None)
+        if to_free is not None:
+            self.cw._free_owned_object(to_free[0], to_free[1])
+
+    def num_tracked(self) -> int:
+        with self._lock:
+            return len(self._refs)
+
+
+@dataclass
+class PendingTask:
+    spec: TaskSpec
+    retries_left: int
+    pushed_to: Optional[WorkerID] = None
+    cancelled: bool = False
+
+
+@dataclass
+class LeasedWorker:
+    worker_id: WorkerID
+    address: Tuple[str, int]
+    lease_id: str
+    conn: rpc.Connection
+    busy: int = 0  # in-flight pushed tasks
+    idle_since: float = 0.0
+
+
+@dataclass
+class SchedulingKeyState:
+    queue: deque = field(default_factory=deque)  # of TaskSpec
+    workers: Dict[WorkerID, LeasedWorker] = field(default_factory=dict)
+    inflight_lease_requests: int = 0
+
+
+@dataclass
+class ActorState:
+    actor_id: ActorID
+    state: str = "PENDING"  # PENDING | ALIVE | RESTARTING | DEAD
+    address: Optional[Address] = None
+    conn: Optional[rpc.Connection] = None
+    queue: deque = field(default_factory=deque)  # buffered specs pre-ALIVE
+    seqno: int = 0
+    inflight: int = 0
+    death_cause: str = ""
+    max_task_retries: int = 0
+
+
+class CoreWorker:
+    def __init__(self, config: Config, loop_thread: rpc.EventLoopThread,
+                 head: HeadClient, job_id: JobID, worker_id: WorkerID,
+                 mode: str, host: str = "127.0.0.1"):
+        self.config = config
+        self.loop_thread = loop_thread
+        self.loop = loop_thread.loop
+        self.head = head
+        self.job_id = job_id
+        self.worker_id = worker_id
+        self.mode = mode  # "driver" | "worker"
+        self.host = host
+        self.port: Optional[int] = None
+        self.address: Optional[Address] = None
+
+        self.memory_store = MemoryStore()
+        self.reference_counter = ReferenceCounter(self)
+        self._task_counter = IndexCounter()
+        self._put_counter = IndexCounter()
+        # The "current task" driving put/return ids. For drivers this is a
+        # synthetic root task per process.
+        self._root_task_id = TaskID.for_normal_task(job_id)
+        self._current_task_id = threading.local()
+
+        self.pending_tasks: Dict[TaskID, PendingTask] = {}
+        self.scheduling_keys: Dict[tuple, SchedulingKeyState] = {}
+        self.actors: Dict[ActorID, ActorState] = {}
+        self._conn_cache: Dict[Tuple[str, int], rpc.Connection] = {}
+        self._conn_cache_lock = asyncio.Lock()
+        self._function_cache: Dict[str, Any] = {}
+        self._exported_functions: Dict[int, str] = {}
+        self._actor_sub_started = False
+        self._shutdown = False
+        self.server: Optional[rpc.Server] = None
+        self._finished_task_ids: set = set()
+        self._pubsub_callbacks: Dict[str, List[Callable]] = {}
+        self._loop_thread_ident: Optional[int] = None
+        try:
+            self.loop.call_soon_threadsafe(
+                lambda: setattr(self, "_loop_thread_ident",
+                                threading.get_ident())
+            )
+        except Exception:
+            pass
+        # Set by worker_main for executor duties.
+        self.executor = None
+
+    # ------------------------------------------------------------------
+    # bootstrap
+    # ------------------------------------------------------------------
+
+    def handlers(self) -> dict:
+        return {
+            "get_object": self.h_get_object,
+            "add_borrow": self.h_add_borrow,
+            "remove_ref": self.h_remove_ref,
+            "pubsub": self.h_pubsub,
+            "ping": self.h_ping,
+        }
+
+    async def start_server(self, extra_handlers: Optional[dict] = None) -> int:
+        handlers = self.handlers()
+        if extra_handlers:
+            handlers.update(extra_handlers)
+        self.server = rpc.Server(handlers, name=f"cw-{self.worker_id.hex()[:8]}")
+        self.port = await self.server.start(self.host, 0)
+        self.address = Address(self.host, self.port, self.worker_id.hex())
+        return self.port
+
+    def current_task_id(self) -> TaskID:
+        return getattr(self._current_task_id, "value", self._root_task_id)
+
+    def set_current_task_id(self, task_id: Optional[TaskID]):
+        self._current_task_id.value = task_id or self._root_task_id
+
+    # ------------------------------------------------------------------
+    # connections
+    # ------------------------------------------------------------------
+
+    async def get_connection(self, address: Tuple[str, int]) -> rpc.Connection:
+        conn = self._conn_cache.get(address)
+        if conn is not None and not conn.closed:
+            return conn
+        async with self._conn_cache_lock:
+            conn = self._conn_cache.get(address)
+            if conn is not None and not conn.closed:
+                return conn
+            conn = await rpc.connect(
+                address[0], address[1], self.handlers(),
+                name=f"peer-{address[1]}",
+                timeout=self.config.rpc_connect_timeout_s,
+            )
+            self._conn_cache[address] = conn
+            return conn
+
+    # ------------------------------------------------------------------
+    # put / get / wait / free
+    # ------------------------------------------------------------------
+
+    def put(self, value: Any) -> ObjectRef:
+        object_id = ObjectID.for_put(self.current_task_id(),
+                                     self._put_counter.next())
+        obj = serialization.serialize(value)
+        self.put_serialized(object_id, obj)
+        return ObjectRef(object_id, self.address, is_owned=True)
+
+    def put_serialized(self, object_id: ObjectID, obj: SerializedObject):
+        in_shm = obj.total_size() > self.config.max_direct_call_object_size
+        if in_shm:
+            size = self._seal_to_shm(object_id, obj)
+            self.memory_store.put(object_id, make_plasma_marker())
+            self.loop_thread.submit(
+                self.head.call("object_sealed",
+                               {"object_id": object_id.hex(), "size": size})
+            )
+        else:
+            self.memory_store.put(object_id, obj)
+        self.reference_counter.register_owned(object_id, in_shm)
+
+    def _seal_to_shm(self, object_id: ObjectID, obj: SerializedObject) -> int:
+        from multiprocessing import shared_memory
+
+        data = ShmStore.pack(obj)
+        try:
+            seg = shared_memory.SharedMemory(
+                name=object_store.segment_name(object_id), create=True,
+                size=max(len(data), 1),
+            )
+        except FileExistsError:
+            return len(data)
+        try:
+            seg.buf[: len(data)] = data
+        finally:
+            seg.close()
+        return len(data)
+
+    def _check_not_on_loop(self, api: str):
+        if threading.get_ident() == getattr(self, "_loop_thread_ident", None):
+            raise RuntimeError(
+                f"{api} would block the event loop (called from an async "
+                f"actor method?). Use `await ref` / the async API instead."
+            )
+
+    def get(self, refs: List[ObjectRef], timeout: Optional[float] = None
+            ) -> List[Any]:
+        self._check_not_on_loop("get()")
+        fut = self.loop_thread.submit(self._get_all_async(refs, timeout))
+        return fut.result()
+
+    async def _get_all_async(self, refs: List[ObjectRef],
+                             timeout: Optional[float]) -> List[Any]:
+        return await asyncio.gather(
+            *(self.get_async(ref, timeout) for ref in refs)
+        )
+
+    async def get_async(self, ref: ObjectRef, timeout: Optional[float] = None):
+        obj = await self._resolve_object(ref, timeout)
+        return serialization.deserialize(obj.metadata, obj.inband, obj.buffers)
+
+    async def _resolve_object(self, ref: ObjectRef,
+                              timeout: Optional[float] = None
+                              ) -> SerializedObject:
+        object_id = ref.id
+        obj = self.memory_store.get_if_exists(object_id)
+        if obj is None:
+            if self._owns(object_id):
+                obj = await self._wait_local(object_id, timeout)
+            else:
+                obj = await self._fetch_from_owner(ref, timeout)
+        if obj.metadata == IN_PLASMA:
+            return await self._open_shm(object_id, timeout)
+        return obj
+
+    def _owns(self, object_id: ObjectID) -> bool:
+        task_id = object_id.task_id()
+        if task_id in self.pending_tasks:
+            return True
+        if task_id == self._root_task_id:
+            return True  # driver-side puts
+        return task_id in self._finished_task_ids
+
+    def _ensure_sets(self):
+        pass  # retained for call-site compatibility
+
+    async def _wait_local(self, object_id: ObjectID,
+                          timeout: Optional[float]) -> SerializedObject:
+        fut = self.loop.create_future()
+
+        def cb(obj):
+            self.loop.call_soon_threadsafe(
+                lambda: fut.set_result(obj) if not fut.done() else None
+            )
+
+        self.memory_store.add_waiter(object_id, cb)
+        try:
+            return await asyncio.wait_for(fut, timeout)
+        except asyncio.TimeoutError:
+            raise exc.GetTimeoutError(
+                f"get() timed out waiting for {object_id.hex()}"
+            )
+
+    async def _fetch_from_owner(self, ref: ObjectRef,
+                                timeout: Optional[float]) -> SerializedObject:
+        owner = ref.owner_address
+        if owner is None:
+            # No owner info: assume shm (e.g. ref recreated from hex).
+            return make_plasma_marker()
+        try:
+            conn = await self.get_connection(owner.key())
+            reply = await conn.call(
+                "get_object", {"object_id": ref.hex(), "timeout": timeout},
+                timeout=timeout,
+            )
+        except (rpc.ConnectionLost, rpc.RpcError, OSError) as e:
+            raise exc.ObjectLostError(ref.hex()) from e
+        if reply.get("in_plasma"):
+            return make_plasma_marker()
+        if not reply.get("found"):
+            raise exc.GetTimeoutError(
+                f"object {ref.hex()} not available from owner"
+            )
+        obj = SerializedObject(
+            metadata=reply["metadata"], inband=reply["inband"],
+            buffers=list(reply.get("buffers", [])),
+        )
+        # Cache small borrowed values locally.
+        self.memory_store.put(ref.id, obj)
+        return obj
+
+    async def _open_shm(self, object_id: ObjectID,
+                        timeout: Optional[float]) -> SerializedObject:
+        obj = ShmStore.open_object(object_id)
+        if obj is not None:
+            return obj
+        reply = await self.head.call(
+            "wait_object", {"object_id": object_id.hex(), "timeout": timeout}
+        )
+        if not reply.get("sealed"):
+            raise exc.GetTimeoutError(
+                f"shm object {object_id.hex()} not sealed in time"
+            )
+        obj = ShmStore.open_object(object_id)
+        if obj is None:
+            raise exc.ObjectLostError(object_id.hex())
+        return obj
+
+    def wait(self, refs: List[ObjectRef], num_returns: int,
+             timeout: Optional[float], fetch_local: bool = True
+             ) -> Tuple[List[ObjectRef], List[ObjectRef]]:
+        self._check_not_on_loop("wait()")
+        fut = self.loop_thread.submit(
+            self._wait_async(refs, num_returns, timeout)
+        )
+        return fut.result()
+
+    async def _wait_async(self, refs, num_returns, timeout):
+        ready: List[ObjectRef] = []
+        pending = {
+            asyncio.ensure_future(self._resolve_object(r)): r for r in refs
+        }
+        deadline = None if timeout is None else time.monotonic() + timeout
+        try:
+            while pending and len(ready) < num_returns:
+                remaining = None
+                if deadline is not None:
+                    remaining = max(0.0, deadline - time.monotonic())
+                    if remaining == 0:
+                        break
+                done, _ = await asyncio.wait(
+                    pending.keys(), timeout=remaining,
+                    return_when=asyncio.FIRST_COMPLETED,
+                )
+                if not done:
+                    break
+                for t in done:
+                    ready.append(pending.pop(t))
+        finally:
+            for t in pending:
+                t.cancel()
+        not_ready = [r for r in refs if r not in ready]
+        ready_sorted = [r for r in refs if r in ready][:num_returns]
+        extra = [r for r in ready if r not in ready_sorted]
+        return ready_sorted, not_ready + extra
+
+    def free(self, refs: List[ObjectRef]):
+        hex_ids = [r.hex() for r in refs]
+        for ref in refs:
+            self.memory_store.delete(ref.id)
+        self.loop_thread.submit(
+            self.head.call("free_objects", {"object_ids": hex_ids})
+        )
+
+    def _free_owned_object(self, object_id: ObjectID, in_shm: bool):
+        self.memory_store.delete(object_id)
+        if in_shm and not self._shutdown:
+            try:
+                self.loop_thread.submit(
+                    self.head.call("free_objects",
+                                   {"object_ids": [object_id.hex()]})
+                )
+            except Exception:
+                pass
+
+    def _notify_owner_ref_removed(self, object_id: ObjectID, owner: Address):
+        if self._shutdown:
+            return
+
+        async def go():
+            try:
+                conn = await self.get_connection(owner.key())
+                await conn.notify("remove_ref", {"object_id": object_id.hex()})
+            except Exception:
+                pass
+
+        try:
+            self.loop_thread.submit(go())
+        except Exception:
+            pass
+
+    def _notify_owner_add_borrow(self, object_id: ObjectID, owner: Address):
+        if self._shutdown:
+            return
+
+        async def go():
+            try:
+                conn = await self.get_connection(owner.key())
+                await conn.notify("add_borrow", {"object_id": object_id.hex()})
+            except Exception:
+                pass
+
+        try:
+            self.loop_thread.submit(go())
+        except Exception:
+            pass
+
+    def as_future(self, ref: ObjectRef):
+        import concurrent.futures
+
+        out = concurrent.futures.Future()
+
+        def done_cb(task):
+            if task.cancelled():
+                out.cancel()
+            elif task.exception() is not None:
+                out.set_exception(task.exception())
+            else:
+                out.set_result(task.result())
+
+        def schedule():
+            t = asyncio.ensure_future(self.get_async(ref))
+            t.add_done_callback(done_cb)
+
+        self.loop.call_soon_threadsafe(schedule)
+        return out
+
+    # ------------------------------------------------------------------
+    # serving owned objects
+    # ------------------------------------------------------------------
+
+    async def h_get_object(self, conn, payload):
+        object_id = ObjectID.from_hex(payload["object_id"])
+        obj = self.memory_store.get_if_exists(object_id)
+        if obj is None and self._owns(object_id):
+            try:
+                obj = await self._wait_local(object_id,
+                                             payload.get("timeout") or 30.0)
+            except exc.GetTimeoutError:
+                obj = None
+        if obj is None:
+            return {"found": False}
+        if obj.metadata == IN_PLASMA:
+            return {"found": True, "in_plasma": True}
+        return {
+            "found": True,
+            "metadata": obj.metadata,
+            "inband": obj.inband,
+            "buffers": [bytes(memoryview(b)) for b in obj.buffers],
+        }
+
+    async def h_add_borrow(self, conn, payload):
+        self.reference_counter.on_borrow_added(
+            ObjectID.from_hex(payload["object_id"])
+        )
+        return {"ok": True}
+
+    async def h_remove_ref(self, conn, payload):
+        self.reference_counter.on_borrow_removed(
+            ObjectID.from_hex(payload["object_id"])
+        )
+        return {"ok": True}
+
+    async def h_ping(self, conn, payload):
+        return {"ok": True}
+
+    # ------------------------------------------------------------------
+    # pubsub dispatch
+    # ------------------------------------------------------------------
+
+    async def h_pubsub(self, conn, payload):
+        channel = payload["channel"]
+        data = payload["data"]
+        if channel == "actor_state":
+            self._on_actor_state(data)
+        elif channel in self._pubsub_callbacks:
+            for cb in self._pubsub_callbacks[channel]:
+                try:
+                    cb(data)
+                except Exception:
+                    logger.exception("pubsub callback failed")
+        return {"ok": True}
+
+    _pubsub_callbacks: Dict[str, List[Callable]] = {}
+
+    def subscribe(self, channel: str, callback: Callable):
+        self._pubsub_callbacks.setdefault(channel, []).append(callback)
+        self.loop_thread.submit(self.head.call("subscribe",
+                                               {"channel": channel}))
+
+    # ------------------------------------------------------------------
+    # function table
+    # ------------------------------------------------------------------
+
+    def export_function(self, fn_or_class: Any) -> str:
+        """Non-blocking: the KV put is fired asynchronously so this is safe
+        to call from the event-loop thread itself (async actor methods
+        submitting tasks). fetch_function retries to cover the put racing
+        the first fetch."""
+        cache_key = id(fn_or_class)
+        key = self._exported_functions.get(cache_key)
+        if key is not None:
+            return key
+        blob = serialization.dumps_control(fn_or_class)
+        import hashlib
+
+        digest = hashlib.sha256(blob).hexdigest()[:24]
+        key = f"fn:{self.job_id.hex()}:{digest}"
+        self.loop_thread.submit(
+            self.head.call("kv_put", {
+                "ns": "functions", "key": key.encode(), "value": blob,
+                "overwrite": False,
+            })
+        )
+        self._exported_functions[cache_key] = key
+        self._function_cache[key] = fn_or_class
+        return key
+
+    async def fetch_function(self, key: str, timeout: float = 30.0) -> Any:
+        fn = self._function_cache.get(key)
+        if fn is not None:
+            return fn
+        deadline = time.monotonic() + timeout
+        while True:
+            reply = await self.head.call(
+                "kv_get", {"ns": "functions", "key": key.encode()}
+            )
+            blob = reply.get("value")
+            if blob is not None:
+                break
+            if time.monotonic() > deadline:
+                raise exc.RayTpuError(f"function {key} not found in GCS")
+            await asyncio.sleep(0.05)
+        fn = serialization.loads_control(blob)
+        self._function_cache[key] = fn
+        return fn
+
+    # ------------------------------------------------------------------
+    # task submission (normal tasks)
+    # ------------------------------------------------------------------
+
+    def serialize_args(self, args: tuple, kwargs: dict) -> List[TaskArg]:
+        """Args are packed as a single (args, kwargs) tuple argument when
+        small; ObjectRefs are always passed by reference."""
+        out: List[TaskArg] = []
+        flat: List[Any] = list(args) + [kwargs]
+        for value in flat:
+            if isinstance(value, ObjectRef):
+                # Register the borrow exactly as pickling the ref would;
+                # the executor's reconstructed ref sends the matching
+                # remove_ref when it is dropped.
+                self.reference_counter.on_ref_serialized(value)
+                out.append(TaskArg(object_id=value.id, owner=value.owner_address))
+                continue
+            obj = serialization.serialize(value)
+            if obj.total_size() > self.config.max_direct_call_object_size:
+                object_id = ObjectID.for_put(self.current_task_id(),
+                                             self._put_counter.next())
+                self.put_serialized(object_id, obj)
+                out.append(TaskArg(object_id=object_id, owner=self.address))
+            else:
+                out.append(TaskArg(inline=(
+                    obj.metadata, obj.inband,
+                    [bytes(memoryview(b)) for b in obj.buffers],
+                )))
+        return out
+
+    def submit_task(self, function_key: str, args: List[TaskArg], *,
+                    name: str, num_returns: int, resources: Dict[str, float],
+                    max_retries: int, retry_exceptions: bool,
+                    scheduling_strategy, runtime_env=None) -> List[ObjectRef]:
+        self._ensure_sets()
+        task_id = TaskID.for_normal_task(self.job_id)
+        spec = TaskSpec(
+            task_id=task_id,
+            job_id=self.job_id,
+            task_type=TaskType.NORMAL_TASK,
+            name=name,
+            function_key=function_key,
+            args=args,
+            num_returns=num_returns,
+            resources=resources,
+            owner=self.address,
+            max_retries=max_retries,
+            retry_exceptions=retry_exceptions,
+            scheduling_strategy=scheduling_strategy,
+            runtime_env=runtime_env,
+        )
+        self.pending_tasks[task_id] = PendingTask(
+            spec=spec, retries_left=max_retries
+        )
+        refs = [
+            ObjectRef(oid, self.address, is_owned=True)
+            for oid in spec.return_object_ids()
+        ]
+        self.loop.call_soon_threadsafe(self._submit_on_loop, spec)
+        return refs
+
+    def _submit_on_loop(self, spec: TaskSpec):
+        key = spec.scheduling_key()
+        state = self.scheduling_keys.setdefault(key, SchedulingKeyState())
+        state.queue.append(spec)
+        self._pump_scheduling_key(key, state)
+
+    def _pump_scheduling_key(self, key: tuple, state: SchedulingKeyState):
+        # Push queued tasks onto idle leased workers.
+        for lw in list(state.workers.values()):
+            while state.queue and lw.conn is not None and not lw.conn.closed:
+                if lw.busy >= 1:
+                    break  # one task at a time per worker (matches reference)
+                spec = state.queue.popleft()
+                self._push_task_to_worker(key, state, lw, spec)
+        # Request more leases if there is a backlog.
+        limit = self.config.max_pending_lease_requests_per_scheduling_category
+        backlog = len(state.queue)
+        while backlog > 0 and state.inflight_lease_requests < min(limit, backlog):
+            state.inflight_lease_requests += 1
+            asyncio.ensure_future(self._request_lease(key, state))
+            backlog -= 1
+
+    async def _request_lease(self, key: tuple, state: SchedulingKeyState):
+        try:
+            if not state.queue:
+                return
+            spec = state.queue[0]
+            reply = await self.head.call(
+                "request_lease",
+                {"spec": serialization.dumps_control(spec)},
+            )
+            if not reply.get("granted"):
+                if reply.get("infeasible"):
+                    # Fail every queued task under this key.
+                    while state.queue:
+                        s = state.queue.popleft()
+                        self._store_task_error(
+                            s,
+                            exc.RayTpuError(
+                                reply.get("error", "infeasible resource request")
+                            ),
+                        )
+                return
+            worker_id = WorkerID.from_hex(reply["worker_id"])
+            address = (reply["host"], reply["port"])
+            try:
+                conn = await self.get_connection(address)
+            except Exception:
+                await self.head.call("return_worker", {
+                    "lease_id": reply["lease_id"],
+                    "worker_id": reply["worker_id"],
+                })
+                self._pump_scheduling_key(key, state)
+                return
+            lw = LeasedWorker(
+                worker_id=worker_id, address=address,
+                lease_id=reply["lease_id"], conn=conn,
+                idle_since=time.monotonic(),
+            )
+            state.workers[worker_id] = lw
+            self._pump_scheduling_key(key, state)
+            if lw.busy == 0:
+                asyncio.ensure_future(self._maybe_return_lease(key, state, lw))
+        finally:
+            state.inflight_lease_requests -= 1
+
+    def _push_task_to_worker(self, key: tuple, state: SchedulingKeyState,
+                             lw: LeasedWorker, spec: TaskSpec):
+        pending = self.pending_tasks.get(spec.task_id)
+        if pending is None or pending.cancelled:
+            return
+        pending.pushed_to = lw.worker_id
+        lw.busy += 1
+
+        async def push():
+            try:
+                reply = await lw.conn.call(
+                    "push_task",
+                    {"spec": serialization.dumps_control(spec)},
+                )
+            except (rpc.ConnectionLost, rpc.RpcError, OSError) as e:
+                state.workers.pop(lw.worker_id, None)
+                self._on_task_worker_failure(spec, e)
+                return
+            lw.busy -= 1
+            lw.idle_since = time.monotonic()
+            self._on_task_reply(spec, reply)
+            self._pump_scheduling_key(key, state)
+            if lw.busy == 0 and not state.queue:
+                asyncio.ensure_future(self._maybe_return_lease(key, state, lw))
+
+        asyncio.ensure_future(push())
+
+    async def _maybe_return_lease(self, key: tuple, state: SchedulingKeyState,
+                                  lw: LeasedWorker):
+        await asyncio.sleep(self.config.idle_worker_lease_timeout_s)
+        if lw.busy > 0 or state.queue:
+            return
+        if state.workers.pop(lw.worker_id, None) is None:
+            return
+        try:
+            await self.head.call("return_worker", {
+                "lease_id": lw.lease_id,
+                "worker_id": lw.worker_id.hex(),
+            })
+        except Exception:
+            pass
+
+    def _on_task_reply(self, spec: TaskSpec, reply: dict):
+        pending = self.pending_tasks.pop(spec.task_id, None)
+        self._ensure_sets()
+        self._finished_task_ids.add(spec.task_id)
+        if len(self._finished_task_ids) > self.config.max_lineage_entries:
+            self._finished_task_ids.clear()
+        is_app_error = reply.get("is_error", False)
+        if is_app_error and pending is not None and spec.retry_exceptions \
+                and pending.retries_left > 0:
+            pending.retries_left -= 1
+            self.pending_tasks[spec.task_id] = pending
+            self._finished_task_ids.discard(spec.task_id)
+            self._submit_on_loop(spec)
+            return
+        for ret in reply.get("returns", []):
+            object_id = ObjectID(ret["object_id"])
+            if ret.get("in_plasma"):
+                self.memory_store.put(object_id, make_plasma_marker())
+                self.reference_counter.register_owned(object_id, True)
+            else:
+                obj = SerializedObject(
+                    metadata=ret["metadata"], inband=ret["inband"],
+                    buffers=list(ret.get("buffers", [])),
+                )
+                self.memory_store.put(object_id, obj)
+                self.reference_counter.register_owned(object_id, False)
+
+    def _on_task_worker_failure(self, spec: TaskSpec, error: Exception):
+        pending = self.pending_tasks.get(spec.task_id)
+        if pending is None:
+            return
+        if pending.retries_left > 0 and not pending.cancelled:
+            pending.retries_left -= 1
+            pending.pushed_to = None
+            logger.info("retrying task %s after worker failure",
+                        spec.name or spec.task_id.hex()[:12])
+            self._submit_on_loop(spec)
+        else:
+            self._store_task_error(
+                spec, exc.WorkerCrashedError(
+                    f"worker died while running task {spec.name}: {error}"
+                )
+            )
+
+    def _store_task_error(self, spec: TaskSpec, error: Exception):
+        self.pending_tasks.pop(spec.task_id, None)
+        self._ensure_sets()
+        self._finished_task_ids.add(spec.task_id)
+        obj = serialization.serialize_error(error, task_name=spec.name)
+        for oid in spec.return_object_ids():
+            self.memory_store.put(oid, obj)
+            self.reference_counter.register_owned(oid, False)
+
+    def cancel_task(self, ref: ObjectRef, force: bool = False):
+        task_id = ref.id.task_id()
+        pending = self.pending_tasks.get(task_id)
+        if pending is None:
+            return
+
+        def go():
+            pending.cancelled = True
+            # Remove from any queue.
+            for key, state in self.scheduling_keys.items():
+                try:
+                    state.queue.remove(pending.spec)
+                    self._store_task_error(
+                        pending.spec, exc.TaskCancelledError(
+                            f"task {pending.spec.name} cancelled"
+                        )
+                    )
+                    return
+                except ValueError:
+                    continue
+            # Already pushed: ask the worker to interrupt.
+            if pending.pushed_to is not None:
+                for state in self.scheduling_keys.values():
+                    lw = state.workers.get(pending.pushed_to)
+                    if lw is not None:
+                        asyncio.ensure_future(lw.conn.notify(
+                            "cancel_task",
+                            {"task_id": pending.spec.task_id.hex(),
+                             "force": force},
+                        ))
+                        return
+
+        self.loop.call_soon_threadsafe(go)
+
+    # ------------------------------------------------------------------
+    # actors
+    # ------------------------------------------------------------------
+
+    def create_actor(self, class_key: str, args: List[TaskArg], *,
+                     name: str, actor_name: str, namespace: str,
+                     resources: Dict[str, float], max_restarts: int,
+                     max_task_retries: int, max_concurrency: int,
+                     is_async: bool, scheduling_strategy,
+                     runtime_env=None, detached: bool = False) -> ActorID:
+        self._ensure_actor_subscription()
+        actor_id = ActorID.of(self.job_id)
+        task_id = TaskID.for_actor_creation(actor_id)
+        spec = TaskSpec(
+            task_id=task_id,
+            job_id=self.job_id,
+            task_type=TaskType.ACTOR_CREATION_TASK,
+            name=name,
+            function_key=class_key,
+            args=args,
+            num_returns=1,
+            resources=resources,
+            owner=self.address,
+            scheduling_strategy=scheduling_strategy,
+            runtime_env=runtime_env,
+            actor_id=actor_id,
+            max_restarts=max_restarts,
+            max_task_retries=max_task_retries,
+            max_concurrency=max_concurrency,
+            is_async_actor=is_async,
+            actor_name=actor_name,
+            namespace=namespace,
+        )
+        spec.detached = detached  # dynamic field, carried in pickle
+        state = ActorState(actor_id=actor_id,
+                           max_task_retries=max_task_retries)
+        self.actors[actor_id] = state
+        # __init__ failures surface as actor DEAD with the traceback in
+        # death_cause; method calls then raise ActorDiedError.
+
+        async def register():
+            reply = await self.head.call(
+                "register_actor",
+                {"spec": serialization.dumps_control(spec)},
+            )
+            if not reply.get("ok"):
+                state.state = "DEAD"
+                state.death_cause = reply.get("error", "registration failed")
+                self._fail_actor_queue(state)
+
+        self.loop_thread.submit(register())
+        return actor_id
+
+    def _ensure_actor_subscription(self):
+        if self._actor_sub_started:
+            return
+        self._actor_sub_started = True
+        self.loop_thread.submit(self.head.call("subscribe",
+                                               {"channel": "actor_state"}))
+
+    def _on_actor_state(self, data: dict):
+        actor_id = ActorID.from_hex(data["actor_id"])
+        state = self.actors.get(actor_id)
+        if state is None:
+            state = ActorState(actor_id=actor_id)
+            self.actors[actor_id] = state
+        new_state = data["state"]
+        state.state = new_state
+        state.death_cause = data.get("death_cause", "")
+        if data.get("address"):
+            host, port, widhex = data["address"]
+            state.address = Address(host, port, widhex)
+        else:
+            state.address = None
+            state.conn = None
+        if new_state == "ALIVE":
+            asyncio.ensure_future(self._drain_actor_queue(state))
+        elif new_state == "DEAD":
+            self._fail_actor_queue(state)
+
+    async def _drain_actor_queue(self, state: ActorState):
+        if state.address is None:
+            return
+        try:
+            state.conn = await self.get_connection(state.address.key())
+        except Exception as e:
+            logger.warning("connect to actor %s failed: %s",
+                           state.actor_id.hex()[:12], e)
+            return
+        while state.queue and state.state == "ALIVE":
+            spec = state.queue.popleft()
+            self._push_actor_task(state, spec)
+
+    def _fail_actor_queue(self, state: ActorState):
+        while state.queue:
+            spec = state.queue.popleft()
+            self._store_task_error(
+                spec, exc.ActorDiedError(state.actor_id.hex(),
+                                         state.death_cause)
+            )
+
+    def submit_actor_task(self, actor_id: ActorID, method_name: str,
+                          args: List[TaskArg], *, num_returns: int,
+                          name: str = "") -> List[ObjectRef]:
+        self._ensure_sets()
+        state = self.actors.get(actor_id)
+        if state is None:
+            # Handle deserialized in another process; subscribe lazily.
+            self._ensure_actor_subscription()
+            state = ActorState(actor_id=actor_id)
+            self.actors[actor_id] = state
+            self.loop_thread.submit(self._refresh_actor_info(actor_id))
+        task_id = TaskID.for_actor_task(actor_id)
+        spec = TaskSpec(
+            task_id=task_id,
+            job_id=self.job_id,
+            task_type=TaskType.ACTOR_TASK,
+            name=name or method_name,
+            function_key="",
+            args=args,
+            num_returns=num_returns,
+            resources={},
+            owner=self.address,
+            actor_id=actor_id,
+            method_name=method_name,
+        )
+        self.pending_tasks[task_id] = PendingTask(
+            spec=spec, retries_left=state.max_task_retries
+        )
+        refs = [
+            ObjectRef(oid, self.address, is_owned=True)
+            for oid in spec.return_object_ids()
+        ]
+
+        def go():
+            spec.seqno = state.seqno
+            state.seqno += 1
+            if state.state == "ALIVE" and state.conn is not None \
+                    and not state.conn.closed:
+                self._push_actor_task(state, spec)
+            elif state.state == "DEAD":
+                self._store_task_error(
+                    spec, exc.ActorDiedError(actor_id.hex(), state.death_cause)
+                )
+            else:
+                state.queue.append(spec)
+
+        self.loop.call_soon_threadsafe(go)
+        return refs
+
+    def _on_actor_state_threadsafe(self, data: dict):
+        self.loop.call_soon_threadsafe(self._on_actor_state, data)
+
+    async def _refresh_actor_info(self, actor_id: ActorID):
+        reply = await self.head.call("get_actor_info",
+                                     {"actor_id": actor_id.hex()})
+        if reply.get("found"):
+            self._on_actor_state(reply)
+
+    def _push_actor_task(self, state: ActorState, spec: TaskSpec):
+        state.inflight += 1
+
+        async def push():
+            try:
+                reply = await state.conn.call(
+                    "push_task", {"spec": serialization.dumps_control(spec)}
+                )
+            except (rpc.ConnectionLost, rpc.RpcError, OSError) as e:
+                state.inflight -= 1
+                self._on_actor_call_failure(state, spec, e)
+                return
+            state.inflight -= 1
+            self._on_task_reply(spec, reply)
+
+        asyncio.ensure_future(push())
+
+    def _on_actor_call_failure(self, state: ActorState, spec: TaskSpec,
+                               error: Exception):
+        pending = self.pending_tasks.get(spec.task_id)
+        if pending is None:
+            return
+        if state.max_task_retries != 0 and pending.retries_left != 0:
+            pending.retries_left -= 1
+            state.queue.append(spec)  # retried when actor is ALIVE again
+            return
+        # If the actor may restart, park the call; otherwise fail it.
+        if state.state in ("RESTARTING", "PENDING"):
+            state.queue.append(spec)
+        else:
+            self._store_task_error(
+                spec,
+                exc.ActorDiedError(state.actor_id.hex(),
+                                   state.death_cause or str(error)),
+            )
+
+    def kill_actor(self, actor_id: ActorID, no_restart: bool = True):
+        self.loop_thread.run(
+            self.head.call("kill_actor", {
+                "actor_id": actor_id.hex(), "no_restart": no_restart,
+            })
+        )
+
+    # ------------------------------------------------------------------
+    # shutdown
+    # ------------------------------------------------------------------
+
+    async def stop(self):
+        self._shutdown = True
+        self.reference_counter.disable()
+        if self.server:
+            await self.server.stop()
+        for conn in self._conn_cache.values():
+            await conn.close()
+        self._conn_cache.clear()

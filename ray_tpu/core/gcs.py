@@ -1,0 +1,786 @@
+"""Head service — the cluster control plane (GCS equivalent).
+
+Reference: src/ray/gcs/gcs_server/gcs_server.h:78 composes actor / node /
+job / placement-group managers, internal KV, pubsub and health checking;
+this module is the same composition on one asyncio loop:
+
+- worker/node registry + death detection (conn close ≈ health check fail)
+- lease scheduling (delegates to ClusterScheduler / WorkerPool)
+- actor manager with restarts (reference: gcs_actor_manager.cc:255,641,1326)
+- placement groups (reference: gcs_placement_group_mgr)
+- internal KV (reference: gcs_kv_manager.cc) — function table, named actors
+- pubsub channels (reference: src/ray/pubsub/) — actor/node state, logs
+- object directory for the node-wide shm store (seal events + waiters)
+- task-event store for the state API (reference: gcs_task_manager)
+
+All handlers run on the head's event loop; peers are either remote
+``rpc.Connection``s (worker processes, remote drivers) or the in-process
+driver's ``LocalPeer``.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import logging
+import time
+from collections import deque
+from typing import Dict, List, Optional, Set
+
+from ray_tpu.core import serialization
+from ray_tpu.core.config import Config
+from ray_tpu.core.ids import ActorID, JobID, NodeID, ObjectID, PlacementGroupID, WorkerID
+from ray_tpu.core.object_store import ShmStore
+from ray_tpu.core.resources import ResourceSet
+from ray_tpu.core.scheduler import (
+    ClusterScheduler,
+    Node,
+    PendingLease,
+    WorkerHandle,
+    WorkerPool,
+)
+from ray_tpu.core.task_spec import ActorInfo, Bundle, NodeInfo, PlacementGroupInfo, TaskSpec
+
+logger = logging.getLogger(__name__)
+
+
+class HeadService:
+    def __init__(self, config: Config, shm_store: ShmStore, session_dir: str,
+                 host: str = "127.0.0.1"):
+        self.config = config
+        self.shm = shm_store
+        self.session_dir = session_dir
+        self.host = host
+        self.port: Optional[int] = None
+        self.pool: Optional[WorkerPool] = None
+        self.scheduler: Optional[ClusterScheduler] = None
+
+        self.kv: Dict[str, Dict[bytes, bytes]] = {}  # namespace -> {k: v}
+        self.actors: Dict[ActorID, ActorInfo] = {}
+        self.named_actors: Dict[tuple, ActorID] = {}  # (namespace, name) -> id
+        self.jobs: Dict[JobID, dict] = {}
+        self._job_counter = 0
+        self.nodes_info: Dict[NodeID, NodeInfo] = {}
+        self.placement_groups: Dict[PlacementGroupID, PlacementGroupInfo] = {}
+        self._pg_waiters: Dict[PlacementGroupID, List[asyncio.Future]] = {}
+        # pubsub: channel -> set of peers
+        self.subscribers: Dict[str, Set] = {}
+        # object directory: hex id -> size (sealed objects on this node)
+        self.sealed_objects: Dict[str, int] = {}
+        self._object_waiters: Dict[str, List[asyncio.Future]] = {}
+        # worker connection -> WorkerHandle
+        self._conn_to_worker: Dict[object, WorkerHandle] = {}
+        # node_id -> deque of grants waiting for a worker to register
+        self._waiting_grants: Dict[NodeID, deque] = {}
+        # actor_id -> in-flight creation task (to avoid double-create)
+        self._creating_actors: Set[ActorID] = set()
+        # task events ring buffer (state API backend)
+        self.task_events: deque = deque(maxlen=config.task_events_max_buffer_size)
+        self._pump_task: Optional[asyncio.Task] = None
+        self._shutdown = False
+
+    # ------------------------------------------------------------------
+    # lifecycle
+    # ------------------------------------------------------------------
+
+    def attach(self, port: int):
+        """Called once the RPC server is listening."""
+        self.port = port
+        self.pool = WorkerPool(self.host, port, self.session_dir)
+        self.scheduler = ClusterScheduler(
+            self.pool, spread_threshold=self.config.scheduler_spread_threshold
+        )
+        self._pump_task = asyncio.get_running_loop().create_task(
+            self._periodic_pump()
+        )
+
+    async def _periodic_pump(self):
+        while not self._shutdown:
+            try:
+                self._pump()
+            except Exception:
+                logger.exception("scheduler pump failed")
+            await asyncio.sleep(0.2)
+
+    def add_node(self, resources: Dict[str, float],
+                 labels: Optional[Dict[str, str]] = None) -> NodeID:
+        node_id = NodeID.from_random()
+        node = Node(node_id, ResourceSet(resources), labels)
+        self.scheduler.add_node(node)
+        self.nodes_info[node_id] = NodeInfo(
+            node_id=node_id, address=self.host,
+            resources=dict(resources), labels=labels or {},
+        )
+        self._publish("node_state", {
+            "node_id": node_id.hex(), "state": "ALIVE",
+            "resources": dict(resources),
+        })
+        self._pump()
+        return node_id
+
+    def remove_node(self, node_id: NodeID):
+        self.scheduler.remove_node(node_id)
+        info = self.nodes_info.get(node_id)
+        if info:
+            info.state = "DEAD"
+        # Kill that node's workers; their deaths cascade to actors/leases.
+        for handle in list(self.pool.workers.values()):
+            if handle.node_id == node_id:
+                self.pool.kill(handle.worker_id)
+                self._on_worker_dead(handle)
+        self._publish("node_state", {"node_id": node_id.hex(), "state": "DEAD"})
+
+    def handlers(self) -> dict:
+        return {
+            "register_worker": self.h_register_worker,
+            "register_driver": self.h_register_driver,
+            "request_lease": self.h_request_lease,
+            "return_worker": self.h_return_worker,
+            "register_actor": self.h_register_actor,
+            "get_actor_info": self.h_get_actor_info,
+            "get_named_actor": self.h_get_named_actor,
+            "list_named_actors": self.h_list_named_actors,
+            "kill_actor": self.h_kill_actor,
+            "actor_exited": self.h_actor_exited,
+            "kv_put": self.h_kv_put,
+            "kv_get": self.h_kv_get,
+            "kv_del": self.h_kv_del,
+            "kv_exists": self.h_kv_exists,
+            "kv_keys": self.h_kv_keys,
+            "subscribe": self.h_subscribe,
+            "publish": self.h_publish,
+            "object_sealed": self.h_object_sealed,
+            "wait_object": self.h_wait_object,
+            "free_objects": self.h_free_objects,
+            "pin_object": self.h_pin_object,
+            "unpin_object": self.h_unpin_object,
+            "create_pg": self.h_create_pg,
+            "remove_pg": self.h_remove_pg,
+            "pg_ready": self.h_pg_ready,
+            "get_pg": self.h_get_pg,
+            "list_pgs": self.h_list_pgs,
+            "get_nodes": self.h_get_nodes,
+            "cluster_resources": self.h_cluster_resources,
+            "available_resources": self.h_available_resources,
+            "report_task_events": self.h_report_task_events,
+            "list_task_events": self.h_list_task_events,
+            "list_workers": self.h_list_workers,
+            "ping": self.h_ping,
+        }
+
+    # ------------------------------------------------------------------
+    # workers / drivers
+    # ------------------------------------------------------------------
+
+    async def h_register_worker(self, conn, payload):
+        worker_id = WorkerID.from_hex(payload["worker_id"])
+        address = (payload["host"], payload["port"])
+        handle = self.pool.on_registered(worker_id, address, conn)
+        if handle is None:
+            return {"ok": False, "error": "unknown worker"}
+        self._conn_to_worker[conn] = handle
+        prev_close = conn.on_close
+        def on_close(c, _prev=prev_close):
+            if _prev:
+                _prev(c)
+            h = self._conn_to_worker.pop(c, None)
+            if h is not None:
+                self._on_worker_dead(h)
+        conn.on_close = on_close
+        # A grant may be waiting for this worker's node.
+        self._match_waiting_grants(handle.node_id)
+        self._pump()
+        return {"ok": True, "node_id": handle.node_id.hex()}
+
+    async def h_register_driver(self, conn, payload):
+        self._job_counter += 1
+        job_id = JobID.from_int(self._job_counter)
+        self.jobs[job_id] = {
+            "address": (payload["host"], payload["port"]),
+            "worker_id": payload["worker_id"],
+            "state": "RUNNING",
+            "start_time": time.time(),
+        }
+        if conn is not None and hasattr(conn, "on_close"):
+            prev_close = conn.on_close
+            def on_close(c, _prev=prev_close, _job=job_id):
+                if _prev:
+                    _prev(c)
+                self._on_driver_exit(_job)
+            conn.on_close = on_close
+        return {
+            "job_id": job_id.hex(),
+            "session_dir": self.session_dir,
+            "nodes": [
+                {"node_id": n.node_id.hex(), "resources": n.resources}
+                for n in self.nodes_info.values()
+            ],
+        }
+
+    def _on_driver_exit(self, job_id: JobID):
+        job = self.jobs.get(job_id)
+        if job:
+            job["state"] = "FINISHED"
+            job["end_time"] = time.time()
+        # Kill non-detached actors of the job.
+        for actor_id, info in list(self.actors.items()):
+            if info.job_id == job_id and info.state in ("ALIVE", "PENDING",
+                                                        "RESTARTING"):
+                spec = info.creation_spec
+                if spec is not None and getattr(spec, "detached", False):
+                    continue
+                asyncio.get_running_loop().create_task(
+                    self._kill_actor(actor_id, no_restart=True,
+                                     reason="driver exited")
+                )
+
+    def _on_worker_dead(self, handle: WorkerHandle):
+        logger.info("worker %s died (state=%s)", handle.worker_id.hex()[:12],
+                    handle.state)
+        self.pool.mark_dead(handle.worker_id)
+        if handle.lease_id:
+            self.scheduler.release_lease(handle.lease_id)
+        # Actor death?
+        for actor_id, info in list(self.actors.items()):
+            if (
+                info.address is not None
+                and info.address.worker_id_hex == handle.worker_id.hex()
+                and info.state in ("ALIVE", "RESTARTING")
+            ):
+                self._on_actor_worker_died(actor_id, info)
+        self._pump()
+
+    # ------------------------------------------------------------------
+    # leases
+    # ------------------------------------------------------------------
+
+    async def h_request_lease(self, conn, payload):
+        spec: TaskSpec = serialization.loads_control(payload["spec"])
+        resources = ResourceSet(spec.resources)
+        fut = asyncio.get_running_loop().create_future()
+        lease = PendingLease(spec=spec, resources=resources, future=fut)
+        self.scheduler.submit(lease)
+        self._pump()
+        try:
+            worker, lease_id = await fut
+        except ValueError as e:
+            return {"granted": False, "infeasible": True, "error": str(e)}
+        return {
+            "granted": True,
+            "lease_id": lease_id,
+            "worker_id": worker.worker_id.hex(),
+            "host": worker.address[0],
+            "port": worker.address[1],
+            "node_id": worker.node_id.hex(),
+        }
+
+    def _pump(self):
+        if self.scheduler is None:
+            return
+        self._retry_pending_pgs()
+        grants = self.scheduler.pump()
+        for lease, node, pg_id, bundle_index, idle_worker in grants:
+            lease_id = self.scheduler.next_lease_id()
+            self.scheduler.record_lease(
+                lease_id, node.node_id, lease.resources, pg_id, bundle_index
+            )
+            if idle_worker is not None:
+                self._grant(lease, idle_worker, lease_id)
+            else:
+                self._waiting_grants.setdefault(node.node_id, deque()).append(
+                    (lease, lease_id)
+                )
+                self.pool.spawn(node.node_id)
+
+    def _grant(self, lease: PendingLease, worker: WorkerHandle, lease_id: str):
+        worker.state = "LEASED"
+        worker.lease_id = lease_id
+        if not lease.future.done():
+            lease.future.set_result((worker, lease_id))
+        else:
+            # Requester gave up; return the worker and resources.
+            self.scheduler.release_lease(lease_id)
+            self.pool.push_idle(worker)
+
+    def _match_waiting_grants(self, node_id: NodeID):
+        queue = self._waiting_grants.get(node_id)
+        while queue:
+            worker = self.pool.pop_idle(node_id)
+            if worker is None:
+                return
+            lease, lease_id = queue.popleft()
+            self._grant(lease, worker, lease_id)
+
+    async def h_return_worker(self, conn, payload):
+        lease_id = payload["lease_id"]
+        worker_id = WorkerID.from_hex(payload["worker_id"])
+        self.scheduler.release_lease(lease_id)
+        handle = self.pool.workers.get(worker_id)
+        if handle and handle.state == "LEASED":
+            self.pool.push_idle(handle)
+            self._match_waiting_grants(handle.node_id)
+        self._pump()
+        return {"ok": True}
+
+    # ------------------------------------------------------------------
+    # actors
+    # ------------------------------------------------------------------
+
+    async def h_register_actor(self, conn, payload):
+        spec: TaskSpec = serialization.loads_control(payload["spec"])
+        actor_id = spec.actor_id
+        name_key = None
+        if spec.actor_name:
+            name_key = (spec.namespace, spec.actor_name)
+            if name_key in self.named_actors:
+                existing = self.named_actors[name_key]
+                info = self.actors.get(existing)
+                if info and info.state != "DEAD":
+                    return {"ok": False,
+                            "error": f"actor name {spec.actor_name!r} taken"}
+        info = ActorInfo(
+            actor_id=actor_id,
+            job_id=spec.job_id,
+            state="PENDING",
+            name=spec.actor_name,
+            namespace=spec.namespace,
+            max_restarts=spec.max_restarts,
+            creation_spec=spec,
+        )
+        self.actors[actor_id] = info
+        if name_key:
+            self.named_actors[name_key] = actor_id
+        asyncio.get_running_loop().create_task(self._create_actor(actor_id))
+        return {"ok": True}
+
+    async def _create_actor(self, actor_id: ActorID):
+        """Lease a worker and push the creation task (reference:
+        gcs_actor_scheduler.h:111,259)."""
+        if actor_id in self._creating_actors:
+            return
+        self._creating_actors.add(actor_id)
+        try:
+            info = self.actors.get(actor_id)
+            if info is None or info.state == "DEAD":
+                return
+            spec = info.creation_spec
+            fut = asyncio.get_running_loop().create_future()
+            lease = PendingLease(
+                spec=spec, resources=ResourceSet(spec.resources), future=fut,
+                is_actor_creation=True,
+            )
+            self.scheduler.submit(lease)
+            self._pump()
+            try:
+                worker, lease_id = await fut
+            except ValueError as e:
+                self._mark_actor_dead(actor_id, f"unschedulable: {e}")
+                return
+            worker.state = "ACTOR"
+            from ray_tpu.core.task_spec import Address
+
+            info.address = Address(
+                host=worker.address[0], port=worker.address[1],
+                worker_id_hex=worker.worker_id.hex(),
+            )
+            info.node_id = worker.node_id
+            try:
+                result = await worker.connection.call(
+                    "create_actor",
+                    {"spec": serialization.dumps_control(spec)},
+                    timeout=None,
+                )
+            except Exception as e:
+                self._mark_actor_dead(actor_id, f"creation push failed: {e}")
+                return
+            if not result.get("ok"):
+                # Creation raised in __init__ — actor is dead; the error
+                # object was already delivered to the owner.
+                self._mark_actor_dead(actor_id,
+                                      result.get("error", "creation failed"))
+                return
+            if info.state != "DEAD":
+                info.state = "ALIVE"
+                self._publish_actor(info)
+        finally:
+            self._creating_actors.discard(actor_id)
+
+    def _on_actor_worker_died(self, actor_id: ActorID, info: ActorInfo):
+        if info.num_restarts < info.max_restarts or info.max_restarts == -1:
+            info.num_restarts += 1
+            info.state = "RESTARTING"
+            info.address = None
+            self._publish_actor(info)
+            asyncio.get_running_loop().create_task(self._create_actor(actor_id))
+        else:
+            self._mark_actor_dead(actor_id, "worker died")
+
+    def _mark_actor_dead(self, actor_id: ActorID, reason: str):
+        info = self.actors.get(actor_id)
+        if info is None:
+            return
+        info.state = "DEAD"
+        info.death_cause = reason
+        info.address = None
+        self._publish_actor(info)
+
+    def _publish_actor(self, info: ActorInfo):
+        self._publish("actor_state", {
+            "actor_id": info.actor_id.hex(),
+            "state": info.state,
+            "address": (
+                [info.address.host, info.address.port,
+                 info.address.worker_id_hex]
+                if info.address else None
+            ),
+            "num_restarts": info.num_restarts,
+            "death_cause": info.death_cause,
+        })
+
+    def _actor_info_payload(self, info: ActorInfo) -> dict:
+        return {
+            "actor_id": info.actor_id.hex(),
+            "state": info.state,
+            "name": info.name,
+            "namespace": info.namespace,
+            "address": (
+                [info.address.host, info.address.port,
+                 info.address.worker_id_hex]
+                if info.address else None
+            ),
+            "num_restarts": info.num_restarts,
+            "max_restarts": info.max_restarts,
+            "death_cause": info.death_cause,
+            "job_id": info.job_id.hex(),
+        }
+
+    async def h_get_actor_info(self, conn, payload):
+        actor_id = ActorID.from_hex(payload["actor_id"])
+        info = self.actors.get(actor_id)
+        if info is None:
+            return {"found": False}
+        return {"found": True, **self._actor_info_payload(info)}
+
+    async def h_get_named_actor(self, conn, payload):
+        key = (payload.get("namespace", ""), payload["name"])
+        actor_id = self.named_actors.get(key)
+        if actor_id is None:
+            return {"found": False}
+        info = self.actors.get(actor_id)
+        if info is None or info.state == "DEAD":
+            return {"found": False}
+        return {"found": True, **self._actor_info_payload(info)}
+
+    async def h_list_named_actors(self, conn, payload):
+        all_ns = payload.get("all_namespaces", False)
+        namespace = payload.get("namespace", "")
+        out = []
+        for (ns, name), actor_id in self.named_actors.items():
+            info = self.actors.get(actor_id)
+            if info is None or info.state == "DEAD":
+                continue
+            if all_ns or ns == namespace:
+                out.append({"namespace": ns, "name": name})
+        return out
+
+    async def h_kill_actor(self, conn, payload):
+        actor_id = ActorID.from_hex(payload["actor_id"])
+        await self._kill_actor(actor_id, payload.get("no_restart", True),
+                               reason="ray_tpu.kill")
+        return {"ok": True}
+
+    async def _kill_actor(self, actor_id: ActorID, no_restart: bool,
+                          reason: str):
+        info = self.actors.get(actor_id)
+        if info is None or info.state == "DEAD":
+            return
+        if no_restart:
+            info.max_restarts = info.num_restarts  # block further restarts
+        address = info.address
+        if address is not None:
+            worker_id = WorkerID.from_hex(address.worker_id_hex)
+            handle = self.pool.workers.get(worker_id)
+            if handle and handle.connection and not handle.connection.closed:
+                try:
+                    await handle.connection.notify("exit_worker", {})
+                except Exception:
+                    pass
+            # Ensure the process dies even if it ignores the notify.
+            await asyncio.sleep(0)
+            if handle:
+                self.pool.kill(worker_id)
+                self._on_worker_dead(handle)
+        if no_restart:
+            self._mark_actor_dead(actor_id, reason)
+
+    async def h_actor_exited(self, conn, payload):
+        """Graceful exit (__ray_terminate__ equivalent)."""
+        actor_id = ActorID.from_hex(payload["actor_id"])
+        info = self.actors.get(actor_id)
+        if info:
+            info.max_restarts = info.num_restarts
+            self._mark_actor_dead(actor_id, "exited gracefully")
+        return {"ok": True}
+
+    # ------------------------------------------------------------------
+    # KV
+    # ------------------------------------------------------------------
+
+    async def h_kv_put(self, conn, payload):
+        ns = self.kv.setdefault(payload.get("ns", ""), {})
+        key = payload["key"]
+        if not payload.get("overwrite", True) and key in ns:
+            return {"added": False}
+        ns[key] = payload["value"]
+        return {"added": True}
+
+    async def h_kv_get(self, conn, payload):
+        ns = self.kv.get(payload.get("ns", ""), {})
+        return {"value": ns.get(payload["key"])}
+
+    async def h_kv_del(self, conn, payload):
+        ns = self.kv.get(payload.get("ns", ""), {})
+        existed = ns.pop(payload["key"], None) is not None
+        return {"deleted": existed}
+
+    async def h_kv_exists(self, conn, payload):
+        ns = self.kv.get(payload.get("ns", ""), {})
+        return {"exists": payload["key"] in ns}
+
+    async def h_kv_keys(self, conn, payload):
+        ns = self.kv.get(payload.get("ns", ""), {})
+        prefix = payload.get("prefix", b"")
+        return {"keys": [k for k in ns if k.startswith(prefix)]}
+
+    # ------------------------------------------------------------------
+    # pubsub
+    # ------------------------------------------------------------------
+
+    async def h_subscribe(self, conn, payload):
+        channel = payload["channel"]
+        self.subscribers.setdefault(channel, set()).add(conn)
+        prev_close = getattr(conn, "on_close", None)
+        def on_close(c, _prev=prev_close):
+            if _prev:
+                _prev(c)
+            for subs in self.subscribers.values():
+                subs.discard(c)
+        if hasattr(conn, "on_close"):
+            conn.on_close = on_close
+        return {"ok": True}
+
+    async def h_publish(self, conn, payload):
+        self._publish(payload["channel"], payload["data"])
+        return {"ok": True}
+
+    def _publish(self, channel: str, data):
+        for peer in list(self.subscribers.get(channel, ())):
+            try:
+                coro = peer.notify("pubsub", {"channel": channel, "data": data})
+                asyncio.get_running_loop().create_task(coro)
+            except Exception:
+                pass
+
+    # ------------------------------------------------------------------
+    # object directory
+    # ------------------------------------------------------------------
+
+    async def h_object_sealed(self, conn, payload):
+        hex_id = payload["object_id"]
+        size = payload["size"]
+        self.sealed_objects[hex_id] = size
+        self.shm.mark_sealed(ObjectID.from_hex(hex_id), size)
+        for fut in self._object_waiters.pop(hex_id, []):
+            if not fut.done():
+                fut.set_result(True)
+        return {"ok": True}
+
+    async def h_wait_object(self, conn, payload):
+        hex_id = payload["object_id"]
+        if hex_id in self.sealed_objects:
+            return {"sealed": True}
+        fut = asyncio.get_running_loop().create_future()
+        self._object_waiters.setdefault(hex_id, []).append(fut)
+        timeout = payload.get("timeout")
+        try:
+            await asyncio.wait_for(fut, timeout)
+            return {"sealed": True}
+        except asyncio.TimeoutError:
+            return {"sealed": False}
+
+    async def h_free_objects(self, conn, payload):
+        for hex_id in payload["object_ids"]:
+            self.sealed_objects.pop(hex_id, None)
+            self.shm.delete(ObjectID.from_hex(hex_id))
+        return {"ok": True}
+
+    async def h_pin_object(self, conn, payload):
+        self.shm.pin(ObjectID.from_hex(payload["object_id"]))
+        return {"ok": True}
+
+    async def h_unpin_object(self, conn, payload):
+        self.shm.unpin(ObjectID.from_hex(payload["object_id"]))
+        return {"ok": True}
+
+    # ------------------------------------------------------------------
+    # placement groups
+    # ------------------------------------------------------------------
+
+    async def h_create_pg(self, conn, payload):
+        pg_id = PlacementGroupID.from_random()
+        bundles = [ResourceSet(b) for b in payload["bundles"]]
+        strategy = payload.get("strategy", "PACK")
+        info = PlacementGroupInfo(
+            pg_id=pg_id,
+            bundles=[Bundle(resources=b) for b in payload["bundles"]],
+            strategy=strategy,
+            name=payload.get("name", ""),
+        )
+        self.placement_groups[pg_id] = info
+        if self.scheduler.try_place_bundles(pg_id, bundles, strategy):
+            info.state = "CREATED"
+            states = self.scheduler.pg_bundles[pg_id]
+            for bundle, st in zip(info.bundles, states):
+                bundle.node_id = st.node_id
+            for fut in self._pg_waiters.pop(pg_id, []):
+                if not fut.done():
+                    fut.set_result(True)
+        # else: stays PENDING; _retry_pending_pgs retries on every pump.
+        return {"pg_id": pg_id.hex(), "state": info.state}
+
+    def _retry_pending_pgs(self):
+        for pg_id, info in self.placement_groups.items():
+            if info.state != "PENDING":
+                continue
+            bundles = [ResourceSet(b.resources) for b in info.bundles]
+            if self.scheduler.try_place_bundles(pg_id, bundles, info.strategy):
+                info.state = "CREATED"
+                states = self.scheduler.pg_bundles[pg_id]
+                for bundle, st in zip(info.bundles, states):
+                    bundle.node_id = st.node_id
+                for fut in self._pg_waiters.pop(pg_id, []):
+                    if not fut.done():
+                        fut.set_result(True)
+
+    async def h_remove_pg(self, conn, payload):
+        pg_id = PlacementGroupID.from_hex(payload["pg_id"])
+        info = self.placement_groups.get(pg_id)
+        if info:
+            info.state = "REMOVED"
+            self.scheduler.remove_pg(pg_id)
+            self._pump()
+        return {"ok": True}
+
+    async def h_pg_ready(self, conn, payload):
+        pg_id = PlacementGroupID.from_hex(payload["pg_id"])
+        info = self.placement_groups.get(pg_id)
+        if info is None:
+            return {"ready": False, "error": "not found"}
+        if info.state == "CREATED":
+            return {"ready": True}
+        fut = asyncio.get_running_loop().create_future()
+        self._pg_waiters.setdefault(pg_id, []).append(fut)
+        try:
+            await asyncio.wait_for(fut, payload.get("timeout"))
+            return {"ready": True}
+        except asyncio.TimeoutError:
+            return {"ready": False}
+
+    async def h_get_pg(self, conn, payload):
+        pg_id = PlacementGroupID.from_hex(payload["pg_id"])
+        info = self.placement_groups.get(pg_id)
+        if info is None:
+            return {"found": False}
+        return {
+            "found": True,
+            "pg_id": pg_id.hex(),
+            "state": info.state,
+            "strategy": info.strategy,
+            "bundles": [
+                {"resources": b.resources,
+                 "node_id": b.node_id.hex() if b.node_id else None}
+                for b in info.bundles
+            ],
+        }
+
+    async def h_list_pgs(self, conn, payload):
+        return [
+            {"pg_id": pg_id.hex(), "state": info.state, "name": info.name,
+             "strategy": info.strategy}
+            for pg_id, info in self.placement_groups.items()
+        ]
+
+    # ------------------------------------------------------------------
+    # introspection
+    # ------------------------------------------------------------------
+
+    async def h_get_nodes(self, conn, payload):
+        return [
+            {
+                "node_id": info.node_id.hex(),
+                "address": info.address,
+                "resources": info.resources,
+                "labels": info.labels,
+                "state": info.state,
+            }
+            for info in self.nodes_info.values()
+        ]
+
+    async def h_cluster_resources(self, conn, payload):
+        return self.scheduler.cluster_resources()
+
+    async def h_available_resources(self, conn, payload):
+        return self.scheduler.available_resources()
+
+    async def h_report_task_events(self, conn, payload):
+        for event in payload["events"]:
+            self.task_events.append(event)
+        return {"ok": True}
+
+    async def h_list_task_events(self, conn, payload):
+        limit = payload.get("limit", 1000)
+        events = list(self.task_events)[-limit:]
+        return {"events": events}
+
+    async def h_list_workers(self, conn, payload):
+        return [
+            {
+                "worker_id": h.worker_id.hex(),
+                "node_id": h.node_id.hex(),
+                "pid": h.pid,
+                "state": h.state,
+            }
+            for h in self.pool.workers.values()
+        ]
+
+    async def h_ping(self, conn, payload):
+        return {"ok": True, "time": time.time()}
+
+    # ------------------------------------------------------------------
+
+    async def shutdown(self):
+        self._shutdown = True
+        if self._pump_task:
+            self._pump_task.cancel()
+        if self.pool:
+            self.pool.shutdown()
+        self.shm.cleanup()
+
+
+class LocalPeer:
+    """In-process stand-in for a Connection (the driver inside the head
+    process talks to HeadService without a socket)."""
+
+    def __init__(self, notify_handler=None):
+        self._notify_handler = notify_handler
+        self.on_close = None
+        self.closed = False
+        self.state: Dict = {}
+
+    async def notify(self, method: str, payload):
+        if self._notify_handler:
+            await self._notify_handler(method, payload)
+
+    def close(self):
+        self.closed = True
+        if self.on_close:
+            self.on_close(self)

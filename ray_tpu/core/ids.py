@@ -1,0 +1,174 @@
+"""Unique identifiers for jobs, tasks, actors, objects, nodes, workers.
+
+Mirrors the nested-ID design of the reference runtime (reference:
+src/ray/common/id.h — JobID ⊂ ActorID ⊂ TaskID ⊂ ObjectID) so that lineage
+can be recovered from an ID alone: an ObjectID embeds the TaskID that created
+it plus a return/put index; a TaskID embeds the ActorID (or a nil actor) and
+the JobID.
+
+Layout (bytes, little-endian indices):
+    JobID:    4 bytes
+    ActorID:  12 bytes = 8 unique + JobID(4)
+    TaskID:   16 bytes = 4 unique + ActorID(12)
+    ObjectID: 20 bytes = TaskID(16) + 4-byte index
+    NodeID / WorkerID / PlacementGroupID: 16 random bytes
+"""
+
+from __future__ import annotations
+
+import os
+import threading
+
+JOB_ID_SIZE = 4
+ACTOR_ID_SIZE = 12
+TASK_ID_SIZE = 16
+OBJECT_ID_SIZE = 20
+UNIQUE_ID_SIZE = 16
+
+_MAX_INDEX = 2**32 - 1
+
+
+class BaseID:
+    __slots__ = ("_bytes",)
+    SIZE = UNIQUE_ID_SIZE
+
+    def __init__(self, id_bytes: bytes):
+        if len(id_bytes) != self.SIZE:
+            raise ValueError(
+                f"{type(self).__name__} requires {self.SIZE} bytes, "
+                f"got {len(id_bytes)}"
+            )
+        self._bytes = bytes(id_bytes)
+
+    @classmethod
+    def from_random(cls):
+        return cls(os.urandom(cls.SIZE))
+
+    @classmethod
+    def nil(cls):
+        return cls(b"\xff" * cls.SIZE)
+
+    @classmethod
+    def from_hex(cls, hex_str: str):
+        return cls(bytes.fromhex(hex_str))
+
+    def binary(self) -> bytes:
+        return self._bytes
+
+    def hex(self) -> str:
+        return self._bytes.hex()
+
+    def is_nil(self) -> bool:
+        return self._bytes == b"\xff" * self.SIZE
+
+    def __hash__(self):
+        return hash(self._bytes)
+
+    def __eq__(self, other):
+        return type(other) is type(self) and other._bytes == self._bytes
+
+    def __repr__(self):
+        return f"{type(self).__name__}({self._bytes.hex()})"
+
+
+class JobID(BaseID):
+    SIZE = JOB_ID_SIZE
+
+    @classmethod
+    def from_int(cls, value: int):
+        return cls(value.to_bytes(JOB_ID_SIZE, "little"))
+
+    def int_value(self) -> int:
+        return int.from_bytes(self._bytes, "little")
+
+
+class NodeID(BaseID):
+    SIZE = UNIQUE_ID_SIZE
+
+
+class WorkerID(BaseID):
+    SIZE = UNIQUE_ID_SIZE
+
+
+class PlacementGroupID(BaseID):
+    SIZE = UNIQUE_ID_SIZE
+
+
+class ActorID(BaseID):
+    SIZE = ACTOR_ID_SIZE
+
+    @classmethod
+    def of(cls, job_id: JobID):
+        return cls(os.urandom(ACTOR_ID_SIZE - JOB_ID_SIZE) + job_id.binary())
+
+    @classmethod
+    def nil_for_job(cls, job_id: JobID):
+        return cls(b"\xff" * (ACTOR_ID_SIZE - JOB_ID_SIZE) + job_id.binary())
+
+    def job_id(self) -> JobID:
+        return JobID(self._bytes[-JOB_ID_SIZE:])
+
+
+class TaskID(BaseID):
+    SIZE = TASK_ID_SIZE
+
+    @classmethod
+    def for_normal_task(cls, job_id: JobID):
+        actor = ActorID.nil_for_job(job_id)
+        return cls(os.urandom(TASK_ID_SIZE - ACTOR_ID_SIZE) + actor.binary())
+
+    @classmethod
+    def for_actor_task(cls, actor_id: ActorID):
+        return cls(os.urandom(TASK_ID_SIZE - ACTOR_ID_SIZE) + actor_id.binary())
+
+    @classmethod
+    def for_actor_creation(cls, actor_id: ActorID):
+        # Deterministic creation-task id: zeros + actor id.
+        return cls(b"\x00" * (TASK_ID_SIZE - ACTOR_ID_SIZE) + actor_id.binary())
+
+    def actor_id(self) -> ActorID:
+        return ActorID(self._bytes[-ACTOR_ID_SIZE:])
+
+    def job_id(self) -> JobID:
+        return self.actor_id().job_id()
+
+
+class ObjectID(BaseID):
+    SIZE = OBJECT_ID_SIZE
+
+    @classmethod
+    def for_task_return(cls, task_id: TaskID, index: int):
+        if not 0 < index <= _MAX_INDEX:
+            raise ValueError(f"return index out of range: {index}")
+        return cls(task_id.binary() + index.to_bytes(4, "little"))
+
+    @classmethod
+    def for_put(cls, task_id: TaskID, put_index: int):
+        # Put objects use the high bit of the index to avoid clashing with
+        # return indices.
+        return cls(task_id.binary() + (put_index | 0x80000000).to_bytes(4, "little"))
+
+    def task_id(self) -> TaskID:
+        return TaskID(self._bytes[:TASK_ID_SIZE])
+
+    def index(self) -> int:
+        return int.from_bytes(self._bytes[TASK_ID_SIZE:], "little")
+
+    def is_put(self) -> bool:
+        return bool(self.index() & 0x80000000)
+
+    def job_id(self) -> JobID:
+        return self.task_id().job_id()
+
+
+class IndexCounter:
+    """Thread-safe monotonically increasing counter for put/return indices."""
+
+    def __init__(self, start: int = 0):
+        self._value = start
+        self._lock = threading.Lock()
+
+    def next(self) -> int:
+        with self._lock:
+            self._value += 1
+            return self._value

@@ -1,0 +1,122 @@
+"""Node bootstrap — starts the head services in the driver process.
+
+Reference: python/ray/_private/node.py:37 (Node supervisor) +
+services.py:1421,1485 (process launchers). Unlike the reference, which
+forks gcs_server and raylet daemons, this runtime hosts the control plane
+on the driver's event-loop thread (head node) — worker processes are the
+only forked processes. A future multi-host deployment runs the same
+HeadService standalone (`python -m ray_tpu.core.head_main`).
+"""
+
+from __future__ import annotations
+
+import logging
+import os
+import tempfile
+import time
+from typing import Dict, List, Optional
+
+from ray_tpu.core import rpc
+from ray_tpu.core.accelerators import TPUAcceleratorManager
+from ray_tpu.core.config import Config
+from ray_tpu.core.gcs import HeadService
+from ray_tpu.core.ids import NodeID
+from ray_tpu.core.object_store import ShmStore, default_capacity
+
+logger = logging.getLogger(__name__)
+
+
+def detect_node_resources(num_cpus: Optional[float] = None,
+                          num_tpus: Optional[float] = None,
+                          resources: Optional[Dict[str, float]] = None,
+                          memory: Optional[float] = None) -> Dict[str, float]:
+    out: Dict[str, float] = {}
+    if num_cpus is None:
+        out["CPU"] = float(os.cpu_count() or 1)
+    else:
+        out["CPU"] = float(num_cpus)
+    if num_tpus is None:
+        out.update(TPUAcceleratorManager.node_resources())
+    elif num_tpus > 0:
+        out["TPU"] = float(num_tpus)
+    if memory is None:
+        try:
+            import psutil
+
+            out["memory"] = float(psutil.virtual_memory().available)
+        except Exception:
+            out["memory"] = 4e9
+    else:
+        out["memory"] = float(memory)
+    if resources:
+        out.update({k: float(v) for k, v in resources.items()})
+    return out
+
+
+class HeadNode:
+    """Owns the head's event loop, RPC server, shm store and services."""
+
+    def __init__(self, config: Config, resources: Dict[str, float],
+                 session_dir: Optional[str] = None):
+        self.config = config
+        self.session_dir = session_dir or _make_session_dir()
+        os.makedirs(os.path.join(self.session_dir, "logs"), exist_ok=True)
+        capacity = config.object_store_memory or default_capacity(
+            config.object_store_memory_proportion
+        )
+        self.shm_store = ShmStore(capacity)
+        self.loop_thread = rpc.EventLoopThread(name="ray-tpu-head")
+        self.service = HeadService(config, self.shm_store, self.session_dir)
+        self.server: Optional[rpc.Server] = None
+        self.port: Optional[int] = None
+        self.node_ids: List[NodeID] = []
+
+        async def boot():
+            self.server = rpc.Server(self.service.handlers(), name="head")
+            port = await self.server.start("127.0.0.1", 0)
+            self.service.attach(port)
+            return port
+
+        self.port = self.loop_thread.run(boot())
+        self.default_node_id = self.add_node(resources)
+
+    def add_node(self, resources: Dict[str, float],
+                 labels: Optional[Dict[str, str]] = None) -> NodeID:
+        """Add a (virtual) node — the fake-multi-node test substrate
+        (reference: cluster_utils.Cluster.add_node, cluster_utils.py:174)."""
+
+        async def go():
+            return self.service.add_node(resources, labels)
+
+        node_id = self.loop_thread.run(go())
+        self.node_ids.append(node_id)
+        return node_id
+
+    def remove_node(self, node_id: NodeID):
+        async def go():
+            self.service.remove_node(node_id)
+
+        self.loop_thread.run(go())
+        if node_id in self.node_ids:
+            self.node_ids.remove(node_id)
+
+    def shutdown(self):
+        try:
+            self.loop_thread.run(self.service.shutdown(), timeout=10)
+        except Exception:
+            logger.exception("head shutdown error")
+        try:
+            if self.server is not None:
+                self.loop_thread.run(self.server.stop(), timeout=5)
+        except Exception:
+            pass
+        self.loop_thread.stop()
+
+
+def _make_session_dir() -> str:
+    base = os.path.join(tempfile.gettempdir(), "ray_tpu")
+    os.makedirs(base, exist_ok=True)
+    path = os.path.join(base, f"session_{time.strftime('%Y%m%d_%H%M%S')}_"
+                              f"{os.getpid()}")
+    os.makedirs(path, exist_ok=True)
+    return path

@@ -1,0 +1,106 @@
+"""ObjectRef — a future-like handle to a task return or put object.
+
+Reference: python/ray/_raylet.pyx ObjectRef + the ownership model of
+src/ray/core_worker/reference_count.h: every object has an **owner** (the
+worker that created it); other holders are **borrowers**. Refs embed the
+owner's address so borrowers can fetch the value and report reference
+removal directly to the owner.
+
+Pickling an ObjectRef (e.g. inside task args) produces a borrowed ref on
+the consumer side; creation/destruction of refs drives the distributed
+reference count through the process-local CoreWorker (set via
+``set_core_worker``).
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+from ray_tpu.core.ids import ObjectID
+from ray_tpu.core.task_spec import Address
+
+# Process-local CoreWorker used by refs for get/refcount traffic.
+_core_worker = None
+
+
+def set_core_worker(cw):
+    global _core_worker
+    _core_worker = cw
+
+
+def get_core_worker():
+    return _core_worker
+
+
+class ObjectRef:
+    __slots__ = ("_id", "_owner", "_is_owned", "__weakref__")
+
+    def __init__(self, object_id: ObjectID, owner: Optional[Address] = None,
+                 is_owned: bool = False, skip_adding_local_ref: bool = False):
+        self._id = object_id
+        self._owner = owner
+        self._is_owned = is_owned
+        if not skip_adding_local_ref and _core_worker is not None:
+            _core_worker.reference_counter.add_local_ref(self)
+
+    @property
+    def id(self) -> ObjectID:
+        return self._id
+
+    @property
+    def owner_address(self) -> Optional[Address]:
+        return self._owner
+
+    def binary(self) -> bytes:
+        return self._id.binary()
+
+    def hex(self) -> str:
+        return self._id.hex()
+
+    def is_nil(self) -> bool:
+        return self._id.is_nil()
+
+    def future(self):
+        """A concurrent.futures.Future resolving to the object's value."""
+        if _core_worker is None:
+            raise RuntimeError("ray_tpu not initialized")
+        return _core_worker.as_future(self)
+
+    def __reduce__(self):
+        owner = (
+            (self._owner.host, self._owner.port, self._owner.worker_id_hex)
+            if self._owner
+            else None
+        )
+        if _core_worker is not None:
+            _core_worker.reference_counter.on_ref_serialized(self)
+        return (_rebuild_ref, (self._id.binary(), owner))
+
+    def __del__(self):
+        if _core_worker is not None:
+            try:
+                _core_worker.reference_counter.remove_local_ref(self)
+            except Exception:
+                pass
+
+    def __hash__(self):
+        return hash(self._id)
+
+    def __eq__(self, other):
+        return isinstance(other, ObjectRef) and other._id == self._id
+
+    def __repr__(self):
+        return f"ObjectRef({self._id.hex()})"
+
+    # Allow `await ref` inside async actors.
+    def __await__(self):
+        if _core_worker is None:
+            raise RuntimeError("ray_tpu not initialized")
+        return _core_worker.get_async(self).__await__()
+
+
+def _rebuild_ref(id_bytes: bytes, owner: Optional[tuple]) -> ObjectRef:
+    address = Address(owner[0], owner[1], owner[2]) if owner else None
+    # Normal construction: registers a local ref whose destruction sends
+    # remove_ref to the owner — the -1 matching the serializer's +1 borrow.
+    return ObjectRef(ObjectID(id_bytes), address, is_owned=False)

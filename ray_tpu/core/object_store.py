@@ -1,0 +1,356 @@
+"""Two-tier object storage.
+
+Tier 1 — ``MemoryStore``: per-process in-memory store for small objects and
+direct-call returns (reference: src/ray/core_worker/store_provider/
+memory_store/memory_store.h:43 CoreWorkerMemoryStore). Supports blocking and
+async waiters.
+
+Tier 2 — ``ShmStore``: node-wide shared-memory store for large objects
+(reference: the plasma store, src/ray/object_manager/plasma/store.h:55).
+Objects live in named POSIX shared-memory segments (/dev/shm), are written
+once and sealed (immutable), and are mapped zero-copy by any process on the
+node. Eviction is LRU over unpinned sealed objects
+(reference: plasma/eviction_policy.h:105).
+
+An object's segment name is derived from its ID, so any process on the node
+can open it without a directory lookup; existence/seal coordination is done
+through the control plane (object directory in the GCS-equivalent).
+"""
+
+from __future__ import annotations
+
+import os
+import threading
+import time
+from collections import OrderedDict
+from multiprocessing import shared_memory
+from typing import Callable, Dict, List, Optional
+
+import msgpack
+
+from ray_tpu.core.ids import ObjectID
+from ray_tpu.core.serialization import SerializedObject
+from ray_tpu.exceptions import ObjectStoreFullError
+
+_ALIGN = 64
+
+
+def _aligned(n: int) -> int:
+    return (n + _ALIGN - 1) & ~(_ALIGN - 1)
+
+
+def segment_name(object_id: ObjectID) -> str:
+    # /dev/shm names are limited to NAME_MAX; 20-byte hex = 40 chars is fine.
+    return f"rtpu_{object_id.hex()}"
+
+
+class MemoryStore:
+    """In-process object store with waiter support."""
+
+    def __init__(self):
+        self._objects: Dict[ObjectID, SerializedObject] = {}
+        self._lock = threading.Lock()
+        self._cv = threading.Condition(self._lock)
+        # Callbacks fired once when an object arrives (used by the async
+        # runtime to resolve futures without polling).
+        self._waiter_callbacks: Dict[ObjectID, List[Callable]] = {}
+
+    def put(self, object_id: ObjectID, obj: SerializedObject):
+        with self._cv:
+            self._objects[object_id] = obj
+            callbacks = self._waiter_callbacks.pop(object_id, [])
+            self._cv.notify_all()
+        for cb in callbacks:
+            cb(obj)
+
+    def contains(self, object_id: ObjectID) -> bool:
+        with self._lock:
+            return object_id in self._objects
+
+    def get_if_exists(self, object_id: ObjectID) -> Optional[SerializedObject]:
+        with self._lock:
+            return self._objects.get(object_id)
+
+    def get(self, object_id: ObjectID, timeout: Optional[float] = None
+            ) -> Optional[SerializedObject]:
+        deadline = None if timeout is None else time.monotonic() + timeout
+        with self._cv:
+            while object_id not in self._objects:
+                remaining = None if deadline is None else deadline - time.monotonic()
+                if remaining is not None and remaining <= 0:
+                    return None
+                self._cv.wait(remaining)
+            return self._objects[object_id]
+
+    def add_waiter(self, object_id: ObjectID, callback: Callable) -> bool:
+        """Register callback(obj); fires immediately if present.
+
+        Returns True if the object was already present (callback fired).
+        """
+        with self._lock:
+            obj = self._objects.get(object_id)
+            if obj is None:
+                self._waiter_callbacks.setdefault(object_id, []).append(callback)
+                return False
+        callback(obj)
+        return True
+
+    def delete(self, object_id: ObjectID):
+        with self._lock:
+            self._objects.pop(object_id, None)
+            self._waiter_callbacks.pop(object_id, None)
+
+    def size(self) -> int:
+        with self._lock:
+            return len(self._objects)
+
+
+class ShmStore:
+    """Node-wide shared-memory store (plasma equivalent).
+
+    One instance runs authoritative bookkeeping (in the node daemon /
+    head process): capacity accounting, LRU eviction, pinning. Worker
+    processes use `open_object` directly (zero-copy map by name) after the
+    control plane confirms the object is sealed.
+    """
+
+    HEADER_MAGIC = b"RTPU"
+
+    def __init__(self, capacity_bytes: int, spill_dir: Optional[str] = None,
+                 spill_threshold: float = 0.8):
+        self.capacity = capacity_bytes
+        self.spill_dir = spill_dir
+        self.spill_threshold = spill_threshold
+        self._used = 0
+        self._lock = threading.Lock()
+        # object hex -> (size, sealed, pinned_count); LRU order = insertion /
+        # last-touch order.
+        self._entries: "OrderedDict[str, dict]" = OrderedDict()
+        self._spilled: Dict[str, str] = {}  # object hex -> file path
+
+    # ---- creation path (writer side) ----
+
+    @staticmethod
+    def pack(obj: SerializedObject) -> bytes:
+        """Serialize an object into the flat segment layout.
+
+        Layout: magic | u32 header_len | msgpack header | inband | buffers
+        (each aligned to 64 bytes).
+        """
+        header = {
+            "metadata": obj.metadata,
+            "inband_len": len(obj.inband),
+            "buffer_lens": [memoryview(b).nbytes for b in obj.buffers],
+        }
+        hbytes = msgpack.packb(header)
+        parts = [ShmStore.HEADER_MAGIC, len(hbytes).to_bytes(4, "little"), hbytes]
+        offset = sum(len(p) for p in parts)
+        pad = _aligned(offset) - offset
+        parts.append(b"\x00" * pad)
+        parts.append(obj.inband)
+        offset = _aligned(offset) + len(obj.inband)
+        for buf in obj.buffers:
+            pad = _aligned(offset) - offset
+            parts.append(b"\x00" * pad)
+            mv = memoryview(buf).cast("B")
+            parts.append(mv)
+            offset = _aligned(offset) + mv.nbytes
+        return b"".join(parts)
+
+    @staticmethod
+    def packed_size(obj: SerializedObject) -> int:
+        header = {
+            "metadata": obj.metadata,
+            "inband_len": len(obj.inband),
+            "buffer_lens": [memoryview(b).nbytes for b in obj.buffers],
+        }
+        hbytes = msgpack.packb(header)
+        offset = len(ShmStore.HEADER_MAGIC) + 4 + len(hbytes)
+        offset = _aligned(offset) + len(obj.inband)
+        for b in obj.buffers:
+            offset = _aligned(offset) + memoryview(b).nbytes
+        return offset
+
+    def create_and_seal(self, object_id: ObjectID, obj: SerializedObject) -> int:
+        """Write an object into a new shm segment. Returns its size."""
+        data = self.pack(obj)
+        size = len(data)
+        self._reserve(object_id.hex(), size)
+        try:
+            seg = shared_memory.SharedMemory(
+                name=segment_name(object_id), create=True, size=max(size, 1)
+            )
+        except FileExistsError:
+            # Idempotent create (e.g. task retry re-produced the object).
+            self._release(object_id.hex())
+            return size
+        try:
+            seg.buf[:size] = data
+        finally:
+            seg.close()
+        with self._lock:
+            if object_id.hex() in self._entries:
+                self._entries[object_id.hex()]["sealed"] = True
+        return size
+
+    def _reserve(self, hex_id: str, size: int):
+        with self._lock:
+            if hex_id in self._entries:
+                raise FileExistsError(hex_id)
+            self._evict_for(size)
+            if self._used + size > self.capacity:
+                raise ObjectStoreFullError(
+                    f"object of {size} bytes does not fit: "
+                    f"{self._used}/{self.capacity} used"
+                )
+            self._used += size
+            self._entries[hex_id] = {"size": size, "sealed": False, "pins": 0}
+
+    def _release(self, hex_id: str):
+        with self._lock:
+            entry = self._entries.pop(hex_id, None)
+            if entry:
+                self._used -= entry["size"]
+
+    def _evict_for(self, size: int):
+        """LRU-evict unpinned sealed objects until `size` fits. Lock held."""
+        if self._used + size <= self.capacity:
+            return
+        victims = []
+        for hex_id, entry in self._entries.items():
+            if self._used + size <= self.capacity:
+                break
+            if entry["sealed"] and entry["pins"] == 0:
+                victims.append(hex_id)
+                self._used -= entry["size"]
+        for hex_id in victims:
+            del self._entries[hex_id]
+            _unlink_segment(hex_id)
+
+    # ---- read path (any process) ----
+
+    # Process-wide cache of mapped segments. Mappings are kept until the
+    # process exits or the object is freed — zero-copy views handed to user
+    # code (numpy arrays aliasing the segment) must outlive any one
+    # SerializedObject, so segments are never closed implicitly.
+    _open_segments: Dict[str, shared_memory.SharedMemory] = {}
+    _open_lock = threading.Lock()
+
+    @staticmethod
+    def open_object(object_id: ObjectID) -> Optional[SerializedObject]:
+        """Zero-copy map of a sealed object. Returns None if absent."""
+        name = segment_name(object_id)
+        with ShmStore._open_lock:
+            seg = ShmStore._open_segments.get(name)
+            if seg is None:
+                try:
+                    seg = shared_memory.SharedMemory(name=name)
+                except FileNotFoundError:
+                    return None
+                ShmStore._open_segments[name] = seg
+        buf = seg.buf
+        if bytes(buf[:4]) != ShmStore.HEADER_MAGIC:
+            # Segment exists but is not (fully) written yet — drop it from
+            # the cache so a later retry re-maps instead of seeing a
+            # poisoned closed segment.
+            with ShmStore._open_lock:
+                ShmStore._open_segments.pop(name, None)
+            _close_or_neuter(seg)
+            return None
+        hlen = int.from_bytes(buf[4:8], "little")
+        header = msgpack.unpackb(bytes(buf[8:8 + hlen]))
+        offset = _aligned(8 + hlen)
+        inband = bytes(buf[offset:offset + header["inband_len"]])
+        offset = _aligned(offset) + header["inband_len"]
+        buffers = []
+        for blen in header["buffer_lens"]:
+            start = _aligned(offset)
+            buffers.append(buf[start:start + blen])
+            offset = start + blen
+        return SerializedObject(
+            metadata=header["metadata"], inband=inband, buffers=buffers
+        )
+
+    # ---- lifetime management (authoritative instance) ----
+
+    def mark_sealed(self, object_id: ObjectID, size: int):
+        """Record an object sealed by another process on this node."""
+        hex_id = object_id.hex()
+        with self._lock:
+            if hex_id not in self._entries:
+                self._evict_for(size)
+                self._used += size
+                self._entries[hex_id] = {"size": size, "sealed": True, "pins": 0}
+            else:
+                self._entries[hex_id]["sealed"] = True
+            self._entries.move_to_end(hex_id)
+
+    def contains(self, object_id: ObjectID) -> bool:
+        with self._lock:
+            entry = self._entries.get(object_id.hex())
+            return bool(entry and entry["sealed"])
+
+    def pin(self, object_id: ObjectID):
+        with self._lock:
+            entry = self._entries.get(object_id.hex())
+            if entry:
+                entry["pins"] += 1
+
+    def unpin(self, object_id: ObjectID):
+        with self._lock:
+            entry = self._entries.get(object_id.hex())
+            if entry and entry["pins"] > 0:
+                entry["pins"] -= 1
+
+    def delete(self, object_id: ObjectID):
+        hex_id = object_id.hex()
+        self._release(hex_id)
+        _unlink_segment(hex_id)
+
+    def used_bytes(self) -> int:
+        with self._lock:
+            return self._used
+
+    def num_objects(self) -> int:
+        with self._lock:
+            return len(self._entries)
+
+    def cleanup(self):
+        with self._lock:
+            hex_ids = list(self._entries)
+            self._entries.clear()
+            self._used = 0
+        for hex_id in hex_ids:
+            _unlink_segment(hex_id)
+
+
+def _unlink_segment(hex_id: str):
+    name = f"rtpu_{hex_id}"
+    with ShmStore._open_lock:
+        seg = ShmStore._open_segments.pop(name, None)
+    try:
+        if seg is None:
+            seg = shared_memory.SharedMemory(name=name)
+        seg.unlink()
+        _close_or_neuter(seg)
+    except FileNotFoundError:
+        pass
+
+
+def _close_or_neuter(seg: shared_memory.SharedMemory):
+    """Close a segment; if user views still alias it, intentionally leak the
+    mapping (zero-copy safety) and disarm __del__ so it doesn't retry."""
+    try:
+        seg.close()
+    except BufferError:
+        seg._buf = None
+        seg._mmap = None
+
+
+def default_capacity(proportion: float = 0.3) -> int:
+    try:
+        import psutil
+
+        return int(psutil.virtual_memory().total * proportion)
+    except Exception:
+        return 2 * 1024**3

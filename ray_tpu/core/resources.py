@@ -1,0 +1,123 @@
+"""Resource sets and node resource accounting.
+
+Reference: src/ray/common/scheduling/resource_set.h and
+cluster_resource_data.h. Resources are name → float maps with fixed-point
+semantics (we quantize to 1e-4 like the reference's FixedPoint) so that
+fractional resources (e.g. ``num_cpus=0.5``) compose without float drift.
+
+TPU specifics: a node exposes ``TPU`` (chip count) plus, when it is part of
+a pod slice, a synthetic gang resource ``TPU-<topology>-head`` on the slice's
+first host (reference: python/ray/_private/accelerators/tpu.py:335,382) so
+that slice-wide placement groups can anchor on one host per slice.
+"""
+
+from __future__ import annotations
+
+from typing import Dict, Optional
+
+QUANTUM = 10_000  # 1e-4 resolution
+
+
+def _to_fp(value: float) -> int:
+    return round(value * QUANTUM)
+
+
+def _from_fp(value: int) -> float:
+    return value / QUANTUM
+
+
+class ResourceSet:
+    """Immutable-ish fixed-point resource map."""
+
+    __slots__ = ("_fp",)
+
+    def __init__(self, resources: Optional[Dict[str, float]] = None):
+        self._fp: Dict[str, int] = {}
+        if resources:
+            for name, value in resources.items():
+                fp = _to_fp(value)
+                if fp < 0:
+                    raise ValueError(f"negative resource {name}={value}")
+                if fp > 0:
+                    self._fp[name] = fp
+
+    @classmethod
+    def _from_fp_map(cls, fp: Dict[str, int]) -> "ResourceSet":
+        rs = cls()
+        rs._fp = {k: v for k, v in fp.items() if v > 0}
+        return rs
+
+    def to_dict(self) -> Dict[str, float]:
+        return {k: _from_fp(v) for k, v in self._fp.items()}
+
+    def get(self, name: str) -> float:
+        return _from_fp(self._fp.get(name, 0))
+
+    def is_empty(self) -> bool:
+        return not self._fp
+
+    def is_subset_of(self, other: "ResourceSet") -> bool:
+        return all(other._fp.get(k, 0) >= v for k, v in self._fp.items())
+
+    def __add__(self, other: "ResourceSet") -> "ResourceSet":
+        fp = dict(self._fp)
+        for k, v in other._fp.items():
+            fp[k] = fp.get(k, 0) + v
+        return ResourceSet._from_fp_map(fp)
+
+    def __sub__(self, other: "ResourceSet") -> "ResourceSet":
+        fp = dict(self._fp)
+        for k, v in other._fp.items():
+            nv = fp.get(k, 0) - v
+            if nv < 0:
+                raise ValueError(f"resource {k} would go negative")
+            fp[k] = nv
+        return ResourceSet._from_fp_map(fp)
+
+    def __eq__(self, other):
+        return isinstance(other, ResourceSet) and self._fp == other._fp
+
+    def __hash__(self):
+        return hash(tuple(sorted(self._fp.items())))
+
+    def __repr__(self):
+        return f"ResourceSet({self.to_dict()})"
+
+
+class NodeResources:
+    """Total + available resources of one node, with acquire/release."""
+
+    def __init__(self, total: ResourceSet):
+        self.total = total
+        self.available = total
+
+    def can_fit(self, request: ResourceSet) -> bool:
+        return request.is_subset_of(self.available)
+
+    def feasible(self, request: ResourceSet) -> bool:
+        """Could ever fit, even if currently busy."""
+        return request.is_subset_of(self.total)
+
+    def acquire(self, request: ResourceSet) -> bool:
+        if not self.can_fit(request):
+            return False
+        self.available = self.available - request
+        return True
+
+    def release(self, request: ResourceSet):
+        self.available = self.available + request
+        # Clamp against double-release bugs.
+        for k, v in self.available._fp.items():
+            cap = self.total._fp.get(k, 0)
+            if v > cap:
+                self.available._fp[k] = cap
+
+    def utilization(self) -> float:
+        """Critical-resource utilization in [0, 1] (for hybrid policy)."""
+        best = 0.0
+        for k, total in self.total._fp.items():
+            if total <= 0:
+                continue
+            used = total - self.available._fp.get(k, 0)
+            best = max(best, used / total)
+        return best

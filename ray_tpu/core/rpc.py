@@ -1,0 +1,248 @@
+"""Lightweight RPC transport.
+
+Equivalent of the reference's gRPC layer (reference: src/ray/rpc/
+grpc_server.h:85 and client wrappers): length-prefixed msgpack frames over
+TCP asyncio streams. Connections are **symmetric** — after the handshake
+either peer can issue requests — which subsumes both the request/reply RPCs
+and the long-poll pubsub pushes of the reference
+(reference: src/ray/pubsub/publisher.h:307) with a single mechanism.
+
+Every process runs one event loop in a dedicated daemon thread
+(``EventLoopThread``); synchronous callers bridge with
+``run_coroutine_threadsafe``.
+
+Wire format: 4-byte little-endian length, then msgpack map:
+  {"t": "req"|"res"|"ntf", "i": request_id, "m": method,
+   "d": payload (msgpack-native; complex values pre-pickled by callers),
+   "e": error string or None}
+"""
+
+from __future__ import annotations
+
+import asyncio
+import itertools
+import logging
+import threading
+from typing import Any, Awaitable, Callable, Dict, Optional
+
+import msgpack
+
+logger = logging.getLogger(__name__)
+
+MAX_FRAME = 1 << 31
+
+Handler = Callable[["Connection", Any], Awaitable[Any]]
+
+
+class RpcError(Exception):
+    pass
+
+
+class ConnectionLost(RpcError):
+    pass
+
+
+class Connection:
+    """One bidirectional peer connection."""
+
+    def __init__(self, reader: asyncio.StreamReader, writer: asyncio.StreamWriter,
+                 handlers: Dict[str, Handler], name: str = ""):
+        self.reader = reader
+        self.writer = writer
+        self.handlers = handlers
+        self.name = name
+        self._req_counter = itertools.count(1)
+        self._pending: Dict[int, asyncio.Future] = {}
+        self._closed = False
+        self._send_lock = asyncio.Lock()
+        self._read_task: Optional[asyncio.Task] = None
+        self.on_close: Optional[Callable[["Connection"], None]] = None
+        # Arbitrary per-connection state (e.g. registered worker id).
+        self.state: Dict[str, Any] = {}
+
+    def start(self):
+        self._read_task = asyncio.get_running_loop().create_task(self._read_loop())
+
+    async def _read_loop(self):
+        try:
+            while True:
+                head = await self.reader.readexactly(4)
+                length = int.from_bytes(head, "little")
+                if length > MAX_FRAME:
+                    raise RpcError(f"frame too large: {length}")
+                body = await self.reader.readexactly(length)
+                msg = msgpack.unpackb(body, raw=False)
+                t = msg["t"]
+                if t == "res":
+                    fut = self._pending.pop(msg["i"], None)
+                    if fut is not None and not fut.done():
+                        if msg.get("e"):
+                            fut.set_exception(RpcError(msg["e"]))
+                        else:
+                            fut.set_result(msg.get("d"))
+                elif t in ("req", "ntf"):
+                    asyncio.get_running_loop().create_task(
+                        self._dispatch(t, msg)
+                    )
+        except (asyncio.IncompleteReadError, ConnectionResetError, OSError):
+            pass
+        except asyncio.CancelledError:
+            raise
+        except Exception:
+            logger.exception("rpc read loop error on %s", self.name)
+        finally:
+            await self._teardown()
+
+    async def _dispatch(self, t: str, msg: dict):
+        method = msg.get("m")
+        handler = self.handlers.get(method)
+        error = None
+        result = None
+        if handler is None:
+            error = f"no handler for method {method!r}"
+        else:
+            try:
+                result = await handler(self, msg.get("d"))
+            except Exception as e:
+                logger.exception("handler %s failed", method)
+                error = f"{type(e).__name__}: {e}"
+        if t == "req":
+            await self._send({"t": "res", "i": msg["i"], "d": result, "e": error})
+
+    async def _send(self, msg: dict):
+        data = msgpack.packb(msg, use_bin_type=True)
+        async with self._send_lock:
+            if self._closed:
+                raise ConnectionLost(self.name)
+            self.writer.write(len(data).to_bytes(4, "little"))
+            self.writer.write(data)
+            await self.writer.drain()
+
+    async def call(self, method: str, payload: Any = None,
+                   timeout: Optional[float] = None) -> Any:
+        req_id = next(self._req_counter)
+        fut = asyncio.get_running_loop().create_future()
+        self._pending[req_id] = fut
+        try:
+            await self._send({"t": "req", "i": req_id, "m": method, "d": payload})
+        except Exception:
+            self._pending.pop(req_id, None)
+            raise
+        if timeout is not None:
+            return await asyncio.wait_for(fut, timeout)
+        return await fut
+
+    async def notify(self, method: str, payload: Any = None):
+        await self._send({"t": "ntf", "i": 0, "m": method, "d": payload})
+
+    async def _teardown(self):
+        if self._closed:
+            return
+        self._closed = True
+        for fut in self._pending.values():
+            if not fut.done():
+                fut.set_exception(ConnectionLost(self.name))
+        self._pending.clear()
+        try:
+            self.writer.close()
+        except Exception:
+            pass
+        if self.on_close:
+            try:
+                self.on_close(self)
+            except Exception:
+                logger.exception("on_close callback failed")
+
+    async def close(self):
+        if self._read_task:
+            self._read_task.cancel()
+        await self._teardown()
+
+    @property
+    def closed(self) -> bool:
+        return self._closed
+
+
+class Server:
+    """Accepts connections; each gets the shared handler table."""
+
+    def __init__(self, handlers: Dict[str, Handler], name: str = "server"):
+        self.handlers = handlers
+        self.name = name
+        self._server: Optional[asyncio.AbstractServer] = None
+        self.connections: list[Connection] = []
+        self.on_connect: Optional[Callable[[Connection], None]] = None
+
+    async def start(self, host: str = "127.0.0.1", port: int = 0) -> int:
+        self._server = await asyncio.start_server(self._on_client, host, port)
+        return self._server.sockets[0].getsockname()[1]
+
+    async def _on_client(self, reader, writer):
+        conn = Connection(reader, writer, self.handlers, name=f"{self.name}-peer")
+        self.connections.append(conn)
+        conn.on_close = lambda c: (
+            self.connections.remove(c) if c in self.connections else None
+        )
+        conn.start()
+        if self.on_connect:
+            self.on_connect(conn)
+
+    async def stop(self):
+        if self._server:
+            self._server.close()
+            await self._server.wait_closed()
+        for conn in list(self.connections):
+            await conn.close()
+
+
+async def connect(host: str, port: int, handlers: Optional[Dict[str, Handler]] = None,
+                  name: str = "client", timeout: float = 10.0) -> Connection:
+    reader, writer = await asyncio.wait_for(
+        asyncio.open_connection(host, port), timeout
+    )
+    conn = Connection(reader, writer, handlers or {}, name=name)
+    conn.start()
+    return conn
+
+
+class EventLoopThread:
+    """A dedicated thread running an asyncio loop, shared per process."""
+
+    def __init__(self, name: str = "ray-tpu-io"):
+        self.loop = asyncio.new_event_loop()
+        self._thread = threading.Thread(
+            target=self._run, name=name, daemon=True
+        )
+        self._started = threading.Event()
+        self._thread.start()
+        self._started.wait()
+
+    def _run(self):
+        asyncio.set_event_loop(self.loop)
+        self.loop.call_soon(self._started.set)
+        self.loop.run_forever()
+
+    def run(self, coro, timeout: Optional[float] = None):
+        """Run a coroutine on the loop from a foreign thread, blocking."""
+        fut = asyncio.run_coroutine_threadsafe(coro, self.loop)
+        return fut.result(timeout)
+
+    def submit(self, coro):
+        """Schedule without waiting; returns concurrent.futures.Future."""
+        return asyncio.run_coroutine_threadsafe(coro, self.loop)
+
+    def stop(self):
+        async def _cancel_all():
+            tasks = [
+                t for t in asyncio.all_tasks(self.loop)
+                if t is not asyncio.current_task()
+            ]
+            for t in tasks:
+                t.cancel()
+
+        try:
+            self.run(_cancel_all(), timeout=2)
+        except Exception:
+            pass
+        self.loop.call_soon_threadsafe(self.loop.stop)
+        self._thread.join(timeout=5)

@@ -1,0 +1,424 @@
+"""Cluster scheduler, scheduling policies, and the worker pool.
+
+Reference mapping:
+- ``ClusterScheduler`` ≈ ClusterTaskManager + ClusterResourceScheduler
+  (reference: src/ray/raylet/scheduling/cluster_task_manager.h:42): queue a
+  lease request → pick a node by policy → dispatch to that node's worker
+  pool → grant the lease; infeasible requests park until resources appear.
+- Policies ≈ src/ray/raylet/scheduling/policy/ — hybrid (default), spread,
+  node-affinity, placement-group bundle packing.
+- ``WorkerPool`` ≈ src/ray/raylet/worker_pool.h:156 — spawns/pools worker
+  processes, prestarts idle workers, hands leased workers out.
+
+In this single-host runtime the head process owns every virtual node's pool;
+the node abstraction (NodeID + ResourceSet + pool) is what multi-host
+deployment shards across machines.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import logging
+import os
+import subprocess
+import sys
+import time
+from dataclasses import dataclass, field
+from typing import Callable, Dict, List, Optional
+
+from ray_tpu.core.ids import NodeID, PlacementGroupID, WorkerID
+from ray_tpu.core.resources import NodeResources, ResourceSet
+from ray_tpu.core.task_spec import (
+    NodeAffinitySchedulingStrategy,
+    PlacementGroupSchedulingStrategy,
+    SpreadSchedulingStrategy,
+    TaskSpec,
+)
+
+logger = logging.getLogger(__name__)
+
+
+@dataclass
+class WorkerHandle:
+    worker_id: WorkerID
+    node_id: NodeID
+    pid: int
+    address: Optional[tuple] = None  # (host, port) once registered
+    connection: object = None  # head<->worker Connection once registered
+    state: str = "STARTING"  # STARTING | IDLE | LEASED | ACTOR | DEAD
+    lease_id: Optional[str] = None
+    started_at: float = field(default_factory=time.monotonic)
+
+
+@dataclass
+class PendingLease:
+    spec: TaskSpec
+    resources: ResourceSet
+    future: asyncio.Future  # resolves to WorkerHandle
+    is_actor_creation: bool = False
+    queued_at: float = field(default_factory=time.monotonic)
+
+
+@dataclass
+class BundleState:
+    resources: ResourceSet
+    node_id: NodeID
+    # Available portion of the reservation (tasks in the PG consume this).
+    available: ResourceSet = None
+
+    def __post_init__(self):
+        if self.available is None:
+            self.available = self.resources
+
+
+class Node:
+    def __init__(self, node_id: NodeID, resources: ResourceSet,
+                 labels: Optional[Dict[str, str]] = None):
+        self.node_id = node_id
+        self.resources = NodeResources(resources)
+        self.labels = labels or {}
+        self.state = "ALIVE"
+
+
+class WorkerPool:
+    """Spawns and pools worker processes for the cluster's nodes."""
+
+    def __init__(self, head_host: str, head_port: int, session_dir: str,
+                 on_worker_exit: Optional[Callable] = None):
+        self.head_host = head_host
+        self.head_port = head_port
+        self.session_dir = session_dir
+        self.workers: Dict[WorkerID, WorkerHandle] = {}
+        # node_id -> list of idle registered workers
+        self.idle: Dict[NodeID, List[WorkerHandle]] = {}
+        # Workers spawned but not yet registered.
+        self.starting: Dict[WorkerID, WorkerHandle] = {}
+        self._procs: Dict[WorkerID, subprocess.Popen] = {}
+        self.on_worker_exit = on_worker_exit
+
+    def spawn(self, node_id: NodeID, env_overrides: Optional[dict] = None
+              ) -> WorkerHandle:
+        worker_id = WorkerID.from_random()
+        env = dict(os.environ)
+        env.update(env_overrides or {})
+        env["RAY_TPU_HEAD_HOST"] = self.head_host
+        env["RAY_TPU_HEAD_PORT"] = str(self.head_port)
+        env["RAY_TPU_WORKER_ID"] = worker_id.hex()
+        env["RAY_TPU_NODE_ID"] = node_id.hex()
+        env["RAY_TPU_SESSION_DIR"] = self.session_dir
+        # Ensure the worker can import ray_tpu regardless of its cwd.
+        import ray_tpu
+
+        pkg_root = os.path.dirname(os.path.dirname(ray_tpu.__file__))
+        existing = env.get("PYTHONPATH", "")
+        if pkg_root not in existing.split(os.pathsep):
+            env["PYTHONPATH"] = (
+                pkg_root + (os.pathsep + existing if existing else "")
+            )
+        log_path = os.path.join(self.session_dir, "logs",
+                                f"worker-{worker_id.hex()[:12]}.log")
+        os.makedirs(os.path.dirname(log_path), exist_ok=True)
+        log_file = open(log_path, "ab")
+        proc = subprocess.Popen(
+            [sys.executable, "-m", "ray_tpu.core.worker_main"],
+            env=env,
+            stdout=log_file,
+            stderr=subprocess.STDOUT,
+            start_new_session=True,
+        )
+        log_file.close()
+        handle = WorkerHandle(worker_id=worker_id, node_id=node_id, pid=proc.pid)
+        self.workers[worker_id] = handle
+        self.starting[worker_id] = handle
+        self._procs[worker_id] = proc
+        return handle
+
+    def on_registered(self, worker_id: WorkerID, address: tuple, connection
+                      ) -> Optional[WorkerHandle]:
+        handle = self.starting.pop(worker_id, None)
+        if handle is None:
+            return None
+        handle.address = address
+        handle.connection = connection
+        handle.state = "IDLE"
+        self.idle.setdefault(handle.node_id, []).append(handle)
+        return handle
+
+    def pop_idle(self, node_id: NodeID) -> Optional[WorkerHandle]:
+        idle = self.idle.get(node_id) or []
+        while idle:
+            handle = idle.pop()
+            if handle.state == "IDLE":
+                return handle
+        return None
+
+    def push_idle(self, handle: WorkerHandle):
+        handle.state = "IDLE"
+        handle.lease_id = None
+        self.idle.setdefault(handle.node_id, []).append(handle)
+
+    def mark_dead(self, worker_id: WorkerID) -> Optional[WorkerHandle]:
+        handle = self.workers.pop(worker_id, None)
+        self.starting.pop(worker_id, None)
+        if handle:
+            handle.state = "DEAD"
+            idle = self.idle.get(handle.node_id)
+            if idle and handle in idle:
+                idle.remove(handle)
+        proc = self._procs.pop(worker_id, None)
+        if proc and proc.poll() is None:
+            try:
+                proc.terminate()
+            except Exception:
+                pass
+        return handle
+
+    def kill(self, worker_id: WorkerID):
+        proc = self._procs.get(worker_id)
+        if proc and proc.poll() is None:
+            try:
+                proc.kill()
+            except Exception:
+                pass
+        self.mark_dead(worker_id)
+
+    def shutdown(self):
+        for worker_id in list(self._procs):
+            self.kill(worker_id)
+
+
+class ClusterScheduler:
+    """Queues lease requests and matches them to nodes/workers."""
+
+    def __init__(self, pool: WorkerPool, spread_threshold: float = 0.5):
+        self.pool = pool
+        self.nodes: Dict[NodeID, Node] = {}
+        self.pending: List[PendingLease] = []
+        self.spread_threshold = spread_threshold
+        # Placement groups: pg_id -> list[BundleState]
+        self.pg_bundles: Dict[PlacementGroupID, List[BundleState]] = {}
+        self._spread_rr = 0  # round-robin cursor for spread policy
+        self._lease_counter = 0
+        # lease_id -> (node_id, resources, pg, bundle_index) for release
+        self.active_leases: Dict[str, tuple] = {}
+
+    # ---- node management ----
+
+    def add_node(self, node: Node):
+        self.nodes[node.node_id] = node
+
+    def remove_node(self, node_id: NodeID):
+        node = self.nodes.pop(node_id, None)
+        if node:
+            node.state = "DEAD"
+
+    # ---- placement groups ----
+
+    def try_place_bundles(self, pg_id: PlacementGroupID,
+                          bundles: List[ResourceSet], strategy: str) -> bool:
+        """Reserve bundle resources (2PC collapsed to one phase on one host).
+
+        Reference: bundle_scheduling_policy.cc (PACK/SPREAD/STRICT_*) and
+        placement_group_resource_manager.h prepare/commit.
+        """
+        alive = [n for n in self.nodes.values() if n.state == "ALIVE"]
+        if not alive:
+            return False
+        placement: List[Node] = []
+        if strategy in ("STRICT_PACK",):
+            total = ResourceSet()
+            for b in bundles:
+                total = total + b
+            candidates = [n for n in alive if n.resources.can_fit(total)]
+            if not candidates:
+                return False
+            placement = [candidates[0]] * len(bundles)
+        else:
+            # Greedy per-bundle placement. SPREAD prefers distinct nodes;
+            # STRICT_SPREAD requires them.
+            used_nodes: List[Node] = []
+            for b in bundles:
+                # Track tentative usage so multiple bundles on one node
+                # don't over-commit.
+                def fits(n: Node) -> bool:
+                    tentative = b
+                    for prev_node, prev_b in zip(placement, bundles):
+                        if prev_node is n:
+                            tentative = tentative + prev_b
+                    return n.resources.can_fit(tentative)
+
+                if strategy == "STRICT_SPREAD":
+                    cands = [n for n in alive
+                             if n not in used_nodes and fits(n)]
+                elif strategy == "SPREAD":
+                    cands = sorted(
+                        [n for n in alive if fits(n)],
+                        key=lambda n: used_nodes.count(n),
+                    )
+                else:  # PACK
+                    cands = sorted(
+                        [n for n in alive if fits(n)],
+                        key=lambda n: -used_nodes.count(n),
+                    )
+                if not cands:
+                    return False
+                placement.append(cands[0])
+                used_nodes.append(cands[0])
+        states = []
+        for node, b in zip(placement, bundles):
+            if not node.resources.acquire(b):
+                # Roll back.
+                for st in states:
+                    self.nodes[st.node_id].resources.release(st.resources)
+                return False
+            states.append(BundleState(resources=b, node_id=node.node_id))
+        self.pg_bundles[pg_id] = states
+        return True
+
+    def remove_pg(self, pg_id: PlacementGroupID):
+        states = self.pg_bundles.pop(pg_id, None)
+        if not states:
+            return
+        for st in states:
+            node = self.nodes.get(st.node_id)
+            if node and node.state == "ALIVE":
+                node.resources.release(st.resources)
+
+    # ---- lease scheduling ----
+
+    def submit(self, lease: PendingLease):
+        self.pending.append(lease)
+
+    def next_lease_id(self) -> str:
+        self._lease_counter += 1
+        return f"lease-{self._lease_counter}"
+
+    def _pick_node(self, lease: PendingLease) -> Optional[tuple]:
+        """Returns (node, pg_id, bundle_index) or None if can't fit now.
+
+        Raises ValueError for permanently infeasible requests.
+        """
+        strategy = lease.spec.scheduling_strategy
+        request = lease.resources
+        alive = [n for n in self.nodes.values() if n.state == "ALIVE"]
+
+        if isinstance(strategy, PlacementGroupSchedulingStrategy):
+            pg_id = PlacementGroupID.from_hex(strategy.placement_group_id_hex)
+            states = self.pg_bundles.get(pg_id)
+            if states is None:
+                raise ValueError(f"placement group {pg_id.hex()} not found")
+            indices = (
+                range(len(states))
+                if strategy.bundle_index < 0
+                else [strategy.bundle_index]
+            )
+            for i in indices:
+                st = states[i]
+                if request.is_subset_of(st.available):
+                    node = self.nodes.get(st.node_id)
+                    if node and node.state == "ALIVE":
+                        return (node, pg_id, i)
+            return None
+
+        if isinstance(strategy, NodeAffinitySchedulingStrategy):
+            node = self.nodes.get(NodeID.from_hex(strategy.node_id_hex))
+            if node is None or node.state != "ALIVE":
+                if strategy.soft:
+                    pass  # fall through to default policy
+                else:
+                    raise ValueError("affinity node not found")
+            elif node.resources.can_fit(request):
+                return (node, None, -1)
+            elif not strategy.soft:
+                if node.resources.feasible(request):
+                    return None
+                raise ValueError("affinity node cannot ever fit request")
+
+        feasible = [n for n in alive if n.resources.feasible(request)]
+        if not feasible:
+            raise ValueError(
+                f"request {request.to_dict()} is infeasible on all nodes"
+            )
+        fitting = [n for n in feasible if n.resources.can_fit(request)]
+        if not fitting:
+            return None
+
+        if isinstance(strategy, SpreadSchedulingStrategy):
+            self._spread_rr += 1
+            return (fitting[self._spread_rr % len(fitting)], None, -1)
+
+        # Hybrid policy (reference: hybrid_scheduling_policy.cc): prefer the
+        # first (local) node while its critical utilization is below the
+        # threshold, otherwise pick the least-utilized fitting node.
+        first = fitting[0]
+        if first.resources.utilization() < self.spread_threshold:
+            return (first, None, -1)
+        best = min(fitting, key=lambda n: n.resources.utilization())
+        return (best, None, -1)
+
+    def pump(self) -> List[tuple]:
+        """Try to grant pending leases.
+
+        Returns a list of (lease, node, pg_id, bundle_index, idle_worker)
+        grants; idle_worker may be None, in which case the caller must spawn
+        a worker on that node and complete the grant on registration.
+        """
+        grants = []
+        remaining = []
+        for lease in self.pending:
+            if lease.future.done():
+                continue  # cancelled
+            try:
+                picked = self._pick_node(lease)
+            except ValueError as e:
+                lease.future.set_exception(e)
+                continue
+            if picked is None:
+                remaining.append(lease)
+                continue
+            node, pg_id, bundle_index = picked
+            if pg_id is not None:
+                st = self.pg_bundles[pg_id][bundle_index]
+                st.available = st.available - lease.resources
+            else:
+                node.resources.acquire(lease.resources)
+            idle_worker = self.pool.pop_idle(node.node_id)
+            grants.append((lease, node, pg_id, bundle_index, idle_worker))
+        self.pending = remaining
+        return grants
+
+    def record_lease(self, lease_id: str, node_id: NodeID,
+                     resources: ResourceSet, pg_id, bundle_index: int):
+        self.active_leases[lease_id] = (node_id, resources, pg_id, bundle_index)
+
+    def release_lease(self, lease_id: str):
+        entry = self.active_leases.pop(lease_id, None)
+        if entry is None:
+            return
+        node_id, resources, pg_id, bundle_index = entry
+        if pg_id is not None:
+            states = self.pg_bundles.get(pg_id)
+            if states is not None:
+                states[bundle_index].available = (
+                    states[bundle_index].available + resources
+                )
+            return
+        node = self.nodes.get(node_id)
+        if node and node.state == "ALIVE":
+            node.resources.release(resources)
+
+    # ---- introspection ----
+
+    def cluster_resources(self) -> Dict[str, float]:
+        total = ResourceSet()
+        for n in self.nodes.values():
+            if n.state == "ALIVE":
+                total = total + n.resources.total
+        return total.to_dict()
+
+    def available_resources(self) -> Dict[str, float]:
+        avail = ResourceSet()
+        for n in self.nodes.values():
+            if n.state == "ALIVE":
+                avail = avail + n.resources.available
+        return avail.to_dict()

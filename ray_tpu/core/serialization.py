@@ -1,0 +1,154 @@
+"""Object serialization.
+
+Mirrors the reference's two-part envelope (reference:
+python/ray/_private/serialization.py:110 SerializationContext — msgpack
+metadata + pickle5 with out-of-band buffers at :415,433): a value is
+serialized to a small inband pickle stream plus a list of out-of-band
+buffers (numpy / jax host arrays contribute their backing memory directly,
+zero-copy).  The buffers can be placed in shared memory and mapped back
+without a copy on the consumer side.
+
+Error objects are tagged in metadata so that ``get`` re-raises them.
+"""
+
+from __future__ import annotations
+
+import pickle
+import traceback
+from dataclasses import dataclass, field
+from typing import Any, List, Sequence
+
+import cloudpickle
+
+from ray_tpu import exceptions as exc
+
+# Metadata tags.
+NORMAL = b"N"
+ERROR = b"E"
+ACTOR_HANDLE = b"A"
+
+
+@dataclass
+class SerializedObject:
+    metadata: bytes  # 1-byte tag
+    inband: bytes  # pickle stream (references out-of-band buffers)
+    buffers: List[Any] = field(default_factory=list)  # buffer-protocol objects
+
+    def total_size(self) -> int:
+        return len(self.inband) + sum(
+            memoryview(b).nbytes for b in self.buffers
+        )
+
+
+def _to_host(value):
+    """Convert jax.Array leaves to numpy so their memory is host-addressable.
+
+    jax.Array does not expose the buffer protocol; device arrays must round
+    trip through host memory to enter the object store (the ICI path for
+    device-to-device transfer lives in the collective layer, not here).
+    """
+    try:
+        import jax
+    except ImportError:
+        return value
+    if isinstance(value, jax.Array):
+        import numpy as np
+
+        return np.asarray(value)
+    return value
+
+
+class _OutOfBandPickler(cloudpickle.CloudPickler):
+    """Cloudpickle with protocol-5 buffer_callback and jax.Array reduction."""
+
+
+def serialize(value: Any) -> SerializedObject:
+    buffers: List[pickle.PickleBuffer] = []
+
+    def buffer_callback(buf: pickle.PickleBuffer) -> bool:
+        buffers.append(buf)
+        return False  # out-of-band
+
+    value = _map_jax_arrays(value)
+    inband = cloudpickle.dumps(value, protocol=5, buffer_callback=buffer_callback)
+    return SerializedObject(
+        metadata=NORMAL,
+        inband=inband,
+        buffers=[b.raw() for b in buffers],
+    )
+
+
+def _map_jax_arrays(value):
+    """Shallowly convert jax arrays (incl. inside tuples/lists/dicts) to numpy.
+
+    Deep structures are handled by pickle itself calling __reduce__ on
+    jax.Array, which jax supports (it pickles via numpy); this fast path
+    avoids an extra copy for the common flat cases.
+    """
+    try:
+        import jax
+    except ImportError:
+        return value
+    if isinstance(value, jax.Array):
+        return _to_host(value)
+    if isinstance(value, tuple):
+        return tuple(_map_jax_arrays(v) for v in value)
+    if isinstance(value, list):
+        return [_map_jax_arrays(v) for v in value]
+    if isinstance(value, dict):
+        return {k: _map_jax_arrays(v) for k, v in value.items()}
+    return value
+
+
+def serialize_error(err: BaseException, task_name: str = "") -> SerializedObject:
+    if isinstance(err, exc.RayTpuError):
+        # System errors (ActorDiedError, WorkerCrashedError, cancellation,
+        # or an already-wrapped TaskError from a nested failure) surface
+        # as-is at get().
+        task_error = err
+    else:
+        task_error = exc.TaskError(
+            cause_cls_name=type(err).__name__,
+            cause_repr=repr(err),
+            traceback_str="".join(
+                traceback.format_exception(type(err), err, err.__traceback__)
+            ),
+            task_name=task_name,
+        )
+    try:
+        inband = cloudpickle.dumps(task_error, protocol=5)
+    except Exception:
+        # The original exception may not be picklable; fall back to the
+        # string form.
+        inband = cloudpickle.dumps(
+            exc.TaskError(
+                cause_cls_name=type(err).__name__,
+                cause_repr=repr(err),
+                traceback_str=task_error.traceback_str,
+                task_name=task_name,
+            ),
+            protocol=5,
+        )
+    return SerializedObject(metadata=ERROR, inband=inband, buffers=[])
+
+
+def deserialize(metadata: bytes, inband: bytes, buffers: Sequence[Any]) -> Any:
+    value = pickle.loads(inband, buffers=[pickle.PickleBuffer(b) for b in buffers])
+    if metadata == ERROR:
+        raise value
+    return value
+
+
+def deserialize_no_raise(metadata: bytes, inband: bytes, buffers: Sequence[Any]):
+    """Returns (value, is_error) without raising."""
+    value = pickle.loads(inband, buffers=[pickle.PickleBuffer(b) for b in buffers])
+    return value, metadata == ERROR
+
+
+def dumps_control(obj: Any) -> bytes:
+    """Serialize control-plane payloads (task specs, descriptors)."""
+    return cloudpickle.dumps(obj, protocol=5)
+
+
+def loads_control(data: bytes) -> Any:
+    return pickle.loads(data)

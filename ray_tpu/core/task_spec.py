@@ -1,0 +1,168 @@
+"""Task / actor specifications — the unit shipped over the wire.
+
+Reference: src/ray/common/task/task_spec.h:247 TaskSpecification and
+common.proto TaskSpec. Specs are plain dataclasses pickled with the control
+codec; argument values are pre-serialized (inline bytes for small args,
+ObjectID references for large / owned objects).
+"""
+
+from __future__ import annotations
+
+import enum
+from dataclasses import dataclass, field
+from typing import Any, Dict, List, Optional, Tuple
+
+from ray_tpu.core.ids import ActorID, JobID, NodeID, ObjectID, PlacementGroupID, TaskID
+
+
+class TaskType(enum.Enum):
+    NORMAL_TASK = 0
+    ACTOR_CREATION_TASK = 1
+    ACTOR_TASK = 2
+
+
+@dataclass
+class Address:
+    """Location of a core worker's RPC endpoint."""
+
+    host: str
+    port: int
+    worker_id_hex: str
+
+    def key(self) -> Tuple[str, int]:
+        return (self.host, self.port)
+
+
+@dataclass
+class TaskArg:
+    """Either an inline serialized value or a reference.
+
+    ``inline``: (metadata, inband, buffers) triple for pass-by-value.
+    ``object_id`` + ``owner``: pass-by-reference; the executor resolves it
+    from local stores or the owner.
+    """
+
+    inline: Optional[tuple] = None
+    object_id: Optional[ObjectID] = None
+    owner: Optional[Address] = None
+
+
+class SchedulingStrategy:
+    """Base for scheduling strategies (reference:
+    python/ray/util/scheduling_strategies.py:15,41,135)."""
+
+
+@dataclass
+class DefaultSchedulingStrategy(SchedulingStrategy):
+    pass
+
+
+@dataclass
+class SpreadSchedulingStrategy(SchedulingStrategy):
+    pass
+
+
+@dataclass
+class NodeAffinitySchedulingStrategy(SchedulingStrategy):
+    node_id_hex: str = ""
+    soft: bool = False
+
+
+@dataclass
+class PlacementGroupSchedulingStrategy(SchedulingStrategy):
+    placement_group_id_hex: str = ""
+    bundle_index: int = -1
+    capture_child_tasks: bool = False
+
+
+@dataclass
+class TaskSpec:
+    task_id: TaskID
+    job_id: JobID
+    task_type: TaskType
+    name: str
+    # Key of the exported function/class in the function table (GCS KV).
+    function_key: str
+    args: List[TaskArg]
+    num_returns: int
+    resources: Dict[str, float]
+    owner: Address
+    max_retries: int = 0
+    retry_exceptions: bool = False
+    scheduling_strategy: SchedulingStrategy = field(
+        default_factory=DefaultSchedulingStrategy
+    )
+    runtime_env: Optional[dict] = None
+    # Actor fields.
+    actor_id: Optional[ActorID] = None
+    method_name: str = ""
+    seqno: int = -1  # actor-call ordering (reference:
+    # sequential_actor_submit_queue.cc)
+    concurrency_group: str = ""
+    # Actor-creation fields.
+    max_restarts: int = 0
+    max_task_retries: int = 0
+    max_concurrency: int = 1
+    is_async_actor: bool = False
+    actor_name: str = ""  # named actor registration
+    namespace: str = ""
+
+    def scheduling_key(self) -> tuple:
+        """Groups tasks that can share a leased worker (reference:
+        direct_task_transport.h:53 SchedulingKey = fn × resource shape ×
+        runtime-env hash)."""
+        return (
+            self.function_key,
+            tuple(sorted(self.resources.items())),
+            repr(self.scheduling_strategy),
+            repr(sorted((self.runtime_env or {}).items())),
+        )
+
+    def return_object_ids(self) -> List[ObjectID]:
+        return [
+            ObjectID.for_task_return(self.task_id, i + 1)
+            for i in range(self.num_returns)
+        ]
+
+
+@dataclass
+class ActorInfo:
+    """Actor-table row (reference: gcs.proto ActorTableData)."""
+
+    actor_id: ActorID
+    job_id: JobID
+    state: str  # PENDING | ALIVE | RESTARTING | DEAD
+    address: Optional[Address] = None
+    node_id: Optional[NodeID] = None
+    name: str = ""
+    namespace: str = ""
+    num_restarts: int = 0
+    max_restarts: int = 0
+    death_cause: str = ""
+    creation_spec: Optional[TaskSpec] = None
+
+
+@dataclass
+class NodeInfo:
+    """Node-table row (reference: gcs.proto GcsNodeInfo)."""
+
+    node_id: NodeID
+    address: str
+    resources: Dict[str, float]
+    labels: Dict[str, str] = field(default_factory=dict)
+    state: str = "ALIVE"  # ALIVE | DEAD
+
+
+@dataclass
+class Bundle:
+    resources: Dict[str, float]
+    node_id: Optional[NodeID] = None  # assigned node after placement
+
+
+@dataclass
+class PlacementGroupInfo:
+    pg_id: PlacementGroupID
+    bundles: List[Bundle]
+    strategy: str  # PACK | SPREAD | STRICT_PACK | STRICT_SPREAD
+    state: str = "PENDING"  # PENDING | CREATED | REMOVED
+    name: str = ""

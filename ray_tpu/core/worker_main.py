@@ -1,0 +1,415 @@
+"""Worker process entry point + task executor.
+
+Reference: python/ray/_private/workers/default_worker.py:282 (main loop) and
+the Cython execute-task callback (_raylet.pyx:2009). A worker process:
+
+1. connects to the head over the RPC transport and registers itself,
+2. serves ``push_task`` / ``create_actor`` / ``cancel_task`` on its own
+   server (direct calls from owners — the "direct task/actor transport"),
+3. executes tasks on an executor (single thread for normal tasks; a thread
+   pool for threaded actors with ``max_concurrency``; the event loop for
+   async actors),
+4. delivers small returns inline in the push reply and seals large returns
+   into the node's shared-memory store,
+5. exits when the head connection drops or on ``exit_worker``.
+
+Actor call ordering: calls are executed in arrival order per caller
+connection (reference: actor_scheduling_queue.cc seqno ordering) — the
+transport preserves submission order on one TCP stream, and the executor
+consumes its queue in FIFO order.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import ctypes
+import logging
+import os
+import sys
+import threading
+import traceback
+from typing import Optional
+
+from ray_tpu import exceptions as exc
+from ray_tpu.core import rpc, serialization
+from ray_tpu.core.config import get_config
+from ray_tpu.core.core_worker import CoreWorker, HeadClient
+from ray_tpu.core.ids import JobID, ObjectID, TaskID, WorkerID
+from ray_tpu.core.object_ref import ObjectRef, set_core_worker
+from ray_tpu.core.serialization import SerializedObject
+from ray_tpu.core.task_spec import TaskSpec, TaskType
+
+logger = logging.getLogger(__name__)
+
+
+from ray_tpu.exceptions import ActorExitSignal  # noqa: E402 — see exceptions.py
+
+
+class Executor:
+    """Runs tasks for this worker process."""
+
+    def __init__(self, cw: CoreWorker):
+        self.cw = cw
+        self.actor_instance = None
+        self.actor_spec: Optional[TaskSpec] = None
+        self._queue: "asyncio.Queue" = asyncio.Queue()
+        self._consumers: list = []
+        self._started = False
+        self._max_concurrency = 1
+        self._is_async = False
+        # task hex -> executing thread ident (for cancellation)
+        self._running_threads = {}
+        self._cancelled_tasks = set()
+
+    def reconfigure(self, max_concurrency: int, is_async: bool):
+        """Restart consumers with new settings (safe only while no task is
+        in flight — i.e. right before an actor creation on a pooled worker
+        that previously ran normal tasks)."""
+        for t in self._consumers:
+            t.cancel()
+        self._consumers = []
+        self._started = False
+        self.ensure_started(max_concurrency, is_async)
+
+    def ensure_started(self, max_concurrency: int = 1, is_async: bool = False):
+        if self._started:
+            return
+        self._started = True
+        self._max_concurrency = max(1, max_concurrency)
+        self._is_async = is_async
+        n = self._max_concurrency if not is_async else 1
+        for _ in range(n):
+            self._consumers.append(
+                asyncio.get_running_loop().create_task(self._consume())
+            )
+
+    async def _consume(self):
+        loop = asyncio.get_running_loop()
+        sem = asyncio.Semaphore(self._max_concurrency)
+        while True:
+            spec, fut = await self._queue.get()
+            if self._is_async:
+                await sem.acquire()
+
+                async def run_async(spec=spec, fut=fut):
+                    try:
+                        result = await self._execute_async(spec)
+                        if not fut.done():
+                            fut.set_result(result)
+                    except Exception as e:
+                        if not fut.done():
+                            fut.set_exception(e)
+                    finally:
+                        sem.release()
+
+                loop.create_task(run_async())
+            else:
+                try:
+                    result = await loop.run_in_executor(
+                        None, self._execute_sync, spec
+                    )
+                    if not fut.done():
+                        fut.set_result(result)
+                except BaseException as e:  # incl. ActorExitSignal
+                    if not fut.done():
+                        fut.set_exception(e)
+
+    async def submit(self, spec: TaskSpec) -> dict:
+        fut = asyncio.get_running_loop().create_future()
+        await self._queue.put((spec, fut))
+        return await fut
+
+    # ---- execution paths ----
+
+    def _resolve_args(self, spec: TaskSpec):
+        flat = []
+        for arg in spec.args:
+            if arg.inline is not None:
+                metadata, inband, buffers = arg.inline
+                flat.append(
+                    serialization.deserialize(metadata, inband, buffers)
+                )
+            else:
+                # Normal construction so the ref's destruction sends the
+                # remove_ref matching the submitter's borrow registration.
+                ref = ObjectRef(arg.object_id, arg.owner)
+                flat.append(self.cw.get([ref])[0])
+        kwargs = flat[-1] if flat else {}
+        args = flat[:-1]
+        return args, kwargs
+
+    def _load_callable(self, spec: TaskSpec):
+        return self.cw.loop_thread.run(
+            self.cw.fetch_function(spec.function_key)
+        )
+
+    def _execute_sync(self, spec: TaskSpec) -> dict:
+        tid = spec.task_id
+        self.cw.set_current_task_id(tid)
+        self._running_threads[tid.hex()] = threading.get_ident()
+        try:
+            if tid.hex() in self._cancelled_tasks:
+                raise exc.TaskCancelledError(f"task {spec.name} cancelled")
+            args, kwargs = self._resolve_args(spec)
+            if spec.task_type == TaskType.NORMAL_TASK:
+                fn = self._load_callable(spec)
+                value = fn(*args, **kwargs)
+            elif spec.task_type == TaskType.ACTOR_CREATION_TASK:
+                cls = self._load_callable(spec)
+                self.actor_instance = cls(*args, **kwargs)
+                self.actor_spec = spec
+                value = None
+            else:  # ACTOR_TASK
+                if self.actor_instance is None:
+                    raise exc.ActorDiedError(
+                        spec.actor_id.hex() if spec.actor_id else "",
+                        "actor instance missing",
+                    )
+                method = getattr(self.actor_instance, spec.method_name)
+                value = method(*args, **kwargs)
+            return self._package_returns(spec, value)
+        except ActorExitSignal:
+            raise
+        except exc.TaskCancelledError as e:
+            return self._package_error(spec, e)
+        except BaseException as e:  # noqa: B036 — tasks isolate all failures
+            if isinstance(e, (KeyboardInterrupt, SystemExit)):
+                raise
+            return self._package_error(spec, e)
+        finally:
+            self._running_threads.pop(tid.hex(), None)
+            self._cancelled_tasks.discard(tid.hex())
+            self.cw.set_current_task_id(None)
+
+    async def _execute_async(self, spec: TaskSpec) -> dict:
+        """Async-actor path: methods may be coroutines."""
+        self.cw.set_current_task_id(spec.task_id)
+        try:
+            args, kwargs = await asyncio.get_running_loop().run_in_executor(
+                None, lambda: self._resolve_args(spec)
+            )
+            if spec.task_type == TaskType.ACTOR_CREATION_TASK:
+                # NB: must await (not _load_callable) — blocking the loop
+                # here would deadlock the worker.
+                cls = await self.cw.fetch_function(spec.function_key)
+                self.actor_instance = cls(*args, **kwargs)
+                self.actor_spec = spec
+                value = None
+            else:
+                method = getattr(self.actor_instance, spec.method_name)
+                value = method(*args, **kwargs)
+                if asyncio.iscoroutine(value):
+                    value = await value
+            return self._package_returns(spec, value)
+        except BaseException as e:  # noqa: B036
+            if isinstance(e, (KeyboardInterrupt, SystemExit, ActorExitSignal)):
+                raise
+            return self._package_error(spec, e)
+        finally:
+            self.cw.set_current_task_id(None)
+
+    # ---- return packaging ----
+
+    def _package_returns(self, spec: TaskSpec, value) -> dict:
+        n = spec.num_returns
+        returns = []
+        if n == 0:
+            values = []
+        elif n == 1:
+            values = [value]
+        else:
+            if not isinstance(value, (tuple, list)) or len(value) != n:
+                raise ValueError(
+                    f"task {spec.name} declared num_returns={n} but returned "
+                    f"{type(value).__name__}"
+                )
+            values = list(value)
+        for i, v in enumerate(values):
+            object_id = ObjectID.for_task_return(spec.task_id, i + 1)
+            obj = serialization.serialize(v)
+            returns.append(self._store_return(object_id, obj))
+        return {"returns": returns, "is_error": False}
+
+    def _package_error(self, spec: TaskSpec, error: BaseException) -> dict:
+        logger.info("task %s failed: %r", spec.name, error)
+        obj = serialization.serialize_error(error, task_name=spec.name)
+        returns = []
+        for object_id in spec.return_object_ids():
+            returns.append(self._store_return(object_id, obj))
+        return {"returns": returns, "is_error": True}
+
+    def _store_return(self, object_id: ObjectID, obj: SerializedObject) -> dict:
+        if obj.total_size() > self.cw.config.max_direct_call_object_size:
+            size = self.cw._seal_to_shm(object_id, obj)
+            self.cw.loop_thread.submit(
+                self.cw.head.call(
+                    "object_sealed",
+                    {"object_id": object_id.hex(), "size": size},
+                )
+            )
+            return {"object_id": object_id.binary(), "in_plasma": True}
+        return {
+            "object_id": object_id.binary(),
+            "in_plasma": False,
+            "metadata": obj.metadata,
+            "inband": obj.inband,
+            "buffers": [bytes(memoryview(b)) for b in obj.buffers],
+        }
+
+    # ---- cancellation ----
+
+    def cancel(self, task_id_hex: str, force: bool):
+        self._cancelled_tasks.add(task_id_hex)
+        ident = self._running_threads.get(task_id_hex)
+        if ident is not None:
+            # Inject TaskCancelledError into the executing thread
+            # (reference: worker interrupt on CancelTask RPC).
+            ctypes.pythonapi.PyThreadState_SetAsyncExc(
+                ctypes.c_ulong(ident),
+                ctypes.py_object(exc.TaskCancelledError),
+            )
+
+
+async def _amain():
+    config = get_config()
+    head_host = os.environ["RAY_TPU_HEAD_HOST"]
+    head_port = int(os.environ["RAY_TPU_HEAD_PORT"])
+    worker_id = WorkerID.from_hex(os.environ["RAY_TPU_WORKER_ID"])
+
+    from ray_tpu.core.rpc import EventLoopThread
+
+    # The running loop belongs to this main coroutine; CoreWorker needs a
+    # loop_thread facade over it.
+    class _LoopFacade:
+        def __init__(self, loop):
+            self.loop = loop
+
+        def run(self, coro, timeout=None):
+            # Called from executor threads only (never from the loop itself).
+            fut = asyncio.run_coroutine_threadsafe(coro, self.loop)
+            return fut.result(timeout)
+
+        def submit(self, coro):
+            return asyncio.run_coroutine_threadsafe(coro, self.loop)
+
+    loop = asyncio.get_running_loop()
+    loop_thread = _LoopFacade(loop)
+
+    # Job id is discovered from the first task spec; start with a nil-ish job.
+    cw = CoreWorker(
+        config=config,
+        loop_thread=loop_thread,
+        head=None,  # set after connect
+        job_id=JobID.from_int(0),
+        worker_id=worker_id,
+        mode="worker",
+    )
+    executor = Executor(cw)
+    cw.executor = executor
+    set_core_worker(cw)
+
+    exit_event = asyncio.Event()
+
+    async def h_push_task(conn, payload):
+        spec: TaskSpec = serialization.loads_control(payload["spec"])
+        # Actor executors are configured by create_actor (reconfigure);
+        # this covers plain tasks on a fresh worker.
+        executor.ensure_started()
+        try:
+            return await executor.submit(spec)
+        except ActorExitSignal:
+            out = {"returns": [], "is_error": False}
+            asyncio.get_running_loop().create_task(_graceful_actor_exit())
+            return out
+
+    async def h_create_actor(conn, payload):
+        spec: TaskSpec = serialization.loads_control(payload["spec"])
+        cw.job_id = spec.job_id
+        executor.reconfigure(
+            max_concurrency=spec.max_concurrency,
+            is_async=spec.is_async_actor,
+        )
+        try:
+            result = await executor.submit(spec)
+        except BaseException as e:
+            return {"ok": False, "error": f"{type(e).__name__}: {e}"}
+        if result.get("is_error"):
+            # Surface the traceback as the death cause.
+            ret = result["returns"][0] if result["returns"] else None
+            detail = ""
+            if ret is not None and not ret.get("in_plasma"):
+                try:
+                    err = serialization.deserialize_no_raise(
+                        ret["metadata"], ret["inband"], ret.get("buffers", [])
+                    )[0]
+                    detail = str(err)
+                except Exception:
+                    detail = "actor __init__ failed"
+            return {"ok": False, "error": detail}
+        return {"ok": True}
+
+    async def _graceful_actor_exit():
+        if executor.actor_spec is not None:
+            try:
+                await head_conn.call("actor_exited", {
+                    "actor_id": executor.actor_spec.actor_id.hex(),
+                })
+            except Exception:
+                pass
+        exit_event.set()
+
+    async def h_cancel_task(conn, payload):
+        executor.cancel(payload["task_id"], payload.get("force", False))
+        return {"ok": True}
+
+    async def h_exit_worker(conn, payload):
+        exit_event.set()
+        return {"ok": True}
+
+    port = await cw.start_server(extra_handlers={
+        "push_task": h_push_task,
+        "create_actor": h_create_actor,
+        "cancel_task": h_cancel_task,
+        "exit_worker": h_exit_worker,
+    })
+
+    head_conn = await rpc.connect(
+        head_host, head_port, {
+            **cw.handlers(),
+            "create_actor": h_create_actor,
+            "exit_worker": h_exit_worker,
+        },
+        name="worker-head",
+    )
+    cw.head = HeadClient(conn=head_conn)
+    head_conn.on_close = lambda c: exit_event.set()
+
+    reply = await head_conn.call("register_worker", {
+        "worker_id": worker_id.hex(),
+        "host": "127.0.0.1",
+        "port": port,
+        "pid": os.getpid(),
+    })
+    if not reply.get("ok"):
+        logger.error("worker registration rejected: %s", reply)
+        return 1
+
+    await exit_event.wait()
+    return 0
+
+
+def main():
+    logging.basicConfig(
+        level=logging.INFO,
+        format="%(asctime)s %(levelname)s worker %(name)s: %(message)s",
+    )
+    try:
+        code = asyncio.run(_amain())
+    except KeyboardInterrupt:
+        code = 0
+    # Skip interpreter teardown races from executor threads.
+    os._exit(code or 0)
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,95 @@
+"""Exception hierarchy (reference: python/ray/exceptions.py)."""
+
+from __future__ import annotations
+
+
+class RayTpuError(Exception):
+    """Base class for all framework errors."""
+
+
+class TaskError(RayTpuError):
+    """A task raised an exception during execution.
+
+    Re-raised at ``get`` on the caller with the remote traceback attached
+    (reference: RayTaskError in python/ray/exceptions.py).
+    """
+
+    def __init__(self, cause_cls_name: str, cause_repr: str, traceback_str: str,
+                 task_name: str = ""):
+        self.cause_cls_name = cause_cls_name
+        self.cause_repr = cause_repr
+        self.traceback_str = traceback_str
+        self.task_name = task_name
+        super().__init__(
+            f"Task '{task_name}' failed with {cause_cls_name}: {cause_repr}\n"
+            f"{traceback_str}"
+        )
+
+    def __reduce__(self):
+        return (TaskError, (self.cause_cls_name, self.cause_repr,
+                            self.traceback_str, self.task_name))
+
+
+class WorkerCrashedError(RayTpuError):
+    """The worker process executing the task died unexpectedly."""
+
+
+class ActorDiedError(RayTpuError):
+    """The actor owning the called method is dead."""
+
+    def __init__(self, actor_id_hex: str = "", reason: str = ""):
+        self.actor_id_hex = actor_id_hex
+        self.reason = reason
+        super().__init__(f"Actor {actor_id_hex} is dead: {reason}")
+
+    def __reduce__(self):
+        return (ActorDiedError, (self.actor_id_hex, self.reason))
+
+
+class ActorUnavailableError(RayTpuError):
+    """The actor is temporarily unreachable (restarting or network issue)."""
+
+
+class ObjectLostError(RayTpuError):
+    """An object is no longer reachable and could not be reconstructed."""
+
+    def __init__(self, object_id_hex: str = ""):
+        self.object_id_hex = object_id_hex
+        super().__init__(f"Object {object_id_hex} was lost and could not be "
+                         "reconstructed from lineage")
+
+    def __reduce__(self):
+        return (ObjectLostError, (self.object_id_hex,))
+
+
+class ObjectStoreFullError(RayTpuError):
+    """The shared-memory object store is out of memory (after spilling)."""
+
+
+class GetTimeoutError(RayTpuError, TimeoutError):
+    """``get`` exceeded its timeout."""
+
+
+class TaskCancelledError(RayTpuError):
+    """The task was cancelled before/while running."""
+
+
+class RuntimeEnvSetupError(RayTpuError):
+    """Failed to set up the runtime environment for a task/actor."""
+
+
+class NodeDiedError(RayTpuError):
+    """A node (scheduler daemon) died."""
+
+
+class PendingCallsLimitExceeded(RayTpuError):
+    """Back-pressure limit on an actor's pending calls was exceeded."""
+
+
+class ActorExitSignal(BaseException):
+    """Raised by user code (via api.actor_exit) for graceful actor exit.
+
+    BaseException (not RayTpuError) so ordinary `except Exception` blocks in
+    user code don't swallow it. Defined here — not in worker_main — because
+    the worker runs as __main__ and would otherwise see two distinct classes.
+    """

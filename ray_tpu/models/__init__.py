@@ -1,0 +1,4 @@
+from ray_tpu.models.llama import Llama, LlamaConfig
+from ray_tpu.models.mlp import MLP
+
+__all__ = ["Llama", "LlamaConfig", "MLP"]

@@ -1,0 +1,304 @@
+"""Llama-family transformer (flagship model), TPU-first.
+
+Design notes (per BASELINE.json north star — Llama-2-7B GSPMD FSDP):
+- bfloat16 activations/params by default; fp32 RMSNorm statistics and
+  softmax (MXU-friendly, VPU for the rest).
+- GQA attention through ``ray_tpu.ops.attention`` (Pallas flash kernel on
+  TPU) or a sequence-parallel callable (ring/Ulysses from
+  ``ray_tpu.parallel.ring_attention``).
+- every parameter annotated with logical axes via
+  ``nn.with_logical_partitioning`` so dp/fsdp/tp/sp/ep are rule-table
+  swaps (see ray_tpu/parallel/sharding.py LOGICAL_RULES).
+- optional layer scan + remat (`config.scan_layers`,
+  `config.remat`) to trade FLOPs for HBM.
+- optional MoE MLP with top-k routing on an "expert" logical axis.
+
+The reference framework contains no model zoo for LLMs (RLlib models are
+RL policy nets); this is the TPU-native flagship required by the survey's
+build plan §7.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Any, Callable, Optional
+
+import flax.linen as nn
+import jax
+import jax.numpy as jnp
+
+from ray_tpu.ops.attention import attention as default_attention
+
+
+@dataclasses.dataclass(frozen=True)
+class LlamaConfig:
+    vocab_size: int = 32000
+    hidden_size: int = 4096
+    intermediate_size: int = 11008
+    num_layers: int = 32
+    num_heads: int = 32
+    num_kv_heads: int = 32
+    head_dim: Optional[int] = None  # default hidden_size // num_heads
+    rope_theta: float = 10000.0
+    rms_norm_eps: float = 1e-5
+    max_seq_len: int = 4096
+    dtype: Any = jnp.bfloat16
+    param_dtype: Any = jnp.float32
+    scan_layers: bool = True
+    remat: bool = True
+    # MoE (0 experts = dense MLP)
+    num_experts: int = 0
+    num_experts_per_token: int = 2
+    # attention implementation: "auto" | "flash" | "xla"
+    attention_impl: str = "auto"
+
+    @property
+    def resolved_head_dim(self) -> int:
+        return self.head_dim or self.hidden_size // self.num_heads
+
+    @staticmethod
+    def tiny(**overrides) -> "LlamaConfig":
+        base = dict(
+            vocab_size=512, hidden_size=128, intermediate_size=256,
+            num_layers=2, num_heads=4, num_kv_heads=2, max_seq_len=256,
+            scan_layers=False, remat=False,
+        )
+        base.update(overrides)
+        return LlamaConfig(**base)
+
+    @staticmethod
+    def llama2_7b(**overrides) -> "LlamaConfig":
+        base = dict(
+            vocab_size=32000, hidden_size=4096, intermediate_size=11008,
+            num_layers=32, num_heads=32, num_kv_heads=32, max_seq_len=4096,
+        )
+        base.update(overrides)
+        return LlamaConfig(**base)
+
+    def num_params(self) -> int:
+        h, f, v = self.hidden_size, self.intermediate_size, self.vocab_size
+        dh = self.resolved_head_dim
+        attn = h * (self.num_heads * dh) * 2 + h * (self.num_kv_heads * dh) * 2
+        if self.num_experts > 0:
+            mlp = 3 * h * f * self.num_experts + h * self.num_experts
+        else:
+            mlp = 3 * h * f
+        per_layer = attn + mlp + 2 * h
+        return self.num_layers * per_layer + 2 * v * h + h
+
+
+class RMSNorm(nn.Module):
+    eps: float = 1e-5
+    dtype: Any = jnp.bfloat16
+
+    @nn.compact
+    def __call__(self, x):
+        scale = self.param(
+            "scale",
+            nn.with_logical_partitioning(nn.initializers.ones, ("norm",)),
+            (x.shape[-1],),
+            jnp.float32,
+        )
+        x32 = x.astype(jnp.float32)
+        var = jnp.mean(x32 * x32, axis=-1, keepdims=True)
+        normed = x32 * jax.lax.rsqrt(var + self.eps)
+        return (normed * scale).astype(self.dtype)
+
+
+def _rope(x, positions, theta: float):
+    """Rotary embedding over the last dim (x: ..., seq, heads, head_dim)."""
+    d = x.shape[-1]
+    half = d // 2
+    freqs = 1.0 / (theta ** (jnp.arange(0, half, dtype=jnp.float32) / half))
+    angles = positions[..., None].astype(jnp.float32) * freqs  # (..., S, half)
+    cos = jnp.cos(angles)[..., None, :]  # broadcast over heads
+    sin = jnp.sin(angles)[..., None, :]
+    x1, x2 = x[..., :half], x[..., half:]
+    out = jnp.concatenate(
+        [x1 * cos - x2 * sin, x2 * cos + x1 * sin], axis=-1
+    )
+    return out.astype(x.dtype)
+
+
+def _dense(features, name, kernel_axes, dtype, param_dtype):
+    return nn.Dense(
+        features,
+        use_bias=False,
+        name=name,
+        dtype=dtype,
+        param_dtype=param_dtype,
+        kernel_init=nn.with_logical_partitioning(
+            nn.initializers.lecun_normal(), kernel_axes
+        ),
+    )
+
+
+class Attention(nn.Module):
+    config: LlamaConfig
+    # Injected attention callable (e.g. ring attention); None = default.
+    attention_fn: Optional[Callable] = None
+
+    @nn.compact
+    def __call__(self, x, positions):
+        cfg = self.config
+        dh = cfg.resolved_head_dim
+        wq = _dense(cfg.num_heads * dh, "wq", ("embed", "heads"),
+                    cfg.dtype, cfg.param_dtype)
+        wk = _dense(cfg.num_kv_heads * dh, "wk", ("embed", "kv_heads"),
+                    cfg.dtype, cfg.param_dtype)
+        wv = _dense(cfg.num_kv_heads * dh, "wv", ("embed", "kv_heads"),
+                    cfg.dtype, cfg.param_dtype)
+        wo = _dense(cfg.hidden_size, "wo", ("heads", "embed"),
+                    cfg.dtype, cfg.param_dtype)
+        B, S, _ = x.shape
+        q = wq(x).reshape(B, S, cfg.num_heads, dh)
+        k = wk(x).reshape(B, S, cfg.num_kv_heads, dh)
+        v = wv(x).reshape(B, S, cfg.num_kv_heads, dh)
+        q = _rope(q, positions, cfg.rope_theta)
+        k = _rope(k, positions, cfg.rope_theta)
+        if cfg.num_kv_heads != cfg.num_heads:
+            rep = cfg.num_heads // cfg.num_kv_heads
+            k = jnp.repeat(k, rep, axis=2)
+            v = jnp.repeat(v, rep, axis=2)
+        if self.attention_fn is not None:
+            out = self.attention_fn(q, k, v)
+        else:
+            out = default_attention(q, k, v, causal=True,
+                                    impl=cfg.attention_impl)
+        out = out.reshape(B, S, cfg.num_heads * dh)
+        return wo(out)
+
+
+class MLP(nn.Module):
+    config: LlamaConfig
+
+    @nn.compact
+    def __call__(self, x):
+        cfg = self.config
+        gate = _dense(cfg.intermediate_size, "gate", ("embed", "ffn"),
+                      cfg.dtype, cfg.param_dtype)
+        up = _dense(cfg.intermediate_size, "up", ("embed", "ffn"),
+                    cfg.dtype, cfg.param_dtype)
+        down = _dense(cfg.hidden_size, "down", ("ffn", "embed"),
+                      cfg.dtype, cfg.param_dtype)
+        return down(nn.silu(gate(x)) * up(x))
+
+
+class MoEMLP(nn.Module):
+    """Top-k routed mixture of experts with an expert-parallel axis.
+
+    Dispatch uses dense one-hot combines (capacity-free). Expert weights
+    carry the "expert" logical axis; with an `expert` mesh axis the einsum
+    becomes an all-to-all-free sharded computation under GSPMD.
+    """
+
+    config: LlamaConfig
+
+    @nn.compact
+    def __call__(self, x):
+        cfg = self.config
+        E, K = cfg.num_experts, cfg.num_experts_per_token
+        H, F = cfg.hidden_size, cfg.intermediate_size
+        B, S, _ = x.shape
+        router = _dense(E, "router", ("embed", None),
+                        jnp.float32, cfg.param_dtype)
+        logits = router(x.astype(jnp.float32))  # (B,S,E)
+        weights, idx = jax.lax.top_k(jax.nn.softmax(logits, axis=-1), K)
+        weights = weights / jnp.sum(weights, axis=-1, keepdims=True)
+        # one-hot combine: (B,S,K,E)
+        dispatch = jax.nn.one_hot(idx, E, dtype=cfg.dtype)
+        combine = dispatch * weights[..., None].astype(cfg.dtype)
+
+        def ew(name, shape, axes):
+            return self.param(
+                name,
+                nn.with_logical_partitioning(
+                    nn.initializers.lecun_normal(), axes
+                ),
+                shape, cfg.param_dtype,
+            ).astype(cfg.dtype)
+
+        w_gate = ew("w_gate", (E, H, F), ("expert", "embed", "expert_ffn"))
+        w_up = ew("w_up", (E, H, F), ("expert", "embed", "expert_ffn"))
+        w_down = ew("w_down", (E, F, H), ("expert", "expert_ffn", "embed"))
+        # tokens routed to experts: (E, B, S, H)
+        xin = jnp.einsum("bske,bsh->ebsh", combine, x)
+        h = nn.silu(jnp.einsum("ebsh,ehf->ebsf", xin, w_gate))
+        h = h * jnp.einsum("ebsh,ehf->ebsf", xin, w_up)
+        out = jnp.einsum("ebsf,efh->ebsh", h, w_down)
+        return jnp.einsum("ebsh,bske->bsh", out, combine).astype(cfg.dtype)
+
+
+class Block(nn.Module):
+    config: LlamaConfig
+    attention_fn: Optional[Callable] = None
+
+    @nn.compact
+    def __call__(self, x, positions):
+        cfg = self.config
+        h = x + Attention(cfg, self.attention_fn, name="attn")(
+            RMSNorm(cfg.rms_norm_eps, cfg.dtype, name="attn_norm")(x),
+            positions,
+        )
+        mlp_cls = MoEMLP if cfg.num_experts > 0 else MLP
+        out = h + mlp_cls(cfg, name="mlp")(
+            RMSNorm(cfg.rms_norm_eps, cfg.dtype, name="mlp_norm")(h)
+        )
+        return out
+
+
+class Llama(nn.Module):
+    config: LlamaConfig
+    attention_fn: Optional[Callable] = None
+
+    @nn.compact
+    def __call__(self, tokens):
+        cfg = self.config
+        B, S = tokens.shape
+        embed = self.param(
+            "embed",
+            nn.with_logical_partitioning(
+                nn.initializers.normal(stddev=0.02),
+                ("vocab_shard", "embed"),
+            ),
+            (cfg.vocab_size, cfg.hidden_size),
+            cfg.param_dtype,
+        )
+        x = embed[tokens].astype(cfg.dtype)
+        positions = jnp.arange(S)[None, :].repeat(B, axis=0)
+
+        block = Block
+        if cfg.remat:
+            block = nn.remat(
+                Block, prevent_cse=not cfg.scan_layers,
+                static_argnums=(),
+            )
+        if cfg.scan_layers:
+            x, _ = nn.scan(
+                lambda mdl, carry, _: (mdl(carry, positions), None),
+                variable_axes={"params": 0},
+                split_rngs={"params": True},
+                length=cfg.num_layers,
+                metadata_params={nn.PARTITION_NAME: "layers"},
+            )(block(cfg, self.attention_fn, name="layers"), x, None)
+        else:
+            for i in range(cfg.num_layers):
+                x = block(cfg, self.attention_fn, name=f"layer_{i}")(
+                    x, positions
+                )
+        x = RMSNorm(cfg.rms_norm_eps, cfg.dtype, name="final_norm")(x)
+        lm_head = _dense(cfg.vocab_size, "lm_head",
+                         ("embed", "vocab_shard"), cfg.dtype,
+                         cfg.param_dtype)
+        return lm_head(x)
+
+
+def cross_entropy_loss(logits, targets, ignore_index: int = -100):
+    mask = (targets != ignore_index)
+    safe_targets = jnp.where(mask, targets, 0)
+    logp = jax.nn.log_softmax(logits.astype(jnp.float32), axis=-1)
+    token_losses = -jnp.take_along_axis(
+        logp, safe_targets[..., None], axis=-1
+    ).squeeze(-1)
+    token_losses = jnp.where(mask, token_losses, 0.0)
+    return jnp.sum(token_losses) / jnp.maximum(jnp.sum(mask), 1)

@@ -1,0 +1,22 @@
+"""Small MLP for the MNIST end-to-end slice (SURVEY.md §7.2 /
+BASELINE.json config #2: "Ray Train MNIST JaxTrainer")."""
+
+from __future__ import annotations
+
+from typing import Any, Sequence
+
+import flax.linen as nn
+import jax.numpy as jnp
+
+
+class MLP(nn.Module):
+    features: Sequence[int] = (128, 64, 10)
+    dtype: Any = jnp.float32
+
+    @nn.compact
+    def __call__(self, x):
+        x = x.reshape((x.shape[0], -1)).astype(self.dtype)
+        for i, f in enumerate(self.features[:-1]):
+            x = nn.relu(nn.Dense(f, dtype=self.dtype, name=f"dense_{i}")(x))
+        return nn.Dense(self.features[-1], dtype=self.dtype,
+                        name="out")(x)

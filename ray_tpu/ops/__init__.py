@@ -1,0 +1,3 @@
+from ray_tpu.ops.attention import attention, flash_attention, reference_attention
+
+__all__ = ["attention", "flash_attention", "reference_attention"]

@@ -1,0 +1,186 @@
+"""Attention kernels.
+
+``flash_attention`` — Pallas TPU kernel with online softmax (blocked over
+query and key/value tiles, accumulator carried in VMEM scratch across the
+sequential kv grid dimension). Forward is the Pallas kernel; backward is an
+XLA recompute VJP (full backward kernel is a later optimization).
+
+The reference framework has no attention kernels at all (it defers to
+torch); this is net-new TPU-first work (SURVEY.md §5.7) and the building
+block the ring/Ulysses sequence parallelism in
+``ray_tpu/parallel/ring_attention.py`` wraps.
+
+Convention: q, k, v are (batch, seq, heads, head_dim); GQA is handled by
+the caller broadcasting kv heads.
+"""
+
+from __future__ import annotations
+
+import functools
+import math
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+
+_NEG_INF = -1e30
+
+DEFAULT_BLOCK_Q = 128
+DEFAULT_BLOCK_K = 128
+
+
+def _flash_kernel(q_ref, k_ref, v_ref, o_ref, acc_ref, m_ref, l_ref, *,
+                  sm_scale: float, causal: bool, block_q: int, block_k: int):
+    """Grid: (batch*heads, num_q_blocks, num_k_blocks); the k dimension is
+    innermost (sequential on TPU) so scratch carries across it."""
+    iq = pl.program_id(1)
+    ik = pl.program_id(2)
+    nk = pl.num_programs(2)
+
+    @pl.when(ik == 0)
+    def _init():
+        acc_ref[:] = jnp.zeros_like(acc_ref)
+        m_ref[:] = jnp.full_like(m_ref, _NEG_INF)
+        l_ref[:] = jnp.zeros_like(l_ref)
+
+    run = True
+    if causal:
+        # Skip fully-masked kv blocks (strictly above the diagonal).
+        run = ik * block_k <= (iq + 1) * block_q - 1
+
+    @pl.when(run)
+    def _body():
+        q = q_ref[0].astype(jnp.float32)  # (block_q, d)
+        k = k_ref[0].astype(jnp.float32)  # (block_k, d)
+        v = v_ref[0].astype(jnp.float32)
+        s = jax.lax.dot_general(
+            q, k, (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        ) * sm_scale  # (block_q, block_k)
+        if causal:
+            q_pos = iq * block_q + jax.lax.broadcasted_iota(
+                jnp.int32, (block_q, block_k), 0
+            )
+            k_pos = ik * block_k + jax.lax.broadcasted_iota(
+                jnp.int32, (block_q, block_k), 1
+            )
+            s = jnp.where(q_pos >= k_pos, s, _NEG_INF)
+        m_prev = m_ref[:]  # (block_q, 1)
+        m_cur = jnp.max(s, axis=-1, keepdims=True)
+        m_new = jnp.maximum(m_prev, m_cur)
+        p = jnp.exp(s - m_new)
+        alpha = jnp.exp(m_prev - m_new)
+        l_ref[:] = l_ref[:] * alpha + jnp.sum(p, axis=-1, keepdims=True)
+        acc_ref[:] = acc_ref[:] * alpha + jax.lax.dot_general(
+            p, v, (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        )
+        m_ref[:] = m_new
+
+    @pl.when(ik == nk - 1)
+    def _finalize():
+        denom = jnp.maximum(l_ref[:], 1e-30)
+        o_ref[0] = (acc_ref[:] / denom).astype(o_ref.dtype)
+
+
+@functools.partial(
+    jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6)
+)
+def flash_attention(q, k, v, causal: bool = True,
+                    sm_scale: Optional[float] = None,
+                    block_q: int = DEFAULT_BLOCK_Q,
+                    block_k: int = DEFAULT_BLOCK_K):
+    return _flash_forward(q, k, v, causal, sm_scale, block_q, block_k)
+
+
+def _flash_forward(q, k, v, causal, sm_scale, block_q, block_k):
+    batch, sq, heads, d = q.shape
+    _, sk, _, _ = k.shape
+    if sm_scale is None:
+        sm_scale = 1.0 / math.sqrt(d)
+    block_q = min(block_q, sq)
+    block_k = min(block_k, sk)
+    if sq % block_q or sk % block_k:
+        raise ValueError(
+            f"seq lengths ({sq},{sk}) must be multiples of blocks "
+            f"({block_q},{block_k})"
+        )
+    # (B, S, H, D) -> (B*H, S, D)
+    qf = q.transpose(0, 2, 1, 3).reshape(batch * heads, sq, d)
+    kf = k.transpose(0, 2, 1, 3).reshape(batch * heads, sk, d)
+    vf = v.transpose(0, 2, 1, 3).reshape(batch * heads, sk, d)
+
+    from jax.experimental.pallas import tpu as pltpu
+
+    interpret = jax.default_backend() == "cpu"
+    grid = (batch * heads, sq // block_q, sk // block_k)
+    out = pl.pallas_call(
+        functools.partial(
+            _flash_kernel, sm_scale=sm_scale, causal=causal,
+            block_q=block_q, block_k=block_k,
+        ),
+        grid=grid,
+        in_specs=[
+            pl.BlockSpec((1, block_q, d), lambda b, i, j: (b, i, 0)),
+            pl.BlockSpec((1, block_k, d), lambda b, i, j: (b, j, 0)),
+            pl.BlockSpec((1, block_k, d), lambda b, i, j: (b, j, 0)),
+        ],
+        out_specs=pl.BlockSpec((1, block_q, d), lambda b, i, j: (b, i, 0)),
+        out_shape=jax.ShapeDtypeStruct((batch * heads, sq, d), q.dtype),
+        scratch_shapes=[
+            pltpu.VMEM((block_q, d), jnp.float32),
+            pltpu.VMEM((block_q, 1), jnp.float32),
+            pltpu.VMEM((block_q, 1), jnp.float32),
+        ],
+        interpret=interpret,
+    )(qf, kf, vf)
+    return out.reshape(batch, heads, sq, d).transpose(0, 2, 1, 3)
+
+
+def _flash_fwd(q, k, v, causal, sm_scale, block_q, block_k):
+    out = _flash_forward(q, k, v, causal, sm_scale, block_q, block_k)
+    return out, (q, k, v)
+
+
+def _flash_bwd(causal, sm_scale, block_q, block_k, residuals, g):
+    q, k, v = residuals
+    # Recompute-based backward through the reference implementation: XLA
+    # fuses this well; a dedicated Pallas backward kernel is the next
+    # optimization step.
+    _, vjp = jax.vjp(
+        lambda q_, k_, v_: reference_attention(q_, k_, v_, causal=causal,
+                                               sm_scale=sm_scale),
+        q, k, v,
+    )
+    return vjp(g)
+
+
+flash_attention.defvjp(_flash_fwd, _flash_bwd)
+
+
+def reference_attention(q, k, v, causal: bool = True,
+                        sm_scale: Optional[float] = None):
+    """Plain XLA attention (numerics reference + CPU/backward path)."""
+    d = q.shape[-1]
+    if sm_scale is None:
+        sm_scale = 1.0 / math.sqrt(d)
+    s = jnp.einsum("bqhd,bkhd->bhqk", q, k,
+                   preferred_element_type=jnp.float32) * sm_scale
+    if causal:
+        sq, sk = s.shape[-2], s.shape[-1]
+        mask = jnp.tril(jnp.ones((sq, sk), dtype=bool), k=sk - sq)
+        s = jnp.where(mask, s, _NEG_INF)
+    p = jax.nn.softmax(s, axis=-1)
+    out = jnp.einsum("bhqk,bkhd->bqhd", p.astype(v.dtype), v)
+    return out.astype(q.dtype)
+
+
+def attention(q, k, v, causal: bool = True, sm_scale: Optional[float] = None,
+              impl: str = "auto"):
+    """Dispatch: Pallas flash kernel on TPU, XLA reference elsewhere."""
+    if impl == "auto":
+        impl = "flash" if jax.default_backend() == "tpu" else "xla"
+    if impl == "flash":
+        return flash_attention(q, k, v, causal, sm_scale)
+    return reference_attention(q, k, v, causal, sm_scale)

@@ -1,0 +1,34 @@
+"""Parallelism strategies as mesh-axis presets (SURVEY.md §2.5).
+
+Every strategy the reference supports (or lacks and we add) is expressed as
+a named mesh axis + sharding rules, not a framework fork:
+
+- **dp**   data parallel         (reference: Train NCCL DDP — train/torch/config.py)
+- **fsdp** sharded data parallel (reference: pass-through FSDP — train_loop_utils.py:184)
+- **tp**   tensor parallel       (absent in reference; net-new)
+- **sp**   sequence/context parallel — ring attention / Ulysses (net-new)
+- **ep**   expert parallel       (net-new)
+- **pp**   pipeline parallel     (compiled-DAG substrate in reference)
+"""
+
+from ray_tpu.parallel.mesh import (
+    MeshConfig,
+    create_mesh,
+    local_mesh,
+)
+from ray_tpu.parallel.sharding import (
+    LOGICAL_RULES,
+    logical_sharding,
+    shard_params,
+    with_logical_constraint,
+)
+
+__all__ = [
+    "LOGICAL_RULES",
+    "MeshConfig",
+    "create_mesh",
+    "local_mesh",
+    "logical_sharding",
+    "shard_params",
+    "with_logical_constraint",
+]

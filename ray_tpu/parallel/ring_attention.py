@@ -1,0 +1,135 @@
+"""Sequence/context parallelism: ring attention and Ulysses all-to-all.
+
+Net-new relative to the reference (SURVEY.md §5.7: ray has no sequence
+parallelism; it only provides the collective substrate). Here they are
+first-class mesh-axis strategies:
+
+- **Ring attention**: q stays put; k/v shards rotate around the `sequence`
+  mesh axis with `ppermute` (ICI neighbor exchange), each step combining a
+  partial attention with the running online-softmax state. Communication
+  overlaps compute step-for-step; memory per device is O(S/P).
+- **Ulysses**: `all_to_all` swaps the sharded axis from sequence to heads,
+  runs dense local attention (the Pallas flash kernel), and swaps back.
+  Cheaper for moderate S, requires heads % P == 0.
+
+Both are written to run inside `shard_map` over a mesh with a "sequence"
+axis; `ring_attention`/`ulysses_attention` are the in-shard functions and
+`make_sequence_parallel_attention` builds the shard_mapped callable.
+"""
+
+from __future__ import annotations
+
+import functools
+import math
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import Mesh, PartitionSpec as P
+
+_NEG_INF = -1e30
+
+
+def _partial_attention(q, k, v, q_offset, k_offset, sm_scale, causal):
+    """One blockwise attention contribution with global-position causal
+    masking. Shapes: q (B, Sq, H, D); k/v (B, Sk, H, D). Returns
+    (unnormalized_out_f32, m_f32, l_f32)."""
+    s = jnp.einsum("bqhd,bkhd->bhqk", q, k,
+                   preferred_element_type=jnp.float32) * sm_scale
+    if causal:
+        sq, sk = q.shape[1], k.shape[1]
+        q_pos = q_offset + jax.lax.broadcasted_iota(jnp.int32, (sq, sk), 0)
+        k_pos = k_offset + jax.lax.broadcasted_iota(jnp.int32, (sq, sk), 1)
+        s = jnp.where((q_pos >= k_pos)[None, None], s, _NEG_INF)
+    m = jnp.max(s, axis=-1, keepdims=True)  # (B,H,Sq,1)
+    p = jnp.exp(s - m)
+    l = jnp.sum(p, axis=-1, keepdims=True)
+    out = jnp.einsum("bhqk,bkhd->bhqd", p, v.astype(jnp.float32),
+                     preferred_element_type=jnp.float32)
+    return out, m, l
+
+
+def ring_attention(q, k, v, axis_name: str = "sequence",
+                   causal: bool = True, sm_scale: Optional[float] = None):
+    """In-shard ring attention. q/k/v: local shards (B, S_local, H, D)."""
+    d = q.shape[-1]
+    s_local = q.shape[1]
+    if sm_scale is None:
+        sm_scale = 1.0 / math.sqrt(d)
+    axis_size = jax.lax.psum(1, axis_name)
+    my_idx = jax.lax.axis_index(axis_name)
+    perm = [(j, (j + 1) % axis_size) for j in range(axis_size)]
+
+    B, _, H, _ = q.shape
+    o0 = jnp.zeros((B, H, s_local, d), jnp.float32)
+    m0 = jnp.full((B, H, s_local, 1), _NEG_INF, jnp.float32)
+    l0 = jnp.zeros((B, H, s_local, 1), jnp.float32)
+
+    def step(carry, i):
+        o, m, l, k_cur, v_cur = carry
+        src_idx = (my_idx - i) % axis_size  # whose kv shard we hold now
+        out_i, m_i, l_i = _partial_attention(
+            q, k_cur, v_cur,
+            q_offset=my_idx * s_local,
+            k_offset=src_idx * s_local,
+            sm_scale=sm_scale, causal=causal,
+        )
+        m_new = jnp.maximum(m, m_i)
+        alpha = jnp.exp(m - m_new)
+        beta = jnp.exp(m_i - m_new)
+        o = o * alpha + out_i * beta
+        l = l * alpha + l_i * beta
+        # Rotate kv to the next device; skipped on the final step.
+        k_next = jax.lax.ppermute(k_cur, axis_name, perm)
+        v_next = jax.lax.ppermute(v_cur, axis_name, perm)
+        return (o, m_new, l, k_next, v_next), None
+
+    (o, m, l, _, _), _ = jax.lax.scan(
+        step, (o0, m0, l0, k, v), jnp.arange(axis_size)
+    )
+    out = o / jnp.maximum(l, 1e-30)
+    return out.transpose(0, 2, 1, 3).astype(q.dtype)  # (B, S_local, H, D)
+
+
+def ulysses_attention(q, k, v, axis_name: str = "sequence",
+                      causal: bool = True, sm_scale: Optional[float] = None,
+                      impl: str = "auto"):
+    """In-shard Ulysses attention: all-to-all heads↔sequence swap."""
+    from ray_tpu.ops.attention import attention
+
+    # (B, S/P, H, D) -> (B, S, H/P, D)
+    q = jax.lax.all_to_all(q, axis_name, split_axis=2, concat_axis=1,
+                           tiled=True)
+    k = jax.lax.all_to_all(k, axis_name, split_axis=2, concat_axis=1,
+                           tiled=True)
+    v = jax.lax.all_to_all(v, axis_name, split_axis=2, concat_axis=1,
+                           tiled=True)
+    out = attention(q, k, v, causal=causal, sm_scale=sm_scale, impl=impl)
+    # (B, S, H/P, D) -> (B, S/P, H, D)
+    return jax.lax.all_to_all(out, axis_name, split_axis=1, concat_axis=2,
+                              tiled=True)
+
+
+def make_sequence_parallel_attention(mesh: Mesh, kind: str = "ring",
+                                     causal: bool = True,
+                                     axis_name: str = "sequence"):
+    """Build a shard_mapped attention callable over `mesh`.
+
+    Input/output layout: (batch, seq, heads, head_dim) with seq sharded on
+    `axis_name` and batch sharded on data axes present in the mesh.
+    """
+    from jax.experimental.shard_map import shard_map
+
+    batch_axes = tuple(a for a in ("data", "fsdp") if a in mesh.axis_names)
+    spec = P(batch_axes if batch_axes else None, axis_name, None, None)
+
+    fn = ring_attention if kind == "ring" else ulysses_attention
+
+    @functools.partial(
+        shard_map, mesh=mesh, in_specs=(spec, spec, spec),
+        out_specs=spec, check_rep=False,
+    )
+    def sp_attention(q, k, v):
+        return fn(q, k, v, axis_name=axis_name, causal=causal)
+
+    return sp_attention

@@ -1,0 +1,145 @@
+"""Logical-axis sharding rules (GSPMD).
+
+Parameters and activations are annotated with *logical* axis names; a rules
+table maps logical names → mesh axes. Changing the parallelism strategy is
+a rules-table swap, not a model change — the GSPMD equivalent of the
+reference's per-strategy backends (reference: train/torch/config.py NCCL
+DDP vs train_loop_utils.py FSDP wrap).
+
+Canonical transformer layout (Llama-family):
+    embedding  (vocab, embed)          -> ("vocab_shard", "embed")
+    attn qkv   (embed, q_heads*dh)     -> ("embed", "heads")
+    attn out   (q_heads*dh, embed)     -> ("heads", "embed")
+    mlp in     (embed, ffn)            -> ("embed", "ffn")
+    mlp out    (ffn, embed)            -> ("ffn", "embed")
+    activation (batch, seq, embed)     -> ("batch", "seq", "embed_act")
+
+FSDP shards the "embed" parameter axis over the fsdp mesh axis (ZeRO-3
+equivalent: params all-gathered per layer by XLA); TP shards "heads"/"ffn"
+over tensor; SP shards "seq" over sequence.
+"""
+
+from __future__ import annotations
+
+from typing import Dict, Optional, Tuple, Union
+
+import jax
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+Rules = Dict[str, Union[str, Tuple[str, ...], None]]
+
+# Default rules: full dp/fsdp/tp/sp composition.
+LOGICAL_RULES: Rules = {
+    "batch": ("data", "fsdp"),
+    "seq": "sequence",
+    "embed": "fsdp",
+    "embed_act": None,
+    "heads": "tensor",
+    "kv_heads": "tensor",
+    "ffn": "tensor",
+    "vocab_shard": "tensor",
+    "expert": "expert",
+    "expert_ffn": "tensor",
+    "layers": None,  # scanned-layer axis stays replicated
+    "norm": None,
+}
+
+
+def spec_from_logical(logical_axes: Tuple[Optional[str], ...],
+                      rules: Optional[Rules] = None) -> P:
+    rules = rules or LOGICAL_RULES
+    out = []
+    for name in logical_axes:
+        if name is None:
+            out.append(None)
+        else:
+            out.append(rules.get(name))
+    return P(*out)
+
+
+def logical_sharding(mesh: Mesh, logical_axes: Tuple[Optional[str], ...],
+                     rules: Optional[Rules] = None) -> NamedSharding:
+    spec = spec_from_logical(logical_axes, rules)
+    # Drop mesh axes the mesh doesn't have (e.g. tests with a 1-axis mesh).
+    cleaned = []
+    for entry in spec:
+        if entry is None:
+            cleaned.append(None)
+        elif isinstance(entry, tuple):
+            kept = tuple(a for a in entry if a in mesh.axis_names)
+            cleaned.append(kept if kept else None)
+        else:
+            cleaned.append(entry if entry in mesh.axis_names else None)
+    return NamedSharding(mesh, P(*cleaned))
+
+
+def with_logical_constraint(x, logical_axes: Tuple[Optional[str], ...],
+                            mesh: Optional[Mesh] = None,
+                            rules: Optional[Rules] = None):
+    """In-graph activation sharding hint (inside jit)."""
+    mesh = mesh or _current_mesh()
+    if mesh is None:
+        return x
+    return jax.lax.with_sharding_constraint(
+        x, logical_sharding(mesh, logical_axes, rules)
+    )
+
+
+def _current_mesh() -> Optional[Mesh]:
+    try:
+        from jax.interpreters import pxla
+
+        mesh = pxla.thread_resources.env.physical_mesh
+        if mesh.empty:
+            return None
+        return mesh
+    except Exception:
+        return None
+
+
+def shard_params(params, mesh: Mesh, logical_axes_tree,
+                 rules: Optional[Rules] = None):
+    """Device-put a param pytree according to a matching tree of logical
+    axis tuples."""
+    shardings = jax.tree.map(
+        lambda axes: logical_sharding(mesh, axes, rules),
+        logical_axes_tree,
+        is_leaf=lambda v: isinstance(v, tuple)
+        and all(isinstance(e, (str, type(None))) for e in v),
+    )
+    return jax.device_put(params, shardings)
+
+
+def infer_param_logical_axes(params):
+    """Heuristic logical axes for a flax param tree, keyed on path + shape.
+
+    Used when a model doesn't carry explicit annotations; the flagship
+    models annotate explicitly via nn.with_partitioning instead.
+    """
+
+    def classify(path: str, leaf):
+        ndim = getattr(leaf, "ndim", 0)
+        path_l = path.lower()
+        if ndim <= 1:
+            return (("norm",) if ndim else ())[:ndim] or (None,) * ndim
+        if "embed" in path_l and ndim == 2:
+            return ("vocab_shard", "embed")
+        if any(k in path_l for k in ("wq", "wk", "wv", "query", "key",
+                                     "value")):
+            return ("embed", "heads")
+        if any(k in path_l for k in ("wo", "out_proj", "attn_out")):
+            return ("heads", "embed")
+        if any(k in path_l for k in ("w1", "w3", "gate", "up")):
+            return ("embed", "ffn")
+        if any(k in path_l for k in ("w2", "down")):
+            return ("ffn", "embed")
+        if ndim == 2:
+            return ("embed", None)
+        return (None,) * ndim
+
+    flat = jax.tree_util.tree_flatten_with_path(params)[0]
+    out = {}
+    for path, leaf in flat:
+        key = jax.tree_util.keystr(path)
+        out[key] = classify(key, leaf)
+    return out

@@ -1,0 +1,37 @@
+"""Test configuration.
+
+Multi-chip sharding is tested on a virtual 8-device CPU mesh (the driver's
+dry-run does the same): JAX_PLATFORMS / XLA_FLAGS must be set before jax
+imports anywhere in the test process.
+"""
+
+import os
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+_flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in _flags:
+    os.environ["XLA_FLAGS"] = (
+        _flags + " --xla_force_host_platform_device_count=8"
+    ).strip()
+
+import pytest  # noqa: E402
+
+
+@pytest.fixture(scope="module")
+def ray_start():
+    """Module-scoped cluster: 4 CPUs, no TPU (workers are plain processes)."""
+    import ray_tpu
+
+    ray_tpu.init(num_cpus=4, num_tpus=0)
+    yield
+    ray_tpu.shutdown()
+
+
+@pytest.fixture
+def ray_start_isolated():
+    """Function-scoped cluster for tests that mutate cluster state."""
+    import ray_tpu
+
+    ray_tpu.init(num_cpus=4, num_tpus=0)
+    yield
+    ray_tpu.shutdown()

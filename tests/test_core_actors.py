@@ -1,0 +1,172 @@
+"""Actor tests (reference test model: python/ray/tests/test_actor.py)."""
+
+import time
+
+import pytest
+
+import ray_tpu
+from ray_tpu import exceptions as exc
+
+
+@ray_tpu.remote
+class Counter:
+    def __init__(self, start=0):
+        self.value = start
+
+    def incr(self, by=1):
+        self.value += by
+        return self.value
+
+    def get(self):
+        return self.value
+
+
+def test_actor_basic(ray_start):
+    c = Counter.remote(5)
+    assert ray_tpu.get(c.incr.remote(), timeout=60) == 6
+    assert ray_tpu.get(c.incr.remote(4), timeout=30) == 10
+    assert ray_tpu.get(c.get.remote(), timeout=30) == 10
+
+
+def test_actor_ordering(ray_start):
+    c = Counter.remote(0)
+    refs = [c.incr.remote() for _ in range(20)]
+    # Sequential execution per submitter: results must be 1..20 in order.
+    assert ray_tpu.get(refs, timeout=60) == list(range(1, 21))
+
+
+def test_actor_init_error(ray_start):
+    @ray_tpu.remote
+    class Broken:
+        def __init__(self):
+            raise ValueError("bad init")
+
+        def ping(self):
+            return "pong"
+
+    b = Broken.remote()
+    with pytest.raises(exc.ActorDiedError):
+        ray_tpu.get(b.ping.remote(), timeout=60)
+
+
+def test_actor_method_error(ray_start):
+    @ray_tpu.remote
+    class Flaky:
+        def boom(self):
+            raise KeyError("nope")
+
+    f = Flaky.remote()
+    with pytest.raises(exc.TaskError) as info:
+        ray_tpu.get(f.boom.remote(), timeout=60)
+    assert info.value.cause_cls_name == "KeyError"
+
+
+def test_kill_actor(ray_start):
+    c = Counter.remote(0)
+    ray_tpu.get(c.get.remote(), timeout=60)
+    ray_tpu.kill(c)
+    time.sleep(0.5)
+    with pytest.raises(exc.ActorDiedError):
+        ray_tpu.get(c.get.remote(), timeout=30)
+
+
+def test_named_actor(ray_start):
+    c = Counter.options(name="counter-named").remote(7)
+    ray_tpu.get(c.get.remote(), timeout=60)
+    h = ray_tpu.get_actor("counter-named")
+    assert ray_tpu.get(h.get.remote(), timeout=30) == 7
+    with pytest.raises(ValueError):
+        ray_tpu.get_actor("no-such-actor")
+
+
+def test_get_if_exists(ray_start):
+    a = Counter.options(name="gie", get_if_exists=True).remote(1)
+    ray_tpu.get(a.get.remote(), timeout=60)
+    b = Counter.options(name="gie", get_if_exists=True).remote(99)
+    # Second create attaches to the first actor.
+    assert ray_tpu.get(b.get.remote(), timeout=30) == 1
+
+
+def test_actor_restart(ray_start):
+    @ray_tpu.remote(max_restarts=2)
+    class Phoenix:
+        def pid(self):
+            import os
+
+            return os.getpid()
+
+        def crash(self):
+            import os
+
+            os._exit(1)
+
+    p = Phoenix.remote()
+    pid1 = ray_tpu.get(p.pid.remote(), timeout=60)
+    p.crash.remote()
+    time.sleep(3)
+    pid2 = ray_tpu.get(p.pid.remote(), timeout=60)
+    assert pid1 != pid2
+
+
+def test_actor_handle_passing(ray_start):
+    c = Counter.remote(100)
+    ray_tpu.get(c.get.remote(), timeout=60)
+
+    @ray_tpu.remote
+    def incr_remote(handle):
+        return ray_tpu.get(handle.incr.remote(), timeout=30)
+
+    assert ray_tpu.get(incr_remote.remote(c), timeout=60) == 101
+    assert ray_tpu.get(c.get.remote(), timeout=30) == 101
+
+
+def test_async_actor(ray_start):
+    @ray_tpu.remote
+    class AsyncWorker:
+        async def work(self, x):
+            import asyncio
+
+            await asyncio.sleep(0.05)
+            return x * 2
+
+    a = AsyncWorker.remote()
+    ray_tpu.get(a.work.remote(0), timeout=60)  # wait for actor start
+    t0 = time.monotonic()
+    refs = [a.work.remote(i) for i in range(8)]
+    results = ray_tpu.get(refs, timeout=60)
+    elapsed = time.monotonic() - t0
+    assert results == [i * 2 for i in range(8)]
+    # Concurrent execution: 8 × 50ms sleeps must overlap.
+    assert elapsed < 2.0
+
+
+def test_threaded_actor_concurrency(ray_start):
+    @ray_tpu.remote(max_concurrency=4)
+    class Blocker:
+        def block(self, t):
+            time.sleep(t)
+            return t
+
+    b = Blocker.remote()
+    ray_tpu.get(b.block.remote(0), timeout=60)  # wait for actor start
+    t0 = time.monotonic()
+    refs = [b.block.remote(0.5) for _ in range(4)]
+    ray_tpu.get(refs, timeout=60)
+    assert time.monotonic() - t0 < 1.9
+
+
+def test_actor_graceful_exit(ray_start):
+    @ray_tpu.remote
+    class Quitter:
+        def quit(self):
+            ray_tpu.actor_exit()
+
+        def ping(self):
+            return "pong"
+
+    q = Quitter.remote()
+    assert ray_tpu.get(q.ping.remote(), timeout=60) == "pong"
+    q.quit.remote()
+    time.sleep(1.0)
+    with pytest.raises(exc.ActorDiedError):
+        ray_tpu.get(q.ping.remote(), timeout=30)

@@ -1,0 +1,153 @@
+"""Core task API tests (reference test model: python/ray/tests/test_basic.py)."""
+
+import time
+
+import pytest
+
+import ray_tpu
+from ray_tpu import exceptions as exc
+
+
+@ray_tpu.remote
+def add(a, b):
+    return a + b
+
+
+@ray_tpu.remote
+def fail(msg):
+    raise RuntimeError(msg)
+
+
+def test_submit_and_get(ray_start):
+    assert ray_tpu.get(add.remote(1, 2), timeout=60) == 3
+
+
+def test_many_tasks(ray_start):
+    refs = [add.remote(i, i) for i in range(50)]
+    assert ray_tpu.get(refs, timeout=60) == [2 * i for i in range(50)]
+
+
+def test_kwargs(ray_start):
+    assert ray_tpu.get(add.remote(a=2, b=3), timeout=60) == 5
+
+
+def test_task_error(ray_start):
+    with pytest.raises(exc.TaskError) as info:
+        ray_tpu.get(fail.remote("boom"), timeout=60)
+    assert "boom" in str(info.value)
+    assert info.value.cause_cls_name == "RuntimeError"
+
+
+def test_nested_task_error_propagates(ray_start):
+    @ray_tpu.remote
+    def outer():
+        return ray_tpu.get(fail.remote("inner"), timeout=30)
+
+    with pytest.raises(exc.TaskError) as info:
+        ray_tpu.get(outer.remote(), timeout=60)
+    assert "inner" in str(info.value)
+
+
+def test_num_returns(ray_start):
+    @ray_tpu.remote(num_returns=3)
+    def three():
+        return 1, 2, 3
+
+    r1, r2, r3 = three.remote()
+    assert ray_tpu.get([r1, r2, r3], timeout=60) == [1, 2, 3]
+
+
+def test_options_override(ray_start):
+    f = add.options(name="custom-add", num_cpus=0.5)
+    assert ray_tpu.get(f.remote(4, 5), timeout=60) == 9
+
+
+def test_pass_ref_as_arg(ray_start):
+    ref = add.remote(1, 1)
+    ref2 = add.remote(ref, 1)
+    assert ray_tpu.get(ref2, timeout=60) == 3
+
+
+def test_direct_call_raises(ray_start):
+    with pytest.raises(TypeError):
+        add(1, 2)
+
+
+def test_nested_submission(ray_start):
+    @ray_tpu.remote
+    def outer(n):
+        refs = [add.remote(i, 1) for i in range(n)]
+        return sum(ray_tpu.get(refs, timeout=30))
+
+    assert ray_tpu.get(outer.remote(4), timeout=90) == 10
+
+
+def test_wait(ray_start):
+    @ray_tpu.remote
+    def slow(t):
+        time.sleep(t)
+        return t
+
+    fast = add.remote(0, 1)
+    refs = [fast, slow.remote(30)]
+    ready, not_ready = ray_tpu.wait(refs, num_returns=1, timeout=30)
+    assert ready == [fast]
+    assert len(not_ready) == 1
+
+
+def test_wait_timeout(ray_start):
+    @ray_tpu.remote
+    def sleepy():
+        time.sleep(60)
+
+    ready, not_ready = ray_tpu.wait([sleepy.remote()], num_returns=1,
+                                    timeout=0.5)
+    assert ready == []
+    assert len(not_ready) == 1
+
+
+def test_retry_on_worker_death(ray_start):
+    @ray_tpu.remote(max_retries=2)
+    def die_once(marker):
+        import os
+
+        path = f"/tmp/ray_tpu_die_once_{marker}"
+        if not os.path.exists(path):
+            open(path, "w").close()
+            os._exit(1)
+        os.remove(path)
+        return "survived"
+
+    marker = str(time.time()).replace(".", "")
+    assert ray_tpu.get(die_once.remote(marker), timeout=240) == "survived"
+
+
+def test_no_retry_exhausted(ray_start):
+    @ray_tpu.remote(max_retries=0)
+    def always_die():
+        import os
+
+        os._exit(1)
+
+    with pytest.raises(exc.WorkerCrashedError):
+        ray_tpu.get(always_die.remote(), timeout=240)
+
+
+def test_get_timeout(ray_start):
+    @ray_tpu.remote
+    def forever():
+        time.sleep(120)
+
+    with pytest.raises(exc.GetTimeoutError):
+        ray_tpu.get(forever.remote(), timeout=1.0)
+
+
+def test_runtime_context(ray_start):
+    ctx = ray_tpu.get_runtime_context()
+    assert len(ctx.job_id) == 8
+    assert ctx.worker_id
+
+
+def test_cluster_resources(ray_start):
+    res = ray_tpu.cluster_resources()
+    assert res.get("CPU") == 4.0

@@ -1,0 +1,119 @@
+"""Object store tests (reference model: python/ray/tests/test_object_store*.py)."""
+
+import numpy as np
+import pytest
+
+import ray_tpu
+from ray_tpu.core.ids import ObjectID
+from ray_tpu.core.object_store import MemoryStore, ShmStore
+from ray_tpu.core.serialization import SerializedObject, deserialize, serialize
+
+
+def test_put_get_small(ray_start):
+    ref = ray_tpu.put({"a": 1, "b": [1, 2, 3]})
+    assert ray_tpu.get(ref, timeout=30) == {"a": 1, "b": [1, 2, 3]}
+
+
+def test_put_get_large_numpy(ray_start):
+    arr = np.random.rand(512, 512)
+    ref = ray_tpu.put(arr)
+    out = ray_tpu.get(ref, timeout=30)
+    np.testing.assert_array_equal(arr, out)
+
+
+def test_large_object_task_arg(ray_start):
+    arr = np.ones((1024, 1024), dtype=np.float32)
+
+    @ray_tpu.remote
+    def total(x):
+        return float(x.sum())
+
+    assert ray_tpu.get(total.remote(arr), timeout=60) == 1024 * 1024
+
+
+def test_large_return(ray_start):
+    @ray_tpu.remote
+    def big():
+        return np.arange(500_000, dtype=np.int64)
+
+    out = ray_tpu.get(big.remote(), timeout=60)
+    assert out.shape == (500_000,)
+    assert out[-1] == 499_999
+
+
+def test_put_of_ref_rejected(ray_start):
+    ref = ray_tpu.put(1)
+    with pytest.raises(TypeError):
+        ray_tpu.put(ref)
+
+
+def test_shared_ref_between_tasks(ray_start):
+    data = ray_tpu.put(np.full(300_000, 7.0))
+
+    @ray_tpu.remote
+    def first(x):
+        return float(x[0])
+
+    refs = [first.remote(data) for _ in range(4)]
+    assert ray_tpu.get(refs, timeout=60) == [7.0] * 4
+
+
+# ---- unit tests (no cluster) ----
+
+
+def test_serialization_roundtrip():
+    value = {"x": np.arange(10), "y": "text", "z": (1, 2.5)}
+    obj = serialize(value)
+    out = deserialize(obj.metadata, obj.inband, obj.buffers)
+    np.testing.assert_array_equal(out["x"], value["x"])
+    assert out["y"] == "text" and out["z"] == (1, 2.5)
+
+
+def test_serialization_zero_copy_numpy():
+    arr = np.arange(100_000, dtype=np.float64)
+    obj = serialize(arr)
+    # The array's memory must be an out-of-band buffer, not in the pickle.
+    assert sum(memoryview(b).nbytes for b in obj.buffers) >= arr.nbytes
+    assert len(obj.inband) < 10_000
+
+
+def test_memory_store_waiters():
+    store = MemoryStore()
+    oid = ObjectID.from_random()
+    hits = []
+    store.add_waiter(oid, hits.append)
+    assert not hits
+    obj = SerializedObject(metadata=b"N", inband=b"x", buffers=[])
+    store.put(oid, obj)
+    assert hits == [obj]
+    # Waiter after presence fires immediately.
+    store.add_waiter(oid, hits.append)
+    assert len(hits) == 2
+
+
+def test_shm_pack_roundtrip():
+    value = np.arange(1000, dtype=np.float32)
+    obj = serialize(value)
+    packed = ShmStore.pack(obj)
+    assert len(packed) == ShmStore.packed_size(obj)
+
+
+def test_shm_store_eviction():
+    store = ShmStore(capacity_bytes=10_000)
+    a = ObjectID.from_random()
+    store.mark_sealed(a, 6_000)
+    b = ObjectID.from_random()
+    store.mark_sealed(b, 6_000)  # evicts a
+    assert store.used_bytes() <= 10_000
+    assert store.contains(b)
+    assert not store.contains(a)
+
+
+def test_shm_store_pin_blocks_eviction():
+    store = ShmStore(capacity_bytes=10_000)
+    a = ObjectID.from_random()
+    store.mark_sealed(a, 6_000)
+    store.pin(a)
+    b = ObjectID.from_random()
+    store.mark_sealed(b, 6_000)  # cannot evict a; over-capacity tolerated
+    assert store.contains(a)

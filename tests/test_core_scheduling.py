@@ -1,0 +1,146 @@
+"""Scheduler, resources, placement groups (reference model:
+python/ray/tests/test_placement_group*.py, test_scheduling*.py)."""
+
+import pytest
+
+import ray_tpu
+from ray_tpu.core.ids import NodeID, PlacementGroupID
+from ray_tpu.core.resources import NodeResources, ResourceSet
+from ray_tpu.core.scheduler import Node
+
+
+def test_resource_set_ops():
+    a = ResourceSet({"CPU": 2, "TPU": 1})
+    b = ResourceSet({"CPU": 0.5})
+    assert (a - b).get("CPU") == 1.5
+    assert (a + b).get("CPU") == 2.5
+    assert b.is_subset_of(a)
+    assert not a.is_subset_of(b)
+
+
+def test_resource_fixed_point():
+    a = ResourceSet({"CPU": 0.1})
+    total = ResourceSet()
+    for _ in range(10):
+        total = total + a
+    assert total.get("CPU") == 1.0  # no float drift
+
+
+def test_node_resources_acquire_release():
+    nr = NodeResources(ResourceSet({"CPU": 4}))
+    req = ResourceSet({"CPU": 3})
+    assert nr.acquire(req)
+    assert not nr.acquire(req)
+    nr.release(req)
+    assert nr.acquire(req)
+
+
+def test_infeasible_task_fails(ray_start):
+    @ray_tpu.remote(num_cpus=128)
+    def impossible():
+        return 1
+
+    with pytest.raises(Exception):
+        ray_tpu.get(impossible.remote(), timeout=60)
+
+
+def test_fractional_cpus(ray_start):
+    @ray_tpu.remote(num_cpus=0.5)
+    def half():
+        return "ok"
+
+    refs = [half.remote() for _ in range(8)]
+    assert ray_tpu.get(refs, timeout=60) == ["ok"] * 8
+
+
+def test_custom_resources_infeasible(ray_start):
+    # The cluster has no "widget" resource.
+    @ray_tpu.remote(resources={"widget": 1})
+    def needs_widget():
+        return 1
+
+    with pytest.raises(Exception):
+        ray_tpu.get(needs_widget.remote(), timeout=60)
+
+
+def test_placement_group_create_ready(ray_start):
+    pg = ray_tpu.placement_group([{"CPU": 1}, {"CPU": 1}], strategy="PACK")
+    assert pg.ready(timeout=10)
+    specs = pg.bundle_specs
+    assert len(specs) == 2
+    ray_tpu.remove_placement_group(pg)
+
+
+def test_placement_group_scheduling(ray_start):
+    from ray_tpu.core.task_spec import PlacementGroupSchedulingStrategy
+
+    pg = ray_tpu.placement_group([{"CPU": 2}], strategy="PACK")
+    assert pg.ready(timeout=10)
+
+    @ray_tpu.remote(num_cpus=1, scheduling_strategy=PlacementGroupSchedulingStrategy(
+        placement_group_id_hex=pg.id_hex, bundle_index=0))
+    def inside():
+        return "placed"
+
+    assert ray_tpu.get(inside.remote(), timeout=60) == "placed"
+    ray_tpu.remove_placement_group(pg)
+
+
+def test_placement_group_infeasible(ray_start):
+    pg = ray_tpu.placement_group([{"CPU": 1000}])
+    assert not pg.ready(timeout=0.5)
+    ray_tpu.remove_placement_group(pg)
+
+
+def test_bundle_reservation_isolated():
+    """Unit test of bundle placement logic on a fake 2-node cluster."""
+    from ray_tpu.core.scheduler import ClusterScheduler
+
+    sched = ClusterScheduler(pool=None)
+    n1 = Node(NodeID.from_random(), ResourceSet({"CPU": 4}))
+    n2 = Node(NodeID.from_random(), ResourceSet({"CPU": 4}))
+    sched.add_node(n1)
+    sched.add_node(n2)
+
+    pg = PlacementGroupID.from_random()
+    ok = sched.try_place_bundles(
+        pg, [ResourceSet({"CPU": 3}), ResourceSet({"CPU": 3})], "STRICT_SPREAD"
+    )
+    assert ok
+    states = sched.pg_bundles[pg]
+    assert states[0].node_id != states[1].node_id
+    assert n1.resources.available.get("CPU") == 1.0
+
+    # Full cluster: a second 2×3-CPU strict-spread PG cannot fit.
+    pg2 = PlacementGroupID.from_random()
+    assert not sched.try_place_bundles(
+        pg2, [ResourceSet({"CPU": 3}), ResourceSet({"CPU": 3})],
+        "STRICT_SPREAD",
+    )
+    sched.remove_pg(pg)
+    assert n1.resources.available.get("CPU") == 4.0
+
+
+def test_strict_pack_one_node():
+    from ray_tpu.core.scheduler import ClusterScheduler
+
+    sched = ClusterScheduler(pool=None)
+    n1 = Node(NodeID.from_random(), ResourceSet({"CPU": 8}))
+    sched.add_node(n1)
+    pg = PlacementGroupID.from_random()
+    assert sched.try_place_bundles(
+        pg, [ResourceSet({"CPU": 4}), ResourceSet({"CPU": 4})], "STRICT_PACK"
+    )
+    states = sched.pg_bundles[pg]
+    assert states[0].node_id == states[1].node_id
+
+
+def test_tpu_resource_detection():
+    from ray_tpu.core.accelerators import TPUAcceleratorManager
+
+    # On the CPU test mesh there are no TPU chips.
+    n = TPUAcceleratorManager.detect_num_chips()
+    assert n >= 0
+    with pytest.raises(ValueError):
+        TPUAcceleratorManager.validate_chip_request(3)
+    TPUAcceleratorManager.validate_chip_request(4)
