@@ -7,12 +7,19 @@ imports anywhere in the test process.
 
 import os
 
-os.environ.setdefault("JAX_PLATFORMS", "cpu")
+os.environ["JAX_PLATFORMS"] = "cpu"
 _flags = os.environ.get("XLA_FLAGS", "")
 if "xla_force_host_platform_device_count" not in _flags:
     os.environ["XLA_FLAGS"] = (
         _flags + " --xla_force_host_platform_device_count=8"
     ).strip()
+
+import jax  # noqa: E402
+
+# The container's sitecustomize registers the axon (tunneled TPU) PJRT
+# plugin in every interpreter and force-sets jax_platforms="axon,cpu".
+# Tests run on a virtual 8-device CPU mesh; override after import.
+jax.config.update("jax_platforms", "cpu")
 
 import pytest  # noqa: E402
 
