@@ -1,0 +1,351 @@
+"""Collective communication API over actor groups.
+
+Reference surface: python/ray/util/collective/collective.py —
+init_collective_group(:120), create_collective_group(:151), allreduce(:258),
+barrier(:298), broadcast(:373), allgather(:423), reducescatter(:472),
+send(:531)/recv(:594). Same call signatures in spirit; the NCCL/Gloo
+backends are replaced per ray_tpu/collective/types.py: the ``host`` backend
+exchanges through the rendezvous store (gloo analog), and device-plane
+traffic belongs in-graph (XLA collectives over a mesh — ``get_group_mesh``
+hands callers the mesh for that).
+
+Collective ordering contract (same as the reference): every rank must
+issue the group's collectives in the same order; each op consumes one
+sequence number on every rank.
+"""
+
+from __future__ import annotations
+
+import itertools
+import threading
+from typing import Any, Dict, List, Optional, Sequence
+
+import numpy as np
+
+from ray_tpu.collective.types import Backend, ReduceOp
+
+_DEFAULT_GROUP = "default"
+
+_lock = threading.Lock()
+_groups: Dict[str, "GroupContext"] = {}
+_store_handle = None
+
+
+def _api():
+    import ray_tpu
+
+    return ray_tpu
+
+
+def _get_store():
+    """Get-or-create the cluster-wide rendezvous store actor. Concurrent
+    creators race on the name; the loser's registration dies, so retry via
+    get_actor until a live store answers."""
+    global _store_handle
+    with _lock:
+        if _store_handle is not None:
+            return _store_handle
+        ray_tpu = _api()
+        from ray_tpu.collective.store import (
+            STORE_ACTOR_NAME,
+            STORE_NAMESPACE,
+            CollectiveStore,
+        )
+
+        last_err = None
+        for _ in range(20):
+            try:
+                handle = (
+                    ray_tpu.remote(CollectiveStore)
+                    .options(name=STORE_ACTOR_NAME,
+                             namespace=STORE_NAMESPACE,
+                             lifetime="detached", get_if_exists=True,
+                             num_cpus=0)
+                    .remote()
+                )
+                ray_tpu.get(handle.ping.remote(), timeout=10)
+                _store_handle = handle
+                return handle
+            except Exception as e:  # lost the name race; retry lookup
+                last_err = e
+                import time
+
+                time.sleep(0.1)
+        raise RuntimeError(
+            f"could not reach collective store actor: {last_err}")
+
+
+class GroupContext:
+    def __init__(self, group_name: str, rank: int, world_size: int,
+                 backend: Backend, store):
+        self.group_name = group_name
+        self.rank = rank
+        self.world_size = world_size
+        self.backend = backend
+        self.store = store
+        self._seq = itertools.count()
+        self._send_seq: Dict[int, "itertools.count"] = {}
+        self._recv_seq: Dict[int, "itertools.count"] = {}
+        self._op_lock = threading.Lock()
+
+    def next_seq(self) -> int:
+        with self._op_lock:
+            return next(self._seq)
+
+    def next_p2p_seq(self, table: Dict[int, Any], peer: int) -> int:
+        with self._op_lock:
+            if peer not in table:
+                table[peer] = itertools.count()
+            return next(table[peer])
+
+    def exchange(self, payload, timeout: Optional[float] = None) -> list:
+        seq = self.next_seq()
+        ray_tpu = _api()
+        return ray_tpu.get(self.store.exchange.remote(
+            self.group_name, seq, self.rank, payload, timeout))
+
+
+def init_collective_group(world_size: int, rank: int,
+                          backend: str = "host",
+                          group_name: str = _DEFAULT_GROUP) -> None:
+    """Initialize this process's membership in a collective group.
+
+    Call from every participating worker/actor with a distinct rank in
+    ``[0, world_size)`` (reference: collective.py:120)."""
+    if not 0 <= rank < world_size:
+        raise ValueError(f"rank {rank} out of range for world {world_size}")
+    be = Backend.parse(backend)
+    store = _get_store()
+    ray_tpu = _api()
+    ray_tpu.get(store.declare_group.remote(group_name, world_size, be.value))
+    with _lock:
+        if group_name in _groups:
+            raise RuntimeError(f"group {group_name!r} already initialized "
+                               "in this process")
+        _groups[group_name] = GroupContext(group_name, rank, world_size, be,
+                                           store)
+
+
+def create_collective_group(actors: Sequence[Any], world_size: int,
+                            ranks: Sequence[int],
+                            backend: str = "host",
+                            group_name: str = _DEFAULT_GROUP) -> None:
+    """Declare a group over actor handles from the driver; members pick up
+    their rank lazily on first collective call (reference: collective.py:151
+    declare + lazy init)."""
+    if len(actors) != len(ranks) or len(actors) != world_size:
+        raise ValueError("need exactly world_size actors and ranks")
+    be = Backend.parse(backend)
+    store = _get_store()
+    members = {a._actor_id.hex(): int(r) for a, r in zip(actors, ranks)}
+    ray_tpu = _api()
+    ray_tpu.get(store.declare_group.remote(group_name, world_size, be.value,
+                                           members))
+
+
+def _get_ctx(group_name: str) -> GroupContext:
+    with _lock:
+        ctx = _groups.get(group_name)
+    if ctx is not None:
+        return ctx
+    # Lazy init path for declaratively-created groups: look up this
+    # actor's rank in the store's membership table.
+    ray_tpu = _api()
+    actor_hex = ray_tpu.get_runtime_context().get_actor_id()
+    if actor_hex is None:
+        raise RuntimeError(
+            f"collective group {group_name!r} is not initialized in this "
+            "process; call init_collective_group() first")
+    store = _get_store()
+    info = ray_tpu.get(store.get_group.remote(group_name))
+    if info is None or actor_hex not in info.get("members", {}):
+        raise RuntimeError(
+            f"collective group {group_name!r} is not declared for this actor")
+    ctx = GroupContext(group_name, info["members"][actor_hex],
+                       info["world_size"], Backend.parse(info["backend"]),
+                       store)
+    with _lock:
+        _groups.setdefault(group_name, ctx)
+        return _groups[group_name]
+
+
+def is_group_initialized(group_name: str = _DEFAULT_GROUP) -> bool:
+    with _lock:
+        return group_name in _groups
+
+
+def get_rank(group_name: str = _DEFAULT_GROUP) -> int:
+    return _get_ctx(group_name).rank
+
+
+def get_collective_group_size(group_name: str = _DEFAULT_GROUP) -> int:
+    return _get_ctx(group_name).world_size
+
+
+def destroy_collective_group(group_name: str = _DEFAULT_GROUP) -> None:
+    """Tear down a group cluster-wide, waking any blocked ranks with an
+    error. Callable from any process (e.g. the driver), not just members."""
+    ray_tpu = _api()
+    with _lock:
+        _groups.pop(group_name, None)
+    store = _get_store()
+    ray_tpu.get(store.destroy_group.remote(group_name))
+
+
+# ---------------------------------------------------------------------------
+# tensor plumbing
+# ---------------------------------------------------------------------------
+
+
+def _to_numpy(tensor) -> np.ndarray:
+    if isinstance(tensor, np.ndarray):
+        return tensor
+    # jax.Array / torch.Tensor / scalars all round-trip through numpy.
+    return np.asarray(tensor)
+
+
+def _like(result: np.ndarray, template):
+    if isinstance(template, np.ndarray):
+        return result
+    mod = type(template).__module__
+    if mod.startswith("jax"):
+        import jax.numpy as jnp
+
+        return jnp.asarray(result)
+    if mod.startswith("torch"):
+        import torch
+
+        return torch.from_numpy(np.ascontiguousarray(result))
+    if np.isscalar(template):
+        return result.item() if result.ndim == 0 else result
+    return result
+
+
+def _reduce(arrays: List[np.ndarray], op: ReduceOp) -> np.ndarray:
+    stack = np.stack(arrays)
+    if op == ReduceOp.SUM:
+        return stack.sum(axis=0)
+    if op == ReduceOp.PRODUCT:
+        return stack.prod(axis=0)
+    if op == ReduceOp.MIN:
+        return stack.min(axis=0)
+    if op == ReduceOp.MAX:
+        return stack.max(axis=0)
+    if op == ReduceOp.MEAN:
+        return stack.mean(axis=0)
+    raise ValueError(f"unknown reduce op: {op}")
+
+
+# ---------------------------------------------------------------------------
+# collectives
+# ---------------------------------------------------------------------------
+
+
+def allreduce(tensor, group_name: str = _DEFAULT_GROUP,
+              op: ReduceOp = ReduceOp.SUM, timeout: Optional[float] = None):
+    """Reduce across all ranks; every rank gets the result
+    (reference: collective.py:258)."""
+    ctx = _get_ctx(group_name)
+    parts = ctx.exchange(_to_numpy(tensor), timeout)
+    return _like(_reduce(parts, ReduceOp(op)), tensor)
+
+
+def allgather(tensor, group_name: str = _DEFAULT_GROUP,
+              timeout: Optional[float] = None) -> list:
+    """Every rank gets the rank-ordered list of all tensors
+    (reference: collective.py:423)."""
+    ctx = _get_ctx(group_name)
+    parts = ctx.exchange(_to_numpy(tensor), timeout)
+    return [_like(p, tensor) for p in parts]
+
+
+def reducescatter(tensor, group_name: str = _DEFAULT_GROUP,
+                  op: ReduceOp = ReduceOp.SUM,
+                  timeout: Optional[float] = None):
+    """Reduce then scatter: rank i gets the i-th equal chunk along axis 0
+    (reference: collective.py:472)."""
+    ctx = _get_ctx(group_name)
+    arr = _to_numpy(tensor)
+    if arr.shape[0] % ctx.world_size != 0:
+        raise ValueError(
+            f"reducescatter dim0={arr.shape[0]} not divisible by "
+            f"world_size={ctx.world_size}")
+    parts = ctx.exchange(arr, timeout)
+    reduced = _reduce(parts, ReduceOp(op))
+    chunk = reduced.shape[0] // ctx.world_size
+    out = reduced[ctx.rank * chunk:(ctx.rank + 1) * chunk]
+    return _like(out, tensor)
+
+
+def broadcast(tensor, src_rank: int = 0, group_name: str = _DEFAULT_GROUP,
+              timeout: Optional[float] = None):
+    """All ranks get src_rank's tensor (reference: collective.py:373)."""
+    ctx = _get_ctx(group_name)
+    payload = _to_numpy(tensor) if ctx.rank == src_rank else None
+    parts = ctx.exchange(payload, timeout)
+    result = parts[src_rank]
+    if result is None:
+        raise RuntimeError(f"broadcast src rank {src_rank} sent no data")
+    return _like(result, tensor)
+
+
+def barrier(group_name: str = _DEFAULT_GROUP,
+            timeout: Optional[float] = None) -> None:
+    """Block until every rank arrives (reference: collective.py:298)."""
+    _get_ctx(group_name).exchange(None, timeout)
+
+
+def alltoall(tensors: Sequence[Any], group_name: str = _DEFAULT_GROUP,
+             timeout: Optional[float] = None) -> list:
+    """Rank i sends tensors[j] to rank j; returns what every rank sent to
+    this one, rank-ordered. (No direct reference equivalent at the Python
+    API level; NCCL groups expose it internally.)"""
+    ctx = _get_ctx(group_name)
+    if len(tensors) != ctx.world_size:
+        raise ValueError("alltoall needs exactly world_size tensors")
+    parts = ctx.exchange([_to_numpy(t) for t in tensors], timeout)
+    return [_like(parts[j][ctx.rank], tensors[0])
+            for j in range(ctx.world_size)]
+
+
+def send(tensor, dst_rank: int, group_name: str = _DEFAULT_GROUP) -> None:
+    """Point-to-point send (reference: collective.py:531). Ordered per
+    (src, dst) pair."""
+    ctx = _get_ctx(group_name)
+    if dst_rank == ctx.rank:
+        raise ValueError("cannot send to self")
+    seq = ctx.next_p2p_seq(ctx._send_seq, dst_rank)
+    ray_tpu = _api()
+    ray_tpu.get(ctx.store.p2p_put.remote(
+        group_name, seq, ctx.rank, dst_rank, _to_numpy(tensor)))
+
+
+def recv(tensor_template, src_rank: int, group_name: str = _DEFAULT_GROUP,
+         timeout: Optional[float] = None):
+    """Point-to-point receive; returns the tensor (the reference mutates
+    in place — functional style here, collective.py:594)."""
+    ctx = _get_ctx(group_name)
+    if src_rank == ctx.rank:
+        raise ValueError("cannot recv from self")
+    seq = ctx.next_p2p_seq(ctx._recv_seq, src_rank)
+    ray_tpu = _api()
+    payload = ray_tpu.get(ctx.store.p2p_get.remote(
+        group_name, seq, src_rank, ctx.rank, timeout))
+    return _like(payload, tensor_template)
+
+
+# ---------------------------------------------------------------------------
+# device-mesh bridge
+# ---------------------------------------------------------------------------
+
+
+def get_group_mesh(group_name: str = _DEFAULT_GROUP, axis_name: str = "ranks"):
+    """Build a 1-D jax Mesh over this process's local devices for in-graph
+    collectives scoped to the group. On a multi-host slice the worker group
+    must have run jax.distributed.initialize (ray_tpu.train's JaxBackend
+    does); then jax.devices() spans the slice and the mesh is global."""
+    import jax
+    from jax.sharding import Mesh
+
+    devices = np.array(jax.devices())
+    return Mesh(devices, (axis_name,))

@@ -1,0 +1,148 @@
+"""Rendezvous store actor for collective groups.
+
+Reference: python/ray/util/collective/collective_group/nccl_util.py +
+the named-actor rendezvous used by NCCLUniqueID exchange (reference
+collective_group/rendezvous). Here the store is not just bootstrap — for
+the ``host`` backend it is also the exchange plane: every collective op is
+one ``exchange`` round (all ranks deposit, all ranks withdraw), which over
+the in-process RPC transport costs two hops per rank. Device-plane
+collectives should instead be in-graph XLA ops (ray_tpu/parallel/).
+
+The store is an async actor, so all ranks of a group can block inside
+``exchange`` concurrently on asyncio events.
+
+Error semantics: a rank that times out inside a collective leaves the
+group desynchronized (its peers may still be waiting on that seq) — same
+contract as NCCL: after a timeout, destroy and recreate the group.
+``destroy_group`` wakes all blocked waiters with an error.
+"""
+
+from __future__ import annotations
+
+import asyncio
+from typing import Any, Dict, Optional
+
+STORE_ACTOR_NAME = "_ray_tpu_collective_store"
+STORE_NAMESPACE = "_ray_tpu_collective"
+
+_DESTROYED = "__group_destroyed__"
+
+
+class _Session:
+    """One in-flight collective round: (group, seq) -> deposits."""
+
+    __slots__ = ("data", "done", "withdrawals", "destroyed")
+
+    def __init__(self):
+        self.data: Dict[int, Any] = {}
+        self.done = asyncio.Event()
+        self.withdrawals = 0
+        self.destroyed = False
+
+
+class CollectiveStore:
+    """Group metadata + barrier/exchange sessions. One per cluster."""
+
+    def __init__(self):
+        self._groups: Dict[str, dict] = {}
+        self._sessions: Dict[tuple, _Session] = {}
+        self._p2p: Dict[tuple, Any] = {}
+        self._p2p_events: Dict[tuple, asyncio.Event] = {}
+
+    async def declare_group(self, group_name: str, world_size: int,
+                            backend: str,
+                            members: Optional[Dict[str, int]] = None) -> dict:
+        """Register (or validate) a group. ``members`` maps actor-id hex ->
+        rank for declarative creation (create_collective_group)."""
+        info = self._groups.get(group_name)
+        if info is None:
+            info = {"world_size": int(world_size), "backend": backend,
+                    "members": dict(members or {})}
+            self._groups[group_name] = info
+        else:
+            if info["world_size"] != int(world_size):
+                raise ValueError(
+                    f"group {group_name!r} already declared with world_size="
+                    f"{info['world_size']}, got {world_size}")
+            if members:
+                info["members"].update(members)
+        return info
+
+    async def get_group(self, group_name: str) -> Optional[dict]:
+        return self._groups.get(group_name)
+
+    async def destroy_group(self, group_name: str) -> None:
+        self._groups.pop(group_name, None)
+        for key in [k for k in self._sessions if k[0] == group_name]:
+            sess = self._sessions.pop(key)
+            sess.destroyed = True
+            sess.done.set()  # wake blocked waiters; they raise below
+        for key in [k for k in self._p2p_events if k[0] == group_name]:
+            self._p2p[key] = _DESTROYED
+            self._p2p_events[key].set()
+        for key in [k for k in self._p2p if k[0] == group_name]:
+            if self._p2p[key] is not _DESTROYED:
+                self._p2p.pop(key)
+
+    async def exchange(self, group_name: str, seq: int, rank: int,
+                       payload: Any, timeout: Optional[float] = None) -> list:
+        """All-to-all deposit/withdraw: blocks until every rank of the group
+        has deposited for this ``seq``, then returns payloads rank-ordered."""
+        info = self._groups.get(group_name)
+        if info is None:
+            raise ValueError(f"collective group {group_name!r} not declared")
+        world = info["world_size"]
+        key = (group_name, seq)
+        sess = self._sessions.get(key)
+        if sess is None:
+            sess = self._sessions[key] = _Session()
+        if rank in sess.data:
+            raise RuntimeError(
+                f"rank {rank} deposited twice for {group_name}#{seq}")
+        sess.data[rank] = payload
+        if len(sess.data) == world:
+            sess.done.set()
+        else:
+            try:
+                await asyncio.wait_for(sess.done.wait(), timeout)
+            except asyncio.TimeoutError:
+                if not sess.done.is_set():
+                    # Withdraw our deposit so peers can't complete the op
+                    # with a payload whose sender saw a failure.
+                    sess.data.pop(rank, None)
+                    if not sess.data:
+                        self._sessions.pop(key, None)
+                    raise
+        if sess.destroyed:
+            raise RuntimeError(
+                f"collective group {group_name!r} destroyed during op")
+        out = [sess.data[r] for r in sorted(sess.data)]
+        sess.withdrawals += 1
+        if sess.withdrawals == world:
+            self._sessions.pop(key, None)
+        return out
+
+    async def p2p_put(self, group_name: str, seq: int, src: int, dst: int,
+                      payload: Any) -> None:
+        key = (group_name, seq, src, dst)
+        self._p2p[key] = payload
+        self._p2p_events.setdefault(key, asyncio.Event()).set()
+
+    async def p2p_get(self, group_name: str, seq: int, src: int, dst: int,
+                      timeout: Optional[float] = None) -> Any:
+        key = (group_name, seq, src, dst)
+        ev = self._p2p_events.setdefault(key, asyncio.Event())
+        try:
+            await asyncio.wait_for(ev.wait(), timeout)
+        except asyncio.TimeoutError:
+            self._p2p_events.pop(key, None)
+            raise
+        self._p2p_events.pop(key, None)
+        payload = self._p2p.pop(key)
+        if isinstance(payload, str) and payload == _DESTROYED:
+            raise RuntimeError(
+                f"collective group {group_name!r} destroyed during recv")
+        return payload
+
+    async def ping(self) -> str:
+        return "ok"

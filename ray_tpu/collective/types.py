@@ -1,0 +1,42 @@
+"""Collective type declarations.
+
+Reference surface: python/ray/util/collective/types.py (ReduceOp enum,
+backend spec). The TPU build keeps the declarative group spec but replaces
+the NCCL/Gloo backend pair with:
+
+- ``host``: rendezvous-store exchange over the task/actor RPC plane (the
+  gloo analog — DCN/host-side barriers, small tensors, bootstrap).
+- ``xla``: same rendezvous for out-of-graph calls, but the *preferred*
+  device path is in-graph XLA collectives (psum/all_gather/ppermute under
+  shard_map over a named mesh — see ray_tpu/parallel/), which ride ICI and
+  never touch the host. ``get_group_mesh`` bridges a collective group to
+  that world.
+"""
+
+from __future__ import annotations
+
+import enum
+
+
+class Backend(str, enum.Enum):
+    HOST = "host"
+    XLA = "xla"
+
+    @classmethod
+    def parse(cls, value: str) -> "Backend":
+        v = str(value).lower()
+        # Accept the reference's backend names so ported user code runs:
+        # host-side groups stand in for gloo; xla groups for nccl.
+        if v in ("host", "gloo", "cpu"):
+            return cls.HOST
+        if v in ("xla", "nccl", "tpu", "ici"):
+            return cls.XLA
+        raise ValueError(f"unknown collective backend: {value!r}")
+
+
+class ReduceOp(str, enum.Enum):
+    SUM = "sum"
+    PRODUCT = "product"
+    MIN = "min"
+    MAX = "max"
+    MEAN = "mean"

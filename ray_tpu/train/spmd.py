@@ -68,10 +68,9 @@ def make_sharded_train(
             rules[k] = None
 
     if batch_spec is None:
-        data_axes = tuple(
-            a for a in ("data", "fsdp") if a in mesh.axis_names
-        )
-        batch_spec = P(data_axes if data_axes else None)
+        from ray_tpu.parallel.mesh import data_axes
+
+        batch_spec = P(data_axes(mesh))
     batch_sharding = jax.tree.map(
         lambda _: NamedSharding(mesh, batch_spec), example_batch
     )

@@ -1,0 +1,166 @@
+"""Collective layer tests (reference strategy:
+python/ray/util/collective/tests/ — rank actors exercising each op)."""
+
+import numpy as np
+import pytest
+
+import ray_tpu
+from ray_tpu import collective as col
+
+
+@ray_tpu.remote
+class Rank:
+    def setup(self, world_size, rank, group_name):
+        col.init_collective_group(world_size, rank, backend="host",
+                                  group_name=group_name)
+        self.rank = rank
+        self.world = world_size
+        self.group = group_name
+        return rank
+
+    def do_allreduce(self, value):
+        return col.allreduce(np.full((4,), value, np.float32),
+                             group_name=self.group)
+
+    def do_allgather(self):
+        return col.allgather(np.array([self.rank], np.int64),
+                             group_name=self.group)
+
+    def do_broadcast(self):
+        t = np.arange(3, dtype=np.float32) if self.rank == 1 else \
+            np.zeros(3, np.float32)
+        return col.broadcast(t, src_rank=1, group_name=self.group)
+
+    def do_reducescatter(self):
+        # Each rank contributes [0..world*2); sum chunked over ranks.
+        t = np.arange(self.world * 2, dtype=np.float32)
+        return col.reducescatter(t, group_name=self.group)
+
+    def do_barrier(self):
+        col.barrier(group_name=self.group)
+        return self.rank
+
+    def do_alltoall(self):
+        tensors = [np.array([self.rank * 10 + j]) for j in range(self.world)]
+        return col.alltoall(tensors, group_name=self.group)
+
+    def do_sendrecv(self):
+        if self.rank == 0:
+            col.send(np.array([42.0]), dst_rank=1, group_name=self.group)
+            return None
+        return col.recv(np.zeros(1), src_rank=0, group_name=self.group)
+
+    def query(self):
+        return (col.get_rank(self.group),
+                col.get_collective_group_size(self.group),
+                col.is_group_initialized(self.group))
+
+
+@pytest.fixture(scope="module")
+def group(ray_start):
+    world = 3
+    actors = [Rank.remote() for _ in range(world)]
+    ray_tpu.get([a.setup.remote(world, i, "g1")
+                 for i, a in enumerate(actors)])
+    yield actors
+    for a in actors:
+        ray_tpu.kill(a)
+
+
+def test_allreduce(group):
+    results = ray_tpu.get([a.do_allreduce.remote(float(i + 1))
+                           for i, a in enumerate(group)])
+    for r in results:
+        np.testing.assert_allclose(r, np.full((4,), 6.0))
+
+
+def test_allgather(group):
+    results = ray_tpu.get([a.do_allgather.remote() for a in group])
+    for r in results:
+        assert [int(x[0]) for x in r] == [0, 1, 2]
+
+
+def test_broadcast(group):
+    results = ray_tpu.get([a.do_broadcast.remote() for a in group])
+    for r in results:
+        np.testing.assert_allclose(r, np.arange(3, dtype=np.float32))
+
+
+def test_reducescatter(group):
+    results = ray_tpu.get([a.do_reducescatter.remote() for a in group])
+    world = len(group)
+    full = np.arange(world * 2, dtype=np.float32) * world
+    for rank, r in enumerate(results):
+        np.testing.assert_allclose(r, full[rank * 2:(rank + 1) * 2])
+
+
+def test_barrier_and_introspection(group):
+    assert sorted(ray_tpu.get([a.do_barrier.remote() for a in group])) == \
+        [0, 1, 2]
+    infos = ray_tpu.get([a.query.remote() for a in group])
+    assert infos == [(0, 3, True), (1, 3, True), (2, 3, True)]
+
+
+def test_alltoall(group):
+    results = ray_tpu.get([a.do_alltoall.remote() for a in group])
+    # rank j receives [i*10+j for each source rank i]
+    for j, r in enumerate(results):
+        assert [int(x[0]) for x in r] == [i * 10 + j for i in range(3)]
+
+
+def test_send_recv(group):
+    out = ray_tpu.get([group[0].do_sendrecv.remote(),
+                       group[1].do_sendrecv.remote()])
+    assert out[0] is None
+    np.testing.assert_allclose(out[1], np.array([42.0]))
+
+
+@ray_tpu.remote
+class LazyRank:
+    def op(self, group_name):
+        # No init_collective_group call: rank resolved from the store's
+        # membership table on first op.
+        return col.allreduce(np.ones(2, np.float32), group_name=group_name)
+
+    def rank(self, group_name):
+        return col.get_rank(group_name)
+
+
+def test_declarative_group(ray_start):
+    world = 2
+    actors = [LazyRank.remote() for _ in range(world)]
+    col.create_collective_group(actors, world, list(range(world)),
+                                backend="host", group_name="g_lazy")
+    results = ray_tpu.get([a.op.remote("g_lazy") for a in actors])
+    for r in results:
+        np.testing.assert_allclose(r, np.array([2.0, 2.0]))
+    assert sorted(ray_tpu.get([a.rank.remote("g_lazy")
+                               for a in actors])) == [0, 1]
+    for a in actors:
+        ray_tpu.kill(a)
+
+
+def test_destroy_wakes_blocked_waiters(ray_start):
+    import time
+
+    @ray_tpu.remote
+    class Straggler:
+        def setup(self, world, rank):
+            col.init_collective_group(world, rank, backend="host",
+                                      group_name="g_destroy")
+        def blocked_barrier(self):
+            try:
+                col.barrier(group_name="g_destroy")
+                return "completed"
+            except Exception:
+                return "raised"
+
+    actors = [Straggler.remote() for _ in range(2)]
+    ray_tpu.get([a.setup.remote(2, i) for i, a in enumerate(actors)])
+    # Only rank 0 enters the barrier; rank 1 never arrives.
+    ref = actors[0].blocked_barrier.remote()
+    time.sleep(0.5)
+    col.destroy_collective_group("g_destroy")
+    assert ray_tpu.get(ref, timeout=10) == "raised"
+    for a in actors:
+        ray_tpu.kill(a)
