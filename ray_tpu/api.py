@@ -124,6 +124,12 @@ def _connect_driver(node: HeadNode, config: Config, namespace: str
 
 def shutdown():
     global _global_node, _global_worker
+    # Cluster-scoped caches in library modules die with the cluster.
+    import sys
+
+    col = sys.modules.get("ray_tpu.collective.collective")
+    if col is not None:
+        col._reset_state()
     with _init_lock:
         cw = object_ref_mod.get_core_worker()
         if cw is not None and _global_node is not None:

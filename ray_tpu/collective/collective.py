@@ -31,6 +31,15 @@ _groups: Dict[str, "GroupContext"] = {}
 _store_handle = None
 
 
+def _reset_state() -> None:
+    """Forget cached store handle + group contexts. Called by
+    ray_tpu.shutdown(); a later init() gets a fresh store actor."""
+    global _store_handle
+    with _lock:
+        _groups.clear()
+        _store_handle = None
+
+
 def _api():
     import ray_tpu
 
@@ -77,12 +86,13 @@ def _get_store():
 
 class GroupContext:
     def __init__(self, group_name: str, rank: int, world_size: int,
-                 backend: Backend, store):
+                 backend: Backend, store, generation: int):
         self.group_name = group_name
         self.rank = rank
         self.world_size = world_size
         self.backend = backend
         self.store = store
+        self.generation = generation
         self._seq = itertools.count()
         self._send_seq: Dict[int, "itertools.count"] = {}
         self._recv_seq: Dict[int, "itertools.count"] = {}
@@ -102,7 +112,8 @@ class GroupContext:
         seq = self.next_seq()
         ray_tpu = _api()
         return ray_tpu.get(self.store.exchange.remote(
-            self.group_name, seq, self.rank, payload, timeout))
+            self.group_name, self.generation, seq, self.rank, payload,
+            timeout))
 
 
 def init_collective_group(world_size: int, rank: int,
@@ -111,19 +122,24 @@ def init_collective_group(world_size: int, rank: int,
     """Initialize this process's membership in a collective group.
 
     Call from every participating worker/actor with a distinct rank in
-    ``[0, world_size)`` (reference: collective.py:120)."""
+    ``[0, world_size)`` (reference: collective.py:120). Re-initializing is
+    allowed after destroy_collective_group (new store generation); it
+    replaces the stale local context."""
     if not 0 <= rank < world_size:
         raise ValueError(f"rank {rank} out of range for world {world_size}")
     be = Backend.parse(backend)
     store = _get_store()
     ray_tpu = _api()
-    ray_tpu.get(store.declare_group.remote(group_name, world_size, be.value))
+    info = ray_tpu.get(
+        store.declare_group.remote(group_name, world_size, be.value))
     with _lock:
-        if group_name in _groups:
+        existing = _groups.get(group_name)
+        if existing is not None and \
+                existing.generation == info["generation"]:
             raise RuntimeError(f"group {group_name!r} already initialized "
                                "in this process")
         _groups[group_name] = GroupContext(group_name, rank, world_size, be,
-                                           store)
+                                           store, info["generation"])
 
 
 def create_collective_group(actors: Sequence[Any], world_size: int,
@@ -135,6 +151,10 @@ def create_collective_group(actors: Sequence[Any], world_size: int,
     declare + lazy init)."""
     if len(actors) != len(ranks) or len(actors) != world_size:
         raise ValueError("need exactly world_size actors and ranks")
+    if sorted(int(r) for r in ranks) != list(range(world_size)):
+        raise ValueError(
+            f"ranks must be a permutation of 0..{world_size - 1}, "
+            f"got {list(ranks)}")
     be = Backend.parse(backend)
     store = _get_store()
     members = {a._actor_id.hex(): int(r) for a, r in zip(actors, ranks)}
@@ -163,10 +183,13 @@ def _get_ctx(group_name: str) -> GroupContext:
             f"collective group {group_name!r} is not declared for this actor")
     ctx = GroupContext(group_name, info["members"][actor_hex],
                        info["world_size"], Backend.parse(info["backend"]),
-                       store)
+                       store, info["generation"])
     with _lock:
-        _groups.setdefault(group_name, ctx)
-        return _groups[group_name]
+        held = _groups.get(group_name)
+        if held is not None and held.generation >= ctx.generation:
+            return held
+        _groups[group_name] = ctx
+        return ctx
 
 
 def is_group_initialized(group_name: str = _DEFAULT_GROUP) -> bool:
@@ -317,7 +340,8 @@ def send(tensor, dst_rank: int, group_name: str = _DEFAULT_GROUP) -> None:
     seq = ctx.next_p2p_seq(ctx._send_seq, dst_rank)
     ray_tpu = _api()
     ray_tpu.get(ctx.store.p2p_put.remote(
-        group_name, seq, ctx.rank, dst_rank, _to_numpy(tensor)))
+        group_name, ctx.generation, seq, ctx.rank, dst_rank,
+        _to_numpy(tensor)))
 
 
 def recv(tensor_template, src_rank: int, group_name: str = _DEFAULT_GROUP,
@@ -330,7 +354,7 @@ def recv(tensor_template, src_rank: int, group_name: str = _DEFAULT_GROUP,
     seq = ctx.next_p2p_seq(ctx._recv_seq, src_rank)
     ray_tpu = _api()
     payload = ray_tpu.get(ctx.store.p2p_get.remote(
-        group_name, seq, src_rank, ctx.rank, timeout))
+        group_name, ctx.generation, seq, src_rank, ctx.rank, timeout))
     return _like(payload, tensor_template)
 
 

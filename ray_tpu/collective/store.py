@@ -14,7 +14,10 @@ The store is an async actor, so all ranks of a group can block inside
 Error semantics: a rank that times out inside a collective leaves the
 group desynchronized (its peers may still be waiting on that seq) — same
 contract as NCCL: after a timeout, destroy and recreate the group.
-``destroy_group`` wakes all blocked waiters with an error.
+``destroy_group`` wakes all blocked waiters with an error. Every declare
+after a destroy bumps the group's **generation**; ops carry the caller's
+generation so stale GroupContexts from the old incarnation fail fast
+instead of desynchronizing the new one.
 """
 
 from __future__ import annotations
@@ -29,7 +32,7 @@ _DESTROYED = "__group_destroyed__"
 
 
 class _Session:
-    """One in-flight collective round: (group, seq) -> deposits."""
+    """One in-flight collective round: (group, gen, seq) -> deposits."""
 
     __slots__ = ("data", "done", "withdrawals", "destroyed")
 
@@ -45,7 +48,10 @@ class CollectiveStore:
 
     def __init__(self):
         self._groups: Dict[str, dict] = {}
+        self._generations: Dict[str, int] = {}
+        # (group, gen, seq) -> _Session
         self._sessions: Dict[tuple, _Session] = {}
+        # (group, gen, seq, src, dst) -> payload / waiting event
         self._p2p: Dict[tuple, Any] = {}
         self._p2p_events: Dict[tuple, asyncio.Event] = {}
 
@@ -56,8 +62,10 @@ class CollectiveStore:
         rank for declarative creation (create_collective_group)."""
         info = self._groups.get(group_name)
         if info is None:
+            gen = self._generations.get(group_name, 0) + 1
+            self._generations[group_name] = gen
             info = {"world_size": int(world_size), "backend": backend,
-                    "members": dict(members or {})}
+                    "members": dict(members or {}), "generation": gen}
             self._groups[group_name] = info
         else:
             if info["world_size"] != int(world_size):
@@ -77,22 +85,37 @@ class CollectiveStore:
             sess = self._sessions.pop(key)
             sess.destroyed = True
             sess.done.set()  # wake blocked waiters; they raise below
+        # p2p: wake blocked receivers with a destroy marker; drop
+        # undelivered payloads outright (their key's generation is dead, so
+        # nothing can collide with a recreated group).
         for key in [k for k in self._p2p_events if k[0] == group_name]:
-            self._p2p[key] = _DESTROYED
-            self._p2p_events[key].set()
+            if key not in self._p2p:  # a receiver is (or will be) waiting
+                self._p2p[key] = _DESTROYED
+                self._p2p_events[key].set()
         for key in [k for k in self._p2p if k[0] == group_name]:
             if self._p2p[key] is not _DESTROYED:
                 self._p2p.pop(key)
+                self._p2p_events.pop(key, None)
 
-    async def exchange(self, group_name: str, seq: int, rank: int,
-                       payload: Any, timeout: Optional[float] = None) -> list:
-        """All-to-all deposit/withdraw: blocks until every rank of the group
-        has deposited for this ``seq``, then returns payloads rank-ordered."""
+    def _check(self, group_name: str, generation: int) -> dict:
         info = self._groups.get(group_name)
         if info is None:
             raise ValueError(f"collective group {group_name!r} not declared")
+        if info["generation"] != generation:
+            raise RuntimeError(
+                f"stale collective context for {group_name!r} (generation "
+                f"{generation}, current {info['generation']}); re-init the "
+                "group in this process")
+        return info
+
+    async def exchange(self, group_name: str, generation: int, seq: int,
+                       rank: int, payload: Any,
+                       timeout: Optional[float] = None) -> list:
+        """All-to-all deposit/withdraw: blocks until every rank of the group
+        has deposited for this ``seq``, then returns payloads rank-ordered."""
+        info = self._check(group_name, generation)
         world = info["world_size"]
-        key = (group_name, seq)
+        key = (group_name, generation, seq)
         sess = self._sessions.get(key)
         if sess is None:
             sess = self._sessions[key] = _Session()
@@ -122,15 +145,18 @@ class CollectiveStore:
             self._sessions.pop(key, None)
         return out
 
-    async def p2p_put(self, group_name: str, seq: int, src: int, dst: int,
-                      payload: Any) -> None:
-        key = (group_name, seq, src, dst)
+    async def p2p_put(self, group_name: str, generation: int, seq: int,
+                      src: int, dst: int, payload: Any) -> None:
+        self._check(group_name, generation)
+        key = (group_name, generation, seq, src, dst)
         self._p2p[key] = payload
         self._p2p_events.setdefault(key, asyncio.Event()).set()
 
-    async def p2p_get(self, group_name: str, seq: int, src: int, dst: int,
+    async def p2p_get(self, group_name: str, generation: int, seq: int,
+                      src: int, dst: int,
                       timeout: Optional[float] = None) -> Any:
-        key = (group_name, seq, src, dst)
+        self._check(group_name, generation)
+        key = (group_name, generation, seq, src, dst)
         ev = self._p2p_events.setdefault(key, asyncio.Event())
         try:
             await asyncio.wait_for(ev.wait(), timeout)
@@ -138,7 +164,8 @@ class CollectiveStore:
             self._p2p_events.pop(key, None)
             raise
         self._p2p_events.pop(key, None)
-        payload = self._p2p.pop(key)
+        # Key may be gone if destroy_group raced the wakeup.
+        payload = self._p2p.pop(key, _DESTROYED)
         if isinstance(payload, str) and payload == _DESTROYED:
             raise RuntimeError(
                 f"collective group {group_name!r} destroyed during recv")

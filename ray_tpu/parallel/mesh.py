@@ -81,7 +81,12 @@ def local_mesh(**axis_sizes) -> Mesh:
     return create_mesh(MeshConfig(**axis_sizes))
 
 
-def data_axes(mesh: Mesh) -> Tuple[str, ...]:
-    """Axes a batch dimension shards over (data + fsdp when present)."""
-    return tuple(a for a in ("data", "fsdp") if a in mesh.axis_names
-                 and mesh.shape[a] > 1) or ("data",)
+def data_axes(mesh: Mesh) -> Optional[Tuple[str, ...]]:
+    """Axes a batch dimension shards over (data + fsdp when present).
+    Returns None (replicate) for meshes with no batch-carrying axis, so
+    the result is always a valid PartitionSpec entry for ``mesh``."""
+    axes = tuple(a for a in ("data", "fsdp") if a in mesh.axis_names
+                 and mesh.shape[a] > 1)
+    if axes:
+        return axes
+    return ("data",) if "data" in mesh.axis_names else None

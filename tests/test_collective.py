@@ -164,3 +164,39 @@ def test_destroy_wakes_blocked_waiters(ray_start):
     assert ray_tpu.get(ref, timeout=10) == "raised"
     for a in actors:
         ray_tpu.kill(a)
+
+
+def test_group_recreate_after_destroy(ray_start):
+    """Generation bump: a destroyed group can be recreated and stale
+    contexts fail fast instead of desynchronizing the new incarnation."""
+
+    @ray_tpu.remote
+    class R:
+        def init(self, world, rank, name):
+            col.init_collective_group(world, rank, group_name=name)
+        def reduce(self, name):
+            return col.allreduce(np.ones(1, np.float32), group_name=name)
+
+    a1 = [R.remote() for _ in range(2)]
+    ray_tpu.get([a.init.remote(2, i, "g_regen") for i, a in enumerate(a1)])
+    ray_tpu.get([a.reduce.remote("g_regen") for a in a1])
+    col.destroy_collective_group("g_regen")
+    # Old members' stale contexts now error (not hang).
+    with pytest.raises(Exception):
+        ray_tpu.get(a1[0].reduce.remote("g_regen"), timeout=30)
+    # Fresh gang on the same name works.
+    a2 = [R.remote() for _ in range(2)]
+    ray_tpu.get([a.init.remote(2, i, "g_regen") for i, a in enumerate(a2)])
+    out = ray_tpu.get([a.reduce.remote("g_regen") for a in a2])
+    for r in out:
+        np.testing.assert_allclose(r, np.array([2.0]))
+    for a in a1 + a2:
+        ray_tpu.kill(a)
+
+
+def test_create_group_validates_ranks(ray_start):
+    a = [object(), object()]
+    with pytest.raises(ValueError):
+        col.create_collective_group(a, 2, [0, 0], group_name="g_bad")
+    with pytest.raises(ValueError):
+        col.create_collective_group(a, 2, [1, 2], group_name="g_bad2")
