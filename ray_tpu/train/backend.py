@@ -1,0 +1,90 @@
+"""Training backends: process-group/device-world setup hooks.
+
+Reference surface: python/ray/train/backend.py (Backend ABC) +
+train/torch/config.py:62-147 (_TorchBackend building NCCL process groups).
+The TPU-native backend replaces NCCL bootstrap with
+``jax.distributed.initialize``: after on_start, ``jax.devices()`` on every
+worker spans the whole slice and GSPMD programs (ray_tpu/train/spmd.py)
+sync gradients in-graph over ICI — there is no out-of-graph gradient
+plane to configure (SURVEY.md §3.4 TPU mapping).
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+from ray_tpu.train.config import ScalingConfig
+from ray_tpu.train.worker_group import WorkerGroup
+
+
+class Backend:
+    def on_start(self, worker_group: WorkerGroup,
+                 scaling_config: ScalingConfig) -> None:
+        """Called after workers start, before the train loop."""
+
+    def on_shutdown(self, worker_group: WorkerGroup) -> None:
+        """Called before workers are torn down."""
+
+
+def _jax_distributed_init(coordinator: str, num_processes: int,
+                          process_id: int) -> None:
+    import jax
+
+    jax.distributed.initialize(
+        coordinator_address=coordinator,
+        num_processes=num_processes,
+        process_id=process_id,
+    )
+
+
+def _jax_distributed_shutdown() -> None:
+    import jax
+
+    try:
+        jax.distributed.shutdown()
+    except Exception:
+        pass
+
+
+class JaxBackend(Backend):
+    """Bootstraps the jax device world across the worker gang.
+
+    ``distributed=None`` (auto): initialize jax.distributed only for
+    multi-worker TPU gangs — each worker is one host of a slice. On
+    single-host or CPU test gangs, workers keep independent device worlds
+    and host-plane sync goes through ray_tpu.collective.
+    """
+
+    def __init__(self, distributed: Optional[bool] = None,
+                 coordinator_port: Optional[int] = None):
+        self.distributed = distributed
+        self.coordinator_port = coordinator_port
+
+    def _should_init(self, scaling: ScalingConfig, world: int) -> bool:
+        if self.distributed is not None:
+            return self.distributed and world > 1
+        return scaling.use_tpu and world > 1
+
+    def on_start(self, worker_group: WorkerGroup,
+                 scaling_config: ScalingConfig) -> None:
+        world = worker_group.num_workers
+        if not self._should_init(scaling_config, world):
+            return
+        ip = worker_group.execute_single(0, "node_ip")
+        port = (self.coordinator_port or
+                worker_group.execute_single(0, "find_free_port"))
+        coordinator = f"{ip}:{port}"
+        import ray_tpu
+
+        refs = [
+            w.execute.remote(_jax_distributed_init, coordinator, world, i)
+            for i, w in enumerate(worker_group.workers)
+        ]
+        ray_tpu.get(refs, timeout=120)
+
+    def on_shutdown(self, worker_group: WorkerGroup) -> None:
+        if worker_group.num_workers > 1:
+            try:
+                worker_group.execute("execute", _jax_distributed_shutdown)
+            except Exception:
+                pass

@@ -1,0 +1,131 @@
+"""BackendExecutor: drives the worker gang through one training run.
+
+Reference surface: python/ray/train/_internal/backend_executor.py
+(start:124, start_training:438, get_next_results:552). Streams per-report
+results from all ranks; rank-0's checkpoints feed the CheckpointManager.
+"""
+
+from __future__ import annotations
+
+import logging
+import os
+from typing import Any, Callable, Dict, List, Optional
+
+from ray_tpu.train.backend import Backend, JaxBackend
+from ray_tpu.train.checkpoint import Checkpoint
+from ray_tpu.train.config import ScalingConfig
+from ray_tpu.train.worker_group import WorkerGroup
+
+logger = logging.getLogger(__name__)
+
+
+class TrainingWorkerError(RuntimeError):
+    pass
+
+
+class BackendExecutor:
+    def __init__(self, scaling_config: ScalingConfig,
+                 backend: Optional[Backend] = None,
+                 experiment_name: str = "train",
+                 trial_id: str = ""):
+        self.scaling = scaling_config
+        self.backend = backend or JaxBackend()
+        self.experiment_name = experiment_name
+        self.trial_id = trial_id
+        self.worker_group: Optional[WorkerGroup] = None
+
+    def start(self) -> None:
+        self.worker_group = WorkerGroup(
+            self.scaling.total_workers,
+            self.scaling.worker_resources(),
+            self.scaling.placement_strategy,
+        )
+        world = self.worker_group.num_workers
+        # Rank/topology env before any jax import in the workers
+        # (reference: backend_executor._setup_gpu/TPU env propagation).
+        def _env(rank: int) -> Dict[str, str]:
+            env = {
+                "RAY_TPU_WORLD_SIZE": str(world),
+                "RAY_TPU_WORLD_RANK": str(rank),
+            }
+            if self.scaling.topology:
+                env["RAY_TPU_TOPOLOGY"] = self.scaling.topology
+            return env
+
+        refs = [w.setup_env.remote(_env(rank))
+                for rank, w in enumerate(self.worker_group.workers)]
+        import ray_tpu
+
+        ray_tpu.get(refs)
+        self.backend.on_start(self.worker_group, self.scaling)
+
+    def start_training(self, train_fn: Callable[[dict], None],
+                       config: Dict[str, Any],
+                       resume_checkpoint: Optional[Checkpoint] = None,
+                       datasets: Optional[Dict[str, Any]] = None) -> None:
+        wg = self.worker_group
+        world = wg.num_workers
+        refs = []
+        for rank, w in enumerate(wg.workers):
+            shard = None
+            if datasets:
+                shard = {name: _shard_for(ds, rank, world)
+                         for name, ds in datasets.items()}
+            refs.append(w.init_session.remote(
+                dict(world_size=world, world_rank=rank, local_rank=0,
+                     node_rank=rank, experiment_name=self.experiment_name,
+                     trial_id=self.trial_id),
+                resume_checkpoint.path if resume_checkpoint else None,
+                shard))
+        import ray_tpu
+
+        ray_tpu.get(refs)
+        wg.execute("start_training", train_fn, config)
+
+    def get_next_results(self, timeout: float = 600.0
+                         ) -> Optional[List[dict]]:
+        """One event per rank, synchronized (reference: all ranks must
+        report in lockstep). Returns None when training is done; raises on
+        any rank error."""
+        wg = self.worker_group
+        events = wg.execute("next_report", timeout)
+        kinds = {k for k, _, _ in events}
+        if "error" in kinds:
+            msgs = [p for k, p, _ in events if k == "error"]
+            raise TrainingWorkerError("\n---\n".join(msgs))
+        if "timeout" in kinds:
+            raise TrainingWorkerError(
+                f"worker report timed out after {timeout}s "
+                "(ranks must call train.report in lockstep)")
+        if kinds == {"done"}:
+            return None
+        if "done" in kinds:
+            raise TrainingWorkerError(
+                "ranks desynchronized: some finished while others reported")
+        return [
+            {"metrics": metrics, "checkpoint_path": ckpt_path, "rank": i}
+            for i, (_, metrics, ckpt_path) in enumerate(events)
+        ]
+
+    def request_stop(self):
+        if self.worker_group is not None:
+            self.worker_group.execute("request_stop")
+
+    def shutdown(self):
+        if self.worker_group is not None:
+            try:
+                self.backend.on_shutdown(self.worker_group)
+            finally:
+                self.worker_group.shutdown()
+                self.worker_group = None
+
+
+def _shard_for(ds, rank: int, world: int):
+    """Split a dataset-like across ranks. ray_tpu.data Datasets split
+    natively; lists/arrays stride; everything else is replicated."""
+    split = getattr(ds, "split_for_worker", None)
+    if callable(split):
+        return split(rank, world)
+    if isinstance(ds, (list, tuple)):
+        return type(ds)(ds[rank::world])
+    return ds

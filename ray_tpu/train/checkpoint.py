@@ -1,0 +1,114 @@
+"""Checkpoint: a directory handle + sharded-pytree save/restore.
+
+Reference surface: python/ray/train/_checkpoint.py:56 (Checkpoint as a
+directory reference with from_directory/to_directory/as_directory) and the
+orbax-style TPU mapping from SURVEY.md §5.4: every host writes its own
+shard of a sharded jax pytree; restore re-shards onto the running mesh.
+
+Pytree persistence uses flax.serialization msgpack for leaves plus a
+pickled treedef skeleton — no framework lock-in in the directory format:
+``checkpoint_dir/{shard_<rank>.msgpack, meta.pkl, <user files>}``.
+"""
+
+from __future__ import annotations
+
+import contextlib
+import os
+import pickle
+import shutil
+import tempfile
+from typing import Any, Iterator, Optional
+
+
+class Checkpoint:
+    """A handle to a checkpoint directory (local/shared filesystem)."""
+
+    def __init__(self, path: str):
+        self.path = os.path.abspath(path)
+
+    @classmethod
+    def from_directory(cls, path: str) -> "Checkpoint":
+        if not os.path.isdir(path):
+            raise ValueError(f"not a directory: {path}")
+        return cls(path)
+
+    def to_directory(self, dest: Optional[str] = None) -> str:
+        """Materialize into ``dest`` (copy). Reference semantics: always a
+        private copy the caller may mutate."""
+        dest = dest or tempfile.mkdtemp(prefix="rtpu_ckpt_")
+        if os.path.abspath(dest) != self.path:
+            shutil.copytree(self.path, dest, dirs_exist_ok=True)
+        return dest
+
+    @contextlib.contextmanager
+    def as_directory(self) -> Iterator[str]:
+        """Read-only view without copying (we are on a shared fs)."""
+        yield self.path
+
+    # -- pytree helpers ----------------------------------------------------
+
+    @classmethod
+    def from_pytree(cls, tree: Any, path: str, *, shard_rank: int = 0,
+                    user_meta: Optional[dict] = None) -> "Checkpoint":
+        """Write ``tree`` (host-local arrays or a process's addressable
+        shards) as this rank's shard file. Multi-host: every rank calls
+        this with the same ``path`` on shared storage."""
+        import jax
+        from flax import serialization
+
+        os.makedirs(path, exist_ok=True)
+        # Pull addressable data to host; fully-replicated arrays write only
+        # from rank 0 (callers pass shard_rank=their process index).
+        host_tree = jax.tree.map(_to_host, tree)
+        leaves, treedef = jax.tree.flatten(host_tree)
+        blob = serialization.msgpack_serialize(
+            {str(i): leaf for i, leaf in enumerate(leaves)})
+        with open(os.path.join(path, f"shard_{shard_rank}.msgpack"),
+                  "wb") as f:
+            f.write(blob)
+        if shard_rank == 0:
+            with open(os.path.join(path, "meta.pkl"), "wb") as f:
+                pickle.dump({"treedef": treedef,
+                             "user_meta": user_meta or {}}, f)
+        return cls(path)
+
+    def to_pytree(self, *, shard_rank: int = 0) -> Any:
+        """Restore this rank's shard as a pytree of numpy arrays; callers
+        re-shard onto their mesh with jax.device_put(..., sharding)."""
+        import jax
+        from flax import serialization
+
+        with open(os.path.join(self.path, "meta.pkl"), "rb") as f:
+            meta = pickle.load(f)
+        shard_file = os.path.join(self.path,
+                                  f"shard_{shard_rank}.msgpack")
+        if not os.path.exists(shard_file):
+            shard_file = os.path.join(self.path, "shard_0.msgpack")
+        with open(shard_file, "rb") as f:
+            loaded = serialization.msgpack_restore(f.read())
+        leaves = [loaded[str(i)] for i in range(len(loaded))]
+        return jax.tree.unflatten(meta["treedef"], leaves)
+
+    @property
+    def user_meta(self) -> dict:
+        with open(os.path.join(self.path, "meta.pkl"), "rb") as f:
+            return pickle.load(f)["user_meta"]
+
+    def __reduce__(self):
+        return (Checkpoint, (self.path,))
+
+    def __repr__(self):
+        return f"Checkpoint({self.path})"
+
+
+def _to_host(x):
+    import jax
+    import numpy as np
+
+    if isinstance(x, jax.Array):
+        if not x.is_fully_addressable:
+            # Multi-host sharded array: persist only this process's shards
+            # (orbax recipe); restore stitches by re-sharding.
+            return np.stack([s.data for s in x.addressable_shards])
+        return np.asarray(x)
+    return x

@@ -1,0 +1,66 @@
+"""Top-K checkpoint retention keyed on a reported metric.
+
+Reference: python/ray/train/_internal/checkpoint_manager.py (keep best K
+by score attribute, always keep the latest).
+"""
+
+from __future__ import annotations
+
+import os
+import shutil
+from typing import List, Optional, Tuple
+
+from ray_tpu.train.checkpoint import Checkpoint
+from ray_tpu.train.config import CheckpointConfig
+
+
+class CheckpointManager:
+    def __init__(self, config: Optional[CheckpointConfig] = None):
+        self.config = config or CheckpointConfig()
+        # (score, index, checkpoint); score None when no attribute set
+        self._tracked: List[Tuple[Optional[float], int, Checkpoint]] = []
+        self._index = 0
+        self.latest: Optional[Checkpoint] = None
+        self.best: Optional[Checkpoint] = None
+
+    def register(self, checkpoint: Checkpoint,
+                 metrics: Optional[dict] = None) -> None:
+        attr = self.config.checkpoint_score_attribute
+        score = None
+        if attr and metrics and attr in metrics:
+            score = float(metrics[attr])
+        self._tracked.append((score, self._index, checkpoint))
+        self._index += 1
+        self.latest = checkpoint
+        self._update_best()
+        self._evict()
+
+    def _sort_key(self, entry):
+        score, idx, _ = entry
+        sign = 1.0 if self.config.checkpoint_score_order == "max" else -1.0
+        # Unscored checkpoints rank by recency below any scored one.
+        return (score is not None, sign * score if score is not None else idx)
+
+    def _update_best(self):
+        if self._tracked:
+            self.best = max(self._tracked, key=self._sort_key)[2]
+
+    def _evict(self):
+        keep = self.config.num_to_keep
+        if keep is None or len(self._tracked) <= keep:
+            return
+        ranked = sorted(self._tracked, key=self._sort_key, reverse=True)
+        survivors = ranked[:keep]
+        # Never evict the latest (resume anchor, reference behavior): it is
+        # retained in addition to the top-K when it didn't make the cut.
+        if self.latest is not None and all(
+                c is not self.latest for _, _, c in survivors):
+            survivors.append(next(
+                e for e in self._tracked if e[2] is self.latest))
+        doomed = [e for e in self._tracked if e not in survivors]
+        self._tracked = [e for e in self._tracked if e in survivors]
+        for _, _, ckpt in doomed:
+            if os.path.isdir(ckpt.path):
+                shutil.rmtree(ckpt.path, ignore_errors=True)
+        # Best must point at a directory that still exists.
+        self._update_best()

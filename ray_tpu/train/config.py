@@ -1,0 +1,83 @@
+"""Run/scaling/failure/checkpoint configuration dataclasses.
+
+Reference surface: python/ray/air/config.py (ScalingConfig, RunConfig,
+FailureConfig, CheckpointConfig). TPU-first deltas: ``ScalingConfig`` gains
+a ``topology`` field describing the pod slice (one worker actor per TPU
+host, gang-placed via a placement group over the slice-head resource —
+reference accelerator trick: _private/accelerators/tpu.py:335), and a
+``mesh_shape`` preset handed to the JaxBackend for GSPMD.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import os
+from typing import Dict, Optional
+
+
+@dataclasses.dataclass
+class ScalingConfig:
+    """How many workers and what each one holds.
+
+    On a TPU pod slice: ``num_workers`` = number of hosts, each worker
+    claims the host's chips (``tpus_per_worker``); jax.distributed makes
+    the slice one device world.
+    """
+
+    num_workers: int = 1
+    use_tpu: bool = False
+    tpus_per_worker: float = 0.0
+    cpus_per_worker: float = 1.0
+    resources_per_worker: Optional[Dict[str, float]] = None
+    topology: Optional[str] = None  # e.g. "v5e-16": gang over slice heads
+    placement_strategy: str = "PACK"
+
+    def worker_resources(self) -> Dict[str, float]:
+        res = dict(self.resources_per_worker or {})
+        res.setdefault("CPU", float(self.cpus_per_worker))
+        if self.use_tpu or self.tpus_per_worker:
+            res.setdefault("TPU", float(self.tpus_per_worker or 1.0))
+        return res
+
+    @property
+    def total_workers(self) -> int:
+        return int(self.num_workers)
+
+
+@dataclasses.dataclass
+class FailureConfig:
+    """Elastic-recovery policy (reference: air/config.py FailureConfig).
+    ``max_failures``: group restarts (from latest checkpoint) before the
+    run errors out; TPU note — a slice failure is a gang failure, the
+    whole worker group restarts."""
+
+    max_failures: int = 0
+
+
+@dataclasses.dataclass
+class CheckpointConfig:
+    """Top-K retention (reference: air/config.py CheckpointConfig)."""
+
+    num_to_keep: Optional[int] = None
+    checkpoint_score_attribute: Optional[str] = None
+    checkpoint_score_order: str = "max"
+
+    def __post_init__(self):
+        if self.checkpoint_score_order not in ("max", "min"):
+            raise ValueError("checkpoint_score_order must be 'max' or 'min'")
+
+
+@dataclasses.dataclass
+class RunConfig:
+    """Where results/checkpoints land + failure policy
+    (reference: air/config.py RunConfig)."""
+
+    name: Optional[str] = None
+    storage_path: Optional[str] = None
+    failure_config: Optional[FailureConfig] = None
+    checkpoint_config: Optional[CheckpointConfig] = None
+    verbose: int = 0
+
+    def resolved_storage_path(self) -> str:
+        return os.path.expanduser(
+            self.storage_path or "~/ray_tpu_results")

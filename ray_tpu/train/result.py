@@ -1,0 +1,24 @@
+"""Result of a training run (reference: python/ray/air/result.py)."""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Any, Dict, List, Optional
+
+from ray_tpu.train.checkpoint import Checkpoint
+
+
+@dataclasses.dataclass
+class Result:
+    metrics: Dict[str, Any]
+    checkpoint: Optional[Checkpoint]
+    path: str
+    error: Optional[str] = None
+    metrics_history: Optional[List[Dict[str, Any]]] = None
+    best_checkpoint: Optional[Checkpoint] = None
+
+    @property
+    def metrics_dataframe(self):
+        import pandas as pd
+
+        return pd.DataFrame(self.metrics_history or [])

@@ -1,0 +1,118 @@
+"""Per-worker training session: report/context/checkpoint access.
+
+Reference surface: python/ray/train/_internal/session.py (report:653,
+get_context, get_checkpoint). The session is process-global inside a
+training worker; ``report`` hands (metrics, checkpoint) to the worker's
+outbox, which the driver-side BackendExecutor streams via next_report().
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import queue
+import threading
+from typing import Any, Dict, Optional
+
+from ray_tpu.train.checkpoint import Checkpoint
+
+_session_lock = threading.Lock()
+_session: Optional["_TrainSession"] = None
+
+
+@dataclasses.dataclass
+class TrainContext:
+    world_size: int
+    world_rank: int
+    local_rank: int
+    node_rank: int
+    experiment_name: str
+    trial_id: str = ""
+
+    def get_world_size(self) -> int:
+        return self.world_size
+
+    def get_world_rank(self) -> int:
+        return self.world_rank
+
+    def get_local_rank(self) -> int:
+        return self.local_rank
+
+    def get_node_rank(self) -> int:
+        return self.node_rank
+
+    def get_experiment_name(self) -> str:
+        return self.experiment_name
+
+
+class _TrainSession:
+    def __init__(self, context: TrainContext,
+                 resume_checkpoint: Optional[Checkpoint],
+                 datasets: Optional[Dict[str, Any]] = None):
+        self.context = context
+        self.resume_checkpoint = resume_checkpoint
+        self.datasets = datasets or {}
+        self.outbox: "queue.Queue" = queue.Queue()
+        self.reported_steps = 0
+        self.stop_requested = threading.Event()
+
+    def report(self, metrics: Dict[str, Any],
+               checkpoint: Optional[Checkpoint] = None) -> None:
+        self.outbox.put(("report", dict(metrics), checkpoint))
+        self.reported_steps += 1
+        # Cooperative early stop (Tune schedulers): raising here unwinds
+        # the user loop; the executor turns it into a clean finish.
+        if self.stop_requested.is_set():
+            raise StopTraining()
+
+
+class StopTraining(Exception):
+    """Raised inside the user train loop on scheduler-requested stop."""
+
+
+def _init_session(context: TrainContext,
+                  resume_checkpoint: Optional[Checkpoint],
+                  datasets: Optional[Dict[str, Any]] = None
+                  ) -> _TrainSession:
+    global _session
+    with _session_lock:
+        _session = _TrainSession(context, resume_checkpoint, datasets)
+        return _session
+
+
+def _shutdown_session() -> None:
+    global _session
+    with _session_lock:
+        _session = None
+
+
+def _get_session() -> _TrainSession:
+    if _session is None:
+        raise RuntimeError(
+            "No training session active — train.report()/get_context() are "
+            "only valid inside a train_loop_per_worker")
+    return _session
+
+
+def report(metrics: Dict[str, Any],
+           checkpoint: Optional[Checkpoint] = None) -> None:
+    """Report metrics (+ optional checkpoint) from the train loop
+    (reference: train/_internal/session.py:653)."""
+    _get_session().report(metrics, checkpoint)
+
+
+def get_context() -> TrainContext:
+    return _get_session().context
+
+
+def get_checkpoint() -> Optional[Checkpoint]:
+    """Latest checkpoint to resume from (set on restart after failure)."""
+    return _get_session().resume_checkpoint
+
+
+def get_dataset_shard(name: str = "train"):
+    """This worker's shard of a dataset passed to the trainer
+    (reference: session.get_dataset_shard)."""
+    ds = _get_session().datasets.get(name)
+    if ds is None:
+        raise KeyError(f"no dataset named {name!r} was passed to the trainer")
+    return ds

@@ -1,0 +1,170 @@
+"""JaxTrainer: the user-facing distributed trainer.
+
+Reference surface: python/ray/train/base_trainer.py:581 (fit),
+data_parallel_trainer.py:26 (training_loop shape), restore(:316).
+Differences by design: the trainer drives the BackendExecutor directly —
+Tune integration is an explicit wrapper (ray_tpu.tune builds a Trainable
+from any trainer via ``as_trainable``) instead of every fit() routing
+through a Tune controller.
+
+Failure handling (reference FailureConfig semantics, TPU gang flavor):
+any worker failure kills the whole gang; up to ``max_failures`` restarts
+re-run the loop from the latest registered checkpoint via
+``session.get_checkpoint()``.
+"""
+
+from __future__ import annotations
+
+import logging
+import os
+import shutil
+import time
+from typing import Any, Callable, Dict, Optional
+
+from ray_tpu.train.backend import Backend, JaxBackend
+from ray_tpu.train.backend_executor import (
+    BackendExecutor,
+    TrainingWorkerError,
+)
+from ray_tpu.train.checkpoint import Checkpoint
+from ray_tpu.train.checkpoint_manager import CheckpointManager
+from ray_tpu.train.config import (
+    CheckpointConfig,
+    FailureConfig,
+    RunConfig,
+    ScalingConfig,
+)
+from ray_tpu.train.result import Result
+
+logger = logging.getLogger(__name__)
+
+
+class JaxTrainer:
+    def __init__(
+        self,
+        train_loop_per_worker: Callable[[dict], None],
+        *,
+        train_loop_config: Optional[Dict[str, Any]] = None,
+        scaling_config: Optional[ScalingConfig] = None,
+        run_config: Optional[RunConfig] = None,
+        backend: Optional[Backend] = None,
+        datasets: Optional[Dict[str, Any]] = None,
+        resume_from_checkpoint: Optional[Checkpoint] = None,
+    ):
+        self.train_loop = train_loop_per_worker
+        self.train_loop_config = train_loop_config or {}
+        self.scaling_config = scaling_config or ScalingConfig()
+        self.run_config = run_config or RunConfig()
+        self.backend = backend or JaxBackend()
+        self.datasets = datasets
+        self.resume_from_checkpoint = resume_from_checkpoint
+
+    # -- storage layout ----------------------------------------------------
+
+    def _experiment_dir(self) -> str:
+        name = self.run_config.name or f"jax_trainer_{int(time.time())}"
+        path = os.path.join(self.run_config.resolved_storage_path(), name)
+        os.makedirs(path, exist_ok=True)
+        return path
+
+    def fit(self) -> Result:
+        exp_dir = self._experiment_dir()
+        ckpt_config = self.run_config.checkpoint_config or CheckpointConfig()
+        failure_config = self.run_config.failure_config or FailureConfig()
+        manager = CheckpointManager(ckpt_config)
+        resume = self.resume_from_checkpoint
+        history: list = []
+        last_metrics: Dict[str, Any] = {}
+        attempts = failure_config.max_failures + 1
+        error: Optional[str] = None
+
+        for attempt in range(attempts):
+            executor = BackendExecutor(
+                self.scaling_config, self.backend,
+                experiment_name=os.path.basename(exp_dir))
+            try:
+                executor.start()
+                executor.start_training(
+                    self.train_loop, self.train_loop_config,
+                    resume_checkpoint=resume, datasets=self.datasets)
+                ckpt_seq = len(history)
+                while True:
+                    results = executor.get_next_results()
+                    if results is None:
+                        break
+                    rank0 = results[0]
+                    last_metrics = rank0["metrics"]
+                    history.append(dict(last_metrics))
+                    ckpt = self._collect_checkpoint(
+                        results, exp_dir, ckpt_seq)
+                    ckpt_seq += 1
+                    if ckpt is not None:
+                        manager.register(ckpt, last_metrics)
+                        resume = manager.latest
+                error = None
+                break
+            except Exception as e:  # worker death, report error, infra
+                error = str(e)
+                logger.warning(
+                    "training attempt %d/%d failed: %s",
+                    attempt + 1, attempts, e)
+                resume = manager.latest or self.resume_from_checkpoint
+            finally:
+                executor.shutdown()
+
+        return Result(
+            metrics=last_metrics,
+            checkpoint=manager.latest,
+            path=exp_dir,
+            error=error,
+            metrics_history=history,
+            best_checkpoint=manager.best,
+        )
+
+    def _collect_checkpoint(self, results, exp_dir: str,
+                            seq: int) -> Optional[Checkpoint]:
+        """Move reported checkpoint dirs into the experiment dir. Multi-rank
+        reports merge into one directory (each rank wrote distinct shard
+        files — the orbax recipe)."""
+        paths = [r["checkpoint_path"] for r in results
+                 if r["checkpoint_path"]]
+        if not paths:
+            return None
+        dest = os.path.join(exp_dir, f"checkpoint_{seq:06d}")
+        os.makedirs(dest, exist_ok=True)
+        for p in dict.fromkeys(paths):  # dedupe, keep order
+            if os.path.abspath(p) == os.path.abspath(dest):
+                continue
+            if os.path.isdir(p):
+                shutil.copytree(p, dest, dirs_exist_ok=True)
+                shutil.rmtree(p, ignore_errors=True)
+        return Checkpoint(dest)
+
+    def as_trainable(self):
+        """Adapter for ray_tpu.tune: a function trainable closing over this
+        trainer's configs; Tune overrides train_loop_config per trial."""
+        base = self
+
+        def trainable(config: dict):
+            merged = dict(base.train_loop_config)
+            merged.update(config)
+            trainer = JaxTrainer(
+                base.train_loop,
+                train_loop_config=merged,
+                scaling_config=base.scaling_config,
+                run_config=base.run_config,
+                backend=base.backend,
+                datasets=base.datasets,
+                resume_from_checkpoint=base.resume_from_checkpoint,
+            )
+            result = trainer.fit()
+            if result.error:
+                raise RuntimeError(result.error)
+            return result.metrics
+
+        trainable.__name__ = "jax_trainer"
+        return trainable
+
+
+# Alias matching the reference's family naming (TorchTrainer et al.)
+DataParallelTrainer = JaxTrainer

@@ -1,0 +1,185 @@
+"""WorkerGroup: the gang of training-worker actors.
+
+Reference surface: python/ray/train/_internal/worker_group.py:102,188 —
+N actors with per-worker resources, ``execute`` fan-out. TPU delta: the
+group is gang-placed via a placement group (one bundle per worker,
+STRICT_PACK-by-slice when a topology is set) because a pod slice is one
+failure/placement domain (SURVEY.md §7.3 item 2).
+"""
+
+from __future__ import annotations
+
+import os
+import queue
+import socket
+import threading
+import traceback
+from typing import Any, Callable, Dict, List, Optional
+
+
+class TrainWorker:
+    """Actor body: hosts the user's train loop + the report outbox."""
+
+    def __init__(self, world_rank: int):
+        self.world_rank = world_rank
+        self._thread: Optional[threading.Thread] = None
+        self._session = None
+
+    def setup_env(self, env: Dict[str, str]) -> str:
+        os.environ.update(env)
+        # The container's sitecustomize force-sets jax_platforms to the
+        # tunneled TPU in every interpreter; honor an explicit JAX_PLATFORMS
+        # (tests run workers on the virtual CPU mesh this way).
+        if "JAX_PLATFORMS" in os.environ:
+            try:
+                import jax
+
+                jax.config.update("jax_platforms",
+                                  os.environ["JAX_PLATFORMS"])
+            except Exception:
+                pass
+        return socket.gethostname()
+
+    def node_ip(self) -> str:
+        return socket.gethostbyname(socket.gethostname())
+
+    def find_free_port(self) -> int:
+        s = socket.socket()
+        s.bind(("", 0))
+        port = s.getsockname()[1]
+        s.close()
+        return port
+
+    def execute(self, fn: Callable, *args, **kwargs):
+        """Run an arbitrary function in the worker process (reference:
+        worker_group.py execute)."""
+        return fn(*args, **kwargs)
+
+    def init_session(self, context_kwargs: dict,
+                     resume_checkpoint_path: Optional[str],
+                     datasets: Optional[dict] = None) -> None:
+        from ray_tpu.train import session as session_mod
+        from ray_tpu.train.checkpoint import Checkpoint
+        from ray_tpu.train.session import TrainContext
+
+        ckpt = (Checkpoint(resume_checkpoint_path)
+                if resume_checkpoint_path else None)
+        self._session = session_mod._init_session(
+            TrainContext(**context_kwargs), ckpt, datasets)
+
+    def start_training(self, train_fn: Callable, config: dict) -> None:
+        """Launch the user loop on a thread; results stream via
+        next_report()."""
+        assert self._session is not None, "init_session first"
+        sess = self._session
+
+        def runner():
+            from ray_tpu.train.session import StopTraining
+
+            try:
+                train_fn(config)
+                sess.outbox.put(("done", None, None))
+            except StopTraining:
+                sess.outbox.put(("done", None, None))
+            except BaseException as e:  # noqa: BLE001 — ships to driver
+                sess.outbox.put(
+                    ("error", f"{type(e).__name__}: {e}\n"
+                              f"{traceback.format_exc()}", None))
+
+        self._thread = threading.Thread(target=runner, daemon=True,
+                                        name="train_loop")
+        self._thread.start()
+
+    def next_report(self, timeout: float = 600.0):
+        """Block for the next (kind, metrics, checkpoint_path) event."""
+        sess = self._session
+        try:
+            kind, payload, ckpt = sess.outbox.get(timeout=timeout)
+        except queue.Empty:
+            return ("timeout", None, None)
+        return (kind, payload, ckpt.path if ckpt is not None else None)
+
+    def request_stop(self) -> None:
+        if self._session is not None:
+            self._session.stop_requested.set()
+
+    def shutdown_session(self) -> None:
+        from ray_tpu.train import session as session_mod
+
+        session_mod._shutdown_session()
+        self._session = None
+
+
+class WorkerGroup:
+    def __init__(self, num_workers: int, resources: Dict[str, float],
+                 placement_strategy: str = "PACK"):
+        import ray_tpu
+
+        self.num_workers = num_workers
+        self.pg = None
+        actor_cls = ray_tpu.remote(TrainWorker)
+        common = dict(
+            num_cpus=resources.get("CPU", 0.0),
+            num_tpus=resources.get("TPU", 0.0),
+            resources={k: v for k, v in resources.items()
+                       if k not in ("CPU", "TPU", "memory")} or None,
+        )
+        if num_workers > 1:
+            from ray_tpu.core.task_spec import (
+                PlacementGroupSchedulingStrategy,
+            )
+
+            self.pg = ray_tpu.placement_group(
+                [dict(resources) for _ in range(num_workers)],
+                strategy=placement_strategy)
+            try:
+                if not self.pg.ready(timeout=60):
+                    raise RuntimeError(
+                        "placement group for worker gang not placeable "
+                        f"({num_workers} x {resources})")
+                self.workers = [
+                    actor_cls.options(
+                        scheduling_strategy=PlacementGroupSchedulingStrategy(
+                            placement_group_id_hex=self.pg.id_hex,
+                            bundle_index=i),
+                        **common).remote(i)
+                    for i in range(num_workers)
+                ]
+            except BaseException:
+                ray_tpu.remove_placement_group(self.pg)
+                raise
+        else:
+            self.workers = [actor_cls.options(**common).remote(0)]
+
+    def execute(self, method: str, *args, **kwargs) -> List[Any]:
+        """Call a TrainWorker method on every worker, gather results."""
+        import ray_tpu
+
+        refs = [getattr(w, method).remote(*args, **kwargs)
+                for w in self.workers]
+        return ray_tpu.get(refs)
+
+    def execute_single(self, rank: int, method: str, *args, **kwargs):
+        import ray_tpu
+
+        return ray_tpu.get(
+            getattr(self.workers[rank], method).remote(*args, **kwargs))
+
+    def execute_async(self, method: str, *args, **kwargs):
+        return [getattr(w, method).remote(*args, **kwargs)
+                for w in self.workers]
+
+    def shutdown(self):
+        import ray_tpu
+
+        for w in self.workers:
+            try:
+                ray_tpu.kill(w)
+            except Exception:
+                pass
+        if self.pg is not None:
+            try:
+                ray_tpu.remove_placement_group(self.pg)
+            except Exception:
+                pass
+        self.workers = []
