@@ -71,6 +71,9 @@ class HeadService:
         self._conn_to_worker: Dict[object, WorkerHandle] = {}
         # node_id -> deque of grants waiting for a worker to register
         self._waiting_grants: Dict[NodeID, deque] = {}
+        # respawn backoff after startup crashes (node_id keyed)
+        self._spawn_backoff_s: Dict[NodeID, float] = {}
+        self._spawn_backoff_until: Dict[NodeID, float] = {}
         # actor_id -> in-flight creation task (to avoid double-create)
         self._creating_actors: Set[ActorID] = set()
         # task events ring buffer (state API backend)
@@ -96,6 +99,18 @@ class HeadService:
     async def _periodic_pump(self):
         while not self._shutdown:
             try:
+                reaped = self.pool.reap_exited_starting()
+                for handle in reaped:
+                    logger.warning("worker %s exited before registering",
+                                   handle.worker_id.hex()[:12])
+                    delay = min(
+                        self._spawn_backoff_s.get(handle.node_id, 0.5) * 2,
+                        30.0,
+                    )
+                    self._spawn_backoff_s[handle.node_id] = delay
+                    self._spawn_backoff_until[handle.node_id] = (
+                        time.monotonic() + delay
+                    )
                 self._pump()
             except Exception:
                 logger.exception("scheduler pump failed")
@@ -178,6 +193,8 @@ class HeadService:
         if handle is None:
             return {"ok": False, "error": "unknown worker"}
         self._conn_to_worker[conn] = handle
+        self._spawn_backoff_s.pop(handle.node_id, None)
+        self._spawn_backoff_until.pop(handle.node_id, None)
         prev_close = conn.on_close
         def on_close(c, _prev=prev_close):
             if _prev:
@@ -289,7 +306,23 @@ class HeadService:
                 self._waiting_grants.setdefault(node.node_id, deque()).append(
                     (lease, lease_id)
                 )
-                self.pool.spawn(node.node_id)
+        # Spawn workers to cover waiting grants, netting out spawns already
+        # in flight — one lease request must not fork one process each time
+        # the pump runs while an earlier spawn is still importing (a spawn
+        # storm serializes every startup on small hosts and starves the very
+        # grant it was meant to serve). Respawns after a startup crash back
+        # off exponentially so a worker that dies during import doesn't turn
+        # the 0.2s pump into a fork loop.
+        now = time.monotonic()
+        for node_id, queue in self._waiting_grants.items():
+            if not queue:
+                continue
+            backoff_until = self._spawn_backoff_until.get(node_id, 0.0)
+            if now < backoff_until:
+                continue
+            deficit = len(queue) - self.pool.starting_count(node_id)
+            for _ in range(deficit):
+                self.pool.spawn(node_id)
 
     def _grant(self, lease: PendingLease, worker: WorkerHandle, lease_id: str):
         worker.state = "LEASED"

@@ -144,6 +144,19 @@ class WorkerPool:
         self.idle.setdefault(handle.node_id, []).append(handle)
         return handle
 
+    def starting_count(self, node_id: NodeID) -> int:
+        return sum(1 for h in self.starting.values()
+                   if h.node_id == node_id)
+
+    def reap_exited_starting(self) -> List[WorkerHandle]:
+        """Collect starting workers whose process died before registering."""
+        dead = []
+        for wid, h in list(self.starting.items()):
+            proc = self._procs.get(wid)
+            if proc is not None and proc.poll() is not None:
+                dead.append(self.mark_dead(wid))
+        return [h for h in dead if h is not None]
+
     def pop_idle(self, node_id: NodeID) -> Optional[WorkerHandle]:
         idle = self.idle.get(node_id) or []
         while idle:

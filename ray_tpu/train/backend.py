@@ -59,6 +59,7 @@ class JaxBackend(Backend):
                  coordinator_port: Optional[int] = None):
         self.distributed = distributed
         self.coordinator_port = coordinator_port
+        self._initialized = False
 
     def _should_init(self, scaling: ScalingConfig, world: int) -> bool:
         if self.distributed is not None:
@@ -81,9 +82,11 @@ class JaxBackend(Backend):
             for i, w in enumerate(worker_group.workers)
         ]
         ray_tpu.get(refs, timeout=120)
+        self._initialized = True
 
     def on_shutdown(self, worker_group: WorkerGroup) -> None:
-        if worker_group.num_workers > 1:
+        if self._initialized:
+            self._initialized = False
             try:
                 worker_group.execute("execute", _jax_distributed_shutdown)
             except Exception:

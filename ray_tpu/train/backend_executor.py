@@ -7,16 +7,12 @@ results from all ranks; rank-0's checkpoints feed the CheckpointManager.
 
 from __future__ import annotations
 
-import logging
-import os
 from typing import Any, Callable, Dict, List, Optional
 
 from ray_tpu.train.backend import Backend, JaxBackend
 from ray_tpu.train.checkpoint import Checkpoint
 from ray_tpu.train.config import ScalingConfig
 from ray_tpu.train.worker_group import WorkerGroup
-
-logger = logging.getLogger(__name__)
 
 
 class TrainingWorkerError(RuntimeError):
@@ -33,8 +29,10 @@ class BackendExecutor:
         self.experiment_name = experiment_name
         self.trial_id = trial_id
         self.worker_group: Optional[WorkerGroup] = None
+        self._stop_requested = False
 
     def start(self) -> None:
+        self._stop_requested = False
         self.worker_group = WorkerGroup(
             self.scaling.total_workers,
             self.scaling.worker_resources(),
@@ -100,6 +98,21 @@ class BackendExecutor:
         if kinds == {"done"}:
             return None
         if "done" in kinds:
+            if self._stop_requested:
+                # A cooperative stop lands on each rank at its next report,
+                # so ranks legitimately finish a report or two apart. Drain
+                # the stragglers to 'done' instead of calling it a desync.
+                for i, (kind, _, _) in enumerate(events):
+                    while kind != "done":
+                        kind, payload, _ = wg.execute_single(
+                            i, "next_report", timeout)
+                        if kind == "error":
+                            raise TrainingWorkerError(payload)
+                        if kind == "timeout":
+                            raise TrainingWorkerError(
+                                f"worker {i} did not finish after stop "
+                                f"request within {timeout}s")
+                return None
             raise TrainingWorkerError(
                 "ranks desynchronized: some finished while others reported")
         return [
@@ -108,6 +121,7 @@ class BackendExecutor:
         ]
 
     def request_stop(self):
+        self._stop_requested = True
         if self.worker_group is not None:
             self.worker_group.execute("request_stop")
 

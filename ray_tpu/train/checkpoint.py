@@ -48,14 +48,24 @@ class Checkpoint:
     # -- pytree helpers ----------------------------------------------------
 
     @classmethod
-    def from_pytree(cls, tree: Any, path: str, *, shard_rank: int = 0,
+    def from_pytree(cls, tree: Any, path: str, *,
+                    shard_rank: Optional[int] = None,
                     user_meta: Optional[dict] = None) -> "Checkpoint":
         """Write ``tree`` (host-local arrays or a process's addressable
         shards) as this rank's shard file. Multi-host: every rank calls
-        this with the same ``path`` on shared storage."""
+        this with the same ``path`` on shared storage.
+
+        ``shard_rank`` defaults to the calling worker's world rank when a
+        train session is active (so concurrent ranks never clobber each
+        other's shard file), else 0."""
         import jax
         from flax import serialization
 
+        if shard_rank is None:
+            from ray_tpu.train import session as _session_mod
+
+            active = _session_mod._session
+            shard_rank = active.context.world_rank if active else 0
         os.makedirs(path, exist_ok=True)
         # Pull addressable data to host; fully-replicated arrays write only
         # from rank 0 (callers pass shard_rank=their process index).
@@ -83,7 +93,18 @@ class Checkpoint:
         shard_file = os.path.join(self.path,
                                   f"shard_{shard_rank}.msgpack")
         if not os.path.exists(shard_file):
-            shard_file = os.path.join(self.path, "shard_0.msgpack")
+            # A single-shard (replicated) checkpoint restores on any rank.
+            # But if other per-rank shards exist, a missing one means real
+            # data loss — never silently substitute another rank's data.
+            shards = [f for f in os.listdir(self.path)
+                      if f.startswith("shard_") and f.endswith(".msgpack")]
+            if shards == ["shard_0.msgpack"]:
+                shard_file = os.path.join(self.path, "shard_0.msgpack")
+            else:
+                raise FileNotFoundError(
+                    f"checkpoint {self.path} has no shard for rank "
+                    f"{shard_rank} (found: {sorted(shards)})"
+                )
         with open(shard_file, "rb") as f:
             loaded = serialization.msgpack_restore(f.read())
         leaves = [loaded[str(i)] for i in range(len(loaded))]

@@ -52,13 +52,11 @@ class _TrainSession:
         self.resume_checkpoint = resume_checkpoint
         self.datasets = datasets or {}
         self.outbox: "queue.Queue" = queue.Queue()
-        self.reported_steps = 0
         self.stop_requested = threading.Event()
 
     def report(self, metrics: Dict[str, Any],
                checkpoint: Optional[Checkpoint] = None) -> None:
         self.outbox.put(("report", dict(metrics), checkpoint))
-        self.reported_steps += 1
         # Cooperative early stop (Tune schedulers): raising here unwinds
         # the user loop; the executor turns it into a clean finish.
         if self.stop_requested.is_set():

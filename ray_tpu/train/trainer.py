@@ -39,6 +39,25 @@ from ray_tpu.train.result import Result
 logger = logging.getLogger(__name__)
 
 
+def _merge_move_tree(src: str, dest: str) -> None:
+    """Merge ``src`` into ``dest`` by renaming files (zero-copy on one
+    filesystem — checkpoints live on shared storage); byte-copy only as a
+    cross-device fallback. Checkpoint dirs can be multi-GB, so a copytree
+    here would double every report's I/O."""
+    for root, dirs, files in os.walk(src):
+        rel = os.path.relpath(root, src)
+        target_dir = dest if rel == "." else os.path.join(dest, rel)
+        os.makedirs(target_dir, exist_ok=True)
+        for name in files:
+            s = os.path.join(root, name)
+            d = os.path.join(target_dir, name)
+            try:
+                os.replace(s, d)
+            except OSError:
+                shutil.copy2(s, d)
+    shutil.rmtree(src, ignore_errors=True)
+
+
 class JaxTrainer:
     def __init__(
         self,
@@ -136,8 +155,7 @@ class JaxTrainer:
             if os.path.abspath(p) == os.path.abspath(dest):
                 continue
             if os.path.isdir(p):
-                shutil.copytree(p, dest, dirs_exist_ok=True)
-                shutil.rmtree(p, ignore_errors=True)
+                _merge_move_tree(p, dest)
         return Checkpoint(dest)
 
     def as_trainable(self):

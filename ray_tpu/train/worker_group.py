@@ -41,7 +41,17 @@ class TrainWorker:
         return socket.gethostname()
 
     def node_ip(self) -> str:
-        return socket.gethostbyname(socket.gethostname())
+        # UDP-connect trick: picks the interface a default route would use,
+        # avoiding the 127.0.0.1 that /etc/hosts often maps hostnames to
+        # (no packet is sent). Reference behavior: ray get_node_ip_address.
+        s = socket.socket(socket.AF_INET, socket.SOCK_DGRAM)
+        try:
+            s.connect(("8.8.8.8", 80))
+            return s.getsockname()[0]
+        except OSError:
+            return socket.gethostbyname(socket.gethostname())
+        finally:
+            s.close()
 
     def find_free_port(self) -> int:
         s = socket.socket()
@@ -121,6 +131,7 @@ class WorkerGroup:
         common = dict(
             num_cpus=resources.get("CPU", 0.0),
             num_tpus=resources.get("TPU", 0.0),
+            memory=resources.get("memory"),
             resources={k: v for k, v in resources.items()
                        if k not in ("CPU", "TPU", "memory")} or None,
         )

@@ -217,4 +217,6 @@ def test_trainer_jax_mlp_e2e(ray_start, tmp_path):
     assert result.error is None, result.error
     assert result.metrics["last_loss"] < result.metrics["first_loss"]
     params = result.checkpoint.to_pytree()
-    assert any(k for k in str(params))  # restored non-empty pytree
+    import jax
+
+    assert len(jax.tree.leaves(params)) > 0  # restored non-empty pytree
