@@ -828,7 +828,12 @@ class CoreWorker:
                     {"spec": serialization.dumps_control(spec)},
                 )
             except (rpc.ConnectionLost, rpc.RpcError, OSError) as e:
-                state.workers.pop(lw.worker_id, None)
+                if state.workers.get(lw.worker_id) is lw:
+                    state.workers.pop(lw.worker_id, None)
+                    # Hand the lease back so the head can release its
+                    # resources even if it hasn't noticed the death yet.
+                    asyncio.ensure_future(
+                        self._return_lease_quietly(lw))
                 self._on_task_worker_failure(spec, e)
                 return
             lw.busy -= 1
@@ -840,13 +845,31 @@ class CoreWorker:
 
         asyncio.ensure_future(push())
 
+    async def _return_lease_quietly(self, lw: "LeasedWorker"):
+        try:
+            await self.head.call("return_worker", {
+                "lease_id": lw.lease_id,
+                "worker_id": lw.worker_id.hex(),
+            })
+        except Exception:
+            # Head unreachable or already aware of the death; it releases
+            # the lease itself on worker-death detection.
+            logger.debug("return_worker for %s failed", lw.lease_id)
+
     async def _maybe_return_lease(self, key: tuple, state: SchedulingKeyState,
                                   lw: LeasedWorker):
         await asyncio.sleep(self.config.idle_worker_lease_timeout_s)
         if lw.busy > 0 or state.queue:
             return
-        if state.workers.pop(lw.worker_id, None) is None:
+        # Identity check before popping: the same worker may have been
+        # re-leased under this key after an earlier idle-timer returned it,
+        # in which case state.workers[worker_id] is a *newer* LeasedWorker
+        # record. A stale timer popping that record by worker_id alone would
+        # orphan the new lease (nobody left to return it) and leak its
+        # resources at the head forever.
+        if state.workers.get(lw.worker_id) is not lw:
             return
+        state.workers.pop(lw.worker_id, None)
         try:
             await self.head.call("return_worker", {
                 "lease_id": lw.lease_id,

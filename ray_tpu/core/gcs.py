@@ -22,6 +22,7 @@ from __future__ import annotations
 
 import asyncio
 import logging
+import os
 import time
 from collections import deque
 from typing import Dict, List, Optional, Set
@@ -114,7 +115,29 @@ class HeadService:
                 self._pump()
             except Exception:
                 logger.exception("scheduler pump failed")
+            if os.environ.get("RAY_TPU_DEBUG_PUMP"):
+                self._debug_dump()
             await asyncio.sleep(0.2)
+
+    _last_debug_dump = 0.0
+
+    def _debug_dump(self):
+        now = time.monotonic()
+        if now - self._last_debug_dump < 5.0:
+            return
+        self._last_debug_dump = now
+        sch = self.scheduler
+        states = {}
+        for h in self.pool.workers.values():
+            states[h.state] = states.get(h.state, 0) + 1
+        print(
+            f"[pump] pending={len(sch.pending)} "
+            f"active_leases={len(sch.active_leases)} "
+            f"avail={sch.available_resources()} "
+            f"workers={states} "
+            f"waiting_grants={ {k.hex()[:6]: len(v) for k, v in self._waiting_grants.items()} }",
+            flush=True,
+        )
 
     def add_node(self, resources: Dict[str, float],
                  labels: Optional[Dict[str, str]] = None) -> NodeID:
@@ -325,6 +348,10 @@ class HeadService:
                 self.pool.spawn(node_id)
 
     def _grant(self, lease: PendingLease, worker: WorkerHandle, lease_id: str):
+        if os.environ.get("RAY_TPU_DEBUG_LEASE"):
+            print(f"[lease] grant {lease_id} w={worker.worker_id.hex()[:6]} "
+                  f"prev_state={worker.state} fn={lease.spec.name or lease.spec.function_key[-12:]}",
+                  flush=True)
         worker.state = "LEASED"
         worker.lease_id = lease_id
         if not lease.future.done():
@@ -348,7 +375,18 @@ class HeadService:
         worker_id = WorkerID.from_hex(payload["worker_id"])
         self.scheduler.release_lease(lease_id)
         handle = self.pool.workers.get(worker_id)
-        if handle and handle.state == "LEASED":
+        if os.environ.get("RAY_TPU_DEBUG_LEASE"):
+            print(f"[lease] return {lease_id} w={worker_id.hex()[:6]} "
+                  f"state={handle.state if handle else None} "
+                  f"cur_lease={handle.lease_id if handle else None}",
+                  flush=True)
+        # Only idle the worker if this return matches its *current* lease;
+        # a stale return (late idle-timer from a previous leaseholder) must
+        # not free a worker that has since been re-leased to someone else.
+        alive = (handle is not None and handle.connection is not None
+                 and not getattr(handle.connection, "closed", False))
+        if (handle and alive and handle.state == "LEASED"
+                and handle.lease_id == lease_id):
             self.pool.push_idle(handle)
             self._match_waiting_grants(handle.node_id)
         self._pump()

@@ -1,0 +1,45 @@
+"""ray_tpu.data — streaming distributed datasets.
+
+Reference capability: python/ray/data (Dataset, read_api, streaming
+executor). See dataset.py / executor.py for the TPU-first design notes.
+"""
+
+from ray_tpu.data.block import Block, BlockAccessor, BlockMetadata
+from ray_tpu.data.dataset import (
+    DataIterator,
+    Dataset,
+    from_arrow,
+    from_items,
+    from_numpy,
+    from_pandas,
+    range,
+    range_tensor,
+    read_binary_files,
+    read_csv,
+    read_json,
+    read_numpy,
+    read_parquet,
+    read_text,
+)
+from ray_tpu.data.grouped import GroupedData
+
+__all__ = [
+    "Block",
+    "BlockAccessor",
+    "BlockMetadata",
+    "DataIterator",
+    "Dataset",
+    "GroupedData",
+    "from_arrow",
+    "from_items",
+    "from_numpy",
+    "from_pandas",
+    "range",
+    "range_tensor",
+    "read_binary_files",
+    "read_csv",
+    "read_json",
+    "read_numpy",
+    "read_parquet",
+    "read_text",
+]

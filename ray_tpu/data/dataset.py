@@ -1,0 +1,545 @@
+"""Dataset: lazy, distributed, streaming data API.
+
+Reference: python/ray/data/dataset.py (map:246, map_batches:376,
+iter_batches:3599, sort, random_shuffle, repartition, split, groupby,
+write_*). Datasets are immutable handles on a logical plan; execution is
+streaming and distributed over the task substrate. TPU-first details:
+blocks are columnar numpy, `iter_batches(batch_format="jax")` device-puts
+batches (optionally with a NamedSharding so multi-chip input pipelines
+produce globally-sharded arrays), and `split()` produces per-worker
+shards for trainer ingest.
+"""
+
+from __future__ import annotations
+
+import builtins
+from typing import Any, Dict, Iterator, List, Optional
+
+import numpy as np
+
+import ray_tpu
+from ray_tpu.data import datasource
+from ray_tpu.data.block import (
+    Block,
+    BlockAccessor,
+    BlockMetadata,
+    ITEM_COL,
+    concat_blocks,
+)
+from ray_tpu.data import plan as lp
+from ray_tpu.data.executor import Bundle, StreamingExecutor
+
+
+def _default_parallelism() -> int:
+    try:
+        return max(2, int(ray_tpu.cluster_resources().get("CPU", 4)))
+    except Exception:
+        return 4
+
+
+class Dataset:
+    def __init__(self, terminal_op: lp.LogicalOp):
+        self._op = terminal_op
+
+    # -- transforms (lazy) ---------------------------------------------
+    def map(self, fn, *, fn_args=(), fn_kwargs=None, **ray_remote_args
+            ) -> "Dataset":
+        t = lp.MapTransform("rows", fn, fn_args, fn_kwargs or {})
+        return Dataset(lp.MapRows(self._op, t,
+                                  ray_remote_args=ray_remote_args))
+
+    def map_batches(self, fn, *, batch_size: Optional[int] = None,
+                    compute: Optional[str] = None,
+                    concurrency: Optional[int] = None,
+                    fn_args=(), fn_kwargs=None,
+                    fn_constructor_args=(), fn_constructor_kwargs=None,
+                    **ray_remote_args) -> "Dataset":
+        if isinstance(fn, type):
+            compute = compute or "actors"
+            t = lp.MapTransform("batches", fn, fn_constructor_args,
+                                fn_constructor_kwargs or {}, batch_size)
+        else:
+            t = lp.MapTransform("batches", fn, fn_args, fn_kwargs or {},
+                                batch_size)
+        return Dataset(lp.MapBatches(
+            self._op, t, compute=compute, concurrency=concurrency,
+            ray_remote_args=ray_remote_args))
+
+    def filter(self, fn, **ray_remote_args) -> "Dataset":
+        t = lp.MapTransform("filter", fn)
+        return Dataset(lp.Filter(self._op, t,
+                                 ray_remote_args=ray_remote_args))
+
+    def flat_map(self, fn, **ray_remote_args) -> "Dataset":
+        t = lp.MapTransform("flat_map", fn)
+        return Dataset(lp.FlatMap(self._op, t,
+                                  ray_remote_args=ray_remote_args))
+
+    def add_column(self, name: str, fn) -> "Dataset":
+        def add(batch, _name=name, _fn=fn):
+            out = dict(batch)
+            out[_name] = np.asarray(_fn(batch))
+            return out
+
+        return self.map_batches(add)
+
+    def drop_columns(self, cols: List[str]) -> "Dataset":
+        def drop(batch, _cols=tuple(cols)):
+            return {k: v for k, v in batch.items() if k not in _cols}
+
+        return self.map_batches(drop)
+
+    def select_columns(self, cols: List[str]) -> "Dataset":
+        def select(batch, _cols=tuple(cols)):
+            return {k: batch[k] for k in _cols}
+
+        return self.map_batches(select)
+
+    def rename_columns(self, mapping: Dict[str, str]) -> "Dataset":
+        def rename(batch, _m=dict(mapping)):
+            return {_m.get(k, k): v for k, v in batch.items()}
+
+        return self.map_batches(rename)
+
+    def repartition(self, num_blocks: int, *, shuffle: bool = False
+                    ) -> "Dataset":
+        return Dataset(lp.Repartition(self._op, num_blocks, shuffle))
+
+    def random_shuffle(self, *, seed: Optional[int] = None) -> "Dataset":
+        return Dataset(lp.RandomShuffle(self._op, seed))
+
+    def randomize_block_order(self, *, seed: Optional[int] = None
+                              ) -> "Dataset":
+        bundles = list(self._execute())
+        rng = np.random.default_rng(seed)
+        order = rng.permutation(len(bundles))
+        return Dataset(lp.InputData([bundles[i] for i in order]))
+
+    def sort(self, key: Optional[str] = None, descending: bool = False
+             ) -> "Dataset":
+        return Dataset(lp.Sort(self._op, key, descending))
+
+    def limit(self, n: int) -> "Dataset":
+        return Dataset(lp.Limit(self._op, n))
+
+    def union(self, *others: "Dataset") -> "Dataset":
+        return Dataset(lp.Union(self._op, [o._op for o in others]))
+
+    def zip(self, other: "Dataset") -> "Dataset":
+        return Dataset(lp.Zip(self._op, other._op))
+
+    def groupby(self, key: str) -> "GroupedData":
+        from ray_tpu.data.grouped import GroupedData
+
+        return GroupedData(self, key)
+
+    # -- execution ------------------------------------------------------
+    def _execute(self) -> Iterator[Bundle]:
+        return StreamingExecutor(self._op).execute()
+
+    def materialize(self) -> "Dataset":
+        """Execute the plan, pinning result blocks in the object store."""
+        return Dataset(lp.InputData(list(self._execute())))
+
+    def stats(self) -> Dict[str, Any]:
+        bundles = list(self._execute())
+        return {
+            "num_blocks": len(bundles),
+            "num_rows": sum(m.num_rows for _, m in bundles),
+            "size_bytes": sum(m.size_bytes for _, m in bundles),
+        }
+
+    # -- consumption ----------------------------------------------------
+    def iter_internal_ref_bundles(self) -> Iterator[Bundle]:
+        return self._execute()
+
+    def take(self, n: int = 20) -> List[Any]:
+        out: List[Any] = []
+        for block_ref, _meta in self.limit(n)._execute():
+            block = ray_tpu.get(block_ref)
+            out.extend(BlockAccessor(block).iter_rows())
+            if len(out) >= n:
+                break
+        return out[:n]
+
+    def take_all(self) -> List[Any]:
+        out: List[Any] = []
+        for block_ref, _ in self._execute():
+            out.extend(BlockAccessor(ray_tpu.get(block_ref)).iter_rows())
+        return out
+
+    def show(self, n: int = 20) -> None:
+        for row in self.take(n):
+            print(row)
+
+    def count(self) -> int:
+        return sum(m.num_rows for _, m in self._execute())
+
+    def schema(self) -> Optional[Dict[str, str]]:
+        for _, m in self._execute():
+            if m.schema:
+                return m.schema
+        return None
+
+    def columns(self) -> List[str]:
+        s = self.schema()
+        return list(s) if s else []
+
+    def iter_rows(self) -> Iterator[Any]:
+        for block_ref, _ in self._execute():
+            yield from BlockAccessor(ray_tpu.get(block_ref)).iter_rows()
+
+    def iter_batches(self, *, batch_size: Optional[int] = 256,
+                     batch_format: str = "numpy",
+                     drop_last: bool = False,
+                     local_shuffle_buffer_size: Optional[int] = None,
+                     local_shuffle_seed: Optional[int] = None,
+                     device: Any = None,
+                     sharding: Any = None) -> Iterator[Any]:
+        """Stream batches. ``batch_format``: "numpy" (dict of arrays),
+        "pandas", or "jax" (device-put, optionally with a NamedSharding —
+        the TPU input pipeline path)."""
+        carry: Optional[Block] = None
+        shuffle_buf: Optional[Block] = None
+        rng = np.random.default_rng(local_shuffle_seed)
+
+        def emit(block: Block):
+            return _format_batch(block, batch_format, device, sharding)
+
+        for block_ref, _ in self._execute():
+            block = ray_tpu.get(block_ref)
+            if BlockAccessor(block).num_rows() == 0:
+                continue
+            if local_shuffle_buffer_size:
+                shuffle_buf = block if shuffle_buf is None else \
+                    concat_blocks([shuffle_buf, block])
+                acc = BlockAccessor(shuffle_buf)
+                while acc.num_rows() >= local_shuffle_buffer_size:
+                    idx = rng.permutation(acc.num_rows())
+                    shuffle_buf = acc.take_indices(idx)
+                    acc = BlockAccessor(shuffle_buf)
+                    take = min(batch_size or acc.num_rows(), acc.num_rows())
+                    yield emit(acc.slice(0, take))
+                    shuffle_buf = acc.slice(take, acc.num_rows())
+                    acc = BlockAccessor(shuffle_buf)
+                continue
+            carry = block if carry is None else concat_blocks([carry, block])
+            if batch_size is None:
+                yield emit(carry)
+                carry = None
+                continue
+            acc = BlockAccessor(carry)
+            while acc.num_rows() >= batch_size:
+                yield emit(acc.slice(0, batch_size))
+                carry = acc.slice(batch_size, acc.num_rows())
+                acc = BlockAccessor(carry)
+        leftover = shuffle_buf if local_shuffle_buffer_size else carry
+        if leftover is not None and BlockAccessor(leftover).num_rows() > 0:
+            if local_shuffle_buffer_size:
+                # Shuffle then drain the residual buffer in batch_size
+                # chunks — the batch_size contract holds even when the
+                # buffer never filled; drop_last discards at most the
+                # final partial batch, not the whole residue.
+                acc = BlockAccessor(leftover)
+                leftover = acc.take_indices(rng.permutation(acc.num_rows()))
+                acc = BlockAccessor(leftover)
+                step = batch_size or acc.num_rows()
+                for start in builtins.range(0, acc.num_rows(), step):
+                    piece = acc.slice(start, start + step)
+                    if (drop_last and batch_size
+                            and BlockAccessor(piece).num_rows() < batch_size):
+                        break
+                    yield emit(piece)
+            elif not (drop_last and batch_size):
+                yield emit(leftover)
+
+    def iter_jax_batches(self, **kwargs) -> Iterator[Any]:
+        kwargs.setdefault("batch_format", "jax")
+        return self.iter_batches(**kwargs)
+
+    def iter_torch_batches(self, **kwargs) -> Iterator[Any]:
+        kwargs.setdefault("batch_format", "torch")
+        return self.iter_batches(**kwargs)
+
+    # -- splits ---------------------------------------------------------
+    def split(self, n: int, *, equal: bool = False) -> List["Dataset"]:
+        bundles = list(self._execute())
+        if equal:
+            total = sum(m.num_rows for _, m in bundles)
+            per = total // n
+            ds = Dataset(lp.InputData(bundles))
+            return [ds._slice_rows(i * per, (i + 1) * per)
+                    for i in builtins.range(n)]
+        chunks: List[List[Bundle]] = [[] for _ in builtins.range(n)]
+        for i, b in enumerate(bundles):
+            chunks[i % n].append(b)
+        return [Dataset(lp.InputData(c)) for c in chunks]
+
+    def _slice_rows(self, lo: int, hi: int) -> "Dataset":
+        assert isinstance(self._op, lp.InputData)
+        bundles = self._op.bundles
+        from ray_tpu.data.executor import _slice_concat, plan_row_slice
+
+        fn = ray_tpu.remote(_slice_concat).options(num_returns=2)
+        ranges, refs = plan_row_slice(bundles, lo, hi)
+        block_ref, meta_ref = fn.remote(ranges, *refs)
+        return Dataset(lp.InputData([(block_ref, ray_tpu.get(meta_ref))]))
+
+    def train_test_split(self, test_size: float, *, shuffle: bool = False,
+                         seed: Optional[int] = None):
+        ds = self.random_shuffle(seed=seed) if shuffle else self
+        bundles = list(ds._execute())
+        total = sum(m.num_rows for _, m in bundles)
+        n_test = int(total * test_size) if test_size < 1 else int(test_size)
+        mat = Dataset(lp.InputData(bundles))
+        return (mat._slice_rows(0, total - n_test),
+                mat._slice_rows(total - n_test, total))
+
+    def streaming_split(self, n: int, *, equal: bool = False,
+                        locality_hints=None) -> List["DataIterator"]:
+        return [DataIterator(s) for s in self.split(n, equal=equal)]
+
+    # -- aggregates -----------------------------------------------------
+    @staticmethod
+    def _agg_target(on: Optional[str], block: Block) -> str:
+        if on is not None:
+            return on
+        if ITEM_COL in block:
+            return ITEM_COL
+        if len(block) == 1:
+            return next(iter(block))
+        raise ValueError(
+            f"dataset has multiple columns {sorted(block)}; pass "
+            f"on=<column> to aggregate")
+
+    def _agg_column(self, col: Optional[str], red, finalize=None):
+        vals = []
+        for block_ref, _ in self._execute():
+            block = ray_tpu.get(block_ref)
+            if not block:
+                continue
+            col_used = self._agg_target(col, block)
+            if len(block[col_used]):
+                vals.append(red(block[col_used]))
+        if not vals:
+            return None
+        out = red(np.asarray(vals))
+        return finalize(out) if finalize else out
+
+    def sum(self, on: Optional[str] = None):
+        per_block = []
+        for block_ref, _ in self._execute():
+            block = ray_tpu.get(block_ref)
+            if block:
+                c = self._agg_target(on, block)
+                if len(block[c]):
+                    per_block.append(np.sum(block[c], axis=0))
+        return np.sum(per_block, axis=0).item() if per_block else None
+
+    def min(self, on: Optional[str] = None):
+        return self._agg_column(on, np.min)
+
+    def max(self, on: Optional[str] = None):
+        return self._agg_column(on, np.max)
+
+    def mean(self, on: Optional[str] = None):
+        total, count = 0.0, 0
+        for block_ref, _ in self._execute():
+            block = ray_tpu.get(block_ref)
+            if block:
+                c = self._agg_target(on, block)
+                total += float(np.sum(block[c]))
+                count += len(block[c])
+        return total / count if count else None
+
+    def std(self, on: Optional[str] = None):
+        rows = self.take_all()
+        if not rows:
+            return None
+        if isinstance(rows[0], dict):
+            c = on or next(iter(rows[0]))
+            vals = np.asarray([r[c] for r in rows])
+        else:
+            vals = np.asarray(rows)
+        return float(np.std(vals, ddof=1))
+
+    def unique(self, column: str) -> List[Any]:
+        out = set()
+        for block_ref, _ in self._execute():
+            block = ray_tpu.get(block_ref)
+            if block and column in block:
+                out.update(np.unique(block[column]).tolist())
+        return sorted(out)
+
+    # -- output ---------------------------------------------------------
+    def to_pandas(self):
+        import pandas as pd
+
+        frames = [BlockAccessor(ray_tpu.get(r)).to_pandas()
+                  for r, _ in self._execute()]
+        if not frames:
+            return pd.DataFrame()
+        return pd.concat(frames, ignore_index=True)
+
+    def to_numpy_refs(self) -> List[Any]:
+        return [r for r, _ in self._execute()]
+
+    def _write(self, fmt: str, path: str, **kwargs) -> List[str]:
+        fn = ray_tpu.remote(datasource.write_block)
+        refs = [fn.remote(fmt, block_ref, path, i)
+                for i, (block_ref, _) in enumerate(self._execute())]
+        return ray_tpu.get(refs)
+
+    def write_parquet(self, path: str) -> List[str]:
+        return self._write("parquet", path)
+
+    def write_csv(self, path: str) -> List[str]:
+        return self._write("csv", path)
+
+    def write_json(self, path: str) -> List[str]:
+        return self._write("json", path)
+
+    def write_numpy(self, path: str) -> List[str]:
+        return self._write("numpy", path)
+
+    def num_blocks(self) -> int:
+        return len(list(self._execute()))
+
+    def __repr__(self):
+        return f"Dataset(plan={'->'.join(o.name for o in self._op.chain())})"
+
+
+def _format_batch(block: Block, batch_format: str, device, sharding):
+    if batch_format == "numpy":
+        if list(block) == [ITEM_COL]:
+            return block[ITEM_COL]
+        return block
+    if batch_format == "pandas":
+        return BlockAccessor(block).to_pandas()
+    if batch_format == "jax":
+        import jax
+
+        def put(a):
+            if a.dtype == object or a.dtype.kind in "US":
+                return a
+            if sharding is not None:
+                return jax.device_put(a, sharding)
+            if device is not None:
+                return jax.device_put(a, device)
+            return jax.device_put(a)
+
+        if list(block) == [ITEM_COL]:
+            return put(block[ITEM_COL])
+        return {k: put(v) for k, v in block.items()}
+    if batch_format == "torch":
+        import torch
+
+        def tt(a):
+            if a.dtype == object or a.dtype.kind in "US":
+                return a
+            return torch.as_tensor(a)
+
+        if list(block) == [ITEM_COL]:
+            return tt(block[ITEM_COL])
+        return {k: tt(v) for k, v in block.items()}
+    raise ValueError(f"unknown batch_format {batch_format!r}")
+
+
+class DataIterator:
+    """Per-worker shard iterator (reference: ray.data.DataIterator as
+    returned by streaming_split, used for Train ingest)."""
+
+    def __init__(self, ds: Dataset):
+        self._ds = ds
+
+    def iter_batches(self, **kwargs) -> Iterator[Any]:
+        return self._ds.iter_batches(**kwargs)
+
+    def iter_rows(self) -> Iterator[Any]:
+        return self._ds.iter_rows()
+
+    def materialize(self) -> Dataset:
+        return self._ds.materialize()
+
+    def count(self) -> int:
+        return self._ds.count()
+
+
+# ---------------------------------------------------------------------------
+# creation API (reference: python/ray/data/read_api.py)
+# ---------------------------------------------------------------------------
+
+
+def range(n: int, *, parallelism: int = -1) -> Dataset:  # noqa: A001
+    if parallelism <= 0:
+        parallelism = min(_default_parallelism(), max(1, n // 50 or 1))
+    return Dataset(lp.Read(datasource.range_tasks(n, parallelism),
+                           num_rows_estimate=n))
+
+
+def range_tensor(n: int, *, shape=(1,), parallelism: int = -1) -> Dataset:
+    if parallelism <= 0:
+        parallelism = min(_default_parallelism(), max(1, n // 50 or 1))
+    return Dataset(lp.Read(
+        datasource.range_tensor_tasks(n, shape, parallelism),
+        num_rows_estimate=n))
+
+
+def from_items(items: List[Any], *, parallelism: int = -1) -> Dataset:
+    if parallelism <= 0:
+        parallelism = min(_default_parallelism(),
+                          max(1, len(items) // 50 or 1))
+    return Dataset(lp.Read(datasource.items_tasks(list(items), parallelism)))
+
+
+def from_numpy(arrays, *, column: str = "data") -> Dataset:
+    return Dataset(lp.Read(datasource.numpy_tasks(arrays, column)))
+
+
+def from_pandas(dfs) -> Dataset:
+    if not isinstance(dfs, list):
+        dfs = [dfs]
+
+    def make(df):
+        cols = {c: df[c].to_numpy() for c in df.columns}
+        return lambda: cols
+
+    return Dataset(lp.Read([make(df) for df in dfs]))
+
+
+def from_arrow(tables) -> Dataset:
+    if not isinstance(tables, list):
+        tables = [tables]
+
+    def make(t):
+        cols = {c: t[c].to_numpy(zero_copy_only=False)
+                for c in t.column_names}
+        return lambda: cols
+
+    return Dataset(lp.Read([make(t) for t in tables]))
+
+
+def read_parquet(paths, *, columns: Optional[List[str]] = None) -> Dataset:
+    return Dataset(lp.Read(
+        datasource.file_tasks("parquet", paths, columns=columns)))
+
+
+def read_csv(paths) -> Dataset:
+    return Dataset(lp.Read(datasource.file_tasks("csv", paths)))
+
+
+def read_json(paths) -> Dataset:
+    return Dataset(lp.Read(datasource.file_tasks("json", paths)))
+
+
+def read_text(paths) -> Dataset:
+    return Dataset(lp.Read(datasource.file_tasks("text", paths)))
+
+
+def read_numpy(paths) -> Dataset:
+    return Dataset(lp.Read(datasource.file_tasks("numpy", paths)))
+
+
+def read_binary_files(paths, *, include_paths: bool = False) -> Dataset:
+    return Dataset(lp.Read(datasource.file_tasks(
+        "binary", paths, include_paths=include_paths)))

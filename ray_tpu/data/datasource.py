@@ -1,0 +1,189 @@
+"""Datasources: read task generation + file writers.
+
+Reference: python/ray/data/read_api.py (read_parquet:549, read_csv:1114,
+read_json:981) and datasource plugins under python/ray/data/datasource/.
+A datasource turns into a list of **read tasks** — picklable zero-arg
+callables, each producing one block — so reads execute as distributed
+tasks and stream through the executor like any other stage.
+"""
+
+from __future__ import annotations
+
+import functools
+import glob
+import os
+from typing import Any, Callable, List, Optional
+
+import numpy as np
+
+from ray_tpu.data.block import (
+    Block,
+    BlockAccessor,
+    ITEM_COL,
+    block_from_rows,
+)
+
+ReadTask = Callable[[], Block]
+
+
+def _expand_paths(paths) -> List[str]:
+    if isinstance(paths, str):
+        paths = [paths]
+    out: List[str] = []
+    for p in paths:
+        if os.path.isdir(p):
+            out.extend(sorted(
+                f for f in glob.glob(os.path.join(p, "**"), recursive=True)
+                if os.path.isfile(f)))
+        elif any(ch in p for ch in "*?["):
+            out.extend(sorted(glob.glob(p)))
+        else:
+            out.append(p)
+    if not out:
+        raise FileNotFoundError(f"no files matched {paths}")
+    return out
+
+
+# -- range ------------------------------------------------------------------
+
+def _range_block(start: int, end: int) -> Block:
+    return {ITEM_COL: np.arange(start, end)}
+
+
+def _range_tensor_block(start: int, end: int, shape) -> Block:
+    n = end - start
+    base = np.arange(start, end).reshape((n,) + (1,) * len(shape))
+    return {"data": np.broadcast_to(
+        base, (n,) + tuple(shape)).copy()}
+
+
+def range_tasks(n: int, parallelism: int) -> List[ReadTask]:
+    parallelism = max(1, min(parallelism, n or 1))
+    cuts = np.linspace(0, n, parallelism + 1).astype(int)
+    return [functools.partial(_range_block, int(cuts[i]), int(cuts[i + 1]))
+            for i in range(parallelism)]
+
+
+def range_tensor_tasks(n: int, shape, parallelism: int) -> List[ReadTask]:
+    parallelism = max(1, min(parallelism, n or 1))
+    cuts = np.linspace(0, n, parallelism + 1).astype(int)
+    return [functools.partial(_range_tensor_block, int(cuts[i]),
+                              int(cuts[i + 1]), tuple(shape))
+            for i in range(parallelism)]
+
+
+# -- file formats -----------------------------------------------------------
+
+def _read_parquet_file(path: str, columns) -> Block:
+    import pyarrow.parquet as pq
+
+    table = pq.read_table(path, columns=columns)
+    return {c: table[c].to_numpy(zero_copy_only=False)
+            for c in table.column_names}
+
+
+def _read_csv_file(path: str) -> Block:
+    import pyarrow.csv as pcsv
+
+    table = pcsv.read_csv(path)
+    return {c: table[c].to_numpy(zero_copy_only=False)
+            for c in table.column_names}
+
+
+def _read_json_file(path: str) -> Block:
+    import pyarrow.json as pjson
+
+    table = pjson.read_json(path)
+    return {c: table[c].to_numpy(zero_copy_only=False)
+            for c in table.column_names}
+
+
+def _read_text_file(path: str) -> Block:
+    with open(path, "r") as f:
+        lines = [ln.rstrip("\n") for ln in f]
+    return {"text": np.asarray(lines, dtype=np.str_)}
+
+
+def _read_numpy_file(path: str) -> Block:
+    return {"data": np.load(path)}
+
+
+def _read_binary_file(path: str, include_paths: bool) -> Block:
+    with open(path, "rb") as f:
+        data = f.read()
+    block: Block = {"bytes": np.asarray([data], dtype=object)}
+    if include_paths:
+        block["path"] = np.asarray([path], dtype=np.str_)
+    return block
+
+
+_FILE_READERS = {
+    "parquet": _read_parquet_file,
+    "csv": _read_csv_file,
+    "json": _read_json_file,
+    "text": _read_text_file,
+    "numpy": _read_numpy_file,
+}
+
+
+def file_tasks(fmt: str, paths, **reader_kwargs) -> List[ReadTask]:
+    files = _expand_paths(paths)
+    if fmt == "binary":
+        include_paths = reader_kwargs.get("include_paths", False)
+        return [functools.partial(_read_binary_file, f, include_paths)
+                for f in files]
+    reader = _FILE_READERS[fmt]
+    if fmt == "parquet":
+        columns = reader_kwargs.get("columns")
+        return [functools.partial(reader, f, columns) for f in files]
+    return [functools.partial(reader, f) for f in files]
+
+
+# -- in-memory sources ------------------------------------------------------
+
+def items_tasks(items: List[Any], parallelism: int) -> List[ReadTask]:
+    parallelism = max(1, min(parallelism, len(items) or 1))
+    cuts = np.linspace(0, len(items), parallelism + 1).astype(int)
+
+    def make(lo, hi):
+        chunk = items[lo:hi]
+        return functools.partial(block_from_rows, chunk)
+
+    return [make(int(cuts[i]), int(cuts[i + 1])) for i in range(parallelism)]
+
+
+def numpy_tasks(arrays, column: str) -> List[ReadTask]:
+    if isinstance(arrays, np.ndarray):
+        arrays = [arrays]
+
+    def make(a):
+        return lambda: {column: a}
+
+    return [make(np.asarray(a)) for a in arrays]
+
+
+# -- writers ----------------------------------------------------------------
+
+def write_block(fmt: str, block: Block, path: str, index: int) -> str:
+    os.makedirs(path, exist_ok=True)
+    acc = BlockAccessor(block)
+    fname = os.path.join(path, f"part-{index:05d}.{fmt}")
+    if fmt == "parquet":
+        import pyarrow as pa
+        import pyarrow.parquet as pq
+
+        table = pa.table({k: list(v) if v.ndim > 1 else v
+                          for k, v in block.items()})
+        pq.write_table(table, fname)
+    elif fmt == "csv":
+        acc.to_pandas().to_csv(fname, index=False)
+    elif fmt == "json":
+        acc.to_pandas().to_json(fname, orient="records", lines=True)
+    elif fmt == "numpy":
+        if len(block) != 1:
+            raise ValueError("write_numpy requires a single-column dataset")
+        np.save(fname.replace(".numpy", ".npy"), next(iter(block.values())))
+        fname = fname.replace(".numpy", ".npy")
+    else:
+        raise ValueError(f"unknown write format {fmt}")
+    return fname

@@ -1,0 +1,389 @@
+"""Streaming execution of physical plans over the task/actor substrate.
+
+Reference: python/ray/data/_internal/execution/streaming_executor.py:55
+(operator DAG driven with backpressure) and
+_internal/planner/exchange/pull_based_shuffle_task_scheduler.py (two-phase
+pull shuffle). Here each fused map stage streams block→block tasks with a
+bounded in-flight window (backpressure); all-to-all ops are barriers
+implemented as map tasks with ``num_returns=num_output_partitions`` so each
+reduce task pulls exactly its partition from the object store — the
+pull-based shuffle, with object transfer riding the runtime's data plane.
+
+Map stages optionally run on a pool of stateful actors
+(``compute="actors"``) — the reference's ActorPoolMapOperator — which is
+the right execution mode for TPU inference UDFs: the actor pins the chip,
+compiles once, and streams batches through the cached executable.
+"""
+
+from __future__ import annotations
+
+import collections
+from typing import Any, Iterator, List, Optional, Tuple
+
+import numpy as np
+
+import ray_tpu
+from ray_tpu.data.block import (
+    Block,
+    BlockAccessor,
+    BlockMetadata,
+    concat_blocks,
+)
+from ray_tpu.data import plan as plan_mod
+from ray_tpu.data.plan import (
+    InputData,
+    Limit,
+    MapStage,
+    RandomShuffle,
+    Read,
+    Repartition,
+    Sort,
+    Union,
+    Zip,
+    apply_transforms,
+    fuse_plan,
+)
+
+# A bundle is (ObjectRef[Block], BlockMetadata).
+Bundle = Tuple[Any, BlockMetadata]
+
+
+# ---------------------------------------------------------------------------
+# remote task bodies (top-level so they pickle cleanly)
+# ---------------------------------------------------------------------------
+
+
+def _run_read_task(read_task):
+    block = read_task()
+    meta = BlockAccessor(block).metadata()
+    return block, meta
+
+
+def _run_map_stage(transforms, block: Block):
+    out = apply_transforms(transforms, block)
+    meta = BlockAccessor(out).metadata()
+    return out, meta
+
+
+def _slice_concat(ranges, *blocks):
+    """Assemble one output block from [(input_idx, start, end), ...]."""
+    parts = [BlockAccessor(blocks[i]).slice(s, e) for (i, s, e) in ranges]
+    out = concat_blocks(parts)
+    return out, BlockAccessor(out).metadata()
+
+
+def plan_row_slice(bundles: List[Bundle], lo: int, hi: int):
+    """Map a global row range [lo, hi) onto per-block sub-ranges.
+
+    Returns (ranges, refs) for _slice_concat: ranges are
+    (index-into-refs, start, end) against each overlapping block.
+    """
+    starts = np.cumsum([0] + [m.num_rows for _, m in bundles])
+    ranges, refs = [], []
+    for i, (ref, _) in enumerate(bundles):
+        s, e = int(starts[i]), int(starts[i + 1])
+        ov_lo, ov_hi = max(lo, s), min(hi, e)
+        if ov_lo < ov_hi:
+            ranges.append((len(refs), ov_lo - s, ov_hi - s))
+            refs.append(ref)
+    return ranges, refs
+
+
+def _shuffle_map(block: Block, num_out: int, seed):
+    acc = BlockAccessor(block)
+    n = acc.num_rows()
+    rng = np.random.default_rng(seed)
+    assign = rng.integers(0, num_out, size=n)
+    parts = tuple(acc.take_indices(np.nonzero(assign == j)[0])
+                  for j in range(num_out))
+    return parts[0] if num_out == 1 else parts
+
+
+def _shuffle_reduce(seed, *parts):
+    out = concat_blocks(list(parts))
+    acc = BlockAccessor(out)
+    rng = np.random.default_rng(seed)
+    out = acc.take_indices(rng.permutation(acc.num_rows()))
+    return out, BlockAccessor(out).metadata()
+
+
+def _sort_sample(block: Block, n: int, key):
+    return BlockAccessor(block).sample(n, key)
+
+
+def _sort_map(block: Block, boundaries, key, descending):
+    parts = tuple(BlockAccessor(block).sort_partitions(
+        np.asarray(boundaries), key, descending))
+    return parts[0] if len(parts) == 1 else parts
+
+
+def _sort_reduce(key, descending, *parts):
+    merged = concat_blocks(list(parts))
+    out = BlockAccessor(merged).sort(key, descending)
+    return out, BlockAccessor(out).metadata()
+
+
+def _truncate(block: Block, n: int):
+    out = BlockAccessor(block).slice(0, n)
+    return out, BlockAccessor(out).metadata()
+
+
+def _zip_blocks(left: Block, right: Block):
+    out = dict(left)
+    for k, v in right.items():
+        name = k
+        while name in out:
+            name = name + "_1"
+        out[name] = v
+    return out, BlockAccessor(out).metadata()
+
+
+class _MapActor:
+    """Stateful map worker (reference: ActorPoolMapOperator's _MapWorker).
+
+    Instantiates callable-class UDFs once in __init__ so model weights /
+    compiled executables persist across blocks.
+    """
+
+    def __init__(self, transforms):
+        self.transforms = []
+        for t in transforms:
+            fn = t.fn
+            if isinstance(fn, type):  # callable class UDF
+                fn = fn(*t.fn_args, **t.fn_kwargs)
+                t = plan_mod.MapTransform(
+                    kind=t.kind, fn=fn, batch_size=t.batch_size)
+            self.transforms.append(t)
+
+    def process(self, block: Block):
+        out = apply_transforms(self.transforms, block)
+        return out, BlockAccessor(out).metadata()
+
+
+# ---------------------------------------------------------------------------
+# executor
+# ---------------------------------------------------------------------------
+
+
+class StreamingExecutor:
+    def __init__(self, terminal_op, *, max_in_flight: Optional[int] = None):
+        self.stages = fuse_plan(terminal_op)
+        if max_in_flight is None:
+            try:
+                cpus = int(ray_tpu.cluster_resources().get("CPU", 4))
+            except Exception:
+                cpus = 4
+            max_in_flight = max(2, 2 * cpus)
+        self.max_in_flight = max_in_flight
+
+    # -- public --------------------------------------------------------
+    def execute(self) -> Iterator[Bundle]:
+        it: Optional[Iterator[Bundle]] = None
+        for stage in self.stages:
+            if isinstance(stage, Read):
+                it = self._read_iter(stage)
+            elif isinstance(stage, InputData):
+                it = iter(stage.bundles)
+            elif isinstance(stage, MapStage):
+                if stage.compute == "actors":
+                    it = self._actor_map_iter(stage, it)
+                else:
+                    it = self._map_iter(stage, it)
+            elif isinstance(stage, Repartition):
+                it = self._repartition(stage, list(it))
+            elif isinstance(stage, RandomShuffle):
+                it = self._shuffle(stage, list(it))
+            elif isinstance(stage, Sort):
+                it = self._sort(stage, list(it))
+            elif isinstance(stage, Limit):
+                it = self._limit_iter(stage, it)
+            elif isinstance(stage, Union):
+                it = self._union_iter(stage, it)
+            elif isinstance(stage, Zip):
+                it = self._zip(stage, list(it))
+            else:
+                raise TypeError(f"unknown stage {stage!r}")
+        assert it is not None, "empty plan"
+        return it
+
+    # -- streaming stages ----------------------------------------------
+    def _windowed(self, submits: Iterator[Tuple[Any, Any]]
+                  ) -> Iterator[Bundle]:
+        """Drive task submissions with a bounded in-flight window, yielding
+        results in submission order (deterministic output block order)."""
+        window: collections.deque = collections.deque()
+        submits = iter(submits)
+        exhausted = False
+        while True:
+            while not exhausted and len(window) < self.max_in_flight:
+                try:
+                    window.append(next(submits))
+                except StopIteration:
+                    exhausted = True
+            if not window:
+                return
+            block_ref, meta_ref = window.popleft()
+            meta = ray_tpu.get(meta_ref)
+            yield block_ref, meta
+
+    def _read_iter(self, stage: Read) -> Iterator[Bundle]:
+        fn = ray_tpu.remote(_run_read_task).options(num_returns=2)
+
+        def submits():
+            for task in stage.read_tasks:
+                yield tuple(fn.remote(task))
+
+        return self._windowed(submits())
+
+    def _map_iter(self, stage: MapStage, upstream: Iterator[Bundle]
+                  ) -> Iterator[Bundle]:
+        opts = dict(stage.ray_remote_args)
+        opts["num_returns"] = 2
+        fn = ray_tpu.remote(_run_map_stage).options(**opts)
+        transforms = stage.transforms
+
+        def submits():
+            for block_ref, _ in upstream:
+                yield tuple(fn.remote(transforms, block_ref))
+
+        return self._windowed(submits())
+
+    def _actor_map_iter(self, stage: MapStage, upstream: Iterator[Bundle]
+                        ) -> Iterator[Bundle]:
+        n = stage.concurrency or 2
+        opts = dict(stage.ray_remote_args)
+        actor_cls = ray_tpu.remote(_MapActor).options(**opts)
+        actors = [actor_cls.remote(stage.transforms) for _ in range(n)]
+        try:
+            idx = 0
+
+            def submits():
+                nonlocal idx
+                for block_ref, _ in upstream:
+                    a = actors[idx % len(actors)]
+                    idx += 1
+                    yield tuple(a.process.options(num_returns=2)
+                                .remote(block_ref))
+
+            yield from self._windowed(submits())
+        finally:
+            for a in actors:
+                ray_tpu.kill(a)
+
+    def _limit_iter(self, stage: Limit, upstream: Iterator[Bundle]
+                    ) -> Iterator[Bundle]:
+        remaining = stage.limit
+        fn = ray_tpu.remote(_truncate).options(num_returns=2)
+        for block_ref, meta in upstream:
+            if remaining <= 0:
+                return
+            if meta.num_rows <= remaining:
+                remaining -= meta.num_rows
+                yield block_ref, meta
+            else:
+                b, m = fn.remote(block_ref, remaining)
+                yield b, ray_tpu.get(m)
+                remaining = 0
+
+    def _union_iter(self, stage: Union, upstream: Iterator[Bundle]
+                    ) -> Iterator[Bundle]:
+        yield from upstream
+        for other in stage.others:
+            yield from StreamingExecutor(
+                other, max_in_flight=self.max_in_flight).execute()
+
+    # -- all-to-all stages ---------------------------------------------
+    def _repartition(self, stage: Repartition, bundles: List[Bundle]
+                     ) -> Iterator[Bundle]:
+        if stage.shuffle:
+            return self._shuffle(
+                RandomShuffle(stage.input_op, seed=0), bundles,
+                num_out=stage.num_blocks)
+        total = sum(m.num_rows for _, m in bundles)
+        n_out = max(1, stage.num_blocks)
+        cuts = np.linspace(0, total, n_out + 1).astype(int)
+        fn = ray_tpu.remote(_slice_concat).options(num_returns=2)
+
+        def submits():
+            for j in range(n_out):
+                ranges, refs = plan_row_slice(
+                    bundles, int(cuts[j]), int(cuts[j + 1]))
+                yield tuple(fn.remote(ranges, *refs))
+
+        return self._windowed(submits())
+
+    def _shuffle(self, stage: RandomShuffle, bundles: List[Bundle],
+                 num_out: Optional[int] = None) -> Iterator[Bundle]:
+        n_in = len(bundles)
+        n_out = num_out or n_in
+        if n_in == 0:
+            return iter([])
+        map_fn = ray_tpu.remote(_shuffle_map).options(num_returns=n_out)
+        reduce_fn = ray_tpu.remote(_shuffle_reduce).options(num_returns=2)
+        parts: List[List[Any]] = []
+        for i, (ref, _) in enumerate(bundles):
+            seed = None if stage.seed is None else stage.seed + i
+            out = map_fn.remote(ref, n_out, seed)
+            parts.append(out if isinstance(out, list) else [out])
+
+        def submits():
+            for j in range(n_out):
+                seed = None if stage.seed is None else stage.seed * 7919 + j
+                yield tuple(reduce_fn.remote(
+                    seed, *[parts[i][j] for i in range(n_in)]))
+
+        return self._windowed(submits())
+
+    def _sort(self, stage: Sort, bundles: List[Bundle]) -> Iterator[Bundle]:
+        if not bundles:
+            return iter([])
+        n_out = len(bundles)
+        sample_fn = ray_tpu.remote(_sort_sample)
+        samples = ray_tpu.get(
+            [sample_fn.remote(ref, 20, stage.key) for ref, _ in bundles])
+        allsamp = np.sort(np.concatenate([s for s in samples if len(s)]))
+        if len(allsamp) == 0:
+            return iter(bundles)
+        q = np.linspace(0, len(allsamp) - 1, n_out + 1).astype(int)[1:-1]
+        boundaries = allsamp[q]
+        map_fn = ray_tpu.remote(_sort_map).options(num_returns=n_out)
+        reduce_fn = ray_tpu.remote(_sort_reduce).options(num_returns=2)
+        parts = []
+        for ref, _ in bundles:
+            out = map_fn.remote(ref, boundaries.tolist(), stage.key,
+                                stage.descending)
+            parts.append(out if isinstance(out, list) else [out])
+
+        def submits():
+            # sort_partitions already emits parts high-to-low for
+            # descending sorts, so reduce order is always natural.
+            for j in range(n_out):
+                yield tuple(reduce_fn.remote(
+                    stage.key, stage.descending,
+                    *[parts[i][j] for i in range(len(bundles))]))
+
+        return self._windowed(submits())
+
+    def _zip(self, stage: Zip, left: List[Bundle]) -> Iterator[Bundle]:
+        right = list(StreamingExecutor(
+            stage.other, max_in_flight=self.max_in_flight).execute())
+        lrows = sum(m.num_rows for _, m in left)
+        rrows = sum(m.num_rows for _, m in right)
+        if lrows != rrows:
+            raise ValueError(
+                f"zip requires equal row counts: {lrows} vs {rrows}")
+        # Realign the right side to the left side's block boundaries.
+        cuts, acc = [], 0
+        for _, m in left:
+            cuts.append((acc, acc + m.num_rows))
+            acc += m.num_rows
+        fn_slice = ray_tpu.remote(_slice_concat).options(num_returns=2)
+        zip_fn = ray_tpu.remote(_zip_blocks).options(num_returns=2)
+
+        def submits():
+            for (lref, _), (lo, hi) in zip(left, cuts):
+                ranges, refs = plan_row_slice(right, lo, hi)
+                raligned, _m = fn_slice.remote(ranges, *refs)
+                yield tuple(zip_fn.remote(lref, raligned))
+
+        return self._windowed(submits())

@@ -1,0 +1,124 @@
+"""GroupedData: sort-based groupby + aggregations.
+
+Reference: python/ray/data/grouped_data.py (GroupedData.aggregate,
+sum/min/max/mean/count/std, map_groups). Implemented as a distributed
+sort on the key followed by per-block group reduction — the same
+sort-based shuffle strategy the reference uses.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Callable, Dict, List, Optional
+
+import numpy as np
+
+import ray_tpu
+from ray_tpu.data.block import Block, BlockAccessor, block_from_rows
+
+
+def _group_slices(col: np.ndarray):
+    """Yield (key, start, end) runs over a sorted key column."""
+    n = len(col)
+    start = 0
+    while start < n:
+        end = start
+        while end < n and col[end] == col[start]:
+            end += 1
+        yield col[start], start, end
+        start = end
+
+
+def _agg_block(block: Block, key: str, aggs: List[tuple]) -> Block:
+    """aggs: list of (name, on_column, reduce_kind)."""
+    acc = BlockAccessor(block)
+    sorted_block = acc.sort(key)
+    col = sorted_block[key]
+    rows = []
+    for k, s, e in _group_slices(col):
+        row: Dict[str, Any] = {key: k}
+        for name, on, kind in aggs:
+            seg = sorted_block[on][s:e] if on else None
+            if kind == "count":
+                row[name] = e - s
+            elif kind == "sum":
+                row[name] = np.sum(seg)
+            elif kind == "min":
+                row[name] = np.min(seg)
+            elif kind == "max":
+                row[name] = np.max(seg)
+            elif kind == "mean":
+                row[name] = float(np.mean(seg))
+            elif kind == "std":
+                row[name] = float(np.std(seg, ddof=1)) if e - s > 1 else 0.0
+            else:
+                raise ValueError(kind)
+        rows.append(row)
+    return block_from_rows(rows)
+
+
+def _map_groups_block(block: Block, key: str, fn: Callable) -> Block:
+    acc = BlockAccessor(block)
+    sorted_block = acc.sort(key)
+    col = sorted_block[key]
+    sacc = BlockAccessor(sorted_block)
+    outs = []
+    for _k, s, e in _group_slices(col):
+        group = sacc.slice(s, e)
+        res = fn(group)
+        from ray_tpu.data.block import block_from_batch
+
+        outs.append(block_from_batch(res))
+    from ray_tpu.data.block import concat_blocks
+
+    return concat_blocks(outs) if outs else {}
+
+
+class GroupedData:
+    def __init__(self, ds, key: str):
+        self._ds = ds
+        self._key = key
+
+    def _sorted_by_key(self):
+        # Distributed sort partitions by key range, so all rows of one
+        # group land in the same output block.
+        return self._ds.sort(self._key)
+
+    def _aggregate(self, aggs: List[tuple]):
+        from ray_tpu.data.dataset import Dataset
+        from ray_tpu.data import plan as lp
+
+        key = self._key
+        t = lp.MapTransform(
+            "batches", lambda b, _k=key, _a=aggs: _agg_block(b, _k, _a))
+        return Dataset(lp.MapBatches(self._sorted_by_key()._op, t))
+
+    def count(self):
+        return self._aggregate([("count()", None, "count")])
+
+    def sum(self, on: str):
+        return self._aggregate([(f"sum({on})", on, "sum")])
+
+    def min(self, on: str):
+        return self._aggregate([(f"min({on})", on, "min")])
+
+    def max(self, on: str):
+        return self._aggregate([(f"max({on})", on, "max")])
+
+    def mean(self, on: str):
+        return self._aggregate([(f"mean({on})", on, "mean")])
+
+    def std(self, on: str):
+        return self._aggregate([(f"std({on})", on, "std")])
+
+    def aggregate(self, *aggs: tuple):
+        """Each agg is a (name, on_column, kind) tuple."""
+        return self._aggregate(list(aggs))
+
+    def map_groups(self, fn: Callable):
+        from ray_tpu.data.dataset import Dataset
+        from ray_tpu.data import plan as lp
+
+        key = self._key
+        t = lp.MapTransform(
+            "batches", lambda b, _k=key, _f=fn: _map_groups_block(b, _k, _f))
+        return Dataset(lp.MapBatches(self._sorted_by_key()._op, t))

@@ -1,0 +1,254 @@
+"""Lazy logical plan + optimizer.
+
+Reference: python/ray/data/_internal/logical/ (logical operators,
+`optimizers.py`) — datasets record a chain of logical operators; an
+optimizer pass fuses adjacent one-to-one (map-like) operators into a
+single physical stage so one task applies the whole UDF chain per block
+(the reference's OperatorFusionRule). All-to-all ops (sort / shuffle /
+repartition) are stage barriers.
+"""
+
+from __future__ import annotations
+
+import itertools
+from dataclasses import dataclass, field
+from typing import Any, Callable, List, Optional, Tuple
+
+from ray_tpu.data.block import (
+    Block,
+    BlockAccessor,
+    block_from_batch,
+    block_from_rows,
+)
+
+_op_counter = itertools.count()
+
+
+class LogicalOp:
+    """Base logical operator; `input_op` forms a linear chain."""
+
+    name = "Op"
+
+    def __init__(self, input_op: Optional["LogicalOp"]):
+        self.input_op = input_op
+        self.id = next(_op_counter)
+
+    def chain(self) -> List["LogicalOp"]:
+        ops: List[LogicalOp] = []
+        op: Optional[LogicalOp] = self
+        while op is not None:
+            ops.append(op)
+            op = op.input_op
+        return ops[::-1]
+
+    def __repr__(self):
+        return f"{self.name}[{self.id}]"
+
+
+class Read(LogicalOp):
+    name = "Read"
+
+    def __init__(self, read_tasks: List[Callable[[], List[Block]]],
+                 num_rows_estimate: Optional[int] = None):
+        super().__init__(None)
+        self.read_tasks = read_tasks
+        self.num_rows_estimate = num_rows_estimate
+
+
+class InputData(LogicalOp):
+    """Pre-materialized blocks (from_items / from_numpy / materialize)."""
+
+    name = "InputData"
+
+    def __init__(self, bundles: List[Tuple[Any, Any]]):
+        super().__init__(None)
+        self.bundles = bundles  # list of (ObjectRef[Block], BlockMetadata)
+
+
+@dataclass
+class MapTransform:
+    """One fused step: a block-level callable, applied in a worker task."""
+
+    kind: str  # "batches" | "rows" | "filter" | "flat_map"
+    fn: Callable
+    fn_args: tuple = ()
+    fn_kwargs: dict = field(default_factory=dict)
+    batch_size: Optional[int] = None
+
+    def apply(self, block: Block) -> Block:
+        acc = BlockAccessor(block)
+        if self.kind == "batches":
+            if self.batch_size is None or acc.num_rows() <= self.batch_size:
+                return block_from_batch(
+                    self.fn(block, *self.fn_args, **self.fn_kwargs))
+            outs = []
+            for start in range(0, acc.num_rows(), self.batch_size):
+                piece = acc.slice(start, start + self.batch_size)
+                outs.append(block_from_batch(
+                    self.fn(piece, *self.fn_args, **self.fn_kwargs)))
+            from ray_tpu.data.block import concat_blocks
+
+            return concat_blocks(outs)
+        if self.kind == "rows":
+            return block_from_rows(
+                [self.fn(r, *self.fn_args, **self.fn_kwargs)
+                 for r in acc.iter_rows()])
+        if self.kind == "filter":
+            rows = [r for r in acc.iter_rows()
+                    if self.fn(r, *self.fn_args, **self.fn_kwargs)]
+            return block_from_rows(rows) if rows else {
+                k: v[:0] for k, v in block.items()}
+        if self.kind == "flat_map":
+            out: List[Any] = []
+            for r in acc.iter_rows():
+                out.extend(self.fn(r, *self.fn_args, **self.fn_kwargs))
+            return block_from_rows(out)
+        raise ValueError(f"unknown transform kind {self.kind}")
+
+
+class AbstractMap(LogicalOp):
+    """One-to-one block transform; fusable."""
+
+    def __init__(self, input_op: LogicalOp, transform: MapTransform,
+                 *, compute: Optional[str] = None,
+                 ray_remote_args: Optional[dict] = None,
+                 concurrency: Optional[int] = None):
+        super().__init__(input_op)
+        self.transform = transform
+        self.compute = compute
+        self.ray_remote_args = ray_remote_args or {}
+        self.concurrency = concurrency
+
+
+class MapBatches(AbstractMap):
+    name = "MapBatches"
+
+
+class MapRows(AbstractMap):
+    name = "Map"
+
+
+class Filter(AbstractMap):
+    name = "Filter"
+
+
+class FlatMap(AbstractMap):
+    name = "FlatMap"
+
+
+class AbstractAllToAll(LogicalOp):
+    """Stage barrier: consumes all input bundles, emits new ones."""
+
+
+class Repartition(AbstractAllToAll):
+    name = "Repartition"
+
+    def __init__(self, input_op: LogicalOp, num_blocks: int,
+                 shuffle: bool = False):
+        super().__init__(input_op)
+        self.num_blocks = num_blocks
+        self.shuffle = shuffle
+
+
+class RandomShuffle(AbstractAllToAll):
+    name = "RandomShuffle"
+
+    def __init__(self, input_op: LogicalOp, seed: Optional[int] = None):
+        super().__init__(input_op)
+        self.seed = seed
+
+
+class Sort(AbstractAllToAll):
+    name = "Sort"
+
+    def __init__(self, input_op: LogicalOp, key: Optional[str],
+                 descending: bool = False):
+        super().__init__(input_op)
+        self.key = key
+        self.descending = descending
+
+
+class Limit(LogicalOp):
+    name = "Limit"
+
+    def __init__(self, input_op: LogicalOp, limit: int):
+        super().__init__(input_op)
+        self.limit = limit
+
+
+class Union(LogicalOp):
+    name = "Union"
+
+    def __init__(self, input_op: LogicalOp, others: List[LogicalOp]):
+        super().__init__(input_op)
+        self.others = others
+
+
+class Zip(LogicalOp):
+    name = "Zip"
+
+    def __init__(self, input_op: LogicalOp, other: LogicalOp):
+        super().__init__(input_op)
+        self.other = other
+
+
+# ---------------------------------------------------------------------------
+# physical plan
+# ---------------------------------------------------------------------------
+
+
+@dataclass
+class MapStage:
+    """A fused chain of map transforms executed as one task per block."""
+
+    transforms: List[MapTransform]
+    ray_remote_args: dict
+    compute: Optional[str] = None
+    concurrency: Optional[int] = None
+    name: str = "Map"
+
+
+def fuse_plan(terminal: LogicalOp) -> List[Any]:
+    """Lower the logical chain into physical stages with map fusion.
+
+    Returns a list whose entries are either the source op (Read/InputData),
+    a MapStage, or a barrier/structural logical op passed through.
+    """
+
+    stages: List[Any] = []
+    pending: Optional[MapStage] = None
+    for op in terminal.chain():
+        if isinstance(op, AbstractMap):
+            compatible = (
+                pending is not None
+                and pending.ray_remote_args == op.ray_remote_args
+                and pending.compute == op.compute
+                and pending.concurrency == op.concurrency
+            )
+            if compatible:
+                pending.transforms.append(op.transform)
+                pending.name += f"->{op.name}"
+            else:
+                if pending is not None:
+                    stages.append(pending)
+                pending = MapStage(
+                    transforms=[op.transform],
+                    ray_remote_args=dict(op.ray_remote_args),
+                    compute=op.compute,
+                    concurrency=op.concurrency,
+                    name=op.name,
+                )
+        else:
+            if pending is not None:
+                stages.append(pending)
+                pending = None
+            stages.append(op)
+    if pending is not None:
+        stages.append(pending)
+    return stages
+
+
+def apply_transforms(transforms: List[MapTransform], block: Block) -> Block:
+    for t in transforms:
+        block = t.apply(block)
+    return block

@@ -82,11 +82,22 @@ class Checkpoint:
                              "user_meta": user_meta or {}}, f)
         return cls(path)
 
-    def to_pytree(self, *, shard_rank: int = 0) -> Any:
+    def to_pytree(self, *, shard_rank: Optional[int] = None) -> Any:
         """Restore this rank's shard as a pytree of numpy arrays; callers
-        re-shard onto their mesh with jax.device_put(..., sharding)."""
+        re-shard onto their mesh with jax.device_put(..., sharding).
+
+        ``shard_rank`` defaults to the calling worker's world rank when a
+        train session is active — symmetric with ``from_pytree``, so a
+        rank>0 worker resuming from a per-rank sharded checkpoint gets its
+        own shard, not rank 0's."""
         import jax
         from flax import serialization
+
+        if shard_rank is None:
+            from ray_tpu.train import session as _session_mod
+
+            active = _session_mod._session
+            shard_rank = active.context.world_rank if active else 0
 
         with open(os.path.join(self.path, "meta.pkl"), "rb") as f:
             meta = pickle.load(f)

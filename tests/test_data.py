@@ -1,0 +1,217 @@
+"""Tests for ray_tpu.data (reference test strategy:
+python/ray/data/tests/test_map.py, test_sort.py, test_consumption.py)."""
+
+import numpy as np
+import pytest
+
+import ray_tpu
+from ray_tpu import data as rd
+
+
+@pytest.fixture(scope="module")
+def ray_data_cluster():
+    ray_tpu.init(num_cpus=4, num_tpus=0)
+    yield
+    ray_tpu.shutdown()
+
+
+def test_range_take_count(ray_data_cluster):
+    ds = rd.range(100)
+    assert ds.count() == 100
+    assert ds.take(5) == [0, 1, 2, 3, 4]
+    assert ds.take_all() == list(range(100))
+
+
+def test_map(ray_data_cluster):
+    ds = rd.range(20).map(lambda x: x * 2)
+    assert ds.take_all() == [2 * i for i in range(20)]
+
+
+def test_map_batches_fusion(ray_data_cluster):
+    ds = (rd.range(50)
+          .map_batches(lambda b: {"item": b["item"] + 1})
+          .map_batches(lambda b: {"item": b["item"] * 3}))
+    from ray_tpu.data.plan import fuse_plan, MapStage
+
+    stages = fuse_plan(ds._op)
+    map_stages = [s for s in stages if isinstance(s, MapStage)]
+    assert len(map_stages) == 1  # fused
+    assert len(map_stages[0].transforms) == 2
+    assert ds.take_all() == [(i + 1) * 3 for i in range(50)]
+
+
+def test_filter_flat_map(ray_data_cluster):
+    ds = rd.range(20).filter(lambda x: x % 2 == 0)
+    assert ds.take_all() == [i for i in range(20) if i % 2 == 0]
+    ds2 = rd.from_items([1, 2]).flat_map(lambda x: [x, x * 10])
+    assert ds2.take_all() == [1, 10, 2, 20]
+
+
+def test_from_items_dicts(ray_data_cluster):
+    items = [{"a": i, "b": i * 2} for i in range(10)]
+    ds = rd.from_items(items)
+    rows = ds.take_all()
+    assert rows[3]["a"] == 3 and rows[3]["b"] == 6
+    assert set(ds.schema()) == {"a", "b"}
+
+
+def test_repartition(ray_data_cluster):
+    ds = rd.range(100, parallelism=4).repartition(7)
+    assert ds.num_blocks() == 7
+    assert ds.take_all() == list(range(100))
+
+
+def test_random_shuffle(ray_data_cluster):
+    ds = rd.range(100).random_shuffle(seed=42)
+    rows = ds.take_all()
+    assert sorted(rows) == list(range(100))
+    assert rows != list(range(100))
+    rows2 = rd.range(100).random_shuffle(seed=42).take_all()
+    assert rows == rows2  # deterministic given seed
+
+
+def test_sort(ray_data_cluster):
+    rng = np.random.default_rng(0)
+    vals = rng.permutation(200).tolist()
+    ds = rd.from_items(vals, parallelism=5).sort()
+    assert ds.take_all() == sorted(vals)
+    ds_desc = rd.from_items(vals, parallelism=5).sort(descending=True)
+    assert ds_desc.take_all() == sorted(vals, reverse=True)
+
+
+def test_sort_by_key(ray_data_cluster):
+    items = [{"k": i % 5, "v": i} for i in range(50)]
+    ds = rd.from_items(items).sort("k")
+    ks = [r["k"] for r in ds.take_all()]
+    assert ks == sorted(ks)
+
+
+def test_limit_union_zip(ray_data_cluster):
+    assert rd.range(100).limit(7).take_all() == list(range(7))
+    u = rd.range(3).union(rd.range(3))
+    assert u.take_all() == [0, 1, 2, 0, 1, 2]
+    z = rd.range(10).zip(rd.range(10).map(lambda x: x * 10))
+    rows = z.take_all()
+    assert rows[2] == {"item": 2, "item_1": 20}
+
+
+def test_aggregates(ray_data_cluster):
+    ds = rd.range(10)
+    assert ds.sum() == 45
+    assert ds.min() == 0
+    assert ds.max() == 9
+    assert ds.mean() == 4.5
+
+
+def test_groupby(ray_data_cluster):
+    items = [{"k": i % 3, "v": float(i)} for i in range(30)]
+    out = rd.from_items(items).groupby("k").sum("v").take_all()
+    got = {r["k"]: r["sum(v)"] for r in out}
+    expect = {}
+    for r in items:
+        expect[r["k"]] = expect.get(r["k"], 0) + r["v"]
+    assert got == expect
+    counts = rd.from_items(items).groupby("k").count().take_all()
+    assert all(r["count()"] == 10 for r in counts)
+
+
+def test_iter_batches(ray_data_cluster):
+    ds = rd.range(100)
+    batches = list(ds.iter_batches(batch_size=32))
+    assert [len(b) for b in batches] == [32, 32, 32, 4]
+    batches = list(ds.iter_batches(batch_size=32, drop_last=True))
+    assert [len(b) for b in batches] == [32, 32, 32]
+
+
+def test_iter_batches_jax(ray_data_cluster):
+    import jax
+
+    ds = rd.range_tensor(16, shape=(4,))
+    batches = list(ds.iter_batches(batch_size=8, batch_format="jax"))
+    assert isinstance(batches[0]["data"], jax.Array)
+    assert batches[0]["data"].shape == (8, 4)
+
+
+def test_local_shuffle_buffer_batch_contract(ray_data_cluster):
+    # Buffer larger than the dataset: batches must still honor batch_size.
+    ds = rd.range(100)
+    batches = list(ds.iter_batches(batch_size=32,
+                                   local_shuffle_buffer_size=10_000,
+                                   local_shuffle_seed=0))
+    assert [len(b) for b in batches] == [32, 32, 32, 4]
+    flat = [x for b in batches for x in b.tolist()]
+    assert sorted(flat) == list(range(100))
+    assert flat != list(range(100))  # actually shuffled
+    dropped = list(ds.iter_batches(batch_size=32, drop_last=True,
+                                   local_shuffle_buffer_size=10_000))
+    assert [len(b) for b in dropped] == [32, 32, 32]
+
+
+def test_multi_column_agg_requires_on(ray_data_cluster):
+    ds = rd.from_items([{"a": i, "b": i} for i in range(5)])
+    with pytest.raises(ValueError, match="multiple columns"):
+        ds.mean()
+    assert ds.mean(on="a") == 2.0
+
+
+def test_split_streaming_split(ray_data_cluster):
+    splits = rd.range(100, parallelism=4).split(2, equal=True)
+    assert [s.count() for s in splits] == [50, 50]
+    its = rd.range(100, parallelism=4).streaming_split(4, equal=True)
+    assert sum(it.count() for it in its) == 100
+
+
+def test_file_roundtrip(ray_data_cluster, tmp_path):
+    items = [{"a": i, "b": float(i) * 0.5} for i in range(40)]
+    ds = rd.from_items(items, parallelism=3)
+    ds.write_parquet(str(tmp_path / "pq"))
+    back = rd.read_parquet(str(tmp_path / "pq"))
+    assert back.count() == 40
+    assert sorted(r["a"] for r in back.take_all()) == list(range(40))
+    ds.write_csv(str(tmp_path / "csv"))
+    assert rd.read_csv(str(tmp_path / "csv")).count() == 40
+    ds.write_json(str(tmp_path / "json"))
+    assert rd.read_json(str(tmp_path / "json")).count() == 40
+
+
+def test_read_text(ray_data_cluster, tmp_path):
+    p = tmp_path / "t.txt"
+    p.write_text("a\nbb\nccc\n")
+    assert [r["text"] for r in rd.read_text(str(p)).take_all()] == \
+        ["a", "bb", "ccc"]
+
+
+def test_column_ops(ray_data_cluster):
+    ds = rd.from_items([{"a": i} for i in range(5)])
+    ds = ds.add_column("b", lambda b: b["a"] * 2)
+    assert ds.take(1)[0] == {"a": 0, "b": 0}
+    assert set(ds.select_columns(["b"]).schema()) == {"b"}
+    assert set(ds.drop_columns(["b"]).schema()) == {"a"}
+    renamed = ds.rename_columns({"a": "x"})
+    assert set(renamed.schema()) == {"x", "b"}
+
+
+def test_map_batches_actor_compute(ray_data_cluster):
+    class AddConst:
+        def __init__(self, c):
+            self.c = c
+
+        def __call__(self, batch):
+            return {"item": batch["item"] + self.c}
+
+    ds = rd.range(40).map_batches(
+        AddConst, fn_constructor_args=(100,), compute="actors",
+        concurrency=2)
+    assert sorted(ds.take_all()) == [i + 100 for i in range(40)]
+
+
+def test_materialize_and_stats(ray_data_cluster):
+    ds = rd.range(50).map(lambda x: x + 1).materialize()
+    st = ds.stats()
+    assert st["num_rows"] == 50
+    assert ds.take_all() == [i + 1 for i in range(50)]
+
+
+def test_train_test_split(ray_data_cluster):
+    tr, te = rd.range(100).train_test_split(0.2)
+    assert tr.count() == 80 and te.count() == 20
