@@ -111,10 +111,19 @@ class WorkerPool:
 
         pkg_root = os.path.dirname(os.path.dirname(ray_tpu.__file__))
         existing = env.get("PYTHONPATH", "")
-        if pkg_root not in existing.split(os.pathsep):
-            env["PYTHONPATH"] = (
-                pkg_root + (os.pathsep + existing if existing else "")
-            )
+        # Workers inherit the driver's import environment (the reference
+        # ships the job's working_dir / py_modules through runtime envs;
+        # in-process clusters just share sys.path) so by-reference pickles
+        # of driver-module functions resolve.
+        driver_paths = [p for p in sys.path if p and os.path.isdir(p)]
+        parts = [pkg_root] + driver_paths + (
+            existing.split(os.pathsep) if existing else [])
+        seen, ordered = set(), []
+        for p in parts:
+            if p not in seen:
+                seen.add(p)
+                ordered.append(p)
+        env["PYTHONPATH"] = os.pathsep.join(ordered)
         log_path = os.path.join(self.session_dir, "logs",
                                 f"worker-{worker_id.hex()[:12]}.log")
         os.makedirs(os.path.dirname(log_path), exist_ok=True)

@@ -110,10 +110,7 @@ class Dataset:
 
     def randomize_block_order(self, *, seed: Optional[int] = None
                               ) -> "Dataset":
-        bundles = list(self._execute())
-        rng = np.random.default_rng(seed)
-        order = rng.permutation(len(bundles))
-        return Dataset(lp.InputData([bundles[i] for i in order]))
+        return Dataset(lp.RandomizeBlockOrder(self._op, seed))
 
     def sort(self, key: Optional[str] = None, descending: bool = False
              ) -> "Dataset":
@@ -334,7 +331,10 @@ class Dataset:
                 c = self._agg_target(on, block)
                 if len(block[c]):
                     per_block.append(np.sum(block[c], axis=0))
-        return np.sum(per_block, axis=0).item() if per_block else None
+        if not per_block:
+            return None
+        total = np.sum(per_block, axis=0)
+        return total.item() if np.ndim(total) == 0 else total
 
     def min(self, on: Optional[str] = None):
         return self._agg_column(on, np.min)

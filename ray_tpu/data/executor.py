@@ -35,6 +35,7 @@ from ray_tpu.data.plan import (
     Limit,
     MapStage,
     RandomShuffle,
+    RandomizeBlockOrder,
     Read,
     Repartition,
     Sort,
@@ -193,6 +194,11 @@ class StreamingExecutor:
                 it = self._repartition(stage, list(it))
             elif isinstance(stage, RandomShuffle):
                 it = self._shuffle(stage, list(it))
+            elif isinstance(stage, RandomizeBlockOrder):
+                bundles = list(it)
+                order = np.random.default_rng(stage.seed).permutation(
+                    len(bundles))
+                it = iter([bundles[i] for i in order])
             elif isinstance(stage, Sort):
                 it = self._sort(stage, list(it))
             elif isinstance(stage, Limit):

@@ -158,6 +158,17 @@ class RandomShuffle(AbstractAllToAll):
         self.seed = seed
 
 
+class RandomizeBlockOrder(AbstractAllToAll):
+    """Permute bundle order without touching block contents (cheap shuffle
+    for block-granular randomness; reference: logical op of same name)."""
+
+    name = "RandomizeBlockOrder"
+
+    def __init__(self, input_op: LogicalOp, seed: Optional[int] = None):
+        super().__init__(input_op)
+        self.seed = seed
+
+
 class Sort(AbstractAllToAll):
     name = "Sort"
 

@@ -77,6 +77,9 @@ class RunConfig:
     failure_config: Optional[FailureConfig] = None
     checkpoint_config: Optional[CheckpointConfig] = None
     verbose: int = 0
+    # Tune stop criteria: {"metric": threshold, "training_iteration": N}
+    # or a callable (trial_id, result) -> bool (reference: RunConfig.stop).
+    stop: Optional[object] = None
 
     def resolved_storage_path(self) -> str:
         return os.path.expanduser(

@@ -1,0 +1,58 @@
+"""ray_tpu.tune — distributed hyperparameter tuning.
+
+Reference capability: python/ray/tune (Tuner, search algorithms, trial
+schedulers, experiment checkpointing).
+"""
+
+from ray_tpu.tune.search import (
+    BasicVariantGenerator,
+    ConcurrencyLimiter,
+    choice,
+    grid_search,
+    loguniform,
+    quniform,
+    randint,
+    sample_from,
+    uniform,
+)
+from ray_tpu.tune.schedulers import (
+    AsyncHyperBandScheduler,
+    FIFOScheduler,
+    MedianStoppingRule,
+    PopulationBasedTraining,
+)
+from ray_tpu.tune.session import (
+    get_checkpoint,
+    get_trial_dir,
+    get_trial_id,
+    report,
+)
+from ray_tpu.tune.trainable import Trainable
+from ray_tpu.tune.tuner import ResultGrid, TuneConfig, Tuner
+
+ASHAScheduler = AsyncHyperBandScheduler
+
+__all__ = [
+    "ASHAScheduler",
+    "AsyncHyperBandScheduler",
+    "BasicVariantGenerator",
+    "ConcurrencyLimiter",
+    "FIFOScheduler",
+    "MedianStoppingRule",
+    "PopulationBasedTraining",
+    "ResultGrid",
+    "Trainable",
+    "TuneConfig",
+    "Tuner",
+    "choice",
+    "get_checkpoint",
+    "get_trial_dir",
+    "get_trial_id",
+    "grid_search",
+    "loguniform",
+    "quniform",
+    "randint",
+    "report",
+    "sample_from",
+    "uniform",
+]

@@ -1,0 +1,177 @@
+"""Trial schedulers: early stopping + population-based training.
+
+Reference: python/ray/tune/schedulers/ — async_hyperband.py (ASHA),
+median_stopping_rule.py, hyperband.py, pbt.py. Decisions are made on
+every reported result: CONTINUE, STOP, or (PBT) an exploit/explore
+directive carrying a source checkpoint + mutated config.
+"""
+
+from __future__ import annotations
+
+import math
+import random
+from typing import Any, Dict, List, Optional
+
+CONTINUE = "CONTINUE"
+STOP = "STOP"
+
+
+class TrialScheduler:
+    def set_metric(self, metric: str, mode: str):
+        self.metric = metric
+        self.mode = mode
+        self._sign = 1.0 if mode == "max" else -1.0
+
+    def score(self, result: dict) -> float:
+        return self._sign * float(result[self.metric])
+
+    def on_result(self, trial, result: dict) -> str:
+        return CONTINUE
+
+    def on_trial_complete(self, trial, result: Optional[dict]):
+        pass
+
+
+class FIFOScheduler(TrialScheduler):
+    pass
+
+
+class AsyncHyperBandScheduler(TrialScheduler):
+    """ASHA (reference: schedulers/async_hyperband.py:AsyncHyperBandScheduler).
+
+    Brackets of rungs at milestones grace_period * reduction_factor^k; a
+    trial reaching a rung continues only if its score is in the top
+    1/reduction_factor of scores recorded at that rung.
+    """
+
+    def __init__(self, *, time_attr: str = "training_iteration",
+                 grace_period: int = 1, reduction_factor: int = 4,
+                 max_t: int = 100):
+        self.time_attr = time_attr
+        self.grace_period = grace_period
+        self.rf = reduction_factor
+        self.max_t = max_t
+        self.rungs: Dict[int, List[float]] = {}
+        # trial_id -> highest rung already evaluated (so float-valued or
+        # skipping time_attrs still hit each rung exactly once; reference
+        # ASHA also compares t >= milestone, not equality).
+        self._trial_rung: Dict[str, int] = {}
+        milestones = []
+        t = grace_period
+        while t < max_t:
+            milestones.append(t)
+            t *= reduction_factor
+        self.milestones = milestones
+
+    def on_result(self, trial, result: dict) -> str:
+        t = result.get(self.time_attr, 0)
+        if t >= self.max_t:
+            return STOP
+        done_rung = self._trial_rung.get(trial.trial_id, -1)
+        for i, m in enumerate(self.milestones):
+            if i <= done_rung or t < m:
+                continue
+            self._trial_rung[trial.trial_id] = i
+            scores = self.rungs.setdefault(m, [])
+            s = self.score(result)
+            scores.append(s)
+            k = max(1, int(math.ceil(len(scores) / self.rf)))
+            top = sorted(scores, reverse=True)[:k]
+            if s < top[-1]:
+                return STOP
+        return CONTINUE
+
+
+class MedianStoppingRule(TrialScheduler):
+    """Stop a trial whose best score is below the median of the running
+    averages of completed/running trials at the same step (reference:
+    schedulers/median_stopping_rule.py)."""
+
+    def __init__(self, *, time_attr: str = "training_iteration",
+                 grace_period: int = 1, min_samples_required: int = 3):
+        self.time_attr = time_attr
+        self.grace_period = grace_period
+        self.min_samples = min_samples_required
+        self._avgs: Dict[str, List[float]] = {}
+
+    def on_result(self, trial, result: dict) -> str:
+        t = result.get(self.time_attr, 0)
+        hist = self._avgs.setdefault(trial.trial_id, [])
+        hist.append(self.score(result))
+        if t <= self.grace_period:
+            return CONTINUE
+        others = [sum(h) / len(h) for tid, h in self._avgs.items()
+                  if tid != trial.trial_id and h]
+        if len(others) < self.min_samples:
+            return CONTINUE
+        median = sorted(others)[len(others) // 2]
+        best = max(hist)
+        return STOP if best < median else CONTINUE
+
+
+class ExploitDirective:
+    """PBT decision: restore from `source_trial_id`'s checkpoint and adopt
+    `new_config`."""
+
+    def __init__(self, source_trial_id: str, new_config: dict):
+        self.source_trial_id = source_trial_id
+        self.new_config = new_config
+
+
+class PopulationBasedTraining(TrialScheduler):
+    """PBT (reference: schedulers/pbt.py:PopulationBasedTraining).
+
+    Every perturbation_interval, a bottom-quantile trial exploits a
+    top-quantile trial's checkpoint and perturbs hyperparameters in
+    hyperparam_mutations (×1.2 / ×0.8 for numeric, resample for lists).
+    """
+
+    def __init__(self, *, time_attr: str = "training_iteration",
+                 perturbation_interval: int = 5,
+                 hyperparam_mutations: Optional[Dict[str, Any]] = None,
+                 quantile_fraction: float = 0.25,
+                 seed: Optional[int] = None):
+        self.time_attr = time_attr
+        self.interval = perturbation_interval
+        self.mutations = hyperparam_mutations or {}
+        self.quantile = quantile_fraction
+        self.rng = random.Random(seed)
+        self._latest: Dict[str, float] = {}
+        self._last_perturb: Dict[str, int] = {}
+
+    def _perturb(self, config: dict) -> dict:
+        new = dict(config)
+        for key, spec in self.mutations.items():
+            if key not in new:
+                continue
+            if isinstance(spec, list):
+                new[key] = self.rng.choice(spec)
+            elif callable(spec):
+                new[key] = spec()
+            else:
+                factor = 1.2 if self.rng.random() > 0.5 else 0.8
+                new[key] = new[key] * factor
+        return new
+
+    def on_result(self, trial, result: dict):
+        t = result.get(self.time_attr, 0)
+        self._latest[trial.trial_id] = self.score(result)
+        last = self._last_perturb.get(trial.trial_id, 0)
+        if t - last < self.interval:
+            return CONTINUE
+        self._last_perturb[trial.trial_id] = t
+        if len(self._latest) < 2:
+            return CONTINUE
+        ranked = sorted(self._latest.items(), key=lambda kv: kv[1])
+        n = len(ranked)
+        k = max(1, int(n * self.quantile))
+        bottom = [tid for tid, _ in ranked[:k]]
+        top = [tid for tid, _ in ranked[-k:]]
+        if trial.trial_id in bottom and top:
+            source = self.rng.choice(top)
+            if source != trial.trial_id:
+                return ExploitDirective(source, self._perturb(trial.config))
+        return CONTINUE
+
+    def on_trial_complete(self, trial, result):
+        self._latest.pop(trial.trial_id, None)

@@ -1,0 +1,198 @@
+"""Search spaces and search algorithms.
+
+Reference: python/ray/tune/search/ — sample.py (Categorical/Float/Integer
+domains, tune.choice/uniform/...), basic_variant.py (BasicVariantGenerator
+expanding grid_search across random samples), concurrency_limiter.py.
+"""
+
+from __future__ import annotations
+
+import itertools
+import math
+import random
+from typing import Any, Dict, List, Optional
+
+
+class Domain:
+    def sample(self, rng: random.Random) -> Any:
+        raise NotImplementedError
+
+
+class Categorical(Domain):
+    def __init__(self, categories):
+        self.categories = list(categories)
+
+    def sample(self, rng):
+        return rng.choice(self.categories)
+
+
+class Uniform(Domain):
+    def __init__(self, lower, upper):
+        self.lower, self.upper = lower, upper
+
+    def sample(self, rng):
+        return rng.uniform(self.lower, self.upper)
+
+
+class LogUniform(Domain):
+    def __init__(self, lower, upper, base=10):
+        self.lower, self.upper, self.base = lower, upper, base
+
+    def sample(self, rng):
+        lo = math.log(self.lower, self.base)
+        hi = math.log(self.upper, self.base)
+        return self.base ** rng.uniform(lo, hi)
+
+
+class RandInt(Domain):
+    def __init__(self, lower, upper):
+        self.lower, self.upper = lower, upper
+
+    def sample(self, rng):
+        return rng.randrange(self.lower, self.upper)
+
+
+class QUniform(Domain):
+    def __init__(self, lower, upper, q):
+        self.lower, self.upper, self.q = lower, upper, q
+
+    def sample(self, rng):
+        v = rng.uniform(self.lower, self.upper)
+        return round(v / self.q) * self.q
+
+
+class GridSearch:
+    def __init__(self, values):
+        self.values = list(values)
+
+
+def choice(categories) -> Categorical:
+    return Categorical(categories)
+
+
+def uniform(lower, upper) -> Uniform:
+    return Uniform(lower, upper)
+
+
+def loguniform(lower, upper, base=10) -> LogUniform:
+    return LogUniform(lower, upper, base)
+
+
+def randint(lower, upper) -> RandInt:
+    return RandInt(lower, upper)
+
+
+def quniform(lower, upper, q) -> QUniform:
+    return QUniform(lower, upper, q)
+
+
+def grid_search(values) -> GridSearch:
+    return GridSearch(values)
+
+
+def sample_from(fn):
+    """Lazy sample depending on the rest of the config (spec)."""
+
+    class _SampleFrom(Domain):
+        def __init__(self, f):
+            self.fn = f
+
+        def sample(self, rng):
+            raise RuntimeError("resolved separately")
+
+    return _SampleFrom(fn)
+
+
+# ---------------------------------------------------------------------------
+
+
+def _walk(space: Dict[str, Any], prefix=()):
+    for k, v in space.items():
+        path = prefix + (k,)
+        if isinstance(v, dict):
+            yield from _walk(v, path)
+        else:
+            yield path, v
+
+
+def _set_path(cfg: dict, path: tuple, value):
+    cur = cfg
+    for k in path[:-1]:
+        cur = cur.setdefault(k, {})
+    cur[path[-1]] = value
+
+
+def _deep_copy_static(space):
+    if isinstance(space, dict):
+        return {k: _deep_copy_static(v) for k, v in space.items()}
+    return space
+
+
+class SearchAlgorithm:
+    """Base: yields trial configs (reference: search/search_algorithm.py)."""
+
+    def set_metric(self, metric: Optional[str], mode: str):
+        self.metric, self.mode = metric, mode
+
+    def next_configs(self) -> Optional[List[dict]]:
+        raise NotImplementedError
+
+    def on_trial_complete(self, trial_id: str, result: Optional[dict],
+                          error: bool = False):
+        pass
+
+
+class BasicVariantGenerator(SearchAlgorithm):
+    """Grid × random expansion (reference: search/basic_variant.py)."""
+
+    def __init__(self, param_space: Dict[str, Any], num_samples: int = 1,
+                 seed: Optional[int] = None):
+        self.space = param_space
+        self.num_samples = num_samples
+        self.rng = random.Random(seed)
+        self._emitted = False
+
+    def next_configs(self) -> Optional[List[dict]]:
+        if self._emitted:
+            return None
+        self._emitted = True
+        grid_axes = []
+        for path, v in _walk(self.space):
+            if isinstance(v, GridSearch):
+                grid_axes.append((path, v.values))
+        configs = []
+        grid_combos = (itertools.product(*[vals for _, vals in grid_axes])
+                       if grid_axes else [()])
+        for combo in grid_combos:
+            for _ in range(self.num_samples):
+                cfg = _deep_copy_static(self.space)
+                for (path, _), val in zip(grid_axes, combo):
+                    _set_path(cfg, path, val)
+                for path, v in _walk(self.space):
+                    if (isinstance(v, Domain)
+                            and type(v).__name__ != "_SampleFrom"):
+                        _set_path(cfg, path, v.sample(self.rng))
+                # resolve sample_from last (may reference sampled values)
+                for path, v in _walk(self.space):
+                    if type(v).__name__ == "_SampleFrom":
+                        _set_path(cfg, path, v.fn(cfg))
+                configs.append(cfg)
+        return configs
+
+
+class ConcurrencyLimiter(SearchAlgorithm):
+    """Caps concurrent trials from a wrapped searcher (reference:
+    search/concurrency_limiter.py). The controller reads max_concurrent."""
+
+    def __init__(self, searcher: SearchAlgorithm, max_concurrent: int):
+        self.searcher = searcher
+        self.max_concurrent = max_concurrent
+
+    def set_metric(self, metric, mode):
+        self.searcher.set_metric(metric, mode)
+
+    def next_configs(self):
+        return self.searcher.next_configs()
+
+    def on_trial_complete(self, trial_id, result=None, error=False):
+        self.searcher.on_trial_complete(trial_id, result, error)

@@ -1,0 +1,59 @@
+"""In-trial session API: tune.report / tune.get_checkpoint.
+
+Reference: python/ray/tune (air session); the function-trainable side of
+trainable/function_trainable.py. A thread-local holds the active trial's
+report channel — the user fn runs in a background thread inside the
+trial actor.
+"""
+
+from __future__ import annotations
+
+import threading
+from dataclasses import dataclass
+from typing import Any, Callable, Dict, Optional
+
+from ray_tpu.train.checkpoint import Checkpoint
+
+_local = threading.local()
+
+
+@dataclass
+class _FnSession:
+    report: Callable[[Dict[str, Any], Optional[Checkpoint]], None]
+    checkpoint: Optional[Checkpoint]
+    trial_id: str
+    trial_dir: str
+
+
+def _set_session(sess: Optional[_FnSession]):
+    _local.session = sess
+
+
+def _get_session() -> Optional[_FnSession]:
+    return getattr(_local, "session", None)
+
+
+def report(metrics: Dict[str, Any],
+           checkpoint: Optional[Checkpoint] = None) -> None:
+    sess = _get_session()
+    if sess is None:
+        raise RuntimeError("tune.report() called outside a Tune trial")
+    sess.report(metrics, checkpoint)
+
+
+def get_checkpoint() -> Optional[Checkpoint]:
+    sess = _get_session()
+    if sess is None:
+        raise RuntimeError(
+            "tune.get_checkpoint() called outside a Tune trial")
+    return sess.checkpoint
+
+
+def get_trial_id() -> str:
+    sess = _get_session()
+    return sess.trial_id if sess else ""
+
+
+def get_trial_dir() -> str:
+    sess = _get_session()
+    return sess.trial_dir if sess else ""

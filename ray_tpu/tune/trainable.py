@@ -1,0 +1,161 @@
+"""Trainables: the unit of execution for a Tune trial.
+
+Reference: python/ray/tune/trainable/trainable.py (class Trainable:
+setup/step/save_checkpoint/load_checkpoint/reset_config) and
+function_trainable.py (function API driven by ``tune.report`` from a
+background thread, results handed over a queue). Both kinds run inside
+one actor per trial; the controller polls ``next_result``.
+"""
+
+from __future__ import annotations
+
+import os
+import queue
+import threading
+import traceback
+from typing import Any, Callable, Dict, Optional
+
+from ray_tpu.train.checkpoint import Checkpoint
+
+DONE = "__trial_done__"
+
+
+class Trainable:
+    """Class trainable API (reference: trainable.py:Trainable)."""
+
+    def setup(self, config: dict) -> None:
+        pass
+
+    def step(self) -> Dict[str, Any]:
+        raise NotImplementedError
+
+    def save_checkpoint(self, checkpoint_dir: str) -> None:
+        pass
+
+    def load_checkpoint(self, checkpoint_dir: str) -> None:
+        pass
+
+    def reset_config(self, new_config: dict) -> bool:
+        """Return True if the trainable supports in-place config reset
+        (used by PBT exploit to avoid a full actor restart)."""
+        return False
+
+    def cleanup(self) -> None:
+        pass
+
+
+class _TrialRunner:
+    """Actor body hosting one trial (class or function trainable).
+
+    The controller drives it via ``next_result`` calls — one per reported
+    result — so scheduler decisions (stop / exploit) apply between steps.
+    """
+
+    def __init__(self, trainable_spec, config: dict, trial_dir: str,
+                 trial_id: str):
+        os.makedirs(trial_dir, exist_ok=True)
+        self.config = dict(config)
+        self.trial_dir = trial_dir
+        self.trial_id = trial_id
+        self.iteration = 0
+        self._ckpt_seq = 0
+        self._restore_path: Optional[str] = None
+        self._fn: Optional[Callable] = None
+        self._cls_instance: Optional[Trainable] = None
+        self._thread: Optional[threading.Thread] = None
+        self._queue: "queue.Queue" = queue.Queue()
+        self._spec = trainable_spec
+        if isinstance(trainable_spec, type) and issubclass(trainable_spec,
+                                                           Trainable):
+            self._cls_instance = trainable_spec()
+            self._cls_instance.setup(self.config)
+        elif callable(trainable_spec):
+            self._fn = trainable_spec
+        else:
+            raise TypeError(f"bad trainable: {trainable_spec!r}")
+
+    # -- function-trainable plumbing -----------------------------------
+    def _run_fn(self):
+        from ray_tpu.tune import session
+
+        token = session._FnSession(
+            report=self._fn_report,
+            checkpoint=(Checkpoint(self._restore_path)
+                        if self._restore_path else None),
+            trial_id=self.trial_id,
+            trial_dir=self.trial_dir,
+        )
+        session._set_session(token)
+        try:
+            self._fn(self.config)
+            self._queue.put((DONE, None))
+        except Exception:
+            self._queue.put(("__error__", traceback.format_exc()))
+        finally:
+            session._set_session(None)
+
+    def _fn_report(self, metrics: Dict[str, Any],
+                   checkpoint: Optional[Checkpoint]):
+        self._queue.put(("result", (dict(metrics),
+                                    checkpoint.path if checkpoint else None)))
+
+    # -- controller-facing API -----------------------------------------
+    def next_result(self) -> Dict[str, Any]:
+        """Blocking: produce the next reported result for this trial."""
+        if self._cls_instance is not None:
+            metrics = self._cls_instance.step()
+            self.iteration += 1
+            out = dict(metrics)
+            out.setdefault("training_iteration", self.iteration)
+            out["trial_id"] = self.trial_id
+            out["done"] = bool(out.get("done", False))
+            return out
+        if self._thread is None:
+            self._thread = threading.Thread(target=self._run_fn, daemon=True)
+            self._thread.start()
+        kind, payload = self._queue.get()
+        if kind == DONE:
+            return {"done": True, "trial_id": self.trial_id}
+        if kind == "__error__":
+            raise RuntimeError(f"trial fn failed:\n{payload}")
+        metrics, ckpt_path = payload
+        self.iteration += 1
+        metrics.setdefault("training_iteration", self.iteration)
+        metrics["trial_id"] = self.trial_id
+        metrics["done"] = bool(metrics.get("done", False))
+        if ckpt_path:
+            metrics["__checkpoint_path__"] = ckpt_path
+        return metrics
+
+    def save(self) -> Optional[str]:
+        """Class trainables: write a checkpoint dir and return its path."""
+        if self._cls_instance is None:
+            return None
+        path = os.path.join(self.trial_dir,
+                            f"checkpoint_{self._ckpt_seq:06d}")
+        self._ckpt_seq += 1
+        os.makedirs(path, exist_ok=True)
+        self._cls_instance.save_checkpoint(path)
+        return path
+
+    def restore(self, checkpoint_path: str) -> None:
+        if self._cls_instance is not None:
+            self._cls_instance.load_checkpoint(checkpoint_path)
+        else:
+            # Applied on (re)start: exposed to the fn via
+            # tune.get_checkpoint().
+            self._restore_path = checkpoint_path
+
+    def reset(self, new_config: dict) -> bool:
+        """PBT exploit path for class trainables."""
+        self.config = dict(new_config)
+        if self._cls_instance is not None:
+            return bool(self._cls_instance.reset_config(self.config))
+        return False
+
+    def get_config(self) -> dict:
+        return self.config
+
+    def stop(self) -> None:
+        if self._cls_instance is not None:
+            self._cls_instance.cleanup()

@@ -1,0 +1,323 @@
+"""TuneController: the trial-driving event loop.
+
+Reference: python/ray/tune/execution/tune_controller.py:72 — owns trial
+lifecycle (PENDING → RUNNING → TERMINATED/ERROR), starts trial actors
+under resource constraints, consumes results, applies scheduler
+decisions, persists experiment state for resume. One actor per trial;
+``next_result`` futures are multiplexed with ``ray_tpu.wait``.
+"""
+
+from __future__ import annotations
+
+import json
+import logging
+import os
+import time
+import traceback
+from dataclasses import dataclass, field
+from typing import Any, Dict, List, Optional
+
+import ray_tpu
+from ray_tpu.train.checkpoint import Checkpoint
+from ray_tpu.train.config import RunConfig
+from ray_tpu.train.result import Result
+from ray_tpu.tune import schedulers as sched_mod
+from ray_tpu.tune.schedulers import (
+    CONTINUE,
+    STOP,
+    ExploitDirective,
+    FIFOScheduler,
+    TrialScheduler,
+)
+from ray_tpu.tune.search import ConcurrencyLimiter, SearchAlgorithm
+from ray_tpu.tune.trainable import _TrialRunner
+
+logger = logging.getLogger(__name__)
+
+PENDING = "PENDING"
+RUNNING = "RUNNING"
+TERMINATED = "TERMINATED"
+ERROR = "ERROR"
+
+
+@dataclass
+class Trial:
+    trial_id: str
+    config: dict
+    trial_dir: str
+    state: str = PENDING
+    last_result: Dict[str, Any] = field(default_factory=dict)
+    history: List[Dict[str, Any]] = field(default_factory=list)
+    checkpoint_path: Optional[str] = None
+    error: Optional[str] = None
+    actor: Any = None
+    future: Any = None
+    retries: int = 0
+
+
+class TuneController:
+    def __init__(self, trainable, *, search_alg: SearchAlgorithm,
+                 scheduler: Optional[TrialScheduler],
+                 metric: Optional[str], mode: str,
+                 run_config: RunConfig, max_concurrent: int,
+                 resources_per_trial: Optional[Dict[str, float]] = None,
+                 checkpoint_freq: int = 0, max_failures: int = 0,
+                 experiment_dir: Optional[str] = None):
+        self.trainable = trainable
+        self.search_alg = search_alg
+        self.scheduler = scheduler or FIFOScheduler()
+        self.metric = metric
+        self.mode = mode
+        if metric:
+            self.scheduler.set_metric(metric, mode)
+            self.search_alg.set_metric(metric, mode)
+        self.run_config = run_config
+        if isinstance(search_alg, ConcurrencyLimiter):
+            max_concurrent = min(max_concurrent, search_alg.max_concurrent)
+        self.max_concurrent = max_concurrent
+        self.resources = resources_per_trial or {"num_cpus": 1}
+        self.checkpoint_freq = checkpoint_freq
+        self.max_failures = max_failures
+        name = run_config.name or f"tune_{int(time.time())}"
+        self.exp_dir = experiment_dir or os.path.join(
+            run_config.resolved_storage_path(), name)
+        os.makedirs(self.exp_dir, exist_ok=True)
+        self.trials: List[Trial] = []
+        self._counter = 0
+
+    # -- lifecycle ------------------------------------------------------
+    def _new_trials(self):
+        configs = self.search_alg.next_configs()
+        if not configs:
+            return
+        for cfg in configs:
+            self._counter += 1
+            tid = f"trial_{self._counter:05d}"
+            self.trials.append(Trial(
+                trial_id=tid, config=cfg,
+                trial_dir=os.path.join(self.exp_dir, tid)))
+
+    def _start_trial(self, trial: Trial):
+        actor_cls = ray_tpu.remote(_TrialRunner).options(**self.resources)
+        trial.actor = actor_cls.remote(
+            self.trainable, trial.config, trial.trial_dir, trial.trial_id)
+        if trial.checkpoint_path:
+            ray_tpu.get(trial.actor.restore.remote(trial.checkpoint_path))
+        trial.state = RUNNING
+        trial.future = trial.actor.next_result.remote()
+
+    def _stop_trial(self, trial: Trial, state: str, error: str = None):
+        trial.state = state
+        trial.error = error
+        trial.future = None
+        if trial.actor is not None:
+            try:
+                ray_tpu.get(trial.actor.stop.remote(), timeout=5)
+            except Exception:
+                pass
+            try:
+                ray_tpu.kill(trial.actor)
+            except Exception:
+                pass
+            trial.actor = None
+        self.search_alg.on_trial_complete(
+            trial.trial_id, trial.last_result, error=state == ERROR)
+        self.scheduler.on_trial_complete(trial, trial.last_result)
+
+    def _maybe_checkpoint(self, trial: Trial, force: bool = False):
+        """Class trainables: periodic checkpoint via actor.save()."""
+        it = trial.last_result.get("training_iteration", 0)
+        due = (self.checkpoint_freq and it
+               and it % self.checkpoint_freq == 0)
+        if not (due or force) or trial.actor is None:
+            return
+        try:
+            path = ray_tpu.get(trial.actor.save.remote(), timeout=60)
+            if path:
+                trial.checkpoint_path = path
+        except Exception:
+            logger.warning("checkpoint of %s failed", trial.trial_id)
+
+    # -- exploit (PBT) --------------------------------------------------
+    def _exploit(self, trial: Trial, directive: ExploitDirective):
+        source = next((t for t in self.trials
+                       if t.trial_id == directive.source_trial_id), None)
+        if source is None:
+            trial.future = trial.actor.next_result.remote()
+            return
+        src_ckpt = None
+        if source.actor is not None:
+            try:
+                src_ckpt = ray_tpu.get(source.actor.save.remote(),
+                                       timeout=60)
+            except Exception:
+                src_ckpt = None
+        src_ckpt = src_ckpt or source.checkpoint_path
+        trial.config = directive.new_config
+        if src_ckpt is None:
+            trial.future = trial.actor.next_result.remote()
+            return
+        in_place = False
+        try:
+            in_place = ray_tpu.get(
+                trial.actor.reset.remote(directive.new_config), timeout=30)
+        except Exception:
+            in_place = False
+        if not in_place:
+            # Restart the actor with the mutated config + source ckpt.
+            try:
+                ray_tpu.kill(trial.actor)
+            except Exception:
+                pass
+            actor_cls = ray_tpu.remote(_TrialRunner).options(
+                **self.resources)
+            trial.actor = actor_cls.remote(
+                self.trainable, trial.config, trial.trial_dir,
+                trial.trial_id)
+        ray_tpu.get(trial.actor.restore.remote(src_ckpt))
+        trial.checkpoint_path = src_ckpt
+        trial.future = trial.actor.next_result.remote()
+
+    # -- main loop ------------------------------------------------------
+    def run(self) -> List[Trial]:
+        self._new_trials()
+        while True:
+            self._new_trials()
+            pending = [t for t in self.trials if t.state == PENDING]
+            running = [t for t in self.trials if t.state == RUNNING]
+            for t in pending:
+                if len(running) >= self.max_concurrent:
+                    break
+                try:
+                    self._start_trial(t)
+                    running.append(t)
+                except Exception as e:
+                    t.state = ERROR
+                    t.error = str(e)
+            running = [t for t in self.trials if t.state == RUNNING]
+            if not running and not pending:
+                break
+            futures = [t.future for t in running if t.future is not None]
+            if not futures:
+                break
+            ready, _ = ray_tpu.wait(futures, num_returns=1, timeout=30.0)
+            if not ready:
+                continue
+            fut = ready[0]
+            trial = next(t for t in running if t.future is fut)
+            try:
+                result = ray_tpu.get(fut)
+            except Exception as e:
+                self._on_trial_error(trial, e)
+                continue
+            self._on_result(trial, result)
+        self._save_state()
+        return self.trials
+
+    def _on_result(self, trial: Trial, result: Dict[str, Any]):
+        if result.get("done") and len(result) <= 2:
+            # Function trainable finished without a final report.
+            self._maybe_checkpoint(trial, force=bool(self.checkpoint_freq))
+            self._stop_trial(trial, TERMINATED)
+            self._save_state()
+            return
+        ckpt = result.pop("__checkpoint_path__", None)
+        if ckpt:
+            trial.checkpoint_path = ckpt
+        trial.last_result = result
+        trial.history.append(dict(result))
+        self._maybe_checkpoint(trial)
+        if self._stop_criteria_met(trial, result):
+            self._maybe_checkpoint(trial, force=bool(self.checkpoint_freq))
+            self._stop_trial(trial, TERMINATED)
+            self._save_state()
+            return
+        if result.get("done"):
+            self._maybe_checkpoint(trial, force=bool(self.checkpoint_freq))
+            self._stop_trial(trial, TERMINATED)
+            self._save_state()
+            return
+        decision = (self.scheduler.on_result(trial, result)
+                    if self.metric else CONTINUE)
+        if isinstance(decision, ExploitDirective):
+            self._exploit(trial, decision)
+        elif decision == STOP:
+            self._maybe_checkpoint(trial, force=bool(self.checkpoint_freq))
+            self._stop_trial(trial, TERMINATED)
+            self._save_state()
+        else:
+            trial.future = trial.actor.next_result.remote()
+
+    def _stop_criteria_met(self, trial: Trial, result: dict) -> bool:
+        stop = self.run_config.stop
+        if stop is None:
+            return False
+        if callable(stop):
+            return bool(stop(trial.trial_id, result))
+        return any(k in result and result[k] >= v for k, v in stop.items())
+
+    def _on_trial_error(self, trial: Trial, error: Exception):
+        logger.warning("trial %s failed: %s", trial.trial_id, error)
+        if trial.retries < self.max_failures:
+            trial.retries += 1
+            try:
+                ray_tpu.kill(trial.actor)
+            except Exception:
+                pass
+            trial.actor = None
+            trial.state = PENDING
+            trial.future = None
+        else:
+            self._stop_trial(trial, ERROR, error=str(error))
+        self._save_state()
+
+    # -- persistence ----------------------------------------------------
+    def _save_state(self):
+        state = {
+            "metric": self.metric,
+            "mode": self.mode,
+            "counter": self._counter,
+            "trials": [
+                {
+                    "trial_id": t.trial_id,
+                    "config_repr": {k: v for k, v in t.config.items()
+                                    if _jsonable(v)},
+                    "state": t.state,
+                    "last_result": {k: v for k, v in t.last_result.items()
+                                    if _jsonable(v)},
+                    "checkpoint_path": t.checkpoint_path,
+                    "error": t.error,
+                }
+                for t in self.trials
+            ],
+        }
+        tmp = os.path.join(self.exp_dir, ".experiment_state.tmp")
+        with open(tmp, "w") as f:
+            json.dump(state, f, indent=2)
+        os.replace(tmp, os.path.join(self.exp_dir,
+                                     "experiment_state.json"))
+        # Full-fidelity configs for restore (config_repr above is a
+        # human-readable JSON projection that drops non-JSON values).
+        import pickle
+
+        tmp2 = os.path.join(self.exp_dir, ".trial_configs.tmp")
+        with open(tmp2, "wb") as f:
+            pickle.dump({t.trial_id: t.config for t in self.trials}, f)
+        os.replace(tmp2, os.path.join(self.exp_dir, "trial_configs.pkl"))
+
+    def results(self) -> List[Result]:
+        out = []
+        for t in self.trials:
+            out.append(Result(
+                metrics=t.last_result,
+                checkpoint=(Checkpoint(t.checkpoint_path)
+                            if t.checkpoint_path else None),
+                path=t.trial_dir,
+                error=t.error,
+                metrics_history=t.history,
+            ))
+        return out
+
+
+def _jsonable(v) -> bool:
+    return isinstance(v, (str, int, float, bool, type(None), list, dict))

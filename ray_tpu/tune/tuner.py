@@ -1,0 +1,239 @@
+"""Tuner: the user-facing entry point.
+
+Reference: python/ray/tune/tuner.py (Tuner.fit:347), tune_config.py
+(TuneConfig), result_grid.py (ResultGrid). ``Tuner`` also accepts a
+``JaxTrainer`` — the trainer becomes a function trainable whose
+param_space key ``train_loop_config`` overrides the trainer's config,
+mirroring the reference's trainer-as-Trainable wrapping
+(train/base_trainer.py:747).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import json
+import os
+from typing import Any, Dict, Optional
+
+from ray_tpu.train.config import RunConfig
+from ray_tpu.train.result import Result
+from ray_tpu.tune.search import (
+    BasicVariantGenerator,
+    SearchAlgorithm,
+)
+from ray_tpu.tune.schedulers import TrialScheduler
+from ray_tpu.tune.tune_controller import TuneController, Trial
+
+
+@dataclasses.dataclass
+class TuneConfig:
+    """Reference: python/ray/tune/tune_config.py."""
+
+    metric: Optional[str] = None
+    mode: str = "max"
+    num_samples: int = 1
+    max_concurrent_trials: int = 4
+    search_alg: Optional[SearchAlgorithm] = None
+    scheduler: Optional[TrialScheduler] = None
+    checkpoint_freq: int = 0
+    seed: Optional[int] = None
+
+    def __post_init__(self):
+        if self.mode not in ("max", "min"):
+            raise ValueError("mode must be 'max' or 'min'")
+
+
+class ResultGrid:
+    """Reference: python/ray/tune/result_grid.py."""
+
+    def __init__(self, results, metric: Optional[str], mode: str):
+        self._results = list(results)
+        self._metric = metric
+        self._mode = mode
+
+    def __len__(self):
+        return len(self._results)
+
+    def __getitem__(self, i) -> Result:
+        return self._results[i]
+
+    def __iter__(self):
+        return iter(self._results)
+
+    @property
+    def errors(self):
+        return [r.error for r in self._results if r.error]
+
+    def get_best_result(self, metric: Optional[str] = None,
+                        mode: Optional[str] = None) -> Result:
+        metric = metric or self._metric
+        mode = mode or self._mode
+        if metric is None:
+            raise ValueError("no metric given to get_best_result")
+        candidates = [r for r in self._results
+                      if not r.error and metric in (r.metrics or {})]
+        if not candidates:
+            raise RuntimeError("no successful trial reported the metric")
+        sign = 1 if mode == "max" else -1
+        return max(candidates, key=lambda r: sign * r.metrics[metric])
+
+    def get_dataframe(self):
+        import pandas as pd
+
+        return pd.DataFrame([r.metrics for r in self._results])
+
+
+class Tuner:
+    def __init__(self, trainable, *, param_space: Optional[dict] = None,
+                 tune_config: Optional[TuneConfig] = None,
+                 run_config: Optional[RunConfig] = None,
+                 resources_per_trial: Optional[Dict[str, float]] = None,
+                 _experiment_dir: Optional[str] = None):
+        from ray_tpu.train.trainer import JaxTrainer
+
+        self.param_space = param_space or {}
+        self.tune_config = tune_config or TuneConfig()
+        self.run_config = run_config or RunConfig()
+        self.resources_per_trial = resources_per_trial
+        self._experiment_dir = _experiment_dir
+        if isinstance(trainable, JaxTrainer):
+            self.trainable = _trainer_as_trainable(trainable)
+            # Trial actors only coordinate; the trainer's own worker
+            # group claims the training resources.
+            self.resources_per_trial = (resources_per_trial
+                                        or {"num_cpus": 0.1})
+        else:
+            self.trainable = trainable
+
+    def fit(self) -> ResultGrid:
+        tc = self.tune_config
+        search_alg = tc.search_alg or BasicVariantGenerator(
+            self.param_space, num_samples=tc.num_samples, seed=tc.seed)
+        failure = self.run_config.failure_config
+        controller = TuneController(
+            self.trainable,
+            search_alg=search_alg,
+            scheduler=tc.scheduler,
+            metric=tc.metric,
+            mode=tc.mode,
+            run_config=self.run_config,
+            max_concurrent=tc.max_concurrent_trials,
+            resources_per_trial=self.resources_per_trial,
+            checkpoint_freq=tc.checkpoint_freq,
+            max_failures=failure.max_failures if failure else 0,
+            experiment_dir=self._experiment_dir,
+        )
+        trials = controller.run()
+        return ResultGrid(controller.results(), tc.metric, tc.mode)
+
+    @classmethod
+    def restore(cls, experiment_dir: str, trainable,
+                *, tune_config: Optional[TuneConfig] = None,
+                run_config: Optional[RunConfig] = None,
+                resources_per_trial: Optional[Dict[str, float]] = None
+                ) -> "Tuner":
+        """Resume an interrupted experiment (reference: Tuner.restore).
+
+        Terminated trials keep their recorded results; unfinished trials
+        restart (from their last checkpoint if any) via a restorer search
+        algorithm that replays the saved trial configs.
+        """
+        state_file = os.path.join(experiment_dir, "experiment_state.json")
+        with open(state_file) as f:
+            state = json.load(f)
+        # Prefer the pickled full-fidelity configs; the JSON config_repr
+        # drops non-JSON-serializable values.
+        cfg_file = os.path.join(experiment_dir, "trial_configs.pkl")
+        if os.path.exists(cfg_file):
+            import pickle
+
+            with open(cfg_file, "rb") as f:
+                full = pickle.load(f)
+            for t in state["trials"]:
+                if t["trial_id"] in full:
+                    t["config_repr"] = full[t["trial_id"]]
+        tuner = cls(trainable, tune_config=tune_config,
+                    run_config=run_config,
+                    resources_per_trial=resources_per_trial,
+                    _experiment_dir=experiment_dir)
+        tuner._restore_state = state
+        tuner.fit = tuner._restored_fit  # type: ignore[method-assign]
+        return tuner
+
+    def _restored_fit(self) -> ResultGrid:
+        state = self._restore_state
+        tc = self.tune_config
+
+        class _Restorer(SearchAlgorithm):
+            def __init__(self, trials):
+                self._trials = trials
+                self._emitted = False
+
+            def set_metric(self, metric, mode):
+                pass
+
+            def next_configs(self):
+                if self._emitted:
+                    return None
+                self._emitted = True
+                return [t["config_repr"] for t in self._trials
+                        if t["state"] not in ("TERMINATED",)]
+
+        unfinished = [t for t in state["trials"]
+                      if t["state"] != "TERMINATED"]
+        failure = self.run_config.failure_config
+        controller = TuneController(
+            self.trainable,
+            search_alg=_Restorer(state["trials"]),
+            scheduler=tc.scheduler,
+            metric=tc.metric or state.get("metric"),
+            mode=tc.mode if tc.metric else state.get("mode", "max"),
+            run_config=self.run_config,
+            max_concurrent=tc.max_concurrent_trials,
+            resources_per_trial=self.resources_per_trial,
+            checkpoint_freq=tc.checkpoint_freq,
+            max_failures=failure.max_failures if failure else 0,
+            experiment_dir=self._experiment_dir,
+        )
+        # Seed checkpoints so restarted trials resume, not restart.
+        controller._new_trials()
+        for trial, saved in zip(controller.trials, unfinished):
+            trial.checkpoint_path = saved.get("checkpoint_path")
+        trials = controller.run()
+        results = controller.results()
+        # Merge back terminated trials' recorded results.
+        from ray_tpu.train.checkpoint import Checkpoint
+
+        for t in state["trials"]:
+            if t["state"] == "TERMINATED":
+                results.append(Result(
+                    metrics=t["last_result"],
+                    checkpoint=(Checkpoint(t["checkpoint_path"])
+                                if t.get("checkpoint_path") else None),
+                    path=os.path.join(self._experiment_dir, t["trial_id"]),
+                    error=t.get("error"),
+                ))
+        metric = tc.metric or state.get("metric")
+        mode = tc.mode if tc.metric else state.get("mode", "max")
+        return ResultGrid(results, metric, mode)
+
+
+def _trainer_as_trainable(trainer):
+    """Wrap a JaxTrainer so each trial runs trainer.fit with the trial's
+    train_loop_config override (reference: base_trainer.py:747)."""
+    import copy
+
+    def _fit_fn(config: dict):
+        from ray_tpu.tune import session as tune_session
+
+        t = copy.copy(trainer)
+        loop_cfg = dict(t.train_loop_config)
+        loop_cfg.update(config.get("train_loop_config", config))
+        t.train_loop_config = loop_cfg
+        result = t.fit()
+        if result.error:
+            raise RuntimeError(result.error)
+        metrics = dict(result.metrics or {})
+        tune_session.report(metrics, checkpoint=result.checkpoint)
+
+    return _fit_fn

@@ -1,0 +1,302 @@
+"""Tests for ray_tpu.tune (reference strategy: python/ray/tune/tests/
+test_tune_restore.py, test_trial_scheduler.py, test_basic_variant.py)."""
+
+import os
+
+import pytest
+
+import ray_tpu
+from ray_tpu import tune
+from ray_tpu.train.config import RunConfig
+from ray_tpu.tune.search import BasicVariantGenerator
+from ray_tpu.tune.schedulers import (
+    CONTINUE,
+    STOP,
+    AsyncHyperBandScheduler,
+    PopulationBasedTraining,
+    ExploitDirective,
+)
+
+
+@pytest.fixture(scope="module")
+def tune_cluster():
+    ray_tpu.init(num_cpus=4, num_tpus=0)
+    yield
+    ray_tpu.shutdown()
+
+
+# -- search spaces (no cluster needed) --------------------------------------
+
+
+def test_basic_variant_grid_and_samples():
+    space = {
+        "lr": tune.grid_search([0.1, 0.01]),
+        "wd": tune.uniform(0, 1),
+        "layers": tune.randint(1, 4),
+    }
+    gen = BasicVariantGenerator(space, num_samples=3, seed=0)
+    configs = gen.next_configs()
+    assert len(configs) == 6  # 2 grid x 3 samples
+    assert gen.next_configs() is None
+    assert {c["lr"] for c in configs} == {0.1, 0.01}
+    for c in configs:
+        assert 0 <= c["wd"] <= 1
+        assert c["layers"] in (1, 2, 3)
+
+
+def test_nested_space_and_loguniform():
+    space = {"opt": {"lr": tune.loguniform(1e-5, 1e-1)},
+             "fixed": "adam"}
+    cfgs = BasicVariantGenerator(space, num_samples=4, seed=1).next_configs()
+    assert len(cfgs) == 4
+    for c in cfgs:
+        assert 1e-5 <= c["opt"]["lr"] <= 1e-1
+        assert c["fixed"] == "adam"
+
+
+def test_asha_decisions():
+    class T:
+        trial_id = "a"
+
+    sched = AsyncHyperBandScheduler(grace_period=1, reduction_factor=2,
+                                    max_t=8)
+    sched.set_metric("score", "max")
+    # First trial at the rung always continues.
+    assert sched.on_result(T(), {"training_iteration": 1,
+                                 "score": 10}) == CONTINUE
+    # A much worse second trial at the same rung stops.
+    t2 = type("T2", (), {"trial_id": "b"})()
+    assert sched.on_result(t2, {"training_iteration": 1,
+                                "score": 1}) == STOP
+    # max_t reached -> stop.
+    assert sched.on_result(T(), {"training_iteration": 8,
+                                 "score": 100}) == STOP
+
+
+def test_pbt_exploit_directive():
+    sched = PopulationBasedTraining(
+        perturbation_interval=2,
+        hyperparam_mutations={"lr": [0.1, 0.01]},
+        quantile_fraction=0.5, seed=0)
+    sched.set_metric("score", "max")
+
+    class Trial:
+        def __init__(self, tid, cfg):
+            self.trial_id = tid
+            self.config = cfg
+
+    good = Trial("good", {"lr": 0.1})
+    bad = Trial("bad", {"lr": 0.5})
+    assert sched.on_result(good, {"training_iteration": 2,
+                                  "score": 100}) == CONTINUE
+    out = sched.on_result(bad, {"training_iteration": 2, "score": 1})
+    assert isinstance(out, ExploitDirective)
+    assert out.source_trial_id == "good"
+    assert out.new_config["lr"] in (0.1, 0.01)
+
+
+# -- end-to-end -------------------------------------------------------------
+
+
+def _objective(config):
+    score = 0.0
+    for i in range(5):
+        score += config["x"]
+        tune.report({"score": score})
+
+
+def test_tuner_function_trainable(tune_cluster, tmp_path):
+    tuner = tune.Tuner(
+        _objective,
+        param_space={"x": tune.grid_search([1.0, 2.0, 3.0])},
+        tune_config=tune.TuneConfig(metric="score", mode="max",
+                                    max_concurrent_trials=2),
+        run_config=RunConfig(name="fn_exp", storage_path=str(tmp_path)),
+    )
+    grid = tuner.fit()
+    assert len(grid) == 3
+    best = grid.get_best_result()
+    assert best.metrics["score"] == 15.0
+    assert not grid.errors
+    assert os.path.exists(tmp_path / "fn_exp" / "experiment_state.json")
+
+
+class _Quadratic(tune.Trainable):
+    def setup(self, config):
+        self.x = config["x"]
+        self.val = 0.0
+
+    def step(self):
+        self.val += self.x * (10 - self.val) * 0.1
+        return {"score": self.val, "done": self.val > 9.0}
+
+    def save_checkpoint(self, path):
+        with open(os.path.join(path, "state.txt"), "w") as f:
+            f.write(str(self.val))
+
+    def load_checkpoint(self, path):
+        with open(os.path.join(path, "state.txt")) as f:
+            self.val = float(f.read())
+
+
+def test_tuner_class_trainable_with_checkpoints(tune_cluster, tmp_path):
+    tuner = tune.Tuner(
+        _Quadratic,
+        param_space={"x": tune.grid_search([0.5, 1.0])},
+        tune_config=tune.TuneConfig(metric="score", mode="max",
+                                    checkpoint_freq=5),
+        run_config=RunConfig(name="cls_exp", storage_path=str(tmp_path)),
+    )
+    grid = tuner.fit()
+    assert len(grid) == 2
+    best = grid.get_best_result()
+    assert best.metrics["score"] > 9.0
+    assert best.checkpoint is not None
+    assert os.path.exists(best.checkpoint.path)
+
+
+def _early_stop_objective(config):
+    for i in range(20):
+        tune.report({"loss": config["lr"] * (i + 1)})
+
+
+def test_tuner_with_asha(tune_cluster, tmp_path):
+    tuner = tune.Tuner(
+        _early_stop_objective,
+        param_space={"lr": tune.grid_search([1.0, 2.0, 3.0, 4.0])},
+        tune_config=tune.TuneConfig(
+            metric="loss", mode="min",
+            scheduler=tune.ASHAScheduler(grace_period=2,
+                                         reduction_factor=2, max_t=20),
+            max_concurrent_trials=2),
+        run_config=RunConfig(name="asha", storage_path=str(tmp_path)),
+    )
+    grid = tuner.fit()
+    assert len(grid) == 4
+    iters = sorted(r.metrics.get("training_iteration", 0) for r in grid)
+    assert iters[0] < 20  # someone was early-stopped
+
+
+def _resumable(config):
+    start = 0
+    ckpt = tune.get_checkpoint()
+    if ckpt is not None:
+        with open(os.path.join(ckpt.path, "it.txt")) as f:
+            start = int(f.read())
+    for i in range(start, 6):
+        d = os.path.join(tune.get_trial_dir(), f"ck_{i}")
+        os.makedirs(d, exist_ok=True)
+        with open(os.path.join(d, "it.txt"), "w") as f:
+            f.write(str(i + 1))
+        from ray_tpu.train.checkpoint import Checkpoint
+
+        tune.report({"it": i + 1}, checkpoint=Checkpoint(d))
+        if config.get("crash_at") == i + 1:
+            raise RuntimeError("boom")
+
+
+def test_tuner_restore_resumes_from_checkpoint(tune_cluster, tmp_path):
+    tuner = tune.Tuner(
+        _resumable,
+        param_space={"crash_at": tune.grid_search([3])},
+        tune_config=tune.TuneConfig(metric="it", mode="max"),
+        run_config=RunConfig(name="resume", storage_path=str(tmp_path)),
+    )
+    grid = tuner.fit()
+    assert grid.errors  # first run crashed at it=3
+    restored = tune.Tuner.restore(
+        str(tmp_path / "resume"), _resumable,
+        tune_config=tune.TuneConfig(metric="it", mode="max"))
+    grid2 = restored.fit()
+    best = grid2.get_best_result()
+    assert best.metrics["it"] == 6
+    assert not grid2.errors
+
+
+class _Counter(tune.Trainable):
+    def setup(self, config):
+        self.i = 0
+
+    def step(self):
+        self.i += 1
+        return {"iters": self.i}
+
+
+def test_stop_criteria(tune_cluster, tmp_path):
+    tuner = tune.Tuner(
+        _Counter,
+        param_space={},
+        tune_config=tune.TuneConfig(metric="iters", mode="max"),
+        run_config=RunConfig(name="stopc", storage_path=str(tmp_path),
+                             stop={"training_iteration": 7}),
+    )
+    grid = tuner.fit()
+    assert grid.get_best_result().metrics["training_iteration"] == 7
+
+
+class _PBTTrainable(tune.Trainable):
+    def setup(self, config):
+        self.lr = config["lr"]
+        self.score = 0.0
+
+    def step(self):
+        # Good lr (1.0) improves fast; bad lr (0.0) doesn't improve.
+        self.score += self.lr
+        return {"score": self.score,
+                "done": self.score >= 20 or False}
+
+    def save_checkpoint(self, path):
+        with open(os.path.join(path, "s.txt"), "w") as f:
+            f.write(f"{self.score},{self.lr}")
+
+    def load_checkpoint(self, path):
+        with open(os.path.join(path, "s.txt")) as f:
+            s, _lr = f.read().split(",")
+            self.score = float(s)
+
+
+def test_pbt_end_to_end(tune_cluster, tmp_path):
+    tuner = tune.Tuner(
+        _PBTTrainable,
+        param_space={"lr": tune.grid_search([0.0, 1.0])},
+        tune_config=tune.TuneConfig(
+            metric="score", mode="max",
+            scheduler=tune.PopulationBasedTraining(
+                perturbation_interval=4,
+                hyperparam_mutations={"lr": [0.5, 1.0]},
+                quantile_fraction=0.5, seed=0),
+        ),
+        run_config=RunConfig(name="pbt", storage_path=str(tmp_path)),
+    )
+    grid = tuner.fit()
+    assert not grid.errors
+    # The lr=0 trial must have exploited the lr=1 trial's checkpoint:
+    # both trials end with a meaningful score.
+    scores = sorted(r.metrics["score"] for r in grid)
+    assert scores[0] > 4.0  # a pure lr=0 trial would stay at 0
+
+
+def test_trial_failure_retry(tune_cluster, tmp_path):
+    import tempfile
+
+    marker_dir = tempfile.mkdtemp()
+
+    def flaky(config):
+        marker = os.path.join(marker_dir, "attempted")
+        if not os.path.exists(marker):
+            open(marker, "w").close()
+            raise RuntimeError("first attempt fails")
+        tune.report({"ok": 1.0})
+
+    from ray_tpu.train.config import FailureConfig
+
+    tuner = tune.Tuner(
+        flaky,
+        param_space={},
+        tune_config=tune.TuneConfig(metric="ok", mode="max"),
+        run_config=RunConfig(name="flaky", storage_path=str(tmp_path),
+                             failure_config=FailureConfig(max_failures=2)),
+    )
+    grid = tuner.fit()
+    assert not grid.errors
+    assert grid.get_best_result().metrics["ok"] == 1.0
