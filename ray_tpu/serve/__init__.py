@@ -1,0 +1,44 @@
+"""ray_tpu.serve — scalable model serving.
+
+Reference capability: python/ray/serve (deployments, controller-managed
+replicas, HTTP ingress, pow-2 routing, autoscaling, batching,
+multiplexing). TPU-first: replicas pin chips and warm up compiled
+executables before joining the routing table.
+"""
+
+from ray_tpu.serve.api import (
+    delete,
+    get_app_handle,
+    get_deployment_handle,
+    run,
+    shutdown,
+    start,
+    status,
+)
+from ray_tpu.serve.batching import batch
+from ray_tpu.serve.config import AutoscalingConfig, DeploymentConfig
+from ray_tpu.serve.deployment import Application, Deployment, deployment
+from ray_tpu.serve.handle import DeploymentHandle, DeploymentResponse
+from ray_tpu.serve.multiplex import get_multiplexed_model_id, multiplexed
+from ray_tpu.serve.proxy import Request
+
+__all__ = [
+    "Application",
+    "AutoscalingConfig",
+    "Deployment",
+    "DeploymentConfig",
+    "DeploymentHandle",
+    "DeploymentResponse",
+    "Request",
+    "batch",
+    "delete",
+    "deployment",
+    "get_app_handle",
+    "get_deployment_handle",
+    "get_multiplexed_model_id",
+    "multiplexed",
+    "run",
+    "shutdown",
+    "start",
+    "status",
+]

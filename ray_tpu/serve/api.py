@@ -1,0 +1,126 @@
+"""serve.run / serve.shutdown / status — the public control API.
+
+Reference: python/ray/serve/api.py (serve.run:429, serve.delete,
+serve.status, serve.start). The controller is a named detached async
+actor (get-or-create), the HTTP proxy starts lazily on first run.
+"""
+
+from __future__ import annotations
+
+import time
+from typing import Any, Dict, Optional
+
+import ray_tpu
+from ray_tpu.serve import handle as handle_mod
+from ray_tpu.serve.controller import CONTROLLER_NAME, ServeController
+from ray_tpu.serve.deployment import Application, build_specs
+from ray_tpu.serve.handle import DeploymentHandle
+from ray_tpu.serve.proxy import PROXY_NAME, ProxyActor
+
+DEFAULT_APP_NAME = "default"
+
+
+def _get_or_create_controller():
+    return (ray_tpu.remote(ServeController)
+            .options(name=CONTROLLER_NAME, lifetime="detached",
+                     get_if_exists=True, num_cpus=0.1)
+            .remote())
+
+
+def start(*, http_host: str = "127.0.0.1", http_port: int = 8000,
+          proxy: bool = True):
+    """Start serve system actors without deploying (reference:
+    serve.start)."""
+    controller = _get_or_create_controller()
+    if proxy:
+        existing_port = ray_tpu.get(controller.get_http_port.remote(),
+                                    timeout=30)
+        if existing_port is None:
+            p = (ray_tpu.remote(ProxyActor)
+                 .options(name=PROXY_NAME, lifetime="detached",
+                          get_if_exists=True, num_cpus=0.1)
+                 .remote(http_host, http_port))
+            port = ray_tpu.get(p.ready.remote(), timeout=60)
+            ray_tpu.get(controller.set_http_port.remote(port), timeout=30)
+    return controller
+
+
+def run(app: Application, *, name: str = DEFAULT_APP_NAME,
+        route_prefix: str = "/", _blocking_ready: bool = True,
+        http_port: int = 8000, proxy: bool = True) -> DeploymentHandle:
+    """Deploy a bound application; returns the ingress handle."""
+    controller = start(http_port=http_port, proxy=proxy)
+    specs, ingress = build_specs(app, name, route_prefix)
+    ray_tpu.get(controller.deploy_application.remote(name, specs),
+                timeout=120)
+    h = DeploymentHandle(name, ingress)
+    if _blocking_ready:
+        _wait_ready(controller, name, timeout=120)
+        handle_mod._reset_router()
+    return h
+
+
+def _wait_ready(controller, app_name: str, timeout: float):
+    deadline = time.monotonic() + timeout
+    while time.monotonic() < deadline:
+        status = ray_tpu.get(controller.get_status.remote(), timeout=30)
+        app_deps = {k: v for k, v in status.items()
+                    if k.startswith(app_name + "#")}
+        if app_deps and all(v["running_replicas"] >= v["target_replicas"]
+                            for v in app_deps.values()):
+            return
+        time.sleep(0.1)
+    raise TimeoutError(f"application {app_name} did not become ready")
+
+
+def get_app_handle(name: str = DEFAULT_APP_NAME) -> DeploymentHandle:
+    controller = ray_tpu.get_actor(CONTROLLER_NAME)
+    status = ray_tpu.get(controller.get_status.remote(), timeout=30)
+    for key, v in status.items():
+        app, dep = key.split("#", 1)
+        if app == name and v.get("is_ingress"):
+            return DeploymentHandle(app, dep)
+    raise ValueError(f"no application named {name!r}")
+
+
+def get_deployment_handle(deployment_name: str,
+                          app_name: str = DEFAULT_APP_NAME
+                          ) -> DeploymentHandle:
+    return DeploymentHandle(app_name, deployment_name)
+
+
+def status() -> Dict[str, Any]:
+    try:
+        controller = ray_tpu.get_actor(CONTROLLER_NAME)
+    except Exception:
+        return {}
+    return ray_tpu.get(controller.get_status.remote(), timeout=30)
+
+
+def delete(name: str):
+    controller = ray_tpu.get_actor(CONTROLLER_NAME)
+    ray_tpu.get(controller.delete_application.remote(name), timeout=60)
+    handle_mod._reset_router()
+
+
+def shutdown():
+    """Tear down all serve actors (reference: serve.shutdown)."""
+    handle_mod._reset_router()
+    try:
+        controller = ray_tpu.get_actor(CONTROLLER_NAME)
+    except Exception:
+        return
+    try:
+        ray_tpu.get(controller.shutdown.remote(), timeout=60)
+    except Exception:
+        pass
+    try:
+        proxy = ray_tpu.get_actor(PROXY_NAME)
+        ray_tpu.get(proxy.shutdown.remote(), timeout=10)
+        ray_tpu.kill(proxy)
+    except Exception:
+        pass
+    try:
+        ray_tpu.kill(controller)
+    except Exception:
+        pass

@@ -1,0 +1,94 @@
+"""@serve.batch: dynamic request batching.
+
+Reference: python/ray/serve/batching.py — an async method decorated with
+@serve.batch collects concurrent calls into a list; the wrapped function
+runs once per batch and its list result is scattered back to callers.
+The TPU payoff is direct: batched requests share one XLA executable
+launch instead of num_requests launches.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import functools
+from typing import Any, Callable, List, Optional
+
+
+class _BatchQueue:
+    def __init__(self, fn, max_batch_size: int, batch_wait_timeout_s: float):
+        self.fn = fn
+        self.max_batch_size = max_batch_size
+        self.timeout = batch_wait_timeout_s
+        self.queue: List[tuple] = []  # (single_arg, future)
+        self._flusher: Optional[asyncio.Task] = None
+
+    async def submit(self, instance, arg) -> Any:
+        fut = asyncio.get_event_loop().create_future()
+        self.queue.append((arg, fut))
+        if len(self.queue) >= self.max_batch_size:
+            await self._flush(instance)
+        elif self._flusher is None or self._flusher.done():
+            self._flusher = asyncio.get_event_loop().create_task(
+                self._delayed_flush(instance))
+        return await fut
+
+    async def _delayed_flush(self, instance):
+        await asyncio.sleep(self.timeout)
+        await self._flush(instance)
+
+    async def _flush(self, instance):
+        if not self.queue:
+            return
+        batch, self.queue = self.queue, []
+        args = [a for a, _ in batch]
+        futs = [f for _, f in batch]
+        try:
+            if instance is not None:
+                results = self.fn(instance, args)
+            else:
+                results = self.fn(args)
+            if asyncio.iscoroutine(results):
+                results = await results
+            if len(results) != len(args):
+                raise ValueError(
+                    f"batch fn returned {len(results)} results for "
+                    f"{len(args)} inputs")
+            for f, r in zip(futs, results):
+                if not f.done():
+                    f.set_result(r)
+        except Exception as e:
+            for f in futs:
+                if not f.done():
+                    f.set_exception(e)
+
+
+def batch(_fn=None, *, max_batch_size: int = 10,
+          batch_wait_timeout_s: float = 0.01):
+    """Decorator for async single-request methods; the wrapped fn receives
+    a list of requests and returns a list of responses."""
+
+    def wrap(fn):
+        queues = {}  # instance id -> _BatchQueue (methods) / None key (fns)
+
+        @functools.wraps(fn)
+        async def wrapper(*args):
+            if len(args) == 2:
+                instance, arg = args
+                key = id(instance)
+            elif len(args) == 1:
+                instance, arg = None, args[0]
+                key = None
+            else:
+                raise TypeError("@serve.batch methods take one argument")
+            q = queues.get(key)
+            if q is None:
+                q = _BatchQueue(fn, max_batch_size, batch_wait_timeout_s)
+                queues[key] = q
+            return await q.submit(instance, arg)
+
+        wrapper._is_serve_batch = True
+        return wrapper
+
+    if _fn is not None:
+        return wrap(_fn)
+    return wrap

@@ -1,0 +1,66 @@
+"""Serve configuration schemas.
+
+Reference: python/ray/serve/config.py (AutoscalingConfig,
+DeploymentConfig) and schema.py. Plain dataclasses with validation —
+the pydantic dependency is not required for behavioral parity.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Any, Dict, Optional
+
+
+@dataclasses.dataclass
+class AutoscalingConfig:
+    """Reference: serve/config.py:AutoscalingConfig — replica count
+    tracks avg ongoing requests per replica around a target."""
+
+    min_replicas: int = 1
+    max_replicas: int = 1
+    target_ongoing_requests: float = 2.0
+    upscale_delay_s: float = 3.0
+    downscale_delay_s: float = 10.0
+    look_back_period_s: float = 5.0
+
+    def __post_init__(self):
+        if self.min_replicas < 0 or self.max_replicas < self.min_replicas:
+            raise ValueError("need 0 <= min_replicas <= max_replicas")
+        if self.target_ongoing_requests <= 0:
+            raise ValueError("target_ongoing_requests must be > 0")
+
+
+@dataclasses.dataclass
+class DeploymentConfig:
+    """Reference: serve/config.py:DeploymentConfig."""
+
+    num_replicas: int = 1
+    max_ongoing_requests: int = 100
+    user_config: Optional[Dict[str, Any]] = None
+    autoscaling_config: Optional[AutoscalingConfig] = None
+    graceful_shutdown_timeout_s: float = 10.0
+    health_check_period_s: float = 2.0
+
+    def initial_replicas(self) -> int:
+        if self.autoscaling_config:
+            return self.autoscaling_config.min_replicas
+        return self.num_replicas
+
+
+@dataclasses.dataclass
+class ReplicaConfig:
+    """Actor-level options for replicas; ``num_tpus`` pins the replica to
+    a chip — the TPU-first detail: a pinned replica owns its device and
+    keeps its compiled executables warm across requests."""
+
+    num_cpus: float = 1.0
+    num_tpus: float = 0.0
+    resources: Optional[Dict[str, float]] = None
+
+    def actor_options(self) -> Dict[str, Any]:
+        out: Dict[str, Any] = {"num_cpus": self.num_cpus}
+        if self.num_tpus:
+            out["num_tpus"] = self.num_tpus
+        if self.resources:
+            out["resources"] = dict(self.resources)
+        return out

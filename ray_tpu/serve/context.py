@@ -1,0 +1,26 @@
+"""Per-request context inside replicas (reference:
+python/ray/serve/context.py _serve_request_context)."""
+
+from __future__ import annotations
+
+import contextvars
+from dataclasses import dataclass, field
+
+
+@dataclass
+class RequestContext:
+    multiplexed_model_id: str = ""
+    route: str = ""
+    deployment: str = ""
+
+
+_request_context: contextvars.ContextVar = contextvars.ContextVar(
+    "serve_request_context", default=RequestContext())
+
+
+def _get_request_context() -> RequestContext:
+    return _request_context.get()
+
+
+def _set_request_context(ctx: RequestContext):
+    _request_context.set(ctx)

@@ -1,0 +1,317 @@
+"""ServeController: the control-plane singleton actor.
+
+Reference: python/ray/serve/_private/controller.py:91 and
+deployment_state.py — reconciles target deployment state (replica
+counts, versions) against live replica actors in a background loop,
+autoscales from replica metrics, and serves the routing table to
+routers/proxies. Routers poll ``get_routing_snapshot`` guarded by a
+version counter — the long-poll host collapsed to versioned pulls.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import logging
+import time
+from typing import Any, Dict, List, Optional
+
+logger = logging.getLogger(__name__)
+
+CONTROLLER_NAME = "SERVE_CONTROLLER"
+
+
+class DeploymentState:
+    def __init__(self, app_name: str, spec: dict):
+        self.app_name = app_name
+        self.spec = spec
+        self.name = spec["name"]
+        self.target_replicas = spec["config"].initial_replicas()
+        self.replicas: Dict[str, Any] = {}  # replica_id -> actor handle
+        self.replica_started: Dict[str, float] = {}
+        self.replica_ready: set = set()
+        self.health_fail_counts: Dict[str, int] = {}
+        self.pending_requests = 0  # reported by routers on empty table
+        self._last_health_check = 0.0
+        self._counter = 0
+        self._metrics: Dict[str, dict] = {}
+        self._last_scale_up = 0.0
+        self._last_scale_down = 0.0
+
+    def key(self) -> str:
+        return f"{self.app_name}#{self.name}"
+
+
+class ServeController:
+    """Async actor; deploy/delete mutate target state, a reconcile loop
+    converges the actual state."""
+
+    def __init__(self):
+        self.apps: Dict[str, List[str]] = {}  # app -> deployment keys
+        self.deployments: Dict[str, DeploymentState] = {}
+        self.routing_version = 0
+        self._shutdown = False
+        self._loop_task = asyncio.get_event_loop().create_task(
+            self._reconcile_loop())
+        self.http_port: Optional[int] = None
+
+    # -- deploy API -----------------------------------------------------
+    async def deploy_application(self, app_name: str,
+                                 specs: List[dict]) -> None:
+        old_keys = set(self.apps.get(app_name, []))
+        new_keys = set()
+        for spec in specs:
+            ds = DeploymentState(app_name, spec)
+            key = ds.key()
+            new_keys.add(key)
+            existing = self.deployments.get(key)
+            if existing is not None:
+                # Redeploy: replace spec; replicas are replaced by the
+                # reconcile loop (version bump -> restart all).
+                await self._stop_all_replicas(existing)
+                ds._counter = existing._counter
+            self.deployments[key] = ds
+        for stale in old_keys - new_keys:
+            st = self.deployments.pop(stale, None)
+            if st:
+                await self._stop_all_replicas(st)
+        self.apps[app_name] = sorted(new_keys)
+        await self._reconcile_once()
+
+    async def delete_application(self, app_name: str) -> None:
+        for key in self.apps.pop(app_name, []):
+            st = self.deployments.pop(key, None)
+            if st:
+                await self._stop_all_replicas(st)
+        self.routing_version += 1
+
+    async def list_applications(self) -> List[str]:
+        return sorted(self.apps)
+
+    async def get_status(self) -> Dict[str, Any]:
+        out: Dict[str, Any] = {}
+        for key, ds in self.deployments.items():
+            out[key] = {
+                "target_replicas": ds.target_replicas,
+                "running_replicas": len(ds.replica_ready
+                                        & set(ds.replicas)),
+                "starting_replicas": len(ds.replicas),
+                "route_prefix": ds.spec.get("route_prefix"),
+                "is_ingress": ds.spec.get("is_ingress", False),
+            }
+        return out
+
+    async def set_http_port(self, port: int) -> None:
+        self.http_port = port
+
+    async def get_http_port(self) -> Optional[int]:
+        return self.http_port
+
+    # -- routing table ---------------------------------------------------
+    async def get_routing_snapshot(self) -> Dict[str, Any]:
+        table = {}
+        for key, ds in self.deployments.items():
+            # Route only to replicas that have answered a health check —
+            # a starting replica (still importing / warming up jit) would
+            # absorb requests its queue can't serve yet.
+            ready = sorted(ds.replica_ready & set(ds.replicas))
+            table[key] = {
+                "replica_names": ready or sorted(ds.replicas),
+                "route_prefix": (ds.spec.get("route_prefix")
+                                 if ds.spec.get("is_ingress") else None),
+                "app": ds.app_name,
+                "deployment": ds.name,
+            }
+        return {"version": self.routing_version, "table": table}
+
+    # -- reconciliation --------------------------------------------------
+    async def _reconcile_loop(self):
+        while not self._shutdown:
+            try:
+                await self._reconcile_once()
+                await self._autoscale()
+                await self._health_check()
+            except Exception:
+                logger.exception("serve reconcile failed")
+            await asyncio.sleep(0.5)
+
+    async def _reconcile_once(self):
+        import ray_tpu
+
+        changed = False
+        for key, ds in list(self.deployments.items()):
+            while len(ds.replicas) < ds.target_replicas:
+                rid = f"{key}#{ds._counter}"
+                ds._counter += 1
+                from ray_tpu.serve.replica import Replica
+
+                opts = dict(ds.spec["replica_config"].actor_options())
+                opts["name"] = f"SERVE_REPLICA::{rid}"
+                opts["lifetime"] = "detached"
+                actor = ray_tpu.remote(Replica).options(**opts).remote(
+                    ds.spec["serialized_callable"],
+                    ds.spec.get("init_args", ()),
+                    ds.spec.get("init_kwargs", {}),
+                    ds.spec["config"].user_config,
+                    ds.name, rid,
+                )
+                name = f"SERVE_REPLICA::{rid}"
+                ds.replicas[name] = actor
+                ds.replica_started[name] = time.time()
+                changed = True
+            while len(ds.replicas) > ds.target_replicas:
+                name, actor = sorted(ds.replicas.items())[-1]
+                del ds.replicas[name]
+                ds.replica_started.pop(name, None)
+                ds.replica_ready.discard(name)
+                asyncio.ensure_future(self._graceful_stop(actor, ds))
+                changed = True
+        if changed:
+            self.routing_version += 1
+
+    async def _graceful_stop(self, actor, ds: DeploymentState):
+        try:
+            timeout = ds.spec["config"].graceful_shutdown_timeout_s
+            await asyncio.wait_for(
+                _aref(actor.prepare_shutdown.remote()), timeout)
+        except Exception:
+            pass
+        await _kill_async(actor)
+
+    async def _stop_all_replicas(self, ds: DeploymentState):
+        for name, actor in list(ds.replicas.items()):
+            asyncio.ensure_future(self._graceful_stop(actor, ds))
+        ds.replicas.clear()
+        self.routing_version += 1
+
+    async def report_pending_request(self, deployment_key: str) -> None:
+        """Routers report a request that found no replicas — the
+        scale-from-zero signal (reference: handle-side queued-request
+        metrics feeding the autoscaler)."""
+        ds = self.deployments.get(deployment_key)
+        if ds is not None:
+            ds.pending_requests += 1
+
+    async def _autoscale(self):
+        now = time.time()
+        for key, ds in self.deployments.items():
+            cfg = ds.spec["config"].autoscaling_config
+            if cfg is None:
+                continue
+            if not ds.replicas:
+                # Scale from zero on queued-request reports.
+                if ds.pending_requests > 0 and ds.target_replicas < 1:
+                    ds.target_replicas = max(1, cfg.min_replicas)
+                    ds._last_scale_up = now
+                ds.pending_requests = 0
+                continue
+            ds.pending_requests = 0
+
+            async def grab(actor):
+                try:
+                    m = await asyncio.wait_for(
+                        _aref(actor.metrics.remote()), 2.0)
+                    return m["num_ongoing"]
+                except Exception:
+                    return None
+
+            results = await asyncio.gather(
+                *[grab(a) for a in ds.replicas.values()])
+            ongoing = [r for r in results if r is not None]
+            if not ongoing:
+                continue
+            total = sum(ongoing)
+            desired = max(
+                cfg.min_replicas,
+                min(cfg.max_replicas,
+                    -(-total // int(max(1, cfg.target_ongoing_requests)))))
+            if desired > ds.target_replicas:
+                if now - ds._last_scale_up >= cfg.upscale_delay_s:
+                    ds.target_replicas = desired
+                    ds._last_scale_up = now
+            elif desired < ds.target_replicas:
+                if now - ds._last_scale_down >= cfg.downscale_delay_s:
+                    ds.target_replicas = max(desired,
+                                             ds.target_replicas - 1)
+                    ds._last_scale_down = now
+
+    STARTUP_GRACE_S = 120.0
+    CONSECUTIVE_FAILURES_TO_KILL = 3  # reference: replica killed after 3
+
+    async def _health_check(self):
+        now = time.time()
+
+        async def check(ds, name, actor):
+            try:
+                ok = await asyncio.wait_for(
+                    _aref(actor.check_health.remote()), 5.0)
+            except Exception:
+                ok = False
+            return ds, name, actor, ok
+
+        probes = []
+        for key, ds in self.deployments.items():
+            period = ds.spec["config"].health_check_period_s
+            if now - ds._last_health_check < period:
+                continue
+            ds._last_health_check = now
+            for name, actor in list(ds.replicas.items()):
+                probes.append(check(ds, name, actor))
+        if not probes:
+            return
+        # Probes run concurrently: one blocked replica (sync user code on
+        # its loop) must not stall health detection for every deployment.
+        for fut in asyncio.as_completed(probes):
+            ds, name, actor, ok = await fut
+            if name not in ds.replicas:
+                continue
+            if ok:
+                ds.health_fail_counts.pop(name, None)
+                if name not in ds.replica_ready:
+                    ds.replica_ready.add(name)
+                    self.routing_version += 1
+                continue
+            if name not in ds.replica_ready:
+                # Never-ready replica: still starting (worker spawn +
+                # imports + warmup jit); only kill past the startup grace.
+                age = now - ds.replica_started.get(name, now)
+                if age < self.STARTUP_GRACE_S:
+                    continue
+            else:
+                # A ready replica may just be busy with a long sync
+                # request; require consecutive failures before killing.
+                fails = ds.health_fail_counts.get(name, 0) + 1
+                ds.health_fail_counts[name] = fails
+                if fails < self.CONSECUTIVE_FAILURES_TO_KILL:
+                    continue
+            logger.warning("replica %s unhealthy; replacing", name)
+            del ds.replicas[name]
+            ds.replica_started.pop(name, None)
+            ds.replica_ready.discard(name)
+            ds.health_fail_counts.pop(name, None)
+            await _kill_async(actor)
+            self.routing_version += 1
+
+    async def shutdown(self) -> None:
+        self._shutdown = True
+        for key, ds in list(self.deployments.items()):
+            await self._stop_all_replicas(ds)
+        self.deployments.clear()
+        self.apps.clear()
+
+
+async def _aref(ref):
+    """Await an ObjectRef from inside an async actor (refs are awaitable;
+    this wrapper keeps call sites compatible with asyncio.wait_for)."""
+    return await ref
+
+
+async def _kill_async(actor):
+    """ray_tpu.kill is a blocking control call; inside an async actor it
+    must run off-loop or it deadlocks the actor's own event loop."""
+    import ray_tpu
+
+    loop = asyncio.get_event_loop()
+    try:
+        await loop.run_in_executor(None, lambda: ray_tpu.kill(actor))
+    except Exception:
+        pass

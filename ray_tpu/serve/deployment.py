@@ -1,0 +1,162 @@
+"""Deployment: declarative unit of serving.
+
+Reference: python/ray/serve/deployment.py + api.py (@serve.deployment).
+``Deployment.bind(*args)`` produces an Application node; bound arguments
+that are themselves Applications are replaced with DeploymentHandles at
+deploy time (the reference's DAG build in build_app).
+"""
+
+from __future__ import annotations
+
+from typing import Dict, List, Optional, Tuple, Union
+
+from ray_tpu.core import serialization as _ser
+
+from ray_tpu.serve.config import (
+    AutoscalingConfig,
+    DeploymentConfig,
+    ReplicaConfig,
+)
+
+
+class Application:
+    """A bound deployment DAG node (reference: serve Application)."""
+
+    def __init__(self, deployment: "Deployment", args: tuple, kwargs: dict):
+        self.deployment = deployment
+        self.args = args
+        self.kwargs = kwargs
+
+    def _collect(self, out: Dict[str, "Application"]):
+        name = self.deployment.name
+        existing = out.get(name)
+        if existing is not None and existing is not self:
+            raise ValueError(f"duplicate deployment name {name!r}")
+        out[name] = self
+        for a in list(self.args) + list(self.kwargs.values()):
+            if isinstance(a, Application):
+                a._collect(out)
+
+
+class Deployment:
+    def __init__(self, func_or_class, name: str,
+                 config: DeploymentConfig,
+                 replica_config: ReplicaConfig,
+                 route_prefix: Optional[str] = None):
+        self.func_or_class = func_or_class
+        self.name = name
+        self.config = config
+        self.replica_config = replica_config
+        self.route_prefix = route_prefix
+
+    def bind(self, *args, **kwargs) -> Application:
+        return Application(self, args, kwargs)
+
+    def options(self, *, name: Optional[str] = None,
+                num_replicas: Optional[int] = None,
+                max_ongoing_requests: Optional[int] = None,
+                user_config: Optional[dict] = None,
+                autoscaling_config: Optional[
+                    Union[AutoscalingConfig, dict]] = None,
+                num_cpus: Optional[float] = None,
+                num_tpus: Optional[float] = None,
+                resources: Optional[Dict[str, float]] = None,
+                route_prefix: Optional[str] = None) -> "Deployment":
+        cfg = DeploymentConfig(
+            num_replicas=(num_replicas if num_replicas is not None
+                          else self.config.num_replicas),
+            max_ongoing_requests=(max_ongoing_requests
+                                  if max_ongoing_requests is not None
+                                  else self.config.max_ongoing_requests),
+            user_config=(user_config if user_config is not None
+                         else self.config.user_config),
+            autoscaling_config=_coerce_autoscaling(
+                autoscaling_config, self.config.autoscaling_config),
+        )
+        rc = ReplicaConfig(
+            num_cpus=(num_cpus if num_cpus is not None
+                      else self.replica_config.num_cpus),
+            num_tpus=(num_tpus if num_tpus is not None
+                      else self.replica_config.num_tpus),
+            resources=(resources if resources is not None
+                       else self.replica_config.resources),
+        )
+        return Deployment(
+            self.func_or_class,
+            name or self.name,
+            cfg, rc,
+            route_prefix if route_prefix is not None else self.route_prefix,
+        )
+
+
+def _coerce_autoscaling(value, default):
+    if value is None:
+        return default
+    if isinstance(value, dict):
+        return AutoscalingConfig(**value)
+    return value
+
+
+def deployment(func_or_class=None, *, name: Optional[str] = None,
+               num_replicas: int = 1, max_ongoing_requests: int = 100,
+               user_config: Optional[dict] = None,
+               autoscaling_config=None,
+               num_cpus: float = 1.0, num_tpus: float = 0.0,
+               resources: Optional[Dict[str, float]] = None,
+               route_prefix: Optional[str] = None):
+    """@serve.deployment decorator (reference: serve/api.py:deployment)."""
+
+    def wrap(fc):
+        return Deployment(
+            fc,
+            name or fc.__name__,
+            DeploymentConfig(
+                num_replicas=num_replicas,
+                max_ongoing_requests=max_ongoing_requests,
+                user_config=user_config,
+                autoscaling_config=_coerce_autoscaling(
+                    autoscaling_config, None),
+            ),
+            ReplicaConfig(num_cpus=num_cpus, num_tpus=num_tpus,
+                          resources=resources),
+            route_prefix,
+        )
+
+    if func_or_class is not None:
+        return wrap(func_or_class)
+    return wrap
+
+
+def build_specs(app: Application, app_name: str,
+                default_route_prefix: str) -> Tuple[List[dict], str]:
+    """Flatten a bound DAG into controller deploy specs; nested bound
+    nodes become DeploymentHandles (reference: build_app)."""
+    from ray_tpu.serve.handle import DeploymentHandle
+
+    nodes: Dict[str, Application] = {}
+    app._collect(nodes)
+    ingress_name = app.deployment.name
+
+    def resolve(v):
+        if isinstance(v, Application):
+            return DeploymentHandle(app_name, v.deployment.name)
+        return v
+
+    specs = []
+    for name, node in nodes.items():
+        d = node.deployment
+        is_ingress = name == ingress_name
+        route = d.route_prefix
+        if is_ingress and route is None:
+            route = default_route_prefix
+        specs.append({
+            "name": name,
+            "serialized_callable": _ser.dumps_control(d.func_or_class),
+            "init_args": tuple(resolve(a) for a in node.args),
+            "init_kwargs": {k: resolve(v) for k, v in node.kwargs.items()},
+            "config": d.config,
+            "replica_config": d.replica_config,
+            "route_prefix": route if is_ingress else None,
+            "is_ingress": is_ingress,
+        })
+    return specs, ingress_name

@@ -1,0 +1,134 @@
+"""DeploymentHandle: Python-level calls into a deployment.
+
+Reference: python/ray/serve/handle.py:830 — handles are the composition
+primitive: deployments receive handles to other deployments as bound
+arguments and fan out calls. ``handle.remote()`` returns a
+DeploymentResponse (future-like); responses can be passed directly as
+arguments to downstream handle calls, which forwards the underlying
+ObjectRef so the value never round-trips the caller.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import concurrent.futures
+import threading
+from typing import Any, Optional
+
+import ray_tpu
+from ray_tpu.serve.controller import CONTROLLER_NAME
+from ray_tpu.serve.router import Router
+
+_router_lock = threading.Lock()
+_router: Optional[Router] = None
+# Handle calls issued from inside an event loop (async replicas doing
+# composition) offload the router's blocking control calls here; blocking
+# the loop would deadlock the replica's own RPC processing.
+_offload = concurrent.futures.ThreadPoolExecutor(
+    max_workers=8, thread_name_prefix="serve-handle")
+
+
+def _get_router() -> Router:
+    global _router
+    with _router_lock:
+        if _router is None:
+            controller = ray_tpu.get_actor(CONTROLLER_NAME)
+            _router = Router(controller)
+        return _router
+
+
+def _reset_router():
+    global _router
+    with _router_lock:
+        _router = None
+
+
+class DeploymentResponse:
+    """Future-like result of a handle call (reference: handle.py
+    DeploymentResponse)."""
+
+    def __init__(self, ref=None, ref_future=None):
+        self._ref = ref
+        self._ref_future = ref_future
+
+    def _resolve_ref(self, timeout: Optional[float] = 60.0):
+        if self._ref is None:
+            self._ref = self._ref_future.result(timeout)
+        return self._ref
+
+    def result(self, timeout: Optional[float] = 60.0) -> Any:
+        return ray_tpu.get(self._resolve_ref(timeout), timeout=timeout)
+
+    def _to_object_ref(self):
+        return self._resolve_ref()
+
+    async def _await_impl(self):
+        if self._ref is None:
+            self._ref = await asyncio.wrap_future(self._ref_future)
+        return await self._ref
+
+    def __await__(self):
+        return self._await_impl().__await__()
+
+
+class DeploymentHandle:
+    def __init__(self, app_name: str, deployment_name: str,
+                 method_name: str = "__call__",
+                 multiplexed_model_id: str = ""):
+        self._app = app_name
+        self._deployment = deployment_name
+        self._method = method_name
+        self._multiplexed_model_id = multiplexed_model_id
+
+    @property
+    def deployment_key(self) -> str:
+        return f"{self._app}#{self._deployment}"
+
+    def options(self, *, method_name: Optional[str] = None,
+                multiplexed_model_id: Optional[str] = None
+                ) -> "DeploymentHandle":
+        return DeploymentHandle(
+            self._app, self._deployment,
+            method_name or self._method,
+            (multiplexed_model_id if multiplexed_model_id is not None
+             else self._multiplexed_model_id))
+
+    def __getattr__(self, name: str) -> "DeploymentHandle":
+        if name.startswith("_"):
+            raise AttributeError(name)
+        return DeploymentHandle(self._app, self._deployment, name,
+                                self._multiplexed_model_id)
+
+    def remote(self, *args, **kwargs) -> DeploymentResponse:
+        args = tuple(
+            a._to_object_ref() if isinstance(a, DeploymentResponse) else a
+            for a in args)
+        kwargs = {
+            k: (v._to_object_ref() if isinstance(v, DeploymentResponse)
+                else v)
+            for k, v in kwargs.items()}
+        if self._multiplexed_model_id:
+            kwargs["__serve_multiplexed_model_id"] = \
+                self._multiplexed_model_id
+        try:
+            asyncio.get_running_loop()
+            on_loop = True
+        except RuntimeError:
+            on_loop = False
+        if on_loop:
+            fut = _offload.submit(
+                lambda: _get_router().assign(
+                    self.deployment_key, self._method, args, kwargs))
+            return DeploymentResponse(ref_future=fut)
+        ref = _get_router().assign(self.deployment_key, self._method,
+                                   args, kwargs)
+        return DeploymentResponse(ref)
+
+    def __reduce__(self):
+        return (DeploymentHandle,
+                (self._app, self._deployment, self._method,
+                 self._multiplexed_model_id))
+
+    def __repr__(self):
+        return (f"DeploymentHandle({self._app}#{self._deployment}"
+                f".{self._method})")

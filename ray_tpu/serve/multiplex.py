@@ -1,0 +1,62 @@
+"""@serve.multiplexed: per-replica LRU of loaded models.
+
+Reference: python/ray/serve/multiplex.py (_ModelMultiplexWrapper) — a
+replica hosts many models, loading on demand and evicting LRU beyond
+max_num_models_per_replica. On TPU the eviction hook matters: dropping
+the model reference frees HBM for the next model's weights.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import collections
+import functools
+import inspect
+from typing import Any, Callable, Optional
+
+
+def multiplexed(_fn=None, *, max_num_models_per_replica: int = 3):
+    def wrap(load_fn):
+        caches = {}
+        locks = {}
+
+        @functools.wraps(load_fn)
+        async def wrapper(self, model_id: str) -> Any:
+            cache = caches.setdefault(
+                id(self), collections.OrderedDict())
+            if model_id in cache:
+                cache.move_to_end(model_id)
+                return cache[model_id]
+            # Per-model load lock: concurrent misses for the same id must
+            # not each load a copy of the weights (N× HBM during load).
+            lock = locks.setdefault((id(self), model_id), asyncio.Lock())
+            async with lock:
+                if model_id in cache:
+                    cache.move_to_end(model_id)
+                    return cache[model_id]
+                model = load_fn(self, model_id)
+                if inspect.isawaitable(model):
+                    model = await model
+                cache[model_id] = model
+                while len(cache) > max_num_models_per_replica:
+                    # Drop the reference; HBM-backed arrays free with it.
+                    cache.popitem(last=False)
+                return model
+
+        wrapper._is_multiplexed = True
+        return wrapper
+
+    if _fn is not None:
+        return wrap(_fn)
+    return wrap
+
+
+def get_multiplexed_model_id() -> str:
+    """Inside a request: the model id from the request context (reference:
+    serve.get_multiplexed_model_id). Set by handle.options or the
+    'serve_multiplexed_model_id' header through the proxy."""
+    from ray_tpu.serve import context
+
+    return context._get_request_context().multiplexed_model_id
+
+

@@ -1,0 +1,150 @@
+"""HTTP proxy: the data-plane ingress.
+
+Reference: python/ray/serve/_private/proxy.py:1115 (ProxyActor hosting
+an HTTP server that routes by prefix and forwards to replicas via the
+router). aiohttp replaces uvicorn/starlette; the user callable receives
+a ``Request`` with method/path/query/body helpers, and return values
+map to JSON (dict/list), text (str), or raw bytes responses.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import json
+import logging
+from typing import Any, Optional
+
+logger = logging.getLogger(__name__)
+
+PROXY_NAME = "SERVE_PROXY"
+
+
+class Request:
+    """Minimal request container handed to ingress callables (reference
+    passes a starlette Request; the shape here is the commonly used
+    subset)."""
+
+    def __init__(self, method: str, path: str, query: dict, headers: dict,
+                 body: bytes):
+        self.method = method
+        self.path = path
+        self.query_params = query
+        self.headers = headers
+        self._body = body
+
+    def body(self) -> bytes:
+        return self._body
+
+    def json(self) -> Any:
+        return json.loads(self._body) if self._body else None
+
+    def text(self) -> str:
+        return self._body.decode()
+
+    def __reduce__(self):
+        return (Request, (self.method, self.path, self.query_params,
+                          self.headers, self._body))
+
+
+class ProxyActor:
+    """Async actor running an aiohttp server; one per node in the
+    reference — one per cluster here (single-host head runtime)."""
+
+    def __init__(self, host: str = "127.0.0.1", port: int = 8000):
+        self.host = host
+        self.port = port
+        self._runner = None
+        self._router = None
+        self._started = asyncio.get_event_loop().create_task(self._start())
+
+    def _get_router(self):
+        if self._router is None:
+            import ray_tpu
+            from ray_tpu.serve.controller import CONTROLLER_NAME
+            from ray_tpu.serve.router import Router
+
+            controller = ray_tpu.get_actor(CONTROLLER_NAME)
+            self._router = Router(controller)
+        return self._router
+
+    async def _start(self):
+        from aiohttp import web
+
+        app = web.Application()
+        app.router.add_route("*", "/{tail:.*}", self._handle)
+        self._runner = web.AppRunner(app)
+        await self._runner.setup()
+        site = web.TCPSite(self._runner, self.host, self.port)
+        await site.start()
+        logger.info("serve proxy listening on %s:%d", self.host, self.port)
+
+    async def ready(self) -> int:
+        await self._started
+        return self.port
+
+    async def _handle(self, request):
+        from aiohttp import web
+
+        # The router's control calls (get_actor, routing-table fetch) are
+        # blocking; everything router-touching runs off-loop — blocking
+        # this actor's event loop would stall its own RPC processing.
+        loop = asyncio.get_event_loop()
+        path = "/" + request.match_info["tail"]
+        if path == "/-/healthz":
+            return web.Response(text="success")
+        if path == "/-/routes":
+            def routes_sync():
+                router = self._get_router()
+                router._refresh(force=True)
+                return {e["route_prefix"]: key
+                        for key, e in router._table.items()
+                        if e.get("route_prefix")}
+
+            return web.json_response(
+                await loop.run_in_executor(None, routes_sync))
+        body = await request.read()
+        req = Request(request.method, path, dict(request.query),
+                      dict(request.headers), body)
+
+        model_id = request.headers.get("serve_multiplexed_model_id", "")
+
+        def assign_sync():
+            router = self._get_router()
+            key = router.route_for_prefix(path)
+            if key is None:
+                router._refresh(force=True)
+                key = router.route_for_prefix(path)
+            if key is None:
+                return None, None
+            kwargs = ({"__serve_multiplexed_model_id": model_id}
+                      if model_id else {})
+            return key, router.assign(key, "__call__", (req,), kwargs)
+
+        try:
+            key, ref = await loop.run_in_executor(None, assign_sync)
+            if key is None:
+                return web.Response(status=404, text=f"no route for {path}")
+            result = await ref
+        except Exception as e:
+            logger.exception("proxy request failed")
+            return web.Response(status=500, text=str(e))
+        return _to_response(result)
+
+    async def shutdown(self):
+        if self._runner is not None:
+            await self._runner.cleanup()
+
+
+def _to_response(result: Any):
+    from aiohttp import web
+
+    if result is None:
+        return web.Response(status=200)
+    if isinstance(result, (dict, list)):
+        return web.json_response(result)
+    if isinstance(result, bytes):
+        return web.Response(body=result)
+    if isinstance(result, (int, float)):
+        return web.Response(text=json.dumps(result),
+                            content_type="application/json")
+    return web.Response(text=str(result))

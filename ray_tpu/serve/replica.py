@@ -1,0 +1,107 @@
+"""Replica actor: hosts one copy of a deployment's callable.
+
+Reference: python/ray/serve/_private/replica.py — wraps the user class,
+counts ongoing requests (the router's pow-2 signal), applies
+reconfigure(user_config), and exposes a health check. TPU-first: an
+optional ``warmup`` hook runs at startup so jit compilation happens
+before the replica joins the routing table (reference gap: Serve TTFT on
+accelerators is dominated by first-request compilation — SURVEY §7.3).
+"""
+
+from __future__ import annotations
+
+import asyncio
+import inspect
+import time
+from typing import Any, Dict, Optional
+
+
+class Replica:
+    def __init__(self, serialized_callable, init_args, init_kwargs,
+                 user_config, deployment_name: str, replica_id: str):
+        from ray_tpu.core import serialization as _ser
+
+        cls_or_fn = _ser.loads_control(serialized_callable)
+        self.deployment_name = deployment_name
+        self.replica_id = replica_id
+        self.num_ongoing = 0
+        self.total_served = 0
+        self._started = time.time()
+        if inspect.isclass(cls_or_fn):
+            self.callable = cls_or_fn(*init_args, **(init_kwargs or {}))
+        else:
+            if init_args or init_kwargs:
+                raise TypeError("function deployments take no init args")
+            self.callable = cls_or_fn
+        if user_config is not None:
+            self._reconfigure_sync(user_config)
+        warmup = getattr(self.callable, "warmup", None)
+        if callable(warmup):
+            warmup()
+
+    def _reconfigure_sync(self, user_config):
+        fn = getattr(self.callable, "reconfigure", None)
+        if fn is None:
+            raise ValueError(
+                f"{self.deployment_name}: user_config given but callable "
+                "has no reconfigure() method")
+        fn(user_config)
+
+    async def reconfigure(self, user_config) -> None:
+        self._reconfigure_sync(user_config)
+
+    async def handle_request(self, method_name: str, args: tuple,
+                             kwargs: dict) -> Any:
+        from ray_tpu.serve import context as _ctx
+
+        model_id = kwargs.pop("__serve_multiplexed_model_id", "")
+        _ctx._set_request_context(_ctx.RequestContext(
+            multiplexed_model_id=model_id,
+            deployment=self.deployment_name))
+        self.num_ongoing += 1
+        try:
+            fn = getattr(self.callable, method_name, None)
+            if fn is None and method_name == "__call__":
+                fn = self.callable
+            if fn is None:
+                raise AttributeError(
+                    f"{self.deployment_name} has no method {method_name!r}")
+            out = fn(*args, **kwargs)
+            if inspect.isawaitable(out):
+                out = await out
+            return out
+        finally:
+            self.num_ongoing -= 1
+            self.total_served += 1
+
+    async def metrics(self) -> Dict[str, Any]:
+        return {
+            "replica_id": self.replica_id,
+            "num_ongoing": self.num_ongoing,
+            "total_served": self.total_served,
+            "uptime_s": time.time() - self._started,
+        }
+
+    async def check_health(self) -> bool:
+        fn = getattr(self.callable, "check_health", None)
+        if callable(fn):
+            out = fn()
+            if inspect.isawaitable(out):
+                out = await out
+            return bool(out) if out is not None else True
+        return True
+
+    async def prepare_shutdown(self) -> None:
+        """Drain ongoing requests, then run the user cleanup hook — the
+        worker process is force-killed afterwards, so finalizers would
+        otherwise never run."""
+        while self.num_ongoing > 0:
+            await asyncio.sleep(0.02)
+        fn = getattr(self.callable, "__del__", None)
+        if callable(fn):
+            try:
+                out = fn()
+                if inspect.isawaitable(out):
+                    await out
+            except Exception:
+                pass

@@ -1,0 +1,133 @@
+"""Router: replica choice for a deployment.
+
+Reference: python/ray/serve/_private/router.py:287 +
+replica_scheduler/pow_2_scheduler.py:51 — pick two random replicas,
+route to the one with fewer ongoing requests. Queue lengths are tracked
+router-locally (incremented on send, decremented on completion), the
+same local-information design as the reference; the routing table is
+refreshed from the controller when its version moves.
+"""
+
+from __future__ import annotations
+
+import random
+import threading
+import time
+from typing import Any, Dict, List, Optional
+
+import ray_tpu
+
+
+class Router:
+    def __init__(self, controller_handle, refresh_period_s: float = 1.0):
+        self._controller = controller_handle
+        self._refresh_period = refresh_period_s
+        self._lock = threading.Lock()
+        self._version = -1
+        self._last_refresh = 0.0
+        # deployment key -> list of replica actor names
+        self._table: Dict[str, dict] = {}
+        self._handles: Dict[str, Any] = {}  # replica name -> actor handle
+        self._qlen: Dict[str, int] = {}
+
+    def _refresh(self, force: bool = False):
+        now = time.time()
+        if not force and now - self._last_refresh < self._refresh_period:
+            return
+        snap = ray_tpu.get(
+            self._controller.get_routing_snapshot.remote(), timeout=30)
+        with self._lock:
+            self._last_refresh = now
+            if snap["version"] != self._version:
+                self._version = snap["version"]
+                self._table = snap["table"]
+                live = {n for e in self._table.values()
+                        for n in e["replica_names"]}
+                self._handles = {n: h for n, h in self._handles.items()
+                                 if n in live}
+                self._qlen = {n: q for n, q in self._qlen.items()
+                              if n in live}
+
+    def route_for_prefix(self, path: str) -> Optional[str]:
+        """Longest-prefix route match (proxy use)."""
+        self._refresh()
+        best_key, best_len = None, -1
+        for key, entry in self._table.items():
+            rp = entry.get("route_prefix")
+            if rp is None:
+                continue
+            if (path == rp or path.startswith(rp.rstrip("/") + "/")
+                    or rp == "/"):
+                if len(rp) > best_len:
+                    best_key, best_len = key, len(rp)
+        return best_key
+
+    def _replica_handle(self, name: str):
+        h = self._handles.get(name)
+        if h is None:
+            h = ray_tpu.get_actor(name)
+            self._handles[name] = h
+        return h
+
+    def pick(self, deployment_key: str):
+        """Pow-2 choice -> (replica_name, actor_handle)."""
+        self._refresh()
+        entry = self._table.get(deployment_key)
+        if not entry or not entry["replica_names"]:
+            self._refresh(force=True)
+            entry = self._table.get(deployment_key)
+            if not entry or not entry["replica_names"]:
+                raise RuntimeError(
+                    f"no replicas for deployment {deployment_key}")
+        names = entry["replica_names"]
+        if len(names) == 1:
+            name = names[0]
+        else:
+            a, b = random.sample(names, 2)
+            name = a if self._qlen.get(a, 0) <= self._qlen.get(b, 0) else b
+        return name, self._replica_handle(name)
+
+    def assign(self, deployment_key: str, method_name: str, args, kwargs):
+        last_err = None
+        for attempt in range(3):
+            try:
+                name, handle = self.pick(deployment_key)
+            except RuntimeError as e:
+                # No replicas: report the queued request (scale-from-zero
+                # signal) and wait for the autoscaler to bring one up.
+                last_err = e
+                ray_tpu.get(self._controller.report_pending_request.remote(
+                    deployment_key), timeout=30)
+                deadline = time.time() + 30
+                name = None
+                while time.time() < deadline:
+                    time.sleep(0.25)
+                    try:
+                        name, handle = self.pick(deployment_key)
+                        break
+                    except RuntimeError:
+                        continue
+                if name is None:
+                    continue
+            with self._lock:
+                self._qlen[name] = self._qlen.get(name, 0) + 1
+            try:
+                ref = handle.handle_request.remote(method_name, args, kwargs)
+            except Exception as e:
+                # Replica died between table refreshes; drop and retry.
+                last_err = e
+                with self._lock:
+                    self._qlen[name] = max(0, self._qlen.get(name, 1) - 1)
+                    self._handles.pop(name, None)
+                self._refresh(force=True)
+                continue
+            self._attach_completion(name, ref)
+            return ref
+        raise RuntimeError(f"could not assign request: {last_err}")
+
+    def _attach_completion(self, name: str, ref):
+        def done(_):
+            with self._lock:
+                self._qlen[name] = max(0, self._qlen.get(name, 1) - 1)
+
+        ref.future().add_done_callback(done)

@@ -1,0 +1,255 @@
+"""Tests for ray_tpu.serve (reference strategy: python/ray/serve/tests/
+test_api.py, test_autoscaling_policy.py, test_batching.py)."""
+
+import asyncio
+import json
+import time
+import urllib.request
+
+import pytest
+
+import ray_tpu
+from ray_tpu import serve
+
+
+@pytest.fixture(scope="module")
+def serve_cluster():
+    ray_tpu.init(num_cpus=6, num_tpus=0)
+    yield
+    serve.shutdown()
+    ray_tpu.shutdown()
+
+
+@pytest.fixture(autouse=True)
+def _cleanup_apps(serve_cluster):
+    yield
+    # Tests normally delete their own apps; a failed assertion must not
+    # leak replicas (and their CPU) into the rest of the module.
+    leftover = {key.split("#", 1)[0] for key in serve.status()}
+    for app in leftover:
+        serve.delete(app)
+
+
+@serve.deployment
+class Echo:
+    def __call__(self, x):
+        return {"echo": x}
+
+    def shout(self, x):
+        return str(x).upper()
+
+
+def test_deploy_and_handle(serve_cluster):
+    h = serve.run(Echo.bind(), name="echo_app", proxy=False)
+    assert h.remote("hi").result() == {"echo": "hi"}
+    assert h.options(method_name="shout").remote("hi").result() == "HI"
+    assert h.shout.remote("abc").result() == "ABC"
+    serve.delete("echo_app")
+
+
+def test_function_deployment(serve_cluster):
+    @serve.deployment
+    def square(x):
+        return x * x
+
+    h = serve.run(square.bind(), name="fn_app", proxy=False)
+    assert h.remote(7).result() == 49
+    serve.delete("fn_app")
+
+
+def test_multi_replica_routing(serve_cluster):
+    @serve.deployment(num_replicas=3, num_cpus=0.1)
+    class WhoAmI:
+        def __init__(self):
+            import os
+
+            self.pid = os.getpid()
+
+        def __call__(self, _):
+            return self.pid
+
+    h = serve.run(WhoAmI.bind(), name="who", proxy=False)
+    pids = {h.remote(None).result() for _ in range(30)}
+    assert len(pids) >= 2  # pow-2 routing spreads load
+    serve.delete("who")
+
+
+def test_composition(serve_cluster):
+    @serve.deployment(num_cpus=0.1)
+    class Doubler:
+        def __call__(self, x):
+            return x * 2
+
+    @serve.deployment(num_cpus=0.1)
+    class Ingress:
+        def __init__(self, doubler):
+            self.doubler = doubler
+
+        async def __call__(self, x):
+            resp = self.doubler.remote(x)
+            doubled = await resp
+            return doubled + 1
+
+    h = serve.run(Ingress.bind(Doubler.bind()), name="comp", proxy=False)
+    assert h.remote(10).result() == 21
+    serve.delete("comp")
+
+
+def test_user_config_reconfigure(serve_cluster):
+    @serve.deployment(user_config={"mult": 3}, num_cpus=0.1)
+    class Mult:
+        def __init__(self):
+            self.mult = 1
+
+        def reconfigure(self, cfg):
+            self.mult = cfg["mult"]
+
+        def __call__(self, x):
+            return x * self.mult
+
+    h = serve.run(Mult.bind(), name="mult", proxy=False)
+    assert h.remote(5).result() == 15
+    serve.delete("mult")
+
+
+def test_http_proxy(serve_cluster):
+    @serve.deployment
+    class Api:
+        def __call__(self, request):
+            data = request.json()
+            return {"sum": data["a"] + data["b"], "path": request.path}
+
+    serve.run(Api.bind(), name="http_app", route_prefix="/calc",
+              http_port=18713)
+    body = json.dumps({"a": 2, "b": 40}).encode()
+    req = urllib.request.Request(
+        "http://127.0.0.1:18713/calc", data=body, method="POST")
+    with urllib.request.urlopen(req, timeout=30) as resp:
+        out = json.loads(resp.read())
+    assert out == {"sum": 42, "path": "/calc"}
+    # routes endpoint
+    with urllib.request.urlopen(
+            "http://127.0.0.1:18713/-/routes", timeout=30) as resp:
+        routes = json.loads(resp.read())
+    assert routes.get("/calc") == "http_app#Api"
+    # health
+    with urllib.request.urlopen(
+            "http://127.0.0.1:18713/-/healthz", timeout=30) as resp:
+        assert resp.read() == b"success"
+    serve.delete("http_app")
+
+
+def test_batching(serve_cluster):
+    @serve.deployment(num_cpus=0.1)
+    class Batched:
+        def __init__(self):
+            self.batch_sizes = []
+
+        @serve.batch(max_batch_size=8, batch_wait_timeout_s=0.1)
+        async def __call__(self, xs):
+            self.batch_sizes.append(len(xs))
+            return [x * 10 for x in xs]
+
+        def get_sizes(self):
+            return self.batch_sizes
+
+    h = serve.run(Batched.bind(), name="batched", proxy=False)
+    responses = [h.remote(i) for i in range(16)]
+    results = [r.result(timeout=60) for r in responses]
+    assert results == [i * 10 for i in range(16)]
+    sizes = h.get_sizes.remote().result()
+    assert max(sizes) > 1  # requests actually batched
+    serve.delete("batched")
+
+
+def test_autoscaling_up(serve_cluster):
+    @serve.deployment(
+        autoscaling_config=serve.AutoscalingConfig(
+            min_replicas=1, max_replicas=3, target_ongoing_requests=1,
+            upscale_delay_s=0.5, downscale_delay_s=60),
+        num_cpus=0.1)
+    class Slow:
+        async def __call__(self, _):
+            await asyncio.sleep(0.8)
+            return "ok"
+
+    h = serve.run(Slow.bind(), name="auto", proxy=False)
+    status = serve.status()["auto#Slow"]
+    assert status["running_replicas"] == 1
+    # Flood with concurrent requests; autoscaler should add replicas.
+    responses = [h.remote(i) for i in range(12)]
+    deadline = time.time() + 30
+    scaled = False
+    while time.time() < deadline:
+        st = serve.status()["auto#Slow"]
+        if st["target_replicas"] > 1:
+            scaled = True
+            break
+        time.sleep(0.5)
+    assert scaled, "autoscaler did not scale up"
+    for r in responses:
+        assert r.result(timeout=60) == "ok"
+    serve.delete("auto")
+
+
+def test_scale_from_zero(serve_cluster):
+    @serve.deployment(
+        autoscaling_config=serve.AutoscalingConfig(
+            min_replicas=0, max_replicas=2, target_ongoing_requests=2,
+            upscale_delay_s=0.1),
+        num_cpus=0.1)
+    class Cold:
+        def __call__(self, x):
+            return x + 1
+
+    h = serve.run(Cold.bind(), name="cold", proxy=False)
+    assert serve.status()["cold#Cold"]["running_replicas"] == 0
+    # First request triggers scale-from-zero and eventually completes.
+    assert h.remote(41).result(timeout=90) == 42
+    serve.delete("cold")
+
+
+def test_multiplexed(serve_cluster):
+    @serve.deployment(num_cpus=0.1)
+    class MultiModel:
+        def __init__(self):
+            self.loads = []
+
+        @serve.multiplexed(max_num_models_per_replica=2)
+        async def get_model(self, model_id: str):
+            self.loads.append(model_id)
+            return {"id": model_id, "weight": len(model_id)}
+
+        async def __call__(self, x):
+            model_id = serve.get_multiplexed_model_id()
+            model = await self.get_model(model_id)
+            return x * model["weight"]
+
+    h = serve.run(MultiModel.bind(), name="mm", proxy=False)
+    r1 = h.options(multiplexed_model_id="ab").remote(10).result()
+    assert r1 == 20
+    r2 = h.options(multiplexed_model_id="abcd").remote(10).result()
+    assert r2 == 40
+    # cached: second call to same model id shouldn't reload
+    h.options(multiplexed_model_id="ab").remote(1).result()
+    serve.delete("mm")
+
+
+def test_status_and_redeploy(serve_cluster):
+    @serve.deployment(num_cpus=0.1)
+    class V:
+        def __call__(self, _):
+            return 1
+
+    serve.run(V.bind(), name="redeploy", proxy=False)
+    assert "redeploy#V" in serve.status()
+
+    @serve.deployment(name="V", num_cpus=0.1)
+    class V2:
+        def __call__(self, _):
+            return 2
+
+    h = serve.run(V2.bind(), name="redeploy", proxy=False)
+    assert h.remote(None).result() == 2
+    serve.delete("redeploy")
+    assert "redeploy#V" not in serve.status()
