@@ -1,0 +1,49 @@
+"""ray_tpu.rllib — reinforcement learning on the actor substrate.
+
+Reference capability: rllib/ (Algorithm/AlgorithmConfig, RLModule,
+Learner, EnvRunner, PPO, IMPALA, FaultTolerantActorManager). Compute is
+jax/flax: jit-compiled forwards and update steps, lax.scan advantage
+recurrences, GSPMD data parallelism on the learner.
+"""
+
+from ray_tpu.rllib.actor_manager import FaultTolerantActorManager
+from ray_tpu.rllib.algorithm import Algorithm, AlgorithmConfig
+from ray_tpu.rllib.env import (
+    CartPoleVecEnv,
+    GridWorldVecEnv,
+    Space,
+    VectorEnv,
+    make_vec,
+    register_env,
+)
+from ray_tpu.rllib.env_runner import EnvRunner
+from ray_tpu.rllib.impala import IMPALA, IMPALAConfig, impala_loss
+from ray_tpu.rllib.learner import JaxLearner
+from ray_tpu.rllib.math import compute_gae, vtrace
+from ray_tpu.rllib.ppo import PPO, PPOConfig, ppo_loss
+from ray_tpu.rllib.rl_module import ActorCriticMLP, RLModule, RLModuleSpec
+
+__all__ = [
+    "ActorCriticMLP",
+    "Algorithm",
+    "AlgorithmConfig",
+    "CartPoleVecEnv",
+    "EnvRunner",
+    "FaultTolerantActorManager",
+    "GridWorldVecEnv",
+    "IMPALA",
+    "IMPALAConfig",
+    "JaxLearner",
+    "PPO",
+    "PPOConfig",
+    "RLModule",
+    "RLModuleSpec",
+    "Space",
+    "VectorEnv",
+    "compute_gae",
+    "impala_loss",
+    "make_vec",
+    "ppo_loss",
+    "register_env",
+    "vtrace",
+]

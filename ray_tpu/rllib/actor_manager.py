@@ -1,0 +1,103 @@
+"""FaultTolerantActorManager: async RPC fan-out with failure handling.
+
+Reference: rllib/utils/actor_manager.py:193 — issue calls to a set of
+worker actors, harvest results asynchronously, mark failed actors and
+restart them. Used by PPO/IMPALA for env-runner sets.
+"""
+
+from __future__ import annotations
+
+import logging
+from typing import Any, Callable, Dict, List, Optional, Tuple
+
+import ray_tpu
+
+logger = logging.getLogger(__name__)
+
+
+class FaultTolerantActorManager:
+    def __init__(self, make_actor: Callable[[int], Any], num_actors: int,
+                 *, max_restarts: int = 3):
+        self._make_actor = make_actor
+        self.actors: Dict[int, Any] = {
+            i: make_actor(i) for i in range(num_actors)}
+        self._restarts: Dict[int, int] = {i: 0 for i in range(num_actors)}
+        self.max_restarts = max_restarts
+
+    @property
+    def num_actors(self) -> int:
+        return len(self.actors)
+
+    def foreach(self, fn: Callable[[Any], Any], *, timeout: float = 120.0,
+                ignore_failures: bool = True) -> List[Tuple[int, Any]]:
+        """fn(actor) -> ObjectRef; gather results, restarting failures.
+        Returns [(actor_index, result)] for the successful actors."""
+        refs = {}
+        for i, actor in list(self.actors.items()):
+            try:
+                refs[i] = fn(actor)
+            except Exception as e:
+                if not ignore_failures:
+                    raise
+                self._on_failure(i, e)
+        out = []
+        for i, ref in refs.items():
+            try:
+                out.append((i, ray_tpu.get(ref, timeout=timeout)))
+            except Exception as e:
+                if not ignore_failures:
+                    raise
+                self._on_failure(i, e)
+        return out
+
+    def call_async(self, fn: Callable[[Any], Any]) -> Dict[int, Any]:
+        """Submit without waiting; returns {actor_index: ref}."""
+        refs = {}
+        for i, actor in list(self.actors.items()):
+            try:
+                refs[i] = fn(actor)
+            except Exception as e:
+                self._on_failure(i, e)
+        return refs
+
+    def fetch_ready(self, refs: Dict[int, Any], *, timeout: float = 0.0,
+                    num_returns: int = 1) -> List[Tuple[int, Any]]:
+        """Harvest completed refs from a call_async map; failed actors are
+        restarted and their refs dropped."""
+        if not refs:
+            return []
+        by_ref = {ref: i for i, ref in refs.items()}
+        ready, _ = ray_tpu.wait(
+            list(by_ref), num_returns=min(num_returns, len(by_ref)),
+            timeout=timeout)
+        out = []
+        for ref in ready:
+            i = by_ref[ref]
+            refs.pop(i, None)
+            try:
+                out.append((i, ray_tpu.get(ref)))
+            except Exception as e:
+                self._on_failure(i, e)
+        return out
+
+    def _on_failure(self, index: int, error: Exception):
+        logger.warning("actor %d failed: %s", index, error)
+        actor = self.actors.pop(index, None)
+        if actor is not None:
+            try:
+                ray_tpu.kill(actor)
+            except Exception:
+                pass
+        if self._restarts[index] < self.max_restarts:
+            self._restarts[index] += 1
+            self.actors[index] = self._make_actor(index)
+        else:
+            logger.error("actor %d exhausted restarts", index)
+
+    def shutdown(self):
+        for actor in self.actors.values():
+            try:
+                ray_tpu.kill(actor)
+            except Exception:
+                pass
+        self.actors.clear()

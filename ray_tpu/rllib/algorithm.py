@@ -1,0 +1,245 @@
+"""Algorithm + AlgorithmConfig: the trainer shell.
+
+Reference: rllib/algorithms/algorithm.py:192 (Algorithm(Trainable)) and
+algorithm_config.py (builder with .environment/.training/.env_runners).
+Algorithm subclasses ray_tpu.tune.Trainable, so `tune.Tuner(PPO, ...)`
+works exactly like the reference's Tune integration; `training_step` is
+the per-algorithm hook.
+"""
+
+from __future__ import annotations
+
+import copy
+import os
+import pickle
+import time
+from typing import Any, Dict, Optional
+
+import numpy as np
+
+import ray_tpu
+from ray_tpu.tune.trainable import Trainable
+
+
+class AlgorithmConfig:
+    """Builder (reference: AlgorithmConfig). Chain .environment(),
+    .training(), .env_runners(), .resources(); then .build()."""
+
+    algo_class: Optional[type] = None
+
+    def __init__(self):
+        self.env: Any = "CartPole-v1"
+        self.num_envs_per_env_runner = 8
+        self.num_env_runners = 2
+        self.rollout_fragment_length = 64
+        self.lr = 3e-4
+        self.gamma = 0.99
+        self.grad_clip: Optional[float] = 0.5
+        self.train_batch_size = 1024
+        self.model: Dict[str, Any] = {}
+        self.seed = 0
+        self.num_cpus_per_env_runner = 1.0
+        self.num_tpus_per_learner = 0.0
+        self.extra: Dict[str, Any] = {}
+
+    def environment(self, env=None, *, num_envs_per_env_runner=None
+                    ) -> "AlgorithmConfig":
+        if env is not None:
+            self.env = env
+        if num_envs_per_env_runner is not None:
+            self.num_envs_per_env_runner = num_envs_per_env_runner
+        return self
+
+    def env_runners(self, *, num_env_runners=None,
+                    rollout_fragment_length=None,
+                    num_cpus_per_env_runner=None) -> "AlgorithmConfig":
+        if num_env_runners is not None:
+            self.num_env_runners = num_env_runners
+        if rollout_fragment_length is not None:
+            self.rollout_fragment_length = rollout_fragment_length
+        if num_cpus_per_env_runner is not None:
+            self.num_cpus_per_env_runner = num_cpus_per_env_runner
+        return self
+
+    def training(self, *, lr=None, gamma=None, grad_clip=None,
+                 train_batch_size=None, model=None, **kwargs
+                 ) -> "AlgorithmConfig":
+        if lr is not None:
+            self.lr = lr
+        if gamma is not None:
+            self.gamma = gamma
+        if grad_clip is not None:
+            self.grad_clip = grad_clip
+        if train_batch_size is not None:
+            self.train_batch_size = train_batch_size
+        if model is not None:
+            self.model = model
+        self.extra.update(kwargs)
+        return self
+
+    def resources(self, *, num_tpus_per_learner=None) -> "AlgorithmConfig":
+        if num_tpus_per_learner is not None:
+            self.num_tpus_per_learner = num_tpus_per_learner
+        return self
+
+    def debugging(self, *, seed=None) -> "AlgorithmConfig":
+        if seed is not None:
+            self.seed = seed
+        return self
+
+    def copy(self) -> "AlgorithmConfig":
+        return copy.deepcopy(self)
+
+    def build(self) -> "Algorithm":
+        if self.algo_class is None:
+            raise ValueError("config has no algo_class")
+        algo = self.algo_class()
+        algo.setup({"algo_config": self})
+        return algo
+
+
+class Algorithm(Trainable):
+    """Reference: Algorithm(Trainable); train() -> iteration results,
+    save/restore via Trainable checkpoints."""
+
+    config_class = AlgorithmConfig
+
+    def setup(self, config):
+        if isinstance(config, AlgorithmConfig):
+            cfg = config
+        elif isinstance(config, dict) and "algo_config" in config:
+            cfg = config["algo_config"]
+            if isinstance(cfg, dict):
+                c = self.config_class()
+                c.__dict__.update(cfg)
+                cfg = c
+        else:
+            cfg = self.config_class()
+            for k, v in (config or {}).items():
+                if hasattr(cfg, k):
+                    setattr(cfg, k, v)
+                else:
+                    cfg.extra[k] = v
+        self.config = cfg
+        self.iteration = 0
+        self._timesteps_total = 0
+        self._episodes_total = 0
+        self._build()
+
+    def _build(self):
+        """Create learner + env runner set. Subclass hook."""
+        raise NotImplementedError
+
+    def _build_common(self, loss_fn, loss_config: Dict[str, Any]):
+        """Shared construction: probe env -> module spec -> learner ->
+        env-runner set -> initial weight broadcast."""
+        from ray_tpu.rllib.actor_manager import FaultTolerantActorManager
+        from ray_tpu.rllib.env import make_vec
+        from ray_tpu.rllib.env_runner import EnvRunner
+        from ray_tpu.rllib.learner import JaxLearner
+        from ray_tpu.rllib.rl_module import RLModuleSpec
+
+        cfg = self.config
+        probe = make_vec(cfg.env, 1, seed=cfg.seed)
+        self.module_spec = RLModuleSpec(
+            probe.observation_space, probe.action_space,
+            model_config=dict(cfg.model))
+        self.learner = JaxLearner(
+            self.module_spec, loss_fn, lr=cfg.lr,
+            grad_clip=cfg.grad_clip, seed=cfg.seed,
+            loss_config=loss_config)
+        env_spec, n_envs, T = (cfg.env, cfg.num_envs_per_env_runner,
+                               cfg.rollout_fragment_length)
+        module_spec, ncpu, seed, gamma = (
+            self.module_spec, cfg.num_cpus_per_env_runner, cfg.seed,
+            cfg.gamma)
+
+        def make_runner(i: int):
+            return (ray_tpu.remote(EnvRunner)
+                    .options(num_cpus=ncpu)
+                    .remote(env_spec, n_envs, T, module_spec,
+                            seed=seed + 1000 * (i + 1), gamma=gamma))
+
+        self.workers = FaultTolerantActorManager(
+            make_runner, cfg.num_env_runners)
+        self._broadcast_weights()
+
+    def training_step(self) -> Dict[str, Any]:
+        raise NotImplementedError
+
+    def step(self) -> Dict[str, Any]:
+        t0 = time.time()
+        result = self.training_step()
+        self.iteration += 1
+        result.setdefault("training_iteration", self.iteration)
+        result["timesteps_total"] = self._timesteps_total
+        result["episodes_total"] = self._episodes_total
+        result["time_this_iter_s"] = time.time() - t0
+        return result
+
+    def train(self) -> Dict[str, Any]:
+        return self.step()
+
+    # -- checkpointing ---------------------------------------------------
+    def save_checkpoint(self, checkpoint_dir: str) -> None:
+        state = self.get_state()
+        with open(os.path.join(checkpoint_dir, "algorithm_state.pkl"),
+                  "wb") as f:
+            pickle.dump(state, f)
+
+    def load_checkpoint(self, checkpoint_dir: str) -> None:
+        with open(os.path.join(checkpoint_dir, "algorithm_state.pkl"),
+                  "rb") as f:
+            self.set_state(pickle.load(f))
+
+    def save(self, checkpoint_dir: Optional[str] = None) -> str:
+        checkpoint_dir = checkpoint_dir or os.path.join(
+            os.path.expanduser("~/ray_tpu_results"),
+            f"{type(self).__name__.lower()}_ckpt_{int(time.time())}")
+        os.makedirs(checkpoint_dir, exist_ok=True)
+        self.save_checkpoint(checkpoint_dir)
+        return checkpoint_dir
+
+    def restore(self, checkpoint_dir: str) -> None:
+        self.load_checkpoint(checkpoint_dir)
+
+    def get_state(self) -> dict:
+        return {
+            "learner": self.learner.get_state(),
+            "iteration": self.iteration,
+            "timesteps_total": self._timesteps_total,
+            "episodes_total": self._episodes_total,
+        }
+
+    def set_state(self, state: dict) -> None:
+        self.learner.set_state(state["learner"])
+        self.iteration = state["iteration"]
+        self._timesteps_total = state["timesteps_total"]
+        self._episodes_total = state["episodes_total"]
+        self._broadcast_weights()
+
+    def _broadcast_weights(self):
+        weights_ref = ray_tpu.put(self.learner.get_weights())
+        self.workers.foreach(
+            lambda a: a.set_weights.remote(
+                weights_ref, self.learner.weights_version))
+
+    def _merge_runner_metrics(self, result: Dict[str, Any]):
+        metrics = self.workers.foreach(lambda a: a.get_metrics.remote())
+        returns, lens, episodes = [], [], 0
+        for _, m in metrics:
+            episodes += m.get("episodes_this_iter", 0)
+            if "episode_return_mean" in m:
+                returns.append(m["episode_return_mean"])
+                lens.append(m["episode_len_mean"])
+        self._episodes_total += episodes
+        result["episodes_this_iter"] = episodes
+        if returns:
+            result["episode_return_mean"] = float(np.mean(returns))
+            result["episode_len_mean"] = float(np.mean(lens))
+
+    def cleanup(self):
+        self.workers.shutdown()
+
+    def stop(self):
+        self.cleanup()

@@ -1,0 +1,173 @@
+"""RL environments: gymnasium-style API + built-in vectorized envs.
+
+Reference: rllib/env/env_runner.py consumes gymnasium vector envs; here
+the built-in envs are pure-numpy *vectorized-first* implementations
+(CartPole, a discrete GridWorld) so the rollout hot loop is array math
+feeding batched jax policy forwards — no per-env Python stepping, no gym
+dependency. Custom envs plug in via the same VectorEnv protocol or a
+single-env class auto-wrapped by ``make_vec``.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Callable, Dict, Optional, Tuple
+
+import numpy as np
+
+
+class Space:
+    def __init__(self, shape: Tuple[int, ...], dtype, n: Optional[int] = None):
+        self.shape = shape
+        self.dtype = dtype
+        self.n = n  # discrete cardinality (None = continuous box)
+
+    @staticmethod
+    def discrete(n: int) -> "Space":
+        return Space((), np.int32, n)
+
+    @staticmethod
+    def box(shape: Tuple[int, ...]) -> "Space":
+        return Space(shape, np.float32)
+
+
+class VectorEnv:
+    """B independent env copies stepped as one batch."""
+
+    observation_space: Space
+    action_space: Space
+    num_envs: int
+
+    def reset(self, seed: Optional[int] = None) -> np.ndarray:
+        raise NotImplementedError
+
+    def step(self, actions: np.ndarray
+             ) -> Tuple[np.ndarray, np.ndarray, np.ndarray, np.ndarray]:
+        """-> (obs, rewards, terminateds, truncateds). Auto-resets done
+        sub-envs (the returned obs for done envs is the fresh reset).
+        After each step, ``self.final_obs`` holds the pre-reset
+        observation batch — rows are meaningful where done — so runners
+        can bootstrap V(s_final) for time-limit truncations."""
+        raise NotImplementedError
+
+    final_obs: Optional[np.ndarray] = None
+
+
+class CartPoleVecEnv(VectorEnv):
+    """Vectorized CartPole-v1 dynamics (standard Barto-Sutton constants;
+    behaviorally matches gymnasium's CartPole for RL purposes)."""
+
+    GRAVITY = 9.8
+    CART_MASS = 1.0
+    POLE_MASS = 0.1
+    POLE_HALF_LEN = 0.5
+    FORCE_MAG = 10.0
+    TAU = 0.02
+    THETA_LIMIT = 12 * 2 * np.pi / 360
+    X_LIMIT = 2.4
+    MAX_STEPS = 500
+
+    def __init__(self, num_envs: int = 8, seed: int = 0):
+        self.num_envs = num_envs
+        self.observation_space = Space.box((4,))
+        self.action_space = Space.discrete(2)
+        self._rng = np.random.default_rng(seed)
+        self.state = np.zeros((num_envs, 4), np.float32)
+        self.steps = np.zeros(num_envs, np.int64)
+
+    def _sample_state(self, n: int) -> np.ndarray:
+        return self._rng.uniform(-0.05, 0.05, size=(n, 4)).astype(np.float32)
+
+    def reset(self, seed: Optional[int] = None) -> np.ndarray:
+        if seed is not None:
+            self._rng = np.random.default_rng(seed)
+        self.state = self._sample_state(self.num_envs)
+        self.steps[:] = 0
+        return self.state.copy()
+
+    def step(self, actions: np.ndarray):
+        x, x_dot, theta, theta_dot = self.state.T
+        force = np.where(actions == 1, self.FORCE_MAG, -self.FORCE_MAG)
+        costheta, sintheta = np.cos(theta), np.sin(theta)
+        total_mass = self.CART_MASS + self.POLE_MASS
+        pm_len = self.POLE_MASS * self.POLE_HALF_LEN
+        temp = (force + pm_len * theta_dot ** 2 * sintheta) / total_mass
+        theta_acc = (self.GRAVITY * sintheta - costheta * temp) / (
+            self.POLE_HALF_LEN
+            * (4.0 / 3.0 - self.POLE_MASS * costheta ** 2 / total_mass))
+        x_acc = temp - pm_len * theta_acc * costheta / total_mass
+        x = x + self.TAU * x_dot
+        x_dot = x_dot + self.TAU * x_acc
+        theta = theta + self.TAU * theta_dot
+        theta_dot = theta_dot + self.TAU * theta_acc
+        self.state = np.stack([x, x_dot, theta, theta_dot],
+                              axis=1).astype(np.float32)
+        self.steps += 1
+        terminated = ((np.abs(x) > self.X_LIMIT)
+                      | (np.abs(theta) > self.THETA_LIMIT))
+        truncated = self.steps >= self.MAX_STEPS
+        reward = np.ones(self.num_envs, np.float32)
+        done = terminated | truncated
+        self.final_obs = self.state.copy()
+        if done.any():
+            n = int(done.sum())
+            self.state[done] = self._sample_state(n)
+            self.steps[done] = 0
+        return self.state.copy(), reward, terminated, truncated
+
+
+class GridWorldVecEnv(VectorEnv):
+    """Tiny deterministic 1-D corridor: move right to the goal. Used for
+    fast learning tests (reference analog: rllib's debugging envs)."""
+
+    def __init__(self, num_envs: int = 8, length: int = 5, seed: int = 0):
+        self.num_envs = num_envs
+        self.length = length
+        self.observation_space = Space.box((length,))
+        self.action_space = Space.discrete(2)
+        self.pos = np.zeros(num_envs, np.int64)
+        self.steps = np.zeros(num_envs, np.int64)
+
+    def _obs(self) -> np.ndarray:
+        obs = np.zeros((self.num_envs, self.length), np.float32)
+        obs[np.arange(self.num_envs), self.pos] = 1.0
+        return obs
+
+    def reset(self, seed: Optional[int] = None) -> np.ndarray:
+        self.pos[:] = 0
+        self.steps[:] = 0
+        return self._obs()
+
+    def step(self, actions: np.ndarray):
+        self.pos = np.clip(self.pos + np.where(actions == 1, 1, -1),
+                           0, self.length - 1)
+        self.steps += 1
+        terminated = self.pos == self.length - 1
+        truncated = self.steps >= 3 * self.length
+        reward = np.where(terminated, 1.0, -0.01).astype(np.float32)
+        done = terminated | truncated
+        self.final_obs = self._obs()
+        if done.any():
+            self.pos[done] = 0
+            self.steps[done] = 0
+        return self._obs(), reward, terminated, truncated
+
+
+_ENV_REGISTRY: Dict[str, Callable[..., VectorEnv]] = {
+    "CartPole-v1": CartPoleVecEnv,
+    "GridWorld-v0": GridWorldVecEnv,
+}
+
+
+def register_env(name: str, creator: Callable[..., VectorEnv]) -> None:
+    """Reference: ray.tune.registry.register_env."""
+    _ENV_REGISTRY[name] = creator
+
+
+def make_vec(env: Any, num_envs: int, seed: int = 0) -> VectorEnv:
+    if isinstance(env, str):
+        if env not in _ENV_REGISTRY:
+            raise ValueError(f"unknown env {env!r}; register_env it first")
+        return _ENV_REGISTRY[env](num_envs=num_envs, seed=seed)
+    if callable(env):
+        return env(num_envs=num_envs, seed=seed)
+    raise TypeError(f"bad env spec: {env!r}")

@@ -1,0 +1,118 @@
+"""EnvRunner: rollout-collecting actor.
+
+Reference: rllib/env/single_agent_env_runner.py — steps a vectorized env
+with the exploration forward, returning [T, B] sample batches. Episode
+returns are tracked across batch boundaries for metrics.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict, Optional
+
+import numpy as np
+
+
+class EnvRunner:
+    def __init__(self, env_spec, num_envs: int, rollout_length: int,
+                 module_spec, seed: int = 0, gamma: float = 0.99):
+        import jax
+
+        from ray_tpu.rllib.env import make_vec
+
+        self.env = make_vec(env_spec, num_envs, seed=seed)
+        self.rollout_length = rollout_length
+        self.gamma = gamma
+        self.module = module_spec.build()
+        self.forwards = self.module.make_forwards()
+        self.params = self.module.init_params(
+            jax.random.PRNGKey(seed))
+        self._key = jax.random.PRNGKey(seed + 1)
+        self.obs = self.env.reset(seed=seed)
+        self._ep_returns = np.zeros(num_envs, np.float32)
+        self._ep_lens = np.zeros(num_envs, np.int64)
+        self._completed: list = []
+        self._weights_version = 0
+
+    def set_weights(self, params, version: int = 0) -> None:
+        self.params = params
+        self._weights_version = version
+
+    def get_weights_version(self) -> int:
+        return self._weights_version
+
+    def sample(self) -> Dict[str, np.ndarray]:
+        """Collect one [T, B] rollout batch."""
+        import jax
+
+        T, B = self.rollout_length, self.env.num_envs
+        obs_buf = np.empty((T, B) + tuple(self.env.observation_space.shape),
+                           np.float32)
+        act_buf = np.empty((T, B), np.int32)
+        logp_buf = np.empty((T, B), np.float32)
+        vf_buf = np.empty((T, B), np.float32)
+        rew_buf = np.empty((T, B), np.float32)
+        done_buf = np.empty((T, B), np.bool_)
+        for t in range(T):
+            self._key, sub = jax.random.split(self._key)
+            action, logp, vf = self.forwards["exploration"](
+                self.params, self.obs, sub)
+            action = np.asarray(action)
+            obs_buf[t] = self.obs
+            act_buf[t] = action
+            logp_buf[t] = np.asarray(logp)
+            vf_buf[t] = np.asarray(vf)
+            self.obs, rew, term, trunc = self.env.step(action)
+            done = term | trunc
+            # Time-limit bootstrapping: a truncation is not a true
+            # terminal — fold gamma * V(s_final) into the reward so the
+            # advantage recurrence (which cuts at done) stays unbiased.
+            only_trunc = trunc & ~term
+            if only_trunc.any() and self.env.final_obs is not None:
+                # Full-batch forward (fixed shape -> no per-count
+                # recompiles), then select the truncated rows.
+                fin = self.forwards["train"](self.params,
+                                             self.env.final_obs)
+                rew = rew.copy()
+                rew[only_trunc] += (
+                    self.gamma * np.asarray(fin["vf"])[only_trunc])
+            rew_buf[t] = rew
+            done_buf[t] = done
+            self._ep_returns += rew
+            self._ep_lens += 1
+            if done.any():
+                for i in np.nonzero(done)[0]:
+                    self._completed.append(
+                        (float(self._ep_returns[i]), int(self._ep_lens[i])))
+                self._ep_returns[done] = 0.0
+                self._ep_lens[done] = 0
+        # Bootstrap value for the final obs.
+        out = self.forwards["train"](self.params, self.obs)
+        last_vf = np.asarray(out["vf"])
+        return {
+            "obs": obs_buf,
+            "actions": act_buf,
+            "logp": logp_buf,
+            "vf": vf_buf,
+            "rewards": rew_buf,
+            "dones": done_buf,
+            "last_vf": last_vf,
+            "weights_version": self._weights_version,
+        }
+
+    def get_metrics(self) -> Dict[str, Any]:
+        eps = self._completed
+        self._completed = []
+        if not eps:
+            return {"episodes_this_iter": 0}
+        returns = [r for r, _ in eps]
+        lens = [l for _, l in eps]
+        return {
+            "episodes_this_iter": len(eps),
+            "episode_return_mean": float(np.mean(returns)),
+            "episode_return_max": float(np.max(returns)),
+            "episode_return_min": float(np.min(returns)),
+            "episode_len_mean": float(np.mean(lens)),
+        }
+
+    def ping(self) -> bool:
+        return True

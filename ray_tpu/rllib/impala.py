@@ -1,0 +1,153 @@
+"""IMPALA (reference: rllib/algorithms/impala/impala.py:667 training_step,
+vtrace_torch.py).
+
+Async actor parallelism: env runners sample continuously (their next
+rollout is already in flight while the learner updates), and the
+off-policy gap between the behavior policy that sampled a batch and the
+current target policy is corrected with V-trace importance weighting.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+import ray_tpu
+from ray_tpu.rllib.algorithm import Algorithm, AlgorithmConfig
+from ray_tpu.rllib.math import vtrace
+
+
+def impala_loss(fwd, batch, *, gamma: float = 0.99,
+                vf_coeff: float = 0.5, entropy_coeff: float = 0.01,
+                clip_rho: float = 1.0, clip_c: float = 1.0):
+    """V-trace actor-critic loss. Batch keeps [T, B] structure (the
+    recurrence needs time ordering)."""
+    T, B = batch["actions"].shape
+    obs = batch["obs"].reshape(T * B, -1)
+    out = fwd(obs)
+    logits = out["logits"].reshape(T, B, -1)
+    values = out["vf"].reshape(T, B)
+    logp_all = jax.nn.log_softmax(logits)
+    target_logp = jnp.take_along_axis(
+        logp_all, batch["actions"][..., None], axis=-1)[..., 0]
+    vs, pg_adv = vtrace(
+        batch["logp"], jax.lax.stop_gradient(target_logp),
+        batch["rewards"], jax.lax.stop_gradient(values),
+        batch["dones"], batch["last_vf"],
+        gamma=gamma, clip_rho=clip_rho, clip_c=clip_c)
+    vs = jax.lax.stop_gradient(vs)
+    pg_adv = jax.lax.stop_gradient(pg_adv)
+    pi_loss = -jnp.mean(target_logp * pg_adv)
+    vf_loss = jnp.mean((values - vs) ** 2)
+    entropy = -jnp.mean(
+        jnp.sum(jnp.exp(logp_all) * logp_all, axis=-1))
+    total = pi_loss + vf_coeff * vf_loss - entropy_coeff * entropy
+    return total, {
+        "policy_loss": pi_loss,
+        "vf_loss": vf_loss,
+        "entropy": entropy,
+    }
+
+
+class IMPALAConfig(AlgorithmConfig):
+    def __init__(self):
+        super().__init__()
+        self.vf_coeff = 0.5
+        self.entropy_coeff = 0.01
+        self.clip_rho = 1.0
+        self.clip_c = 1.0
+        self.num_batches_per_step = 4
+        self.broadcast_interval = 1  # learner updates between syncs
+        self.lr = 6e-4
+        self.algo_class = IMPALA
+
+    def training(self, *, vf_coeff=None, entropy_coeff=None, clip_rho=None,
+                 clip_c=None, num_batches_per_step=None,
+                 broadcast_interval=None, **kwargs) -> "IMPALAConfig":
+        super().training(**kwargs)
+        for name, val in [("vf_coeff", vf_coeff),
+                          ("entropy_coeff", entropy_coeff),
+                          ("clip_rho", clip_rho), ("clip_c", clip_c),
+                          ("num_batches_per_step", num_batches_per_step),
+                          ("broadcast_interval", broadcast_interval)]:
+            if val is not None:
+                setattr(self, name, val)
+        return self
+
+
+class IMPALA(Algorithm):
+    config_class = IMPALAConfig
+
+    def _build(self):
+        cfg = self.config
+        self._build_common(impala_loss, dict(
+            gamma=cfg.gamma, vf_coeff=cfg.vf_coeff,
+            entropy_coeff=cfg.entropy_coeff,
+            clip_rho=cfg.clip_rho, clip_c=cfg.clip_c))
+        # The async pipeline: one in-flight sample per runner at all times.
+        self._inflight = self.workers.call_async(
+            lambda a: a.sample.remote())
+        self._updates_since_broadcast = 0
+
+    def _refill_pipeline(self):
+        """Every live runner (including just-restarted ones) must always
+        have exactly one sample in flight."""
+        for i, actor in list(self.workers.actors.items()):
+            if i not in self._inflight:
+                try:
+                    self._inflight[i] = actor.sample.remote()
+                except Exception:
+                    pass
+
+    def training_step(self) -> Dict[str, Any]:
+        cfg = self.config
+        stats: Dict[str, float] = {}
+        consumed = 0
+        steps = 0
+        while consumed < cfg.num_batches_per_step:
+            if not self.workers.actors:
+                raise RuntimeError(
+                    "every env runner is dead (restarts exhausted)")
+            self._refill_pipeline()
+            ready = self.workers.fetch_ready(
+                self._inflight, timeout=30.0,
+                num_returns=min(len(self._inflight) or 1, 2))
+            for i, batch in ready:
+                T, B = batch["actions"].shape
+                steps += T * B
+                train_batch = {
+                    "obs": jnp.asarray(batch["obs"]),
+                    "actions": jnp.asarray(batch["actions"]),
+                    "logp": jnp.asarray(batch["logp"]),
+                    "rewards": jnp.asarray(batch["rewards"]),
+                    "dones": jnp.asarray(batch["dones"]),
+                    "last_vf": jnp.asarray(batch["last_vf"]),
+                }
+                stats = self.learner.update(train_batch)
+                consumed += 1
+                self._updates_since_broadcast += 1
+                if (self._updates_since_broadcast
+                        >= cfg.broadcast_interval):
+                    self._async_broadcast_weights()
+                    self._updates_since_broadcast = 0
+        self._timesteps_total += steps
+        result = {f"learner/{k}": v for k, v in stats.items()}
+        result["num_env_steps_sampled_this_iter"] = steps
+        self._merge_runner_metrics(result)
+        return result
+
+    def _async_broadcast_weights(self):
+        """Fire-and-forget weight sync — samplers keep rolling with
+        slightly stale weights (that's what V-trace corrects)."""
+        weights_ref = ray_tpu.put(self.learner.get_weights())
+        self.workers.call_async(
+            lambda a: a.set_weights.remote(
+                weights_ref, self.learner.weights_version))
+
+    def cleanup(self):
+        # Drain in-flight sample refs before killing runners.
+        self._inflight.clear()
+        super().cleanup()

@@ -1,0 +1,74 @@
+"""Advantage estimation: GAE and V-trace, as jit-compiled lax.scan.
+
+Reference: rllib/evaluation/postprocessing.py (compute_gae_for_sample_batch)
+and rllib/algorithms/impala/vtrace_torch.py. Both are time-reversed
+recurrences — on TPU they compile to a single fused scan instead of a
+Python loop over timesteps.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+from functools import partial
+
+
+@partial(jax.jit, static_argnames=("gamma", "lam"))
+def compute_gae(rewards, values, dones, last_values, *,
+                gamma: float = 0.99, lam: float = 0.95):
+    """All inputs [T, B]; last_values [B]. Returns (advantages, targets).
+
+    delta_t = r_t + gamma * V_{t+1} * (1-done_t) - V_t
+    A_t     = delta_t + gamma * lam * (1-done_t) * A_{t+1}
+    """
+    next_values = jnp.concatenate([values[1:], last_values[None]], axis=0)
+    nonterminal = 1.0 - dones.astype(jnp.float32)
+    deltas = rewards + gamma * next_values * nonterminal - values
+
+    def scan_fn(carry, x):
+        delta, nt = x
+        adv = delta + gamma * lam * nt * carry
+        return adv, adv
+
+    _, advs = jax.lax.scan(scan_fn, jnp.zeros_like(last_values),
+                           (deltas, nonterminal), reverse=True)
+    return advs, advs + values
+
+
+@partial(jax.jit, static_argnames=("gamma", "clip_rho", "clip_c"))
+def vtrace(behavior_logp, target_logp, rewards, values, dones, last_values,
+           *, gamma: float = 0.99, clip_rho: float = 1.0,
+           clip_c: float = 1.0):
+    """V-trace targets (IMPALA, Espeholt et al. 2018). Inputs [T, B].
+
+    rho_t = min(clip_rho, pi/mu); c_t = min(clip_c, pi/mu)
+    vs_t = V_t + sum_k gamma^k (prod c) rho delta  — computed as a
+    reversed scan: vs_t - V_t = delta_t + gamma c_t (vs_{t+1}-V_{t+1}).
+    Returns (vs_targets [T,B], pg_advantages [T,B]).
+    """
+    rhos = jnp.exp(target_logp - behavior_logp)
+    clipped_rhos = jnp.minimum(clip_rho, rhos)
+    cs = jnp.minimum(clip_c, rhos)
+    nonterminal = 1.0 - dones.astype(jnp.float32)
+    next_values = jnp.concatenate([values[1:], last_values[None]], axis=0)
+    deltas = clipped_rhos * (
+        rewards + gamma * next_values * nonterminal - values)
+
+    def scan_fn(carry, x):
+        delta, c, nt = x
+        acc = delta + gamma * c * nt * carry
+        return acc, acc
+
+    _, vs_minus_v = jax.lax.scan(
+        scan_fn, jnp.zeros_like(last_values),
+        (deltas, cs, nonterminal), reverse=True)
+    vs = vs_minus_v + values
+    next_vs = jnp.concatenate([vs[1:], last_values[None]], axis=0)
+    pg_adv = clipped_rhos * (
+        rewards + gamma * next_vs * nonterminal - values)
+    return vs, pg_adv
+
+
+def explained_variance(targets, values):
+    var_y = jnp.var(targets)
+    return jnp.where(var_y > 0, 1 - jnp.var(targets - values) / var_y, 0.0)

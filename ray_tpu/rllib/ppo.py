@@ -1,0 +1,127 @@
+"""PPO (reference: rllib/algorithms/ppo/ppo.py:379, training_step :405,
+ppo_learner.py loss).
+
+training_step: parallel env-runner sampling -> GAE -> minibatched
+clipped-surrogate SGD on the learner -> weight broadcast. The loss and
+GAE are jit-compiled; sampling runs on CPU actors.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ray_tpu.rllib.algorithm import Algorithm, AlgorithmConfig
+from ray_tpu.rllib.math import compute_gae, explained_variance
+
+
+def ppo_loss(fwd, batch, *, clip_param: float = 0.2,
+             vf_coeff: float = 0.5, entropy_coeff: float = 0.01,
+             vf_clip_param: float = 10.0):
+    """Clipped surrogate objective (reference: ppo_learner compute_loss)."""
+    out = fwd(batch["obs"])
+    logits = out["logits"]
+    logp_all = jax.nn.log_softmax(logits)
+    idx = jnp.arange(logits.shape[0])
+    logp = logp_all[idx, batch["actions"]]
+    ratio = jnp.exp(logp - batch["logp"])
+    adv = batch["advantages"]
+    surr1 = ratio * adv
+    surr2 = jnp.clip(ratio, 1 - clip_param, 1 + clip_param) * adv
+    pi_loss = -jnp.mean(jnp.minimum(surr1, surr2))
+    vf_err = jnp.clip((out["vf"] - batch["targets"]) ** 2,
+                      0.0, vf_clip_param ** 2)
+    vf_loss = jnp.mean(vf_err)
+    entropy = -jnp.mean(
+        jnp.sum(jnp.exp(logp_all) * logp_all, axis=-1))
+    total = pi_loss + vf_coeff * vf_loss - entropy_coeff * entropy
+    aux = {
+        "policy_loss": pi_loss,
+        "vf_loss": vf_loss,
+        "entropy": entropy,
+        "kl": jnp.mean(batch["logp"] - logp),
+        "vf_explained_var": explained_variance(batch["targets"],
+                                               out["vf"]),
+    }
+    return total, aux
+
+
+class PPOConfig(AlgorithmConfig):
+    def __init__(self):
+        super().__init__()
+        self.clip_param = 0.2
+        self.vf_coeff = 0.5
+        self.entropy_coeff = 0.01
+        self.vf_clip_param = 10.0
+        self.num_epochs = 4
+        self.minibatch_size = 256
+        self.lam = 0.95
+        self.algo_class = PPO
+
+    def training(self, *, clip_param=None, vf_coeff=None,
+                 entropy_coeff=None, num_epochs=None, minibatch_size=None,
+                 lam=None, vf_clip_param=None, **kwargs) -> "PPOConfig":
+        super().training(**kwargs)
+        for name, val in [("clip_param", clip_param),
+                          ("vf_coeff", vf_coeff),
+                          ("entropy_coeff", entropy_coeff),
+                          ("num_epochs", num_epochs),
+                          ("minibatch_size", minibatch_size),
+                          ("lam", lam),
+                          ("vf_clip_param", vf_clip_param)]:
+            if val is not None:
+                setattr(self, name, val)
+        return self
+
+
+class PPO(Algorithm):
+    config_class = PPOConfig
+
+    def _build(self):
+        cfg = self.config
+        self._build_common(ppo_loss, dict(
+            clip_param=cfg.clip_param, vf_coeff=cfg.vf_coeff,
+            entropy_coeff=cfg.entropy_coeff,
+            vf_clip_param=cfg.vf_clip_param))
+
+    def training_step(self) -> Dict[str, Any]:
+        cfg = self.config
+        samples = self.workers.foreach(lambda a: a.sample.remote())
+        if not samples:
+            raise RuntimeError(
+                "every env runner failed to sample this iteration "
+                "(restarts exhausted?)")
+        # GAE per rollout, then flatten across runners and time.
+        flat: Dict[str, list] = {k: [] for k in
+                                 ("obs", "actions", "logp",
+                                  "advantages", "targets")}
+        steps = 0
+        for _, batch in samples:
+            adv, targets = compute_gae(
+                jnp.asarray(batch["rewards"]), jnp.asarray(batch["vf"]),
+                jnp.asarray(batch["dones"]), jnp.asarray(batch["last_vf"]),
+                gamma=cfg.gamma, lam=cfg.lam)
+            T, B = batch["actions"].shape
+            steps += T * B
+            flat["obs"].append(batch["obs"].reshape(T * B, -1))
+            flat["actions"].append(batch["actions"].reshape(-1))
+            flat["logp"].append(batch["logp"].reshape(-1))
+            flat["advantages"].append(np.asarray(adv).reshape(-1))
+            flat["targets"].append(np.asarray(targets).reshape(-1))
+        train_batch = {k: np.concatenate(v) for k, v in flat.items()}
+        adv = train_batch["advantages"]
+        train_batch["advantages"] = ((adv - adv.mean())
+                                     / (adv.std() + 1e-8))
+        self._timesteps_total += steps
+        mb = min(cfg.minibatch_size, len(adv))
+        stats = self.learner.update_minibatches(
+            train_batch, minibatch_size=mb, num_epochs=cfg.num_epochs,
+            seed=cfg.seed)
+        self._broadcast_weights()
+        result = {f"learner/{k}": v for k, v in stats.items()}
+        result["num_env_steps_sampled_this_iter"] = steps
+        self._merge_runner_metrics(result)
+        return result

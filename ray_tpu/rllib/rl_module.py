@@ -1,0 +1,89 @@
+"""RLModule: the framework-agnostic policy container, in Flax.
+
+Reference: rllib/core/rl_module/rl_module.py — a module exposes
+forward_inference / forward_exploration / forward_train over batches.
+Here modules are Flax linen modules returning {"logits", "vf"} and the
+three forwards are pure jit-compiled functions of (params, obs) — the
+TPU-idiomatic shape: one traced forward reused everywhere, no
+stochastic Python in the hot path (sampling uses jax PRNG keys).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Any, Callable, Dict, Optional, Sequence, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from flax import linen as nn
+
+from ray_tpu.rllib.env import Space
+
+
+class ActorCriticMLP(nn.Module):
+    """Default module (reference: rllib default MLP catalog encoders +
+    policy/value heads)."""
+
+    num_actions: int
+    hidden: Sequence[int] = (64, 64)
+
+    @nn.compact
+    def __call__(self, obs):
+        x = obs
+        for h in self.hidden:
+            x = nn.tanh(nn.Dense(h)(x))
+        logits = nn.Dense(self.num_actions)(x)
+        vf = nn.Dense(1)(x)
+        return {"logits": logits, "vf": jnp.squeeze(vf, -1)}
+
+
+@dataclasses.dataclass
+class RLModuleSpec:
+    """Reference: SingleAgentRLModuleSpec."""
+
+    observation_space: Space
+    action_space: Space
+    model_config: Dict[str, Any] = dataclasses.field(default_factory=dict)
+    module_class: Optional[type] = None
+
+    def build(self) -> "RLModule":
+        cls = self.module_class or ActorCriticMLP
+        net = cls(num_actions=self.action_space.n,
+                  **self.model_config)
+        return RLModule(net, self.observation_space)
+
+
+class RLModule:
+    def __init__(self, net: nn.Module, obs_space: Space):
+        self.net = net
+        self.obs_space = obs_space
+
+    def init_params(self, rng_key) -> Any:
+        dummy = jnp.zeros((1,) + tuple(self.obs_space.shape), jnp.float32)
+        return self.net.init(rng_key, dummy)
+
+    def make_forwards(self) -> Dict[str, Callable]:
+        """Build the three jit-compiled forwards."""
+        net = self.net
+
+        def forward_train(params, obs):
+            return net.apply(params, obs)
+
+        def forward_inference(params, obs):
+            out = net.apply(params, obs)
+            return jnp.argmax(out["logits"], axis=-1)
+
+        def forward_exploration(params, obs, key):
+            out = net.apply(params, obs)
+            logits = out["logits"]
+            action = jax.random.categorical(key, logits)
+            logp = jax.nn.log_softmax(logits)[
+                jnp.arange(logits.shape[0]), action]
+            return action, logp, out["vf"]
+
+        return {
+            "train": jax.jit(forward_train),
+            "inference": jax.jit(forward_inference),
+            "exploration": jax.jit(forward_exploration),
+        }
