@@ -16,6 +16,12 @@ from ray_tpu.parallel.mesh import (
     create_mesh,
     local_mesh,
 )
+from ray_tpu.parallel.moe import MoELayer, moe_aux_loss
+from ray_tpu.parallel.pipeline import (
+    make_pipeline,
+    stack_stage_params,
+    stage_sharding,
+)
 from ray_tpu.parallel.sharding import (
     LOGICAL_RULES,
     logical_sharding,
@@ -26,9 +32,14 @@ from ray_tpu.parallel.sharding import (
 __all__ = [
     "LOGICAL_RULES",
     "MeshConfig",
+    "MoELayer",
     "create_mesh",
     "local_mesh",
     "logical_sharding",
+    "make_pipeline",
+    "moe_aux_loss",
     "shard_params",
+    "stack_stage_params",
+    "stage_sharding",
     "with_logical_constraint",
 ]

@@ -77,8 +77,16 @@ def create_mesh(config: Optional[MeshConfig] = None,
 
 
 def local_mesh(**axis_sizes) -> Mesh:
-    """Convenience: `local_mesh(data=2, tensor=4)` over local devices."""
-    return create_mesh(MeshConfig(**axis_sizes))
+    """Convenience: `local_mesh(data=2, tensor=4)` over local devices.
+    Axis names outside the canonical five (e.g. ``stage`` for pipeline
+    parallelism) build a custom mesh directly."""
+    if all(a in AXES for a in axis_sizes):
+        return create_mesh(MeshConfig(**axis_sizes))
+    names = tuple(axis_sizes)
+    shape = tuple(axis_sizes[a] for a in names)
+    n = math.prod(shape)
+    dev_array = np.array(jax.devices()[:n]).reshape(shape)
+    return Mesh(dev_array, names)
 
 
 def data_axes(mesh: Mesh) -> Optional[Tuple[str, ...]]:

@@ -1,0 +1,154 @@
+"""Mixture-of-Experts with expert parallelism (EP).
+
+Reference gap: ray has no MoE/expert-parallel support (SURVEY §2.5 —
+"EP: Absent"). This is the GSPMD formulation (Switch Transformer /
+GShard): routing builds a dispatch tensor, expert computation is an
+einsum over a leading expert dimension, and a sharding constraint on
+the "expert" mesh axis makes XLA insert the token all-to-alls over ICI
+— no hand-written collectives, and the dispatch/combine einsums land on
+the MXU.
+
+Capacity-based top-1 (Switch) and top-2 (GShard) routing with an
+auxiliary load-balancing loss, exposed via flax's ``sow`` mechanism.
+"""
+
+from __future__ import annotations
+
+import math
+from typing import Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+from flax import linen as nn
+from jax.sharding import PartitionSpec as P
+
+
+def _dispatch_tensors(router_probs, expert_idx, num_experts: int,
+                      capacity: int, position_offset=None):
+    """Build [N, E, C] dispatch (0/1) and combine (gate-weighted) tensors
+    for one routing choice. Tokens beyond an expert's capacity drop.
+
+    ``position_offset`` [E]: slots already occupied by a higher-priority
+    routing choice (GShard: second choices queue behind all first
+    choices, so top-1 and top-2 tokens never collide on a slot)."""
+    n = expert_idx.shape[0]
+    onehot = jax.nn.one_hot(expert_idx, num_experts, dtype=jnp.float32)
+    # Position of each token within its expert's queue.
+    pos = jnp.cumsum(onehot, axis=0) * onehot  # [N, E], 1-based
+    if position_offset is not None:
+        pos = pos + position_offset[None, :] * onehot
+    keep = (pos > 0) & (pos <= capacity)
+    pos_idx = jnp.clip(pos - 1, 0, capacity - 1).astype(jnp.int32)
+    cap_onehot = jax.nn.one_hot(
+        jnp.sum(pos_idx * onehot.astype(jnp.int32), axis=-1),
+        capacity, dtype=jnp.float32)  # [N, C]
+    dispatch = (onehot * keep)[:, :, None] * cap_onehot[:, None, :]
+    gates = jnp.sum(router_probs * onehot, axis=-1)  # [N]
+    combine = dispatch * gates[:, None, None]
+    return dispatch, combine
+
+
+def load_balancing_loss(router_probs, expert_idx, num_experts: int):
+    """Switch aux loss: E * dot(fraction_routed, mean_prob)."""
+    onehot = jax.nn.one_hot(expert_idx, num_experts, dtype=jnp.float32)
+    density = jnp.mean(onehot, axis=0)
+    density_proxy = jnp.mean(router_probs, axis=0)
+    return num_experts * jnp.sum(density * density_proxy)
+
+
+class MoELayer(nn.Module):
+    """Expert-parallel FFN block.
+
+    Expert weights carry a leading [E, ...] dimension; constraining the
+    expert-payload tensors to P("expert") shards experts across the mesh
+    and XLA lowers the dispatch einsum into an all-to-all over ICI.
+    """
+
+    num_experts: int
+    ffn_dim: int
+    k: int = 2  # 1 = Switch, 2 = GShard top-2
+    capacity_factor: float = 1.25
+    expert_axis: Optional[str] = "expert"
+    router_jitter: float = 0.0
+
+    @nn.compact
+    def __call__(self, x, *, deterministic: bool = True):
+        orig_shape = x.shape
+        hidden = orig_shape[-1]
+        tokens = x.reshape(-1, hidden)
+        n = tokens.shape[0]
+        e = self.num_experts
+        capacity = max(1, int(math.ceil(
+            n / e * self.capacity_factor * self.k)))
+
+        logits = nn.Dense(e, use_bias=False, name="router")(tokens)
+        if self.router_jitter and not deterministic:
+            key = self.make_rng("router")
+            logits = logits + jax.random.uniform(
+                key, logits.shape, minval=-self.router_jitter,
+                maxval=self.router_jitter)
+        probs = jax.nn.softmax(logits, axis=-1)
+
+        top1 = jnp.argmax(probs, axis=-1)
+        dispatch, combine = _dispatch_tensors(probs, top1, e, capacity)
+        aux = load_balancing_loss(probs, top1, e)
+        if self.k == 2:
+            probs2 = probs * (1.0 - jax.nn.one_hot(top1, e))
+            top2 = jnp.argmax(probs2, axis=-1)
+            # Second choices queue behind every first choice of the same
+            # expert — without the offset, top-1 and top-2 tokens land on
+            # the same slot and their activations sum.
+            top1_counts = jnp.sum(
+                jax.nn.one_hot(top1, e, dtype=jnp.float32), axis=0)
+            d2, c2 = _dispatch_tensors(probs, top2, e, capacity,
+                                       position_offset=top1_counts)
+            dispatch = dispatch + d2
+            combine = combine + c2
+        self.sow("intermediates", "load_balancing_loss", aux)
+
+        w_in = self.param(
+            "w_in", nn.initializers.lecun_normal(batch_axis=(0,)),
+            (e, hidden, self.ffn_dim))
+        w_out = self.param(
+            "w_out", nn.initializers.lecun_normal(batch_axis=(0,)),
+            (e, self.ffn_dim, hidden))
+
+        # [N, E, C] x [N, H] -> [E, C, H]: the token all-to-all.
+        expert_in = jnp.einsum("nec,nh->ech", dispatch, tokens)
+        expert_in = _constrain(expert_in, P(self.expert_axis, None, None))
+        h = jnp.einsum("ech,ehf->ecf", expert_in, w_in)
+        h = nn.gelu(h)
+        expert_out = jnp.einsum("ecf,efh->ech", h, w_out)
+        expert_out = _constrain(expert_out, P(self.expert_axis, None, None))
+        # Combine back: [N, E, C] x [E, C, H] -> [N, H].
+        out = jnp.einsum("nec,ech->nh", combine, expert_out)
+        return out.reshape(orig_shape)
+
+
+def _constrain(x, spec: P):
+    """Apply a sharding constraint under a mesh context; no-op with no
+    mesh (single-device tests). A mesh that lacks the requested axis is
+    a loud error — silently dropping the constraint would quietly lose
+    expert parallelism (every device holding all experts)."""
+    wanted = {a for a in jax.tree.leaves(tuple(spec)) if a is not None}
+    if not wanted:
+        return x
+    mesh = jax.sharding.get_abstract_mesh()
+    if mesh is None or mesh.empty:
+        return x
+    missing = wanted - set(mesh.axis_names or ())
+    if missing:
+        raise ValueError(
+            f"mesh {tuple(mesh.axis_names)} lacks axes {sorted(missing)} "
+            f"required by this MoE layer's expert_axis")
+    return jax.lax.with_sharding_constraint(x, spec)
+
+
+def moe_aux_loss(intermediates) -> jnp.ndarray:
+    """Sum all sown load-balancing losses from a flax intermediates
+    collection (use: loss = task_loss + coef * moe_aux_loss(inter))."""
+    total = 0.0
+    flat = jax.tree.leaves(intermediates)
+    for leaf in flat:
+        total = total + jnp.sum(leaf)
+    return total

@@ -1,0 +1,100 @@
+"""Pipeline parallelism: SPMD collective-permute pipelining.
+
+Reference gap: ray has no pipeline-parallel training (SURVEY §2.5 —
+"PP: Absent"; compiled DAGs are its general substrate). The TPU-native
+formulation is not actor channels but a *single SPMD program*: stages
+live on a mesh axis, microbatch activations circulate stage→stage with
+``lax.ppermute`` inside a ``lax.scan`` over ticks, and the whole
+pipeline — bubbles and all — compiles to one XLA executable with
+point-to-point ICI transfers (the scaling-book / praxis recipe).
+
+Layout: stage-stacked params [S, ...] sharded P("stage"); at tick t,
+stage s processes microbatch t - s (the GPipe schedule).
+"""
+
+from __future__ import annotations
+
+from functools import partial
+from typing import Any, Callable
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+
+def stack_stage_params(param_trees):
+    """Stack per-stage param pytrees into [S, ...] leaves (shard the
+    leading axis on the "stage" mesh axis)."""
+    return jax.tree.map(lambda *xs: jnp.stack(xs), *param_trees)
+
+
+def make_pipeline(stage_fn: Callable[[Any, jnp.ndarray], jnp.ndarray],
+                  mesh: Mesh, *, num_microbatches: int,
+                  axis_name: str = "stage"):
+    """Build pipelined_apply(stacked_params, x) -> y.
+
+    - ``stage_fn(stage_params, activations)`` applies ONE stage.
+    - ``stacked_params``: pytree with leading stage axis [S, ...].
+    - ``x``: [num_microbatches, microbatch, ...] global batch.
+    Output has x's shape (activations shape must be stage-invariant).
+    """
+    num_stages = mesh.shape[axis_name]
+    m = num_microbatches
+
+    def per_device(params_blk, x):
+        # shard_map hands each device its stage's params with a leading
+        # block axis of size 1.
+        params_s = jax.tree.map(lambda a: jnp.squeeze(a, 0), params_blk)
+        s = jax.lax.axis_index(axis_name)
+        state0 = jnp.zeros_like(x[0])
+        outputs0 = jnp.zeros_like(x)
+        last = num_stages - 1
+        perm = [(i, (i + 1) % num_stages) for i in range(num_stages)]
+
+        def tick(carry, t):
+            state, outputs = carry
+            # Stage 0 ingests microbatch t (clamped replay past the end
+            # is garbage that never reaches an output slot).
+            x_t = x[jnp.clip(t, 0, m - 1)]
+            state = jnp.where(s == 0, x_t, state)
+            y = stage_fn(params_s, state)
+            mb_idx = t - last
+            write = (s == last) & (mb_idx >= 0)
+            idx = jnp.clip(mb_idx, 0, m - 1)
+            outputs = jax.lax.dynamic_update_index_in_dim(
+                outputs,
+                jnp.where(write, y,
+                          jax.lax.dynamic_index_in_dim(
+                              outputs, idx, 0, keepdims=False)),
+                idx, 0)
+            state = jax.lax.ppermute(y, axis_name, perm)
+            return (state, outputs), None
+
+        (_, outputs), _ = jax.lax.scan(
+            tick, (state0, outputs0), jnp.arange(m + num_stages - 1))
+        # Only the last stage holds real outputs; psum broadcasts them
+        # (all other stages contributed zeros).
+        mask = jnp.where(s == last, 1.0, 0.0).astype(outputs.dtype)
+        return jax.lax.psum(outputs * mask, axis_name)
+
+    # P(axis_name) applies as a prefix spec to every param leaf.
+    sharded = jax.shard_map(
+        per_device, mesh=mesh,
+        in_specs=(P(axis_name), P()),
+        out_specs=P(),
+        check_vma=False,
+    )
+
+    def pipelined_apply(stacked_params, x):
+        if x.shape[0] != m:
+            raise ValueError(
+                f"expected leading microbatch dim {m}, got {x.shape[0]}")
+        return sharded(stacked_params, x)
+
+    return pipelined_apply
+
+
+def stage_sharding(mesh: Mesh, axis_name: str = "stage") -> NamedSharding:
+    """Sharding for stacked stage params: leading axis over the stage
+    mesh axis."""
+    return NamedSharding(mesh, P(axis_name))

@@ -118,16 +118,14 @@ def make_sequence_parallel_attention(mesh: Mesh, kind: str = "ring",
     Input/output layout: (batch, seq, heads, head_dim) with seq sharded on
     `axis_name` and batch sharded on data axes present in the mesh.
     """
-    from jax.experimental.shard_map import shard_map
-
     batch_axes = tuple(a for a in ("data", "fsdp") if a in mesh.axis_names)
     spec = P(batch_axes if batch_axes else None, axis_name, None, None)
 
     fn = ring_attention if kind == "ring" else ulysses_attention
 
     @functools.partial(
-        shard_map, mesh=mesh, in_specs=(spec, spec, spec),
-        out_specs=spec, check_rep=False,
+        jax.shard_map, mesh=mesh, in_specs=(spec, spec, spec),
+        out_specs=spec, check_vma=False,
     )
     def sp_attention(q, k, v):
         return fn(q, k, v, axis_name=axis_name, causal=causal)

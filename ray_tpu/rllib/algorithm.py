@@ -94,7 +94,9 @@ class AlgorithmConfig:
         if self.algo_class is None:
             raise ValueError("config has no algo_class")
         algo = self.algo_class()
-        algo.setup({"algo_config": self})
+        # The algorithm owns a snapshot: mutating this builder (or
+        # building twice) must not touch a running algorithm's config.
+        algo.setup({"algo_config": self.copy()})
         return algo
 
 

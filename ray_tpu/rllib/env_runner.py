@@ -63,6 +63,10 @@ class EnvRunner:
             vf_buf[t] = np.asarray(vf)
             self.obs, rew, term, trunc = self.env.step(action)
             done = term | trunc
+            # Episode metrics use the TRUE env reward (before any
+            # bootstrap augmentation below).
+            self._ep_returns += rew
+            self._ep_lens += 1
             # Time-limit bootstrapping: a truncation is not a true
             # terminal — fold gamma * V(s_final) into the reward so the
             # advantage recurrence (which cuts at done) stays unbiased.
@@ -77,8 +81,6 @@ class EnvRunner:
                     self.gamma * np.asarray(fin["vf"])[only_trunc])
             rew_buf[t] = rew
             done_buf[t] = done
-            self._ep_returns += rew
-            self._ep_lens += 1
             if done.any():
                 for i in np.nonzero(done)[0]:
                     self._completed.append(

@@ -99,8 +99,11 @@ class IMPALA(Algorithm):
             if i not in self._inflight:
                 try:
                     self._inflight[i] = actor.sample.remote()
-                except Exception:
-                    pass
+                except Exception as e:
+                    # Dead handle: route through the manager so the
+                    # runner is restarted (or retired) instead of
+                    # lingering forever with no in-flight work.
+                    self.workers._on_failure(i, e)
 
     def training_step(self) -> Dict[str, Any]:
         cfg = self.config
