@@ -258,6 +258,11 @@ class CoreWorker:
         self._finished_task_ids: set = set()
         self._pubsub_callbacks: Dict[str, List[Callable]] = {}
         self._loop_thread_ident: Optional[int] = None
+        # Task-event buffer: appended from executor threads AND the loop
+        # thread; all access goes through the lock.
+        self._task_event_buf: List[dict] = []
+        self._task_event_lock = threading.Lock()
+        self._event_flush_scheduled = False
         try:
             self.loop.call_soon_threadsafe(
                 lambda: setattr(self, "_loop_thread_ident",
@@ -844,6 +849,58 @@ class CoreWorker:
                 asyncio.ensure_future(self._maybe_return_lease(key, state, lw))
 
         asyncio.ensure_future(push())
+
+    # ------------------------------------------------------------------
+    # task events (reference: core_worker/task_event_buffer.h -> the
+    # GCS task-event store; backend of the state API / timeline)
+    # ------------------------------------------------------------------
+
+    def record_task_event(self, spec, state: str):
+        event = {
+            "task_id": spec.task_id.hex(),
+            "name": spec.name,
+            "type": spec.task_type.name
+            if hasattr(spec.task_type, "name") else str(spec.task_type),
+            "actor_id": spec.actor_id.hex() if spec.actor_id else None,
+            "worker_id": self.worker_id.hex(),
+            "state": state,
+            "ts": time.time(),
+        }
+        with self._task_event_lock:
+            self._task_event_buf.append(event)
+            size = len(self._task_event_buf)
+        if size >= 100:
+            self._flush_task_events()
+        else:
+            self.loop.call_soon_threadsafe(self._schedule_event_flush)
+
+    def _schedule_event_flush(self):
+        if self._event_flush_scheduled:
+            return
+        self._event_flush_scheduled = True
+
+        async def flush_later():
+            await asyncio.sleep(1.0)
+            self._event_flush_scheduled = False
+            self._flush_task_events()
+
+        asyncio.ensure_future(flush_later())
+
+    def _flush_task_events(self):
+        with self._task_event_lock:
+            if not self._task_event_buf:
+                return
+            events, self._task_event_buf = self._task_event_buf, []
+
+        async def send():
+            try:
+                await self.head.call("report_task_events",
+                                     {"events": events})
+            except Exception:
+                pass
+
+        self.loop.call_soon_threadsafe(
+            lambda: asyncio.ensure_future(send()))
 
     async def _return_lease_quietly(self, lw: "LeasedWorker"):
         try:

@@ -202,6 +202,9 @@ class HeadService:
             "report_task_events": self.h_report_task_events,
             "list_task_events": self.h_list_task_events,
             "list_workers": self.h_list_workers,
+            "list_actors": self.h_list_actors,
+            "list_objects": self.h_list_objects,
+            "list_jobs": self.h_list_jobs,
             "ping": self.h_ping,
         }
 
@@ -822,6 +825,28 @@ class HeadService:
             }
             for h in self.pool.workers.values()
         ]
+
+    async def h_list_actors(self, conn, payload):
+        out = []
+        for info in self.actors.values():
+            row = self._actor_info_payload(info)
+            row["class_name"] = (info.creation_spec.name.split(".")[0]
+                                 if info.creation_spec else None)
+            out.append(row)
+        return {"actors": out}
+
+    async def h_list_objects(self, conn, payload):
+        return {"objects": [
+            {"object_id": oid, "size_bytes": size}
+            for oid, size in self.sealed_objects.items()
+        ]}
+
+    async def h_list_jobs(self, conn, payload):
+        return {"jobs": [
+            {"job_id": job_id.hex(), **{k: v for k, v in info.items()
+                                        if k != "address"}}
+            for job_id, info in self.jobs.items()
+        ]}
 
     async def h_ping(self, conn, payload):
         return {"ok": True, "time": time.time()}

@@ -94,9 +94,11 @@ class Executor:
                 async def run_async(spec=spec, fut=fut):
                     try:
                         result = await self._execute_async(spec)
+                        self._record_terminal(spec, result)
                         if not fut.done():
                             fut.set_result(result)
                     except Exception as e:
+                        self.cw.record_task_event(spec, "FAILED")
                         if not fut.done():
                             fut.set_exception(e)
                     finally:
@@ -108,14 +110,24 @@ class Executor:
                     result = await loop.run_in_executor(
                         None, self._execute_sync, spec
                     )
+                    self._record_terminal(spec, result)
                     if not fut.done():
                         fut.set_result(result)
                 except BaseException as e:  # incl. ActorExitSignal
+                    self.cw.record_task_event(spec, "FAILED")
                     if not fut.done():
                         fut.set_exception(e)
 
+    def _record_terminal(self, spec: TaskSpec, reply: dict):
+        """Terminal state comes from where the result is produced, not
+        from submit(): a cancelled awaiter must not mark a task that is
+        still running (and may finish) as FAILED."""
+        self.cw.record_task_event(
+            spec, "FAILED" if reply.get("is_error") else "FINISHED")
+
     async def submit(self, spec: TaskSpec) -> dict:
         fut = asyncio.get_running_loop().create_future()
+        self.cw.record_task_event(spec, "PENDING_EXECUTION")
         await self._queue.put((spec, fut))
         return await fut
 
@@ -147,6 +159,7 @@ class Executor:
         tid = spec.task_id
         self.cw.set_current_task_id(tid)
         self._running_threads[tid.hex()] = threading.get_ident()
+        self.cw.record_task_event(spec, "RUNNING")
         try:
             if tid.hex() in self._cancelled_tasks:
                 raise exc.TaskCancelledError(f"task {spec.name} cancelled")
@@ -184,6 +197,7 @@ class Executor:
     async def _execute_async(self, spec: TaskSpec) -> dict:
         """Async-actor path: methods may be coroutines."""
         self.cw.set_current_task_id(spec.task_id)
+        self.cw.record_task_event(spec, "RUNNING")
         try:
             args, kwargs = await asyncio.get_running_loop().run_in_executor(
                 None, lambda: self._resolve_args(spec)

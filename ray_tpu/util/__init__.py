@@ -1,0 +1,15 @@
+"""ray_tpu.util — utilities over the core (reference: python/ray/util)."""
+
+from ray_tpu.util.actor_pool import ActorPool
+from ray_tpu.util.cluster_utils import Cluster
+from ray_tpu.util.queue import Empty, Full, Queue
+from ray_tpu.util.timeline import timeline
+
+__all__ = [
+    "ActorPool",
+    "Cluster",
+    "Empty",
+    "Full",
+    "Queue",
+    "timeline",
+]

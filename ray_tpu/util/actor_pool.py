@@ -1,0 +1,103 @@
+"""ActorPool (reference: python/ray/util/actor_pool.py)."""
+
+from __future__ import annotations
+
+from typing import Any, Callable, Iterable, List
+
+import ray_tpu
+
+
+class ActorPool:
+    def __init__(self, actors: List[Any]):
+        self._idle = list(actors)
+        self._future_to_actor = {}
+        self._index_to_future = {}
+        self._next_task_index = 0
+        self._next_return_index = 0
+        self._pending_submits: List[tuple] = []
+
+    def submit(self, fn: Callable[[Any, Any], Any], value: Any) -> None:
+        if self._idle:
+            actor = self._idle.pop()
+            future = fn(actor, value)
+            self._future_to_actor[future] = (self._next_task_index, actor)
+            self._index_to_future[self._next_task_index] = future
+            self._next_task_index += 1
+        else:
+            self._pending_submits.append((fn, value))
+
+    def has_next(self) -> bool:
+        return bool(self._index_to_future) or bool(self._pending_submits)
+
+    def get_next(self, timeout=None):
+        """Next result in submission order."""
+        if self._next_return_index >= self._next_task_index \
+                and not self._pending_submits:
+            raise StopIteration("no pending results")
+        while self._next_return_index not in self._index_to_future:
+            if not self._pending_submits:
+                raise StopIteration("no pending results")
+            self._drain_one()
+        future = self._index_to_future.pop(self._next_return_index)
+        self._next_return_index += 1
+        value = ray_tpu.get(future, timeout=timeout)
+        self._return_actor(future)
+        return value
+
+    def get_next_unordered(self, timeout=None):
+        """Any completed result."""
+        if not self.has_next():
+            raise StopIteration("no pending results")
+        if not self._future_to_actor and self._pending_submits:
+            self._drain_one()
+        ready, _ = ray_tpu.wait(list(self._future_to_actor),
+                                num_returns=1, timeout=timeout)
+        if not ready:
+            raise TimeoutError("timed out waiting for result")
+        future = ready[0]
+        index, _ = self._future_to_actor[future]
+        del self._index_to_future[index]
+        value = ray_tpu.get(future)
+        self._return_actor(future)
+        return value
+
+    def _drain_one(self):
+        # No idle actors by definition here; wait for any completion.
+        ready, _ = ray_tpu.wait(list(self._future_to_actor),
+                                num_returns=1, timeout=None)
+        fut = ready[0]
+        idx, _actor = self._future_to_actor[fut]
+        # Leave the result fetchable; just free the actor for the queue.
+        self._return_actor(fut, drop_result=False)
+
+    def _return_actor(self, future, drop_result: bool = True):
+        entry = self._future_to_actor.pop(future, None)
+        if entry is None:
+            return
+        _, actor = entry
+        self._idle.append(actor)
+        if self._pending_submits:
+            fn, value = self._pending_submits.pop(0)
+            self.submit(fn, value)
+
+    def map(self, fn: Callable[[Any, Any], Any], values: Iterable[Any]):
+        for v in values:
+            self.submit(fn, v)
+        while self.has_next():
+            yield self.get_next()
+
+    def map_unordered(self, fn: Callable[[Any, Any], Any],
+                      values: Iterable[Any]):
+        for v in values:
+            self.submit(fn, v)
+        while self.has_next():
+            yield self.get_next_unordered()
+
+    def has_free(self) -> bool:
+        return bool(self._idle)
+
+    def pop_idle(self):
+        return self._idle.pop() if self._idle else None
+
+    def push(self, actor: Any):
+        self._idle.append(actor)

@@ -1,0 +1,100 @@
+"""Chaos testing: first-class fault injectors.
+
+Reference: _private/test_utils.py:1396 (ResourceKillerActor),
+:1527 (WorkerKillerActor) — actors that kill workers/actors on a
+schedule, used by chaos test suites to validate fault tolerance.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import os
+import random
+import signal
+from typing import List, Optional
+
+
+class WorkerKiller:
+    """Async actor that SIGKILLs random task-running worker processes."""
+
+    def __init__(self, kill_interval_s: float = 1.0,
+                 max_kills: int = 5, seed: int = 0):
+        self.kill_interval_s = kill_interval_s
+        self.max_kills = max_kills
+        self.rng = random.Random(seed)
+        self.killed: List[int] = []
+        self._running = False
+
+    async def run(self) -> int:
+        import ray_tpu
+        from ray_tpu.util.state import list_workers
+
+        self._running = True
+        me = os.getpid()
+        while self._running and len(self.killed) < self.max_kills:
+            await asyncio.sleep(self.kill_interval_s)
+            loop = asyncio.get_event_loop()
+            workers = await loop.run_in_executor(None, list_workers)
+            candidates = [w for w in workers
+                          if w["state"] == "LEASED" and w["pid"] != me]
+            if not candidates:
+                continue
+            victim = self.rng.choice(candidates)
+            try:
+                os.kill(victim["pid"], signal.SIGKILL)
+                self.killed.append(victim["pid"])
+            except ProcessLookupError:
+                pass
+        return len(self.killed)
+
+    async def stop(self) -> List[int]:
+        self._running = False
+        return self.killed
+
+    async def get_killed(self) -> List[int]:
+        return list(self.killed)
+
+
+class ActorKiller:
+    """Kills named/visible actors at random (reference: chaos killers
+    targeting actors instead of raw workers)."""
+
+    def __init__(self, kill_interval_s: float = 1.0, max_kills: int = 3,
+                 name_prefix: str = "", seed: int = 0):
+        self.kill_interval_s = kill_interval_s
+        self.max_kills = max_kills
+        self.name_prefix = name_prefix
+        self.rng = random.Random(seed)
+        self.killed: List[str] = []
+        self._running = False
+
+    async def run(self) -> int:
+        import ray_tpu
+        from ray_tpu.util.state import list_actors
+
+        self._running = True
+        while self._running and len(self.killed) < self.max_kills:
+            await asyncio.sleep(self.kill_interval_s)
+            loop = asyncio.get_event_loop()
+            actors = await loop.run_in_executor(None, list_actors)
+            candidates = [
+                a for a in actors
+                if a["state"] == "ALIVE" and a.get("name")
+                and a["name"].startswith(self.name_prefix)
+                and not a["name"].startswith("_chaos")]
+            if not candidates:
+                continue
+            victim = self.rng.choice(candidates)
+            try:
+                handle = await loop.run_in_executor(
+                    None, lambda: ray_tpu.get_actor(victim["name"]))
+                await loop.run_in_executor(
+                    None, lambda: ray_tpu.kill(handle))
+                self.killed.append(victim["name"])
+            except Exception:
+                pass
+        return len(self.killed)
+
+    async def stop(self) -> List[str]:
+        self._running = False
+        return self.killed

@@ -1,0 +1,52 @@
+"""Fake multi-node cluster for tests.
+
+Reference: python/ray/cluster_utils.py:108 (Cluster.add_node:174,
+remove_node:247) — extra logical nodes in one host so multi-node
+scheduling semantics (spread, node affinity, gang placement across
+hosts) are testable without machines. Workers for every logical node
+run as local processes; the scheduler sees distinct nodes with their
+own resource pools.
+"""
+
+from __future__ import annotations
+
+from typing import Dict, List, Optional
+
+import ray_tpu
+from ray_tpu.core.ids import NodeID
+
+
+class Cluster:
+    def __init__(self, initialize_head: bool = True,
+                 head_node_args: Optional[dict] = None):
+        self.node_ids: List[NodeID] = []
+        if initialize_head:
+            ray_tpu.init(**(head_node_args or {}))
+        from ray_tpu import api as _api
+
+        self._head = _api._global_node
+        if self._head is None:
+            raise RuntimeError("cluster requires ray_tpu.init()")
+
+    def add_node(self, *, num_cpus: float = 1.0, num_tpus: float = 0.0,
+                 resources: Optional[Dict[str, float]] = None) -> NodeID:
+        res: Dict[str, float] = {"CPU": float(num_cpus)}
+        if num_tpus:
+            res["TPU"] = float(num_tpus)
+        res.update(resources or {})
+        node_id = self._head.add_node(res)
+        self.node_ids.append(node_id)
+        return node_id
+
+    def remove_node(self, node_id: NodeID):
+        self._head.remove_node(node_id)
+        if node_id in self.node_ids:
+            self.node_ids.remove(node_id)
+
+    def list_nodes(self) -> List[dict]:
+        from ray_tpu.util.state import list_nodes
+
+        return list_nodes()
+
+    def shutdown(self):
+        ray_tpu.shutdown()

@@ -1,0 +1,224 @@
+"""Application metrics: Counter / Gauge / Histogram.
+
+Reference: python/ray/util/metrics.py:153,224,299 — app metrics flow to
+the node metrics agent and out to Prometheus. Here each process keeps a
+local registry and pushes snapshots to the head KV (namespace
+"metrics", keyed by worker id); ``collect_metrics`` merges all
+processes' snapshots and ``prometheus_text`` renders the standard
+exposition format for scraping.
+"""
+
+from __future__ import annotations
+
+import bisect
+import json
+import threading
+import time
+from typing import Dict, List, Optional, Sequence, Tuple
+
+_registry_lock = threading.Lock()
+_registry: Dict[str, "Metric"] = {}
+_last_push = 0.0
+_PUSH_INTERVAL_S = 2.0
+
+
+class Metric:
+    metric_type = "untyped"
+
+    def __init__(self, name: str, description: str = "",
+                 tag_keys: Optional[Sequence[str]] = None):
+        if not name:
+            raise ValueError("metric name required")
+        self.name = name
+        self.description = description
+        self.tag_keys = tuple(tag_keys or ())
+        self._default_tags: Dict[str, str] = {}
+        # frozen tag tuple -> value(s)
+        self._values: Dict[Tuple[Tuple[str, str], ...], float] = {}
+        with _registry_lock:
+            _registry[name] = self
+
+    def set_default_tags(self, tags: Dict[str, str]):
+        self._default_tags = dict(tags)
+        return self
+
+    def _tag_key(self, tags: Optional[Dict[str, str]]
+                 ) -> Tuple[Tuple[str, str], ...]:
+        merged = dict(self._default_tags)
+        merged.update(tags or {})
+        extra = set(merged) - set(self.tag_keys)
+        if extra:
+            raise ValueError(
+                f"undeclared tag keys {sorted(extra)} for {self.name}")
+        return tuple(sorted(merged.items()))
+
+    def _snapshot(self) -> dict:
+        return {
+            "type": self.metric_type,
+            "description": self.description,
+            "values": [[list(k), v] for k, v in self._values.items()],
+        }
+
+
+class Counter(Metric):
+    metric_type = "counter"
+
+    def inc(self, value: float = 1.0,
+            tags: Optional[Dict[str, str]] = None):
+        if value < 0:
+            raise ValueError("counters only increase")
+        key = self._tag_key(tags)
+        self._values[key] = self._values.get(key, 0.0) + value
+        _maybe_push()
+
+
+class Gauge(Metric):
+    metric_type = "gauge"
+
+    def set(self, value: float, tags: Optional[Dict[str, str]] = None):
+        self._values[self._tag_key(tags)] = float(value)
+        _maybe_push()
+
+
+DEFAULT_BOUNDARIES = [0.005, 0.01, 0.025, 0.05, 0.1, 0.25, 0.5, 1.0,
+                      2.5, 5.0, 10.0]
+
+
+class Histogram(Metric):
+    metric_type = "histogram"
+
+    def __init__(self, name: str, description: str = "",
+                 boundaries: Optional[List[float]] = None,
+                 tag_keys: Optional[Sequence[str]] = None):
+        super().__init__(name, description, tag_keys)
+        self.boundaries = sorted(boundaries or DEFAULT_BOUNDARIES)
+        # tag key -> [bucket counts..., +inf count, sum, count]
+        self._hists: Dict[tuple, list] = {}
+
+    def observe(self, value: float,
+                tags: Optional[Dict[str, str]] = None):
+        key = self._tag_key(tags)
+        h = self._hists.get(key)
+        if h is None:
+            h = self._hists[key] = [0] * (len(self.boundaries) + 1) + [0.0, 0]
+        idx = bisect.bisect_left(self.boundaries, value)
+        h[idx] += 1
+        h[-2] += value
+        h[-1] += 1
+        _maybe_push()
+
+    def _snapshot(self) -> dict:
+        return {
+            "type": self.metric_type,
+            "description": self.description,
+            "boundaries": self.boundaries,
+            "hists": [[list(k), v] for k, v in self._hists.items()],
+        }
+
+
+def _maybe_push(force: bool = False):
+    """Throttled push of this process's registry to the head KV."""
+    global _last_push
+    now = time.time()
+    if not force and now - _last_push < _PUSH_INTERVAL_S:
+        return
+    _last_push = now
+    try:
+        from ray_tpu.core.object_ref import get_core_worker
+
+        cw = get_core_worker()
+        if cw is None:
+            return
+        with _registry_lock:
+            snap = {name: m._snapshot() for name, m in _registry.items()}
+        blob = json.dumps(snap).encode()
+        key = f"metrics:{cw.worker_id.hex()}".encode()
+        cw.loop_thread.submit(cw.head.call("kv_put", {
+            "ns": "metrics", "key": key, "value": blob,
+            "overwrite": True,
+        }))
+    except Exception:
+        pass
+
+
+def flush_metrics():
+    _maybe_push(force=True)
+
+
+def collect_metrics() -> Dict[str, dict]:
+    """Merge all processes' metric snapshots (driver-side)."""
+    import ray_tpu
+    from ray_tpu.core.object_ref import get_core_worker
+
+    cw = get_core_worker()
+    if cw is None:
+        raise RuntimeError("ray_tpu not initialized")
+    keys = cw.loop_thread.run(
+        cw.head.call("kv_keys", {"ns": "metrics", "prefix": b"metrics:"}))
+    merged: Dict[str, dict] = {}
+    for key in keys.get("keys", []):
+        reply = cw.loop_thread.run(
+            cw.head.call("kv_get", {"ns": "metrics", "key": key}))
+        blob = reply.get("value")
+        if not blob:
+            continue
+        snap = json.loads(bytes(blob).decode())
+        for name, data in snap.items():
+            dst = merged.setdefault(name, {
+                "type": data["type"],
+                "description": data.get("description", ""),
+                "values": {},
+            })
+            if data["type"] == "histogram":
+                dst.setdefault("boundaries", data.get("boundaries"))
+                for k, h in data.get("hists", []):
+                    tk = tuple(tuple(p) for p in k)
+                    cur = dst["values"].get(tk)
+                    dst["values"][tk] = ([a + b for a, b in zip(cur, h)]
+                                         if cur else list(h))
+            else:
+                for k, v in data.get("values", []):
+                    tk = tuple(tuple(p) for p in k)
+                    if data["type"] == "counter":
+                        dst["values"][tk] = dst["values"].get(tk, 0.0) + v
+                    else:  # gauge: last write wins
+                        dst["values"][tk] = v
+    return merged
+
+
+def prometheus_text() -> str:
+    """Render merged metrics in Prometheus exposition format (reference:
+    the metrics agent's OpenCensus->Prometheus proxy)."""
+    out: List[str] = []
+
+    def fmt_tags(tk) -> str:
+        if not tk:
+            return ""
+        inner = ",".join(f'{k}="{v}"' for k, v in tk)
+        return "{" + inner + "}"
+
+    for name, data in sorted(collect_metrics().items()):
+        out.append(f"# HELP {name} {data['description']}")
+        out.append(f"# TYPE {name} {data['type']}")
+        if data["type"] == "histogram":
+            bounds = data.get("boundaries") or []
+            for tk, h in data["values"].items():
+                acc = 0
+                for b, c in zip(bounds, h):
+                    acc += c
+                    tags = dict(tk)
+                    tags["le"] = str(b)
+                    out.append(f"{name}_bucket"
+                               f"{fmt_tags(tuple(sorted(tags.items())))}"
+                               f" {acc}")
+                acc += h[len(bounds)]
+                tags = dict(tk)
+                tags["le"] = "+Inf"
+                out.append(f"{name}_bucket"
+                           f"{fmt_tags(tuple(sorted(tags.items())))} {acc}")
+                out.append(f"{name}_sum{fmt_tags(tk)} {h[-2]}")
+                out.append(f"{name}_count{fmt_tags(tk)} {h[-1]}")
+        else:
+            for tk, v in data["values"].items():
+                out.append(f"{name}{fmt_tags(tk)} {v}")
+    return "\n".join(out) + "\n"

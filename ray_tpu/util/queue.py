@@ -1,0 +1,139 @@
+"""Distributed Queue (reference: python/ray/util/queue.py — an
+actor-backed asyncio.Queue)."""
+
+from __future__ import annotations
+
+import asyncio
+from typing import Any, List, Optional
+
+import ray_tpu
+
+
+class Empty(Exception):
+    pass
+
+
+class Full(Exception):
+    pass
+
+
+class _QueueActor:
+    def __init__(self, maxsize: int):
+        self.q: asyncio.Queue = asyncio.Queue(maxsize=maxsize)
+
+    async def put(self, item, timeout: Optional[float] = None):
+        if timeout is None:
+            await self.q.put(item)
+            return True
+        try:
+            await asyncio.wait_for(self.q.put(item), timeout)
+            return True
+        except asyncio.TimeoutError:
+            return False
+
+    async def get(self, timeout: Optional[float] = None):
+        if timeout is None:
+            return True, await self.q.get()
+        try:
+            return True, await asyncio.wait_for(self.q.get(), timeout)
+        except asyncio.TimeoutError:
+            return False, None
+
+    async def put_nowait(self, item):
+        try:
+            self.q.put_nowait(item)
+            return True
+        except asyncio.QueueFull:
+            return False
+
+    async def get_nowait(self):
+        try:
+            return True, self.q.get_nowait()
+        except asyncio.QueueEmpty:
+            return False, None
+
+    async def put_nowait_batch(self, items: List[Any]):
+        ok = 0
+        for item in items:
+            try:
+                self.q.put_nowait(item)
+                ok += 1
+            except asyncio.QueueFull:
+                break
+        return ok
+
+    async def get_nowait_batch(self, num_items: int):
+        out = []
+        for _ in range(num_items):
+            try:
+                out.append(self.q.get_nowait())
+            except asyncio.QueueEmpty:
+                break
+        return out
+
+    async def qsize(self):
+        return self.q.qsize()
+
+    async def empty(self):
+        return self.q.empty()
+
+    async def full(self):
+        return self.q.full()
+
+
+class Queue:
+    def __init__(self, maxsize: int = 0, *,
+                 actor_options: Optional[dict] = None):
+        opts = dict(actor_options or {})
+        opts.setdefault("num_cpus", 0.1)
+        self.actor = ray_tpu.remote(_QueueActor).options(**opts).remote(
+            maxsize)
+
+    def put(self, item, block: bool = True,
+            timeout: Optional[float] = None):
+        if not block:
+            ok = ray_tpu.get(self.actor.put_nowait.remote(item))
+            if not ok:
+                raise Full("queue full")
+            return
+        ok = ray_tpu.get(self.actor.put.remote(item, timeout),
+                         timeout=None if timeout is None else timeout + 30)
+        if not ok:
+            raise Full("queue full")
+
+    def get(self, block: bool = True, timeout: Optional[float] = None):
+        if not block:
+            ok, item = ray_tpu.get(self.actor.get_nowait.remote())
+            if not ok:
+                raise Empty("queue empty")
+            return item
+        ok, item = ray_tpu.get(
+            self.actor.get.remote(timeout),
+            timeout=None if timeout is None else timeout + 30)
+        if not ok:
+            raise Empty("queue empty")
+        return item
+
+    def put_nowait(self, item):
+        self.put(item, block=False)
+
+    def get_nowait(self):
+        return self.get(block=False)
+
+    def put_nowait_batch(self, items: List[Any]) -> int:
+        return ray_tpu.get(self.actor.put_nowait_batch.remote(list(items)))
+
+    def get_nowait_batch(self, num_items: int) -> List[Any]:
+        return ray_tpu.get(self.actor.get_nowait_batch.remote(num_items))
+
+    def qsize(self) -> int:
+        return ray_tpu.get(self.actor.qsize.remote())
+
+    def empty(self) -> bool:
+        return ray_tpu.get(self.actor.empty.remote())
+
+    def full(self) -> bool:
+        return ray_tpu.get(self.actor.full.remote())
+
+    def shutdown(self):
+        ray_tpu.kill(self.actor)

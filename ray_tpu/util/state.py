@@ -1,0 +1,99 @@
+"""State API: cluster introspection.
+
+Reference: python/ray/util/state/api.py (list_actors:782, list_tasks,
+list_objects:1060, list_nodes, list_workers, summarize_tasks:1376),
+backed by the head's task-event store and live tables.
+"""
+
+from __future__ import annotations
+
+import collections
+from typing import Any, Dict, List, Optional
+
+from ray_tpu.core.object_ref import get_core_worker
+
+
+def _call(method: str, payload: Optional[dict] = None):
+    cw = get_core_worker()
+    if cw is None:
+        raise RuntimeError("ray_tpu not initialized")
+    return cw.loop_thread.run(cw.head.call(method, payload or {}))
+
+
+def list_actors(*, filters: Optional[List[tuple]] = None
+                ) -> List[Dict[str, Any]]:
+    actors = _call("list_actors")["actors"]
+    return _apply_filters(actors, filters)
+
+
+def list_workers() -> List[Dict[str, Any]]:
+    return _call("list_workers")
+
+
+def list_nodes() -> List[Dict[str, Any]]:
+    return _call("get_nodes")
+
+
+def list_objects() -> List[Dict[str, Any]]:
+    return _call("list_objects")["objects"]
+
+
+def list_jobs() -> List[Dict[str, Any]]:
+    return _call("list_jobs")["jobs"]
+
+
+def list_placement_groups() -> List[Dict[str, Any]]:
+    return _call("list_pgs")
+
+
+def list_tasks(*, limit: int = 1000,
+               filters: Optional[List[tuple]] = None
+               ) -> List[Dict[str, Any]]:
+    """Latest state per task, from the task-event store."""
+    events = _call("list_task_events", {"limit": 10 * limit})["events"]
+    latest: Dict[str, Dict[str, Any]] = {}
+    for ev in events:
+        latest[ev["task_id"]] = ev
+    tasks = list(latest.values())[-limit:]
+    return _apply_filters(tasks, filters)
+
+
+def list_task_events(*, limit: int = 1000) -> List[Dict[str, Any]]:
+    return _call("list_task_events", {"limit": limit})["events"]
+
+
+def summarize_tasks() -> Dict[str, Dict[str, int]]:
+    """Per-function-name counts by state (reference: summarize_tasks)."""
+    summary: Dict[str, Dict[str, int]] = collections.defaultdict(
+        lambda: collections.defaultdict(int))
+    for t in list_tasks(limit=100000):
+        summary[t.get("name") or "<anonymous>"][t["state"]] += 1
+    return {k: dict(v) for k, v in summary.items()}
+
+
+def summarize_actors() -> Dict[str, int]:
+    out: Dict[str, int] = collections.defaultdict(int)
+    for a in list_actors():
+        out[a["state"]] += 1
+    return dict(out)
+
+
+def _apply_filters(rows: List[dict], filters) -> List[dict]:
+    if not filters:
+        return rows
+    out = []
+    for row in rows:
+        ok = True
+        for key, op, value in filters:
+            have = row.get(key)
+            if op in ("=", "=="):
+                ok = have == value
+            elif op == "!=":
+                ok = have != value
+            else:
+                raise ValueError(f"unsupported filter op {op!r}")
+            if not ok:
+                break
+        if ok:
+            out.append(row)
+    return out

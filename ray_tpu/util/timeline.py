@@ -1,0 +1,41 @@
+"""Task timeline export in chrome://tracing format.
+
+Reference: `ray timeline` (_private/state.py:434 chrome_tracing_dump) —
+task state transitions from the event store become complete events
+("ph": "X") grouped by worker, loadable in chrome://tracing / Perfetto.
+"""
+
+from __future__ import annotations
+
+import json
+from typing import List, Optional
+
+from ray_tpu.util.state import list_task_events
+
+
+def timeline(filename: Optional[str] = None) -> List[dict]:
+    events = list_task_events(limit=100000)
+    # Pair RUNNING -> FINISHED/FAILED per task.
+    start_ts = {}
+    trace: List[dict] = []
+    for ev in events:
+        tid = ev["task_id"]
+        if ev["state"] == "RUNNING":
+            start_ts[tid] = ev
+        elif ev["state"] in ("FINISHED", "FAILED") and tid in start_ts:
+            begin = start_ts.pop(tid)
+            trace.append({
+                "name": ev.get("name") or tid[:8],
+                "cat": ev.get("type", "task"),
+                "ph": "X",
+                "ts": begin["ts"] * 1e6,
+                "dur": max(0.0, (ev["ts"] - begin["ts"]) * 1e6),
+                "pid": "ray_tpu",
+                "tid": ev.get("worker_id", "?")[:12],
+                "args": {"task_id": tid,
+                         "state": ev["state"]},
+            })
+    if filename:
+        with open(filename, "w") as f:
+            json.dump(trace, f)
+    return trace

@@ -1,0 +1,38 @@
+"""Fake multi-node cluster tests (reference strategy:
+python/ray/tests/test_multi_node.py via cluster_utils.Cluster)."""
+
+import ray_tpu
+
+
+def test_cluster_utils_multi_node():
+    from ray_tpu.util import Cluster
+
+    cluster = Cluster(head_node_args={"num_cpus": 1, "num_tpus": 0})
+    try:
+        cluster.add_node(num_cpus=2)
+        cluster.add_node(num_cpus=2)
+        nodes = cluster.list_nodes()
+        assert len(nodes) == 3
+        total = ray_tpu.cluster_resources()
+        assert total["CPU"] == 5.0
+
+        @ray_tpu.remote
+        def where():
+            import os
+
+            return os.getpid()
+
+        # SPREAD strategy should run tasks despite multiple nodes.
+        refs = [where.options(scheduling_strategy="SPREAD",
+                              num_cpus=1).remote() for _ in range(4)]
+        pids = ray_tpu.get(refs, timeout=120)
+        assert len(pids) == 4
+        cluster.remove_node(cluster.node_ids[0])
+        # Dead nodes stay in the table with state DEAD (reference
+        # semantics); only 2 remain alive.
+        alive = [n for n in cluster.list_nodes() if n["state"] == "ALIVE"]
+        assert len(alive) == 2
+    finally:
+        cluster.shutdown()
+
+

@@ -1,0 +1,206 @@
+"""Tests for ray_tpu.util: actor pool, queue, metrics, state API,
+timeline, chaos (reference strategy: python/ray/tests/test_actor_pool.py,
+test_queue.py, test_metrics_agent.py, util/state tests)."""
+
+import time
+
+import pytest
+
+import ray_tpu
+from ray_tpu.util import ActorPool, Queue, timeline
+from ray_tpu.util import metrics as um
+from ray_tpu.util import state as ust
+
+
+@pytest.fixture(scope="module")
+def util_cluster():
+    ray_tpu.init(num_cpus=4, num_tpus=0)
+    yield
+    ray_tpu.shutdown()
+
+
+class _Doubler:
+    def double(self, x):
+        return x * 2
+
+    def slow_double(self, x):
+        time.sleep(0.05)
+        return x * 2
+
+
+def test_actor_pool_map(util_cluster):
+    actors = [ray_tpu.remote(_Doubler).options(num_cpus=0.5).remote()
+              for _ in range(2)]
+    pool = ActorPool(actors)
+    out = list(pool.map(lambda a, v: a.double.remote(v), range(8)))
+    assert out == [i * 2 for i in range(8)]
+    out2 = sorted(pool.map_unordered(
+        lambda a, v: a.slow_double.remote(v), range(6)))
+    assert out2 == [i * 2 for i in range(6)]
+    for a in actors:
+        ray_tpu.kill(a)
+
+
+def test_actor_pool_submit_get_next(util_cluster):
+    actors = [ray_tpu.remote(_Doubler).options(num_cpus=0.5).remote()]
+    pool = ActorPool(actors)
+    pool.submit(lambda a, v: a.double.remote(v), 1)
+    pool.submit(lambda a, v: a.double.remote(v), 2)
+    assert pool.get_next() == 2
+    assert pool.get_next() == 4
+    assert not pool.has_next()
+    ray_tpu.kill(actors[0])
+
+
+def test_queue_basic(util_cluster):
+    q = Queue(maxsize=4)
+    q.put("a")
+    q.put("b")
+    assert q.qsize() == 2
+    assert q.get() == "a"
+    assert q.get() == "b"
+    assert q.empty()
+    with pytest.raises(Exception):
+        q.get(block=False)
+    q.shutdown()
+
+
+def test_queue_batch_and_full(util_cluster):
+    from ray_tpu.util import Full
+
+    q = Queue(maxsize=3)
+    n = q.put_nowait_batch([1, 2, 3, 4])
+    assert n == 3
+    with pytest.raises(Full):
+        q.put(9, block=False)
+    assert q.get_nowait_batch(10) == [1, 2, 3]
+    q.shutdown()
+
+
+def test_queue_producer_consumer(util_cluster):
+    q = Queue()
+
+    @ray_tpu.remote
+    def producer(q, n):
+        for i in range(n):
+            q.put(i)
+        return n
+
+    @ray_tpu.remote
+    def consumer(q, n):
+        return sum(q.get(timeout=30) for _ in range(n))
+
+    pref = producer.remote(q, 10)
+    cref = consumer.remote(q, 10)
+    assert ray_tpu.get(cref, timeout=60) == 45
+    assert ray_tpu.get(pref, timeout=60) == 10
+    q.shutdown()
+
+
+def test_metrics_counter_gauge_histogram(util_cluster):
+    c = um.Counter("req_total", "requests", tag_keys=("route",))
+    c.inc(1, tags={"route": "/a"})
+    c.inc(2, tags={"route": "/a"})
+    c.inc(5, tags={"route": "/b"})
+    g = um.Gauge("inflight", "in flight")
+    g.set(7)
+    h = um.Histogram("latency_s", "latency", boundaries=[0.1, 1.0])
+    h.observe(0.05)
+    h.observe(0.5)
+    h.observe(5.0)
+    um.flush_metrics()
+    merged = um.collect_metrics()
+    vals = merged["req_total"]["values"]
+    assert vals[(("route", "/a"),)] == 3
+    assert vals[(("route", "/b"),)] == 5
+    assert merged["inflight"]["values"][()] == 7
+    hist = merged["latency_s"]["values"][()]
+    assert hist[-1] == 3  # count
+    assert abs(hist[-2] - 5.55) < 1e-6  # sum
+    text = um.prometheus_text()
+    assert "# TYPE req_total counter" in text
+    assert 'req_total{route="/a"} 3' in text
+    assert "latency_s_count 3" in text
+
+
+def test_state_api(util_cluster):
+    @ray_tpu.remote
+    def named_task():
+        return 1
+
+    refs = [named_task.options(name="state_test_task").remote()
+            for _ in range(3)]
+    ray_tpu.get(refs, timeout=60)
+
+    class StateActor:
+        def ping(self):
+            return "pong"
+
+    a = ray_tpu.remote(StateActor).options(
+        name="state_actor", num_cpus=0.1).remote()
+    assert ray_tpu.get(a.ping.remote(), timeout=60) == "pong"
+
+    actors = ust.list_actors()
+    assert any(x.get("name") == "state_actor" and x["state"] == "ALIVE"
+               for x in actors)
+    workers = ust.list_workers()
+    assert len(workers) >= 1
+    nodes = ust.list_nodes()
+    assert len(nodes) >= 1
+
+    # Task events flush after <= ~1s.
+    deadline = time.time() + 15
+    while time.time() < deadline:
+        tasks = ust.list_tasks()
+        done = [t for t in tasks if t.get("name") == "state_test_task"
+                and t["state"] == "FINISHED"]
+        if len(done) >= 1:
+            break
+        time.sleep(0.3)
+    assert done, f"no finished task events: {tasks[:5]}"
+    summary = ust.summarize_tasks()
+    assert "state_test_task" in summary
+    ray_tpu.kill(a)
+
+
+def test_timeline_export(util_cluster, tmp_path):
+    @ray_tpu.remote
+    def traced():
+        time.sleep(0.02)
+        return 1
+
+    ray_tpu.get([traced.options(name="traced_task").remote()
+                 for _ in range(2)], timeout=60)
+    deadline = time.time() + 15
+    trace = []
+    while time.time() < deadline:
+        trace = timeline()
+        if any(ev["name"] == "traced_task" for ev in trace):
+            break
+        time.sleep(0.3)
+    spans = [ev for ev in trace if ev["name"] == "traced_task"]
+    assert spans and spans[0]["ph"] == "X"
+    assert spans[0]["dur"] >= 0.02 * 1e6 * 0.5
+    out = tmp_path / "timeline.json"
+    timeline(str(out))
+    assert out.exists()
+
+
+def test_chaos_worker_killer(util_cluster):
+    from ray_tpu.util.chaos import WorkerKiller
+
+    @ray_tpu.remote
+    def steady(x):
+        time.sleep(0.2)
+        return x
+
+    killer = ray_tpu.remote(WorkerKiller).options(num_cpus=0.1).remote(
+        kill_interval_s=0.3, max_kills=2)
+    run_ref = killer.run.remote()
+    # Tasks keep succeeding despite worker kills (retries).
+    results = ray_tpu.get(
+        [steady.options(max_retries=5).remote(i) for i in range(12)],
+        timeout=240)
+    assert results == list(range(12))
+    ray_tpu.get(killer.stop.remote(), timeout=30)
+    ray_tpu.kill(killer)
