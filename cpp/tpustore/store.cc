@@ -1,0 +1,402 @@
+// tpustore: node-wide shared-memory object arena.
+//
+// Native equivalent of the reference's plasma store
+// (src/ray/object_manager/plasma/store.h:55, eviction_policy.h:105,
+// dlmalloc.cc): one shared-memory arena per node holding immutable
+// sealed objects, allocated from a free-extent allocator with
+// boundary coalescing, evicted LRU over unpinned sealed objects.
+// Unlike plasma's socket protocol, coordination is in-memory: every
+// process on the node maps the same arena and synchronizes on a
+// process-shared robust mutex in the arena header. Object payloads are
+// mapped zero-copy into clients (host buffers feed jax.device_put
+// without a copy).
+//
+// Exported C API (ctypes-friendly); all functions returning int use
+// 0 = ok, negative = error (see TS_E* codes).
+
+#include <errno.h>
+#include <fcntl.h>
+#include <pthread.h>
+#include <stdint.h>
+#include <string.h>
+#include <sys/mman.h>
+#include <sys/stat.h>
+#include <unistd.h>
+
+#include <new>
+
+namespace {
+
+constexpr uint64_t kMagic = 0x7470757374307245ull;  // "tpust0rE"
+constexpr uint32_t kKeyLen = 20;
+constexpr uint32_t kEntryCap = 32768;         // max live objects per node
+constexpr uint32_t kExtentCap = kEntryCap + 8;
+constexpr uint64_t kAlign = 64;
+
+constexpr int TS_OK = 0;
+constexpr int TS_EEXIST = -1;
+constexpr int TS_ENOENT = -2;
+constexpr int TS_EFULL = -3;     // no space even after eviction
+constexpr int TS_ETABLE = -4;    // entry table full
+constexpr int TS_ESTATE = -5;    // wrong state (e.g. seal of sealed)
+constexpr int TS_ESYS = -6;      // system error (shm/mmap)
+
+enum EntryState : uint32_t {
+  kEmpty = 0,
+  kCreated = 1,
+  kSealed = 2,
+  kTombstone = 3,
+};
+
+struct Entry {
+  uint8_t key[kKeyLen];
+  uint64_t offset;
+  uint64_t size;
+  uint32_t state;
+  uint32_t pin;
+  uint64_t lru;
+};
+
+struct Extent {
+  uint64_t offset;
+  uint64_t size;
+};
+
+struct Header {
+  uint64_t magic;
+  uint64_t total_size;      // arena mapping size
+  uint64_t data_offset;     // start of the data area
+  uint64_t data_size;
+  pthread_mutex_t mutex;
+  uint64_t lru_tick;
+  uint64_t used_bytes;
+  uint64_t num_objects;
+  uint64_t num_evicted;     // stats
+  uint32_t num_extents;     // live free extents
+  uint32_t pad;
+  Entry entries[kEntryCap];
+  Extent extents[kExtentCap];  // sorted by offset
+};
+
+struct Handle {
+  Header* hdr;
+  uint64_t map_size;
+};
+
+uint64_t AlignUp(uint64_t n) { return (n + kAlign - 1) & ~(kAlign - 1); }
+
+uint64_t HashKey(const uint8_t* key) {
+  // FNV-1a over the 20-byte id.
+  uint64_t h = 1469598103934665603ull;
+  for (uint32_t i = 0; i < kKeyLen; i++) {
+    h ^= key[i];
+    h *= 1099511628211ull;
+  }
+  return h;
+}
+
+class Locker {
+ public:
+  explicit Locker(Header* hdr) : hdr_(hdr) {
+    int rc = pthread_mutex_lock(&hdr_->mutex);
+    if (rc == EOWNERDEAD) {
+      // A process died holding the lock; the table is still usable
+      // because all mutations below are ordered to be crash-tolerant
+      // (worst case: a leaked created-but-unsealed allocation, which
+      // eviction of unsealed-stale entries could reclaim later).
+      pthread_mutex_consistent(&hdr_->mutex);
+    }
+  }
+  ~Locker() { pthread_mutex_unlock(&hdr_->mutex); }
+
+ private:
+  Header* hdr_;
+};
+
+// ---- entry table (open addressing, linear probe) ----
+
+Entry* FindEntry(Header* hdr, const uint8_t* key) {
+  uint64_t idx = HashKey(key) % kEntryCap;
+  for (uint32_t probe = 0; probe < kEntryCap; probe++) {
+    Entry* e = &hdr->entries[(idx + probe) % kEntryCap];
+    if (e->state == kEmpty) return nullptr;
+    if (e->state != kTombstone && memcmp(e->key, key, kKeyLen) == 0) {
+      return e;
+    }
+  }
+  return nullptr;
+}
+
+Entry* FindSlot(Header* hdr, const uint8_t* key) {
+  uint64_t idx = HashKey(key) % kEntryCap;
+  Entry* first_tomb = nullptr;
+  for (uint32_t probe = 0; probe < kEntryCap; probe++) {
+    Entry* e = &hdr->entries[(idx + probe) % kEntryCap];
+    if (e->state == kEmpty) return first_tomb ? first_tomb : e;
+    if (e->state == kTombstone && !first_tomb) first_tomb = e;
+    if (e->state != kTombstone && memcmp(e->key, key, kKeyLen) == 0) {
+      return e;  // existing
+    }
+  }
+  return first_tomb;
+}
+
+// ---- free-extent allocator (array sorted by offset) ----
+
+int64_t AllocFromExtents(Header* hdr, uint64_t size) {
+  for (uint32_t i = 0; i < hdr->num_extents; i++) {
+    Extent* ex = &hdr->extents[i];
+    if (ex->size >= size) {
+      uint64_t off = ex->offset;
+      ex->offset += size;
+      ex->size -= size;
+      if (ex->size == 0) {
+        memmove(ex, ex + 1, (hdr->num_extents - i - 1) * sizeof(Extent));
+        hdr->num_extents--;
+      }
+      return static_cast<int64_t>(off);
+    }
+  }
+  return -1;
+}
+
+void FreeExtent(Header* hdr, uint64_t offset, uint64_t size) {
+  // Insert sorted by offset, then coalesce with neighbors.
+  uint32_t pos = 0;
+  while (pos < hdr->num_extents && hdr->extents[pos].offset < offset) pos++;
+  memmove(&hdr->extents[pos + 1], &hdr->extents[pos],
+          (hdr->num_extents - pos) * sizeof(Extent));
+  hdr->extents[pos] = {offset, size};
+  hdr->num_extents++;
+  // Coalesce right.
+  if (pos + 1 < hdr->num_extents &&
+      hdr->extents[pos].offset + hdr->extents[pos].size ==
+          hdr->extents[pos + 1].offset) {
+    hdr->extents[pos].size += hdr->extents[pos + 1].size;
+    memmove(&hdr->extents[pos + 1], &hdr->extents[pos + 2],
+            (hdr->num_extents - pos - 2) * sizeof(Extent));
+    hdr->num_extents--;
+  }
+  // Coalesce left.
+  if (pos > 0 && hdr->extents[pos - 1].offset + hdr->extents[pos - 1].size ==
+                     hdr->extents[pos].offset) {
+    hdr->extents[pos - 1].size += hdr->extents[pos].size;
+    memmove(&hdr->extents[pos], &hdr->extents[pos + 1],
+            (hdr->num_extents - pos - 1) * sizeof(Extent));
+    hdr->num_extents--;
+  }
+}
+
+void DeleteEntryLocked(Header* hdr, Entry* e) {
+  FreeExtent(hdr, e->offset, e->size);
+  hdr->used_bytes -= e->size;
+  hdr->num_objects--;
+  e->state = kTombstone;
+  e->pin = 0;
+}
+
+// Evict the least-recently-used unpinned sealed object. Returns freed
+// bytes, or 0 if nothing evictable.
+uint64_t EvictOne(Header* hdr) {
+  Entry* victim = nullptr;
+  for (uint32_t i = 0; i < kEntryCap; i++) {
+    Entry* e = &hdr->entries[i];
+    if (e->state == kSealed && e->pin == 0) {
+      if (!victim || e->lru < victim->lru) victim = e;
+    }
+  }
+  if (!victim) return 0;
+  uint64_t freed = victim->size;
+  DeleteEntryLocked(hdr, victim);
+  hdr->num_evicted++;
+  return freed;
+}
+
+}  // namespace
+
+extern "C" {
+
+// Create the arena (head process). Fails if it already exists.
+void* ts_create(const char* name, uint64_t capacity_bytes) {
+  uint64_t total = sizeof(Header) + AlignUp(capacity_bytes);
+  int fd = shm_open(name, O_CREAT | O_EXCL | O_RDWR, 0600);
+  if (fd < 0) return nullptr;
+  if (ftruncate(fd, static_cast<off_t>(total)) != 0) {
+    close(fd);
+    shm_unlink(name);
+    return nullptr;
+  }
+  void* mem =
+      mmap(nullptr, total, PROT_READ | PROT_WRITE, MAP_SHARED, fd, 0);
+  close(fd);
+  if (mem == MAP_FAILED) {
+    shm_unlink(name);
+    return nullptr;
+  }
+  Header* hdr = new (mem) Header();
+  memset(hdr->entries, 0, sizeof(hdr->entries));
+  hdr->total_size = total;
+  hdr->data_offset = AlignUp(sizeof(Header));
+  hdr->data_size = total - hdr->data_offset;
+  hdr->lru_tick = 1;
+  hdr->used_bytes = 0;
+  hdr->num_objects = 0;
+  hdr->num_evicted = 0;
+  hdr->num_extents = 1;
+  hdr->extents[0] = {hdr->data_offset, hdr->data_size};
+  pthread_mutexattr_t attr;
+  pthread_mutexattr_init(&attr);
+  pthread_mutexattr_setpshared(&attr, PTHREAD_PROCESS_SHARED);
+  pthread_mutexattr_setrobust(&attr, PTHREAD_MUTEX_ROBUST);
+  pthread_mutex_init(&hdr->mutex, &attr);
+  pthread_mutexattr_destroy(&attr);
+  hdr->magic = kMagic;  // last: attachers spin on magic
+  Handle* h = new Handle{hdr, total};
+  return h;
+}
+
+// Attach to an existing arena (worker processes).
+void* ts_attach(const char* name) {
+  int fd = shm_open(name, O_RDWR, 0600);
+  if (fd < 0) return nullptr;
+  struct stat st;
+  if (fstat(fd, &st) != 0) {
+    close(fd);
+    return nullptr;
+  }
+  void* mem = mmap(nullptr, static_cast<size_t>(st.st_size),
+                   PROT_READ | PROT_WRITE, MAP_SHARED, fd, 0);
+  close(fd);
+  if (mem == MAP_FAILED) return nullptr;
+  Header* hdr = static_cast<Header*>(mem);
+  if (hdr->magic != kMagic) {
+    munmap(mem, static_cast<size_t>(st.st_size));
+    return nullptr;
+  }
+  Handle* h = new Handle{hdr, static_cast<uint64_t>(st.st_size)};
+  return h;
+}
+
+void ts_detach(void* handle) {
+  Handle* h = static_cast<Handle*>(handle);
+  if (!h) return;
+  munmap(h->hdr, h->map_size);
+  delete h;
+}
+
+int ts_destroy(const char* name) { return shm_unlink(name); }
+
+// Allocate space for an object; evicts LRU unpinned sealed objects as
+// needed. On success writes the data offset to *out_offset.
+int ts_alloc(void* handle, const uint8_t* key, uint64_t size,
+             uint64_t* out_offset) {
+  Handle* h = static_cast<Handle*>(handle);
+  uint64_t need = AlignUp(size);
+  if (need > h->hdr->data_size) return TS_EFULL;
+  Locker lock(h->hdr);
+  Header* hdr = h->hdr;
+  Entry* existing = FindEntry(hdr, key);
+  if (existing) return TS_EEXIST;
+  Entry* slot = FindSlot(hdr, key);
+  if (!slot) return TS_ETABLE;
+  int64_t off = AllocFromExtents(hdr, need);
+  while (off < 0) {
+    if (EvictOne(hdr) == 0) return TS_EFULL;
+    off = AllocFromExtents(hdr, need);
+  }
+  memcpy(slot->key, key, kKeyLen);
+  slot->offset = static_cast<uint64_t>(off);
+  slot->size = need;
+  slot->state = kCreated;
+  slot->pin = 0;
+  slot->lru = hdr->lru_tick++;
+  hdr->used_bytes += need;
+  hdr->num_objects++;
+  *out_offset = slot->offset;
+  return TS_OK;
+}
+
+int ts_seal(void* handle, const uint8_t* key) {
+  Handle* h = static_cast<Handle*>(handle);
+  Locker lock(h->hdr);
+  Entry* e = FindEntry(h->hdr, key);
+  if (!e) return TS_ENOENT;
+  if (e->state != kCreated) return TS_ESTATE;
+  e->state = kSealed;
+  return TS_OK;
+}
+
+// Look up a sealed object; bumps its LRU stamp.
+int ts_lookup(void* handle, const uint8_t* key, uint64_t* out_offset,
+              uint64_t* out_size) {
+  Handle* h = static_cast<Handle*>(handle);
+  Locker lock(h->hdr);
+  Entry* e = FindEntry(h->hdr, key);
+  if (!e || e->state != kSealed) return TS_ENOENT;
+  e->lru = h->hdr->lru_tick++;
+  *out_offset = e->offset;
+  *out_size = e->size;
+  return TS_OK;
+}
+
+int ts_contains(void* handle, const uint8_t* key) {
+  Handle* h = static_cast<Handle*>(handle);
+  Locker lock(h->hdr);
+  Entry* e = FindEntry(h->hdr, key);
+  return (e && e->state == kSealed) ? 1 : 0;
+}
+
+int ts_pin(void* handle, const uint8_t* key) {
+  Handle* h = static_cast<Handle*>(handle);
+  Locker lock(h->hdr);
+  Entry* e = FindEntry(h->hdr, key);
+  if (!e) return TS_ENOENT;
+  e->pin++;
+  return TS_OK;
+}
+
+int ts_unpin(void* handle, const uint8_t* key) {
+  Handle* h = static_cast<Handle*>(handle);
+  Locker lock(h->hdr);
+  Entry* e = FindEntry(h->hdr, key);
+  if (!e) return TS_ENOENT;
+  if (e->pin > 0) e->pin--;
+  return TS_OK;
+}
+
+int ts_delete(void* handle, const uint8_t* key) {
+  Handle* h = static_cast<Handle*>(handle);
+  Locker lock(h->hdr);
+  Entry* e = FindEntry(h->hdr, key);
+  if (!e) return TS_ENOENT;
+  DeleteEntryLocked(h->hdr, e);
+  return TS_OK;
+}
+
+uint8_t* ts_base(void* handle) {
+  return reinterpret_cast<uint8_t*>(static_cast<Handle*>(handle)->hdr);
+}
+
+uint64_t ts_used_bytes(void* handle) {
+  Handle* h = static_cast<Handle*>(handle);
+  Locker lock(h->hdr);
+  return h->hdr->used_bytes;
+}
+
+uint64_t ts_num_objects(void* handle) {
+  Handle* h = static_cast<Handle*>(handle);
+  Locker lock(h->hdr);
+  return h->hdr->num_objects;
+}
+
+uint64_t ts_num_evicted(void* handle) {
+  Handle* h = static_cast<Handle*>(handle);
+  Locker lock(h->hdr);
+  return h->hdr->num_evicted;
+}
+
+uint64_t ts_capacity(void* handle) {
+  return static_cast<Handle*>(handle)->hdr->data_size;
+}
+
+}  // extern "C"

@@ -43,6 +43,9 @@ class Config:
     object_spilling_dir: str = ""
     # Spill to disk when the shm store exceeds this fraction of capacity.
     object_spilling_threshold: float = 0.8
+    # Back large objects with the native C++ arena (cpp/tpustore);
+    # falls back to the python per-segment store if the build fails.
+    use_native_object_store: bool = True
 
     # --- scheduler ---
     # Max worker leases requested in parallel per scheduling key

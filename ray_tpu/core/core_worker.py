@@ -346,21 +346,7 @@ class CoreWorker:
         self.reference_counter.register_owned(object_id, in_shm)
 
     def _seal_to_shm(self, object_id: ObjectID, obj: SerializedObject) -> int:
-        from multiprocessing import shared_memory
-
-        data = ShmStore.pack(obj)
-        try:
-            seg = shared_memory.SharedMemory(
-                name=object_store.segment_name(object_id), create=True,
-                size=max(len(data), 1),
-            )
-        except FileExistsError:
-            return len(data)
-        try:
-            seg.buf[: len(data)] = data
-        finally:
-            seg.close()
-        return len(data)
+        return object_store.node_store_write(object_id, obj)
 
     def _check_not_on_loop(self, api: str):
         if threading.get_ident() == getattr(self, "_loop_thread_ident", None):
@@ -457,7 +443,7 @@ class CoreWorker:
 
     async def _open_shm(self, object_id: ObjectID,
                         timeout: Optional[float]) -> SerializedObject:
-        obj = ShmStore.open_object(object_id)
+        obj = object_store.node_store_open(object_id)
         if obj is not None:
             return obj
         reply = await self.head.call(
@@ -467,7 +453,7 @@ class CoreWorker:
             raise exc.GetTimeoutError(
                 f"shm object {object_id.hex()} not sealed in time"
             )
-        obj = ShmStore.open_object(object_id)
+        obj = object_store.node_store_open(object_id)
         if obj is None:
             raise exc.ObjectLostError(object_id.hex())
         return obj
@@ -871,7 +857,9 @@ class CoreWorker:
             size = len(self._task_event_buf)
         if size >= 100:
             self._flush_task_events()
-        else:
+        elif not self._event_flush_scheduled:
+            # Benignly racy read; avoids a cross-thread loop wakeup per
+            # event when a flush timer is already pending.
             self.loop.call_soon_threadsafe(self._schedule_event_flush)
 
     def _schedule_event_flush(self):

@@ -1,0 +1,251 @@
+"""ctypes bindings for the native shared-memory arena (cpp/tpustore).
+
+The C++ store (cpp/tpustore/store.cc) is the plasma-equivalent data
+plane: a single mmap'd arena per node with a free-extent allocator,
+process-shared locking, and LRU eviction. This module builds the
+library on first use (g++, cached by source hash) and exposes a thin
+Python wrapper; payload parsing shares the flat layout of
+object_store.ShmStore.pack so the two backends are wire-compatible.
+"""
+
+from __future__ import annotations
+
+import ctypes
+import hashlib
+import logging
+import os
+import subprocess
+import threading
+from typing import Optional
+
+logger = logging.getLogger(__name__)
+
+_CPP_DIR = os.path.join(os.path.dirname(os.path.dirname(
+    os.path.dirname(os.path.abspath(__file__)))), "cpp", "tpustore")
+_SRC = os.path.join(_CPP_DIR, "store.cc")
+
+_lib = None
+_lib_lock = threading.Lock()
+_build_failed = False
+
+
+def _build_library() -> Optional[str]:
+    """Compile store.cc into a cached .so keyed by source hash."""
+    if not os.path.exists(_SRC):
+        return None
+    with open(_SRC, "rb") as f:
+        digest = hashlib.sha256(f.read()).hexdigest()[:16]
+    build_dir = os.path.join(_CPP_DIR, "build")
+    os.makedirs(build_dir, exist_ok=True)
+    so_path = os.path.join(build_dir, f"libtpustore_{digest}.so")
+    if os.path.exists(so_path):
+        return so_path
+    tmp = so_path + f".tmp{os.getpid()}"
+    cmd = ["g++", "-O2", "-fPIC", "-shared", "-std=c++17", "-pthread",
+           _SRC, "-o", tmp, "-lrt"]
+    try:
+        subprocess.run(cmd, check=True, capture_output=True, timeout=120)
+        os.replace(tmp, so_path)
+        return so_path
+    except Exception as e:
+        logger.warning("tpustore build failed (%s); falling back to the "
+                       "python shm store", e)
+        return None
+
+
+def get_library():
+    global _lib, _build_failed
+    with _lib_lock:
+        if _lib is not None or _build_failed:
+            return _lib
+        so_path = _build_library()
+        if so_path is None:
+            _build_failed = True
+            return None
+        try:
+            lib = _load_library(so_path)
+        except Exception as e:
+            logger.warning("tpustore load failed (%s); falling back to "
+                           "the python shm store", e)
+            _build_failed = True
+            return None
+        _lib = lib
+        return _lib
+
+
+def _load_library(so_path: str):
+        lib = ctypes.CDLL(so_path)
+        lib.ts_create.restype = ctypes.c_void_p
+        lib.ts_create.argtypes = [ctypes.c_char_p, ctypes.c_uint64]
+        lib.ts_attach.restype = ctypes.c_void_p
+        lib.ts_attach.argtypes = [ctypes.c_char_p]
+        lib.ts_detach.argtypes = [ctypes.c_void_p]
+        lib.ts_destroy.argtypes = [ctypes.c_char_p]
+        lib.ts_alloc.argtypes = [ctypes.c_void_p, ctypes.c_char_p,
+                                 ctypes.c_uint64,
+                                 ctypes.POINTER(ctypes.c_uint64)]
+        lib.ts_seal.argtypes = [ctypes.c_void_p, ctypes.c_char_p]
+        lib.ts_lookup.argtypes = [ctypes.c_void_p, ctypes.c_char_p,
+                                  ctypes.POINTER(ctypes.c_uint64),
+                                  ctypes.POINTER(ctypes.c_uint64)]
+        lib.ts_contains.argtypes = [ctypes.c_void_p, ctypes.c_char_p]
+        lib.ts_pin.argtypes = [ctypes.c_void_p, ctypes.c_char_p]
+        lib.ts_unpin.argtypes = [ctypes.c_void_p, ctypes.c_char_p]
+        lib.ts_delete.argtypes = [ctypes.c_void_p, ctypes.c_char_p]
+        lib.ts_base.restype = ctypes.POINTER(ctypes.c_uint8)
+        lib.ts_base.argtypes = [ctypes.c_void_p]
+        for fn in ("ts_used_bytes", "ts_num_objects", "ts_num_evicted",
+                   "ts_capacity"):
+            getattr(lib, fn).restype = ctypes.c_uint64
+            getattr(lib, fn).argtypes = [ctypes.c_void_p]
+        return lib
+
+
+TS_OK = 0
+TS_EEXIST = -1
+TS_ENOENT = -2
+TS_EFULL = -3
+
+
+class NativeArena:
+    """One node's object arena (create in the head, attach in workers)."""
+
+    def __init__(self, handle, lib, name: str, owner: bool):
+        self._h = handle
+        self._lib = lib
+        self.name = name
+        self._owner = owner
+        self._base_addr = ctypes.cast(
+            lib.ts_base(handle), ctypes.c_void_p).value
+        # Objects this process has handed out zero-copy views of. Each is
+        # pinned once in the arena so LRU eviction can never reuse memory
+        # a live view may alias (the per-segment python store got this for
+        # free from POSIX unlink semantics; an arena does not). The
+        # owner-driven delete path ignores pins — deletion only happens
+        # when the owner has proven no refs remain.
+        self._read_pinned: set = set()
+        self._pin_lock = threading.Lock()
+
+    @classmethod
+    def create(cls, name: str, capacity_bytes: int
+               ) -> Optional["NativeArena"]:
+        lib = get_library()
+        if lib is None:
+            return None
+        h = lib.ts_create(name.encode(), capacity_bytes)
+        if not h:
+            return None
+        return cls(h, lib, name, owner=True)
+
+    @classmethod
+    def attach(cls, name: str) -> Optional["NativeArena"]:
+        lib = get_library()
+        if lib is None:
+            return None
+        h = lib.ts_attach(name.encode())
+        if not h:
+            return None
+        return cls(h, lib, name, owner=False)
+
+    def _view(self, offset: int, size: int) -> memoryview:
+        """Zero-copy view into the arena."""
+        buf = (ctypes.c_uint8 * size).from_address(
+            self._base_addr + offset)
+        return memoryview(buf).cast("B")
+
+    def create_and_seal(self, key20: bytes, data) -> bool:
+        """Returns False if the object already exists (idempotent)."""
+        mv = memoryview(data).cast("B")
+        off = ctypes.c_uint64()
+        rc = self._lib.ts_alloc(self._h, key20, mv.nbytes,
+                                ctypes.byref(off))
+        if rc == TS_EEXIST:
+            return False
+        if rc == TS_EFULL:
+            from ray_tpu.exceptions import ObjectStoreFullError
+
+            raise ObjectStoreFullError(
+                f"object of {mv.nbytes} bytes does not fit in arena "
+                f"({self.used_bytes()}/{self.capacity()} used)")
+        if rc != TS_OK:
+            raise RuntimeError(f"ts_alloc failed: {rc}")
+        self._view(off.value, mv.nbytes)[:] = mv
+        rc = self._lib.ts_seal(self._h, key20)
+        if rc != TS_OK:
+            raise RuntimeError(f"ts_seal failed: {rc}")
+        return True
+
+    def lookup(self, key20: bytes, *, pin_for_read: bool = True
+               ) -> Optional[memoryview]:
+        off = ctypes.c_uint64()
+        size = ctypes.c_uint64()
+        rc = self._lib.ts_lookup(self._h, key20, ctypes.byref(off),
+                                 ctypes.byref(size))
+        if rc != TS_OK:
+            return None
+        if pin_for_read:
+            with self._pin_lock:
+                if key20 not in self._read_pinned:
+                    self._lib.ts_pin(self._h, key20)
+                    self._read_pinned.add(key20)
+        return self._view(off.value, size.value)
+
+    def contains(self, key20: bytes) -> bool:
+        return bool(self._lib.ts_contains(self._h, key20))
+
+    def pin(self, key20: bytes):
+        self._lib.ts_pin(self._h, key20)
+
+    def unpin(self, key20: bytes):
+        self._lib.ts_unpin(self._h, key20)
+
+    def delete(self, key20: bytes):
+        self._lib.ts_delete(self._h, key20)
+        with self._pin_lock:
+            self._read_pinned.discard(key20)
+
+    def used_bytes(self) -> int:
+        return int(self._lib.ts_used_bytes(self._h))
+
+    def num_objects(self) -> int:
+        return int(self._lib.ts_num_objects(self._h))
+
+    def num_evicted(self) -> int:
+        return int(self._lib.ts_num_evicted(self._h))
+
+    def capacity(self) -> int:
+        return int(self._lib.ts_capacity(self._h))
+
+    def destroy(self):
+        if self._h:
+            self._lib.ts_detach(self._h)
+            self._h = None
+        if self._owner:
+            self._lib.ts_destroy(self.name.encode())
+
+
+# -- process-wide attachment (workers) --------------------------------------
+
+_attached: Optional[NativeArena] = None
+_attach_lock = threading.Lock()
+
+
+def get_attached_arena() -> Optional[NativeArena]:
+    """Attach to the node arena named by RAY_TPU_ARENA (set by the head
+    for all spawned workers); None when the native store is disabled."""
+    global _attached
+    if _attached is not None:
+        return _attached
+    name = os.environ.get("RAY_TPU_ARENA")
+    if not name:
+        return None
+    with _attach_lock:
+        if _attached is None:
+            _attached = NativeArena.attach(name)
+        return _attached
+
+
+def set_attached_arena(arena: Optional[NativeArena]):
+    global _attached
+    with _attach_lock:
+        _attached = arena

@@ -64,7 +64,22 @@ class HeadNode:
         capacity = config.object_store_memory or default_capacity(
             config.object_store_memory_proportion
         )
-        self.shm_store = ShmStore(capacity)
+        # Prefer the native C++ arena (cpp/tpustore); fall back to the
+        # per-segment python store if the toolchain is unavailable.
+        self.arena = None
+        self.shm_store = None
+        if config.use_native_object_store:
+            from ray_tpu.core import native_store
+            from ray_tpu.core.object_store import NativeShmStore
+
+            name = f"rtpu_arena_{os.getpid()}_{int(time.time())}"
+            self.arena = native_store.NativeArena.create(name, capacity)
+            if self.arena is not None:
+                os.environ["RAY_TPU_ARENA"] = name
+                native_store.set_attached_arena(self.arena)
+                self.shm_store = NativeShmStore(self.arena)
+        if self.shm_store is None:
+            self.shm_store = ShmStore(capacity)
         self.loop_thread = rpc.EventLoopThread(name="ray-tpu-head")
         self.service = HeadService(config, self.shm_store, self.session_dir)
         self.server: Optional[rpc.Server] = None
@@ -111,6 +126,12 @@ class HeadNode:
         except Exception:
             pass
         self.loop_thread.stop()
+        if self.arena is not None:
+            from ray_tpu.core import native_store
+
+            native_store.set_attached_arena(None)
+            os.environ.pop("RAY_TPU_ARENA", None)
+            self.arena = None
 
 
 def _make_session_dir() -> str:

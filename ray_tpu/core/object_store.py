@@ -257,19 +257,7 @@ class ShmStore:
                 ShmStore._open_segments.pop(name, None)
             _close_or_neuter(seg)
             return None
-        hlen = int.from_bytes(buf[4:8], "little")
-        header = msgpack.unpackb(bytes(buf[8:8 + hlen]))
-        offset = _aligned(8 + hlen)
-        inband = bytes(buf[offset:offset + header["inband_len"]])
-        offset = _aligned(offset) + header["inband_len"]
-        buffers = []
-        for blen in header["buffer_lens"]:
-            start = _aligned(offset)
-            buffers.append(buf[start:start + blen])
-            offset = start + blen
-        return SerializedObject(
-            metadata=header["metadata"], inband=inband, buffers=buffers
-        )
+        return parse_packed(buf)
 
     # ---- lifetime management (authoritative instance) ----
 
@@ -322,6 +310,112 @@ class ShmStore:
             self._used = 0
         for hex_id in hex_ids:
             _unlink_segment(hex_id)
+
+
+def parse_packed(buf) -> Optional[SerializedObject]:
+    """Parse the flat packed layout (ShmStore.pack) from any buffer —
+    an shm segment or a native-arena view — keeping payload buffers
+    zero-copy."""
+    if bytes(buf[:4]) != ShmStore.HEADER_MAGIC:
+        return None
+    hlen = int.from_bytes(buf[4:8], "little")
+    header = msgpack.unpackb(bytes(buf[8:8 + hlen]))
+    offset = _aligned(8 + hlen)
+    inband = bytes(buf[offset:offset + header["inband_len"]])
+    offset = _aligned(offset) + header["inband_len"]
+    buffers = []
+    for blen in header["buffer_lens"]:
+        start = _aligned(offset)
+        buffers.append(buf[start:start + blen])
+        offset = start + blen
+    return SerializedObject(
+        metadata=header["metadata"], inband=inband, buffers=buffers
+    )
+
+
+class NativeShmStore:
+    """Head-side bookkeeping over the native C++ arena — the same
+    authoritative interface as ShmStore, with allocation/LRU/eviction
+    delegated to cpp/tpustore (which is shared by every process on the
+    node, so worker writes hit the same accounting)."""
+
+    def __init__(self, arena):
+        self.arena = arena
+        self.capacity = arena.capacity()
+
+    def create_and_seal(self, object_id: ObjectID,
+                        obj: SerializedObject) -> int:
+        data = ShmStore.pack(obj)
+        self.arena.create_and_seal(object_id.binary(), data)
+        return len(data)
+
+    def mark_sealed(self, object_id: ObjectID, size: int):
+        # The arena is authoritative; the seal already happened in the
+        # producing process.
+        pass
+
+    def open_object(self, object_id: ObjectID) -> Optional[SerializedObject]:
+        view = self.arena.lookup(object_id.binary())
+        if view is None:
+            return None
+        return parse_packed(view)
+
+    def contains(self, object_id: ObjectID) -> bool:
+        return self.arena.contains(object_id.binary())
+
+    def pin(self, object_id: ObjectID):
+        self.arena.pin(object_id.binary())
+
+    def unpin(self, object_id: ObjectID):
+        self.arena.unpin(object_id.binary())
+
+    def delete(self, object_id: ObjectID):
+        self.arena.delete(object_id.binary())
+
+    def used_bytes(self) -> int:
+        return self.arena.used_bytes()
+
+    def num_objects(self) -> int:
+        return self.arena.num_objects()
+
+    def cleanup(self):
+        self.arena.destroy()
+
+
+def node_store_write(object_id: ObjectID, obj: SerializedObject) -> int:
+    """Worker-side write of a large object to the node store (native
+    arena when enabled, else a per-object shm segment)."""
+    from ray_tpu.core import native_store
+
+    arena = native_store.get_attached_arena()
+    data = ShmStore.pack(obj)
+    if arena is not None:
+        arena.create_and_seal(object_id.binary(), data)
+        return len(data)
+    try:
+        seg = shared_memory.SharedMemory(
+            name=segment_name(object_id), create=True,
+            size=max(len(data), 1))
+    except FileExistsError:
+        return len(data)
+    try:
+        seg.buf[:len(data)] = data
+    finally:
+        seg.close()
+    return len(data)
+
+
+def node_store_open(object_id: ObjectID) -> Optional[SerializedObject]:
+    """Worker-side zero-copy read from the node store."""
+    from ray_tpu.core import native_store
+
+    arena = native_store.get_attached_arena()
+    if arena is not None:
+        view = arena.lookup(object_id.binary())
+        if view is not None:
+            return parse_packed(view)
+        return None
+    return ShmStore.open_object(object_id)
 
 
 def _unlink_segment(hex_id: str):

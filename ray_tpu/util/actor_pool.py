@@ -38,9 +38,15 @@ class ActorPool:
             if not self._pending_submits:
                 raise StopIteration("no pending results")
             self._drain_one()
-        future = self._index_to_future.pop(self._next_return_index)
+        future = self._index_to_future[self._next_return_index]
+        # Wait BEFORE mutating any pool state: a timeout must leave the
+        # result fetchable and the actor accounted for.
+        ready, _ = ray_tpu.wait([future], num_returns=1, timeout=timeout)
+        if not ready:
+            raise TimeoutError("timed out waiting for result")
+        del self._index_to_future[self._next_return_index]
         self._next_return_index += 1
-        value = ray_tpu.get(future, timeout=timeout)
+        value = ray_tpu.get(future)
         self._return_actor(future)
         return value
 
@@ -62,15 +68,14 @@ class ActorPool:
         return value
 
     def _drain_one(self):
-        # No idle actors by definition here; wait for any completion.
+        # No idle actors by definition here; wait for any completion and
+        # free that actor for the pending-submit queue (the completed
+        # result stays fetchable in _index_to_future).
         ready, _ = ray_tpu.wait(list(self._future_to_actor),
                                 num_returns=1, timeout=None)
-        fut = ready[0]
-        idx, _actor = self._future_to_actor[fut]
-        # Leave the result fetchable; just free the actor for the queue.
-        self._return_actor(fut, drop_result=False)
+        self._return_actor(ready[0])
 
-    def _return_actor(self, future, drop_result: bool = True):
+    def _return_actor(self, future):
         entry = self._future_to_actor.pop(future, None)
         if entry is None:
             return

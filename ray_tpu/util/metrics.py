@@ -33,8 +33,10 @@ class Metric:
         self.description = description
         self.tag_keys = tuple(tag_keys or ())
         self._default_tags: Dict[str, str] = {}
-        # frozen tag tuple -> value(s)
+        # frozen tag tuple -> value(s); guarded by _mutex (recorded from
+        # executor threads, snapshotted by whichever thread pushes).
         self._values: Dict[Tuple[Tuple[str, str], ...], float] = {}
+        self._mutex = threading.Lock()
         with _registry_lock:
             _registry[name] = self
 
@@ -53,10 +55,12 @@ class Metric:
         return tuple(sorted(merged.items()))
 
     def _snapshot(self) -> dict:
+        with self._mutex:
+            values = [[list(k), v] for k, v in self._values.items()]
         return {
             "type": self.metric_type,
             "description": self.description,
-            "values": [[list(k), v] for k, v in self._values.items()],
+            "values": values,
         }
 
 
@@ -68,7 +72,8 @@ class Counter(Metric):
         if value < 0:
             raise ValueError("counters only increase")
         key = self._tag_key(tags)
-        self._values[key] = self._values.get(key, 0.0) + value
+        with self._mutex:
+            self._values[key] = self._values.get(key, 0.0) + value
         _maybe_push()
 
 
@@ -76,7 +81,9 @@ class Gauge(Metric):
     metric_type = "gauge"
 
     def set(self, value: float, tags: Optional[Dict[str, str]] = None):
-        self._values[self._tag_key(tags)] = float(value)
+        key = self._tag_key(tags)
+        with self._mutex:
+            self._values[key] = float(value)
         _maybe_push()
 
 
@@ -98,21 +105,25 @@ class Histogram(Metric):
     def observe(self, value: float,
                 tags: Optional[Dict[str, str]] = None):
         key = self._tag_key(tags)
-        h = self._hists.get(key)
-        if h is None:
-            h = self._hists[key] = [0] * (len(self.boundaries) + 1) + [0.0, 0]
-        idx = bisect.bisect_left(self.boundaries, value)
-        h[idx] += 1
-        h[-2] += value
-        h[-1] += 1
+        with self._mutex:
+            h = self._hists.get(key)
+            if h is None:
+                h = self._hists[key] = (
+                    [0] * (len(self.boundaries) + 1) + [0.0, 0])
+            idx = bisect.bisect_left(self.boundaries, value)
+            h[idx] += 1
+            h[-2] += value
+            h[-1] += 1
         _maybe_push()
 
     def _snapshot(self) -> dict:
+        with self._mutex:
+            hists = [[list(k), list(v)] for k, v in self._hists.items()]
         return {
             "type": self.metric_type,
             "description": self.description,
             "boundaries": self.boundaries,
-            "hists": [[list(k), v] for k, v in self._hists.items()],
+            "hists": hists,
         }
 
 
