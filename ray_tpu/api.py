@@ -252,6 +252,12 @@ class RemoteFunction:
         merged.update(opts)
         return RemoteFunction(self._fn, merged)
 
+    def bind(self, *args, **kwargs):
+        """Build a lazy DAG node (reference: ray.dag fn.bind)."""
+        from ray_tpu.dag import FunctionNode
+
+        return FunctionNode(self, args, kwargs)
+
     def remote(self, *args, **kwargs) -> Union[ObjectRef, List[ObjectRef]]:
         cw = _require_worker()
         opts = self._options

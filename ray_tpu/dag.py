@@ -1,0 +1,145 @@
+"""DAG nodes: lazy task graphs built with .bind().
+
+Reference: python/ray/dag/ (dag_node.py, function_node.py,
+input_node.py) — ``fn.bind(*args)`` records a node instead of
+executing; ``node.execute()`` walks the graph submitting tasks whose
+arguments are upstream ObjectRefs, so the whole DAG runs without
+materializing intermediates on the driver. This is also the workflow
+library's substrate (per-step durable execution). Nodes nested inside
+lists/tuples/dicts are found and resolved like top-level arguments.
+"""
+
+from __future__ import annotations
+
+import itertools
+from typing import Any, Callable, Dict, List
+
+_node_counter = itertools.count()
+
+
+def map_structure(fn: Callable[[Any], Any], value: Any) -> Any:
+    """Apply fn to DAGNodes anywhere inside lists/tuples/dicts."""
+    if isinstance(value, DAGNode):
+        return fn(value)
+    if isinstance(value, list):
+        return [map_structure(fn, v) for v in value]
+    if isinstance(value, tuple):
+        return tuple(map_structure(fn, v) for v in value)
+    if isinstance(value, dict):
+        return {k: map_structure(fn, v) for k, v in value.items()}
+    return value
+
+
+def find_nodes(value: Any, out: List["DAGNode"]) -> None:
+    if isinstance(value, DAGNode):
+        out.append(value)
+    elif isinstance(value, (list, tuple)):
+        for v in value:
+            find_nodes(v, out)
+    elif isinstance(value, dict):
+        for v in value.values():
+            find_nodes(v, out)
+
+
+class DAGNode:
+    def execute(self, *input_args, **input_kwargs):
+        return _ExecutionState(input_args, input_kwargs).submit(self)
+
+    def _children(self) -> List["DAGNode"]:
+        out: List[DAGNode] = []
+        for a in list(self.args) + list(self.kwargs.values()):
+            find_nodes(a, out)
+        return out
+
+    def topo_order(self) -> List["DAGNode"]:
+        """Deterministic post-order (children before parents)."""
+        seen = set()
+        order: List[DAGNode] = []
+
+        def visit(node: DAGNode):
+            if id(node) in seen:
+                return
+            seen.add(id(node))
+            for c in node._children():
+                visit(c)
+            order.append(node)
+
+        visit(self)
+        return order
+
+
+class InputNode(DAGNode):
+    """Placeholder for the value passed to execute() (reference:
+    input_node.py). Supports a single positional input."""
+
+    def __init__(self):
+        self.args = ()
+        self.kwargs = {}
+        self.index = next(_node_counter)
+
+    def __repr__(self):
+        return "InputNode()"
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        return False
+
+
+class FunctionNode(DAGNode):
+    def __init__(self, remote_fn, args: tuple, kwargs: dict):
+        self.remote_fn = remote_fn
+        self.args = args
+        self.kwargs = kwargs
+        self.index = next(_node_counter)
+
+    @property
+    def name(self) -> str:
+        fn = getattr(self.remote_fn, "_fn", None)
+        return getattr(fn, "__name__", "fn")
+
+    def __repr__(self):
+        return f"FunctionNode({self.name})"
+
+
+class _ExecutionState:
+    def __init__(self, input_args: tuple, input_kwargs: dict):
+        if input_kwargs:
+            raise TypeError(
+                "execute() takes a single positional input; keyword "
+                "inputs are not supported")
+        self.input_args = input_args
+        self.results: Dict[int, Any] = {}
+
+    def _resolve_node(self, node: "DAGNode", materialize: bool):
+        if isinstance(node, InputNode):
+            if not self.input_args:
+                raise ValueError(
+                    "DAG contains an InputNode but execute() was called "
+                    "without an input")
+            return self.input_args[0]
+        ref = self.results[id(node)]
+        if materialize:
+            # Refs nested inside containers are not dereferenced by the
+            # worker (matching top-level-only arg resolution), so nested
+            # node results must be materialized here.
+            import ray_tpu
+
+            return ray_tpu.get(ref)
+        return ref
+
+    def resolve(self, value):
+        if isinstance(value, DAGNode):
+            return self._resolve_node(value, materialize=False)
+        return map_structure(
+            lambda n: self._resolve_node(n, materialize=True), value)
+
+    def submit(self, root: DAGNode):
+        for node in root.topo_order():
+            if isinstance(node, InputNode):
+                continue
+            args = tuple(self.resolve(a) for a in node.args)
+            kwargs = {k: self.resolve(v) for k, v in node.kwargs.items()}
+            self.results[id(node)] = node.remote_fn.remote(*args, **kwargs)
+        return self.results[id(root)]

@@ -1,0 +1,29 @@
+"""ray_tpu.workflow — durable DAG execution.
+
+Reference capability: python/ray/workflow (workflow.run, per-step
+checkpoints in workflow_storage.py, replay recovery in
+workflow_state_from_storage.py). A workflow is a DAG (ray_tpu.dag
+nodes); each step's result is checkpointed to storage as it completes,
+and resume replays the DAG with completed steps served from storage —
+so a crashed workflow continues from its last finished step.
+"""
+
+from ray_tpu.workflow.api import (
+    delete,
+    get_output,
+    get_status,
+    list_all,
+    resume,
+    run,
+    run_async,
+)
+
+__all__ = [
+    "delete",
+    "get_output",
+    "get_status",
+    "list_all",
+    "resume",
+    "run",
+    "run_async",
+]

@@ -1,0 +1,168 @@
+"""workflow.run / resume / status — the public workflow API.
+
+Reference: python/ray/workflow/api.py + workflow_executor.py. Execution
+is a ready-set scheduler over the DAG: independent branches run
+concurrently as remote tasks, each step's result is checkpointed the
+moment it completes and always before any dependent starts, and on
+resume completed steps are served from storage (replay recovery).
+"""
+
+from __future__ import annotations
+
+import hashlib
+import uuid
+from typing import Any, Dict, List, Optional
+
+import ray_tpu
+from ray_tpu.core import serialization as _ser
+from ray_tpu.dag import DAGNode, FunctionNode, InputNode, map_structure
+from ray_tpu.workflow.storage import WorkflowStorage, list_workflow_ids
+
+
+def _step_keys(root: DAGNode) -> Dict[int, str]:
+    """Deterministic step keys: topo position + function name. Stable
+    across resume because topo_order is a deterministic DFS of the same
+    pickled DAG."""
+    keys = {}
+    for pos, node in enumerate(root.topo_order()):
+        if isinstance(node, FunctionNode):
+            keys[id(node)] = f"{pos:04d}_{node.name}"
+    return keys
+
+
+def _dag_fingerprint(dag: DAGNode) -> str:
+    return hashlib.sha256(_ser.dumps_control(
+        [(k, ) for k in sorted(_step_keys(dag).values())]
+    )).hexdigest()[:16]
+
+
+def _execute_workflow(root: DAGNode, storage: WorkflowStorage) -> Any:
+    keys = _step_keys(root)
+    results: Dict[int, Any] = {}
+
+    def resolve_node(node: DAGNode):
+        if isinstance(node, InputNode):
+            raise ValueError("workflows take no runtime inputs; bind "
+                             "constants into the DAG")
+        return results[id(node)]
+
+    storage.set_status("RUNNING")
+    try:
+        nodes = [n for n in root.topo_order()
+                 if isinstance(n, FunctionNode)]
+        remaining = {id(n): n for n in nodes}
+        deps = {id(n): {id(c) for c in n._children()
+                        if isinstance(c, FunctionNode)}
+                for n in nodes}
+        # Serve already-checkpointed steps from storage.
+        for n in nodes:
+            if storage.has_step(keys[id(n)]):
+                results[id(n)] = storage.load_step(keys[id(n)])
+                remaining.pop(id(n), None)
+        inflight: Dict[Any, int] = {}  # ref -> node id
+        while remaining or inflight:
+            ready = [n for nid, n in remaining.items()
+                     if deps[nid] <= results.keys() and not any(
+                         ref_nid == nid for ref_nid in inflight.values())]
+            for n in ready:
+                args = tuple(map_structure(resolve_node, a)
+                             for a in n.args)
+                kwargs = {k: map_structure(resolve_node, v)
+                          for k, v in n.kwargs.items()}
+                inflight[n.remote_fn.remote(*args, **kwargs)] = id(n)
+            if not inflight:
+                raise RuntimeError("workflow deadlock (cyclic DAG?)")
+            done, _ = ray_tpu.wait(list(inflight), num_returns=1,
+                                   timeout=None)
+            ref = done[0]
+            nid = inflight.pop(ref)
+            value = ray_tpu.get(ref)
+            # Checkpoint BEFORE any dependent can start: the durability
+            # contract is that a step never re-executes once recorded.
+            storage.save_step(keys[nid], value)
+            results[nid] = value
+            remaining.pop(nid, None)
+        output = results[id(root)]
+        storage.save_output(output)
+        storage.set_status("SUCCESSFUL")
+        return output
+    except BaseException as e:
+        storage.set_status("FAILED", error=str(e))
+        raise
+
+
+def run(dag: DAGNode, *, workflow_id: Optional[str] = None,
+        storage_dir: Optional[str] = None) -> Any:
+    """Run a workflow to completion, checkpointing each step."""
+    if not isinstance(dag, DAGNode):
+        raise TypeError("workflow.run takes a DAG (use fn.bind(...))")
+    workflow_id = workflow_id or f"workflow_{uuid.uuid4().hex[:12]}"
+    storage = WorkflowStorage(workflow_id, storage_dir, create=True)
+    fingerprint = _dag_fingerprint(dag)
+    recorded = storage.get_status().get("fingerprint")
+    if recorded is not None and recorded != fingerprint:
+        raise ValueError(
+            f"workflow id {workflow_id!r} was already used for a "
+            f"different DAG; delete it or pick a new id")
+    if storage.has_output():
+        return storage.load_output()  # idempotent re-run, same DAG
+    storage.save_dag(dag)
+    storage.set_status("PENDING", fingerprint=fingerprint)
+    return _execute_workflow(dag, storage)
+
+
+def run_async(dag: DAGNode, *, workflow_id: Optional[str] = None,
+              storage_dir: Optional[str] = None):
+    """Run a workflow in a detached driver task; returns (workflow_id,
+    ObjectRef of the output)."""
+    workflow_id = workflow_id or f"workflow_{uuid.uuid4().hex[:12]}"
+    storage = WorkflowStorage(workflow_id, storage_dir, create=True)
+    storage.save_dag(dag)
+    storage.set_status("PENDING", fingerprint=_dag_fingerprint(dag))
+
+    @ray_tpu.remote
+    def _driver(wf_id: str, sdir):
+        from ray_tpu.workflow.api import resume
+
+        return resume(wf_id, storage_dir=sdir)
+
+    return workflow_id, _driver.options(num_cpus=0.1).remote(
+        workflow_id, storage_dir)
+
+
+def resume(workflow_id: str, *, storage_dir: Optional[str] = None) -> Any:
+    """Resume an interrupted workflow: completed steps replay from
+    storage, the rest execute."""
+    storage = WorkflowStorage(workflow_id, storage_dir)
+    if not storage.exists():
+        raise ValueError(f"no workflow {workflow_id!r}")
+    if storage.has_output():
+        return storage.load_output()
+    dag = storage.load_dag()
+    return _execute_workflow(dag, storage)
+
+
+def get_status(workflow_id: str, *,
+               storage_dir: Optional[str] = None) -> str:
+    return WorkflowStorage(workflow_id, storage_dir).get_status()["status"]
+
+
+def get_output(workflow_id: str, *,
+               storage_dir: Optional[str] = None) -> Any:
+    storage = WorkflowStorage(workflow_id, storage_dir)
+    if not storage.has_output():
+        raise ValueError(f"workflow {workflow_id} has no output "
+                         f"(status={storage.get_status()['status']})")
+    return storage.load_output()
+
+
+def list_all(storage_dir: Optional[str] = None) -> List[tuple]:
+    out = []
+    for wf_id in list_workflow_ids(storage_dir):
+        status = WorkflowStorage(wf_id, storage_dir).get_status()
+        out.append((wf_id, status["status"]))
+    return out
+
+
+def delete(workflow_id: str, *, storage_dir: Optional[str] = None):
+    WorkflowStorage(workflow_id, storage_dir).delete()

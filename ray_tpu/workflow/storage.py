@@ -1,0 +1,112 @@
+"""Workflow storage: durable step results + workflow metadata.
+
+Reference: python/ray/workflow/workflow_storage.py — filesystem layout
+per workflow id: the pickled DAG, per-step results, and a status file.
+Writes are atomic (tmp + rename) so a crash mid-write never leaves a
+corrupt checkpoint.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import pickle
+import shutil
+import time
+from typing import Any, List, Optional
+
+from ray_tpu.core import serialization as _ser
+
+
+def default_storage_dir() -> str:
+    return os.environ.get(
+        "RAY_TPU_WORKFLOW_STORAGE",
+        os.path.expanduser("~/ray_tpu_workflows"))
+
+
+class WorkflowStorage:
+    def __init__(self, workflow_id: str,
+                 storage_dir: Optional[str] = None, *,
+                 create: bool = False):
+        self.workflow_id = workflow_id
+        self.root = os.path.join(storage_dir or default_storage_dir(),
+                                 workflow_id)
+        self.steps_dir = os.path.join(self.root, "steps")
+        if create:
+            os.makedirs(self.steps_dir, exist_ok=True)
+
+    def exists(self) -> bool:
+        return os.path.isdir(self.root)
+
+    def _atomic_write(self, path: str, data: bytes):
+        tmp = path + f".tmp{os.getpid()}"
+        with open(tmp, "wb") as f:
+            f.write(data)
+        os.replace(tmp, path)
+
+    # -- DAG -----------------------------------------------------------
+    def save_dag(self, dag) -> None:
+        self._atomic_write(os.path.join(self.root, "dag.pkl"),
+                           _ser.dumps_control(dag))
+
+    def load_dag(self):
+        with open(os.path.join(self.root, "dag.pkl"), "rb") as f:
+            return _ser.loads_control(f.read())
+
+    # -- steps ---------------------------------------------------------
+    def _step_path(self, step_key: str) -> str:
+        return os.path.join(self.steps_dir, f"{step_key}.pkl")
+
+    def has_step(self, step_key: str) -> bool:
+        return os.path.exists(self._step_path(step_key))
+
+    def save_step(self, step_key: str, result: Any) -> None:
+        self._atomic_write(self._step_path(step_key),
+                           pickle.dumps(result))
+
+    def load_step(self, step_key: str) -> Any:
+        with open(self._step_path(step_key), "rb") as f:
+            return pickle.load(f)
+
+    # -- status --------------------------------------------------------
+    def set_status(self, status: str, error: Optional[str] = None,
+                   fingerprint: Optional[str] = None):
+        payload = self.get_status()
+        if payload.get("status") == "NOT_FOUND":
+            payload = {}
+        payload.update({"status": status, "error": error,
+                        "ts": time.time()})
+        if fingerprint is not None:
+            payload["fingerprint"] = fingerprint
+        self._atomic_write(os.path.join(self.root, "status.json"),
+                           json.dumps(payload).encode())
+
+    def get_status(self) -> dict:
+        try:
+            with open(os.path.join(self.root, "status.json")) as f:
+                return json.load(f)
+        except FileNotFoundError:
+            return {"status": "NOT_FOUND"}
+
+    def save_output(self, value: Any):
+        self._atomic_write(os.path.join(self.root, "output.pkl"),
+                           pickle.dumps(value))
+
+    def load_output(self) -> Any:
+        with open(os.path.join(self.root, "output.pkl"), "rb") as f:
+            return pickle.load(f)
+
+    def has_output(self) -> bool:
+        return os.path.exists(os.path.join(self.root, "output.pkl"))
+
+    def delete(self):
+        shutil.rmtree(self.root, ignore_errors=True)
+
+
+def list_workflow_ids(storage_dir: Optional[str] = None) -> List[str]:
+    root = storage_dir or default_storage_dir()
+    if not os.path.isdir(root):
+        return []
+    return sorted(
+        d for d in os.listdir(root)
+        if os.path.isdir(os.path.join(root, d)))

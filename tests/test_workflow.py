@@ -1,0 +1,146 @@
+"""Tests for ray_tpu.workflow + ray_tpu.dag (reference strategy:
+python/ray/workflow/tests/test_basic_workflows.py, test_recovery.py)."""
+
+import os
+
+import pytest
+
+import ray_tpu
+from ray_tpu import workflow
+
+
+@pytest.fixture(scope="module")
+def wf_cluster():
+    ray_tpu.init(num_cpus=4, num_tpus=0)
+    yield
+    ray_tpu.shutdown()
+
+
+@ray_tpu.remote
+def add(a, b):
+    return a + b
+
+
+@ray_tpu.remote
+def mul(a, b):
+    return a * b
+
+
+def test_dag_bind_execute(wf_cluster):
+    dag = add.bind(mul.bind(2, 3), add.bind(1, 1))
+    ref = dag.execute()
+    assert ray_tpu.get(ref, timeout=60) == 8
+
+
+def test_workflow_run(wf_cluster, tmp_path):
+    dag = mul.bind(add.bind(2, 3), 10)
+    out = workflow.run(dag, workflow_id="wf1",
+                       storage_dir=str(tmp_path))
+    assert out == 50
+    assert workflow.get_status("wf1", storage_dir=str(tmp_path)) == \
+        "SUCCESSFUL"
+    assert workflow.get_output("wf1", storage_dir=str(tmp_path)) == 50
+    assert ("wf1", "SUCCESSFUL") in workflow.list_all(str(tmp_path))
+    # Idempotent: re-running returns the recorded output.
+    assert workflow.run(dag, workflow_id="wf1",
+                        storage_dir=str(tmp_path)) == 50
+
+
+_marker_path = None
+
+
+@ray_tpu.remote
+def count_calls(x, marker):
+    # Append one line per execution so the test can count replays.
+    with open(marker, "a") as f:
+        f.write("x\n")
+    return x + 1
+
+
+@ray_tpu.remote
+def fail_once(x, marker):
+    if not os.path.exists(marker + ".attempted"):
+        open(marker + ".attempted", "w").close()
+        raise RuntimeError("transient failure")
+    return x * 100
+
+
+def test_workflow_resume_skips_completed_steps(wf_cluster, tmp_path):
+    marker = str(tmp_path / "calls.txt")
+    dag = fail_once.bind(
+        count_calls.bind(1, marker), str(tmp_path / "f"))
+    with pytest.raises(Exception):
+        workflow.run(dag, workflow_id="wf_resume",
+                     storage_dir=str(tmp_path))
+    assert workflow.get_status(
+        "wf_resume", storage_dir=str(tmp_path)) == "FAILED"
+    # First step ran exactly once and was checkpointed.
+    assert open(marker).read().count("x") == 1
+    out = workflow.resume("wf_resume", storage_dir=str(tmp_path))
+    assert out == 200
+    # The completed step was NOT re-executed on resume.
+    assert open(marker).read().count("x") == 1
+    assert workflow.get_status(
+        "wf_resume", storage_dir=str(tmp_path)) == "SUCCESSFUL"
+
+
+def test_workflow_run_async(wf_cluster, tmp_path):
+    dag = add.bind(20, 22)
+    wf_id, ref = workflow.run_async(dag, storage_dir=str(tmp_path))
+    assert ray_tpu.get(ref, timeout=120) == 42
+    assert workflow.get_output(wf_id, storage_dir=str(tmp_path)) == 42
+
+
+def test_workflow_delete(wf_cluster, tmp_path):
+    workflow.run(add.bind(1, 2), workflow_id="wf_del",
+                 storage_dir=str(tmp_path))
+    workflow.delete("wf_del", storage_dir=str(tmp_path))
+    assert workflow.get_status(
+        "wf_del", storage_dir=str(tmp_path)) == "NOT_FOUND"
+
+
+@ray_tpu.remote
+def total(xs):
+    return sum(xs)
+
+
+def test_nested_container_args(wf_cluster, tmp_path):
+    dag = total.bind([add.bind(1, 2), mul.bind(2, 2), 5])
+    assert ray_tpu.get(dag.execute(), timeout=60) == 12
+    out = workflow.run(dag, workflow_id="wf_nested",
+                       storage_dir=str(tmp_path))
+    assert out == 12
+
+
+def test_input_node(wf_cluster):
+    from ray_tpu.dag import InputNode
+
+    with InputNode() as inp:
+        dag = add.bind(inp, 10)
+    assert ray_tpu.get(dag.execute(7), timeout=60) == 17
+    with pytest.raises(ValueError, match="without an input"):
+        dag.execute()
+
+
+def test_workflow_id_reuse_different_dag_raises(wf_cluster, tmp_path):
+    workflow.run(add.bind(1, 2), workflow_id="wf_reuse",
+                 storage_dir=str(tmp_path))
+    with pytest.raises(ValueError, match="different DAG"):
+        workflow.run(mul.bind(add.bind(1, 1), 3), workflow_id="wf_reuse",
+                     storage_dir=str(tmp_path))
+
+
+def test_readonly_status_does_not_create_dirs(wf_cluster, tmp_path):
+    assert workflow.get_status("nope", storage_dir=str(tmp_path)) == \
+        "NOT_FOUND"
+    assert workflow.list_all(str(tmp_path)) == []
+
+
+def test_diamond_dag_shared_node_runs_once(wf_cluster, tmp_path):
+    marker = str(tmp_path / "shared.txt")
+    shared = count_calls.bind(5, marker)
+    dag = add.bind(mul.bind(shared, 2), mul.bind(shared, 3))
+    out = workflow.run(dag, workflow_id="wf_diamond",
+                       storage_dir=str(tmp_path))
+    assert out == 6 * 2 + 6 * 3
+    assert open(marker).read().count("x") == 1  # shared step ran once
