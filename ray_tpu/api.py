@@ -15,6 +15,8 @@ from __future__ import annotations
 import functools
 import inspect
 import logging
+import os
+import tempfile
 import threading
 from typing import Any, Dict, List, Optional, Sequence, Union
 
@@ -54,14 +56,22 @@ def _require_worker() -> CoreWorker:
     return cw
 
 
-def init(num_cpus: Optional[float] = None, num_tpus: Optional[float] = None,
+ADDRESS_FILE = os.path.join(tempfile.gettempdir(), "ray_tpu",
+                            "ray_current_cluster")
+
+
+def init(address: Optional[str] = None,
+         num_cpus: Optional[float] = None, num_tpus: Optional[float] = None,
          resources: Optional[Dict[str, float]] = None,
          object_store_memory: Optional[int] = None,
          system_config: Optional[dict] = None,
          namespace: str = "",
          logging_level: int = logging.INFO,
          ignore_reinit_error: bool = False) -> "RuntimeContext":
-    """Start the runtime (head node + driver core worker)."""
+    """Start the runtime (head node + driver core worker), or attach to
+    a running cluster with ``address="host:port"`` / ``address="auto"``
+    (reference: ray.init address semantics; discovery through the
+    current-cluster file like /tmp/ray/ray_current_cluster)."""
     global _global_node, _global_worker
     with _init_lock:
         if is_initialized():
@@ -74,12 +84,98 @@ def init(num_cpus: Optional[float] = None, num_tpus: Optional[float] = None,
         if object_store_memory:
             config.object_store_memory = object_store_memory
 
+        if address is not None:
+            if address == "auto":
+                address = _read_cluster_address()
+            worker = _connect_remote_driver(address, config, namespace)
+            _global_worker = worker
+            return get_runtime_context()
+
         node_resources = detect_node_resources(num_cpus, num_tpus, resources)
         node = HeadNode(config, node_resources)
         worker = _connect_driver(node, config, namespace)
         _global_node = node
         _global_worker = worker
+        _write_cluster_address(f"127.0.0.1:{node.port}")
         return get_runtime_context()
+
+
+def _read_cluster_address() -> str:
+    env = os.environ.get("RAY_TPU_ADDRESS")
+    if env:
+        return env
+    try:
+        with open(ADDRESS_FILE) as f:
+            return f.read().strip()
+    except FileNotFoundError:
+        raise ConnectionError(
+            "address='auto' but no running cluster found (no "
+            f"{ADDRESS_FILE}); start one with ray_tpu.init() or "
+            "`ray-tpu start --head`")
+
+
+def _write_cluster_address(addr: str):
+    try:
+        os.makedirs(os.path.dirname(ADDRESS_FILE), exist_ok=True)
+        with open(ADDRESS_FILE, "w") as f:
+            f.write(addr)
+    except OSError:
+        pass
+
+
+def _clear_cluster_address():
+    try:
+        os.remove(ADDRESS_FILE)
+    except OSError:
+        pass
+
+
+def _connect_remote_driver(address: str, config: Config, namespace: str
+                           ) -> CoreWorker:
+    """Attach to a head in another process over the RPC transport."""
+    host, port_s = address.rsplit(":", 1)
+    from ray_tpu.core.rpc import EventLoopThread
+
+    loop_thread = EventLoopThread(name="ray-tpu-driver")
+    worker_id = WorkerID.from_random()
+    cw = CoreWorker(
+        config=config,
+        loop_thread=loop_thread,
+        head=None,
+        job_id=JobID.from_int(0),
+        worker_id=worker_id,
+        mode="driver",
+    )
+    cw.namespace = namespace
+
+    async def boot():
+        await cw.start_server()
+        conn = await rpc.connect(host, int(port_s), cw.handlers(),
+                                 name="driver-head")
+        cw.head = HeadClient(conn=conn)
+        return await cw.head.call("register_driver", {
+            "host": cw.host, "port": cw.port,
+            "worker_id": worker_id.hex(),
+        })
+
+    try:
+        reply = loop_thread.run(boot(), timeout=30)
+    except BaseException:
+        # Connection failed: tear down the loop thread and the bound
+        # server socket so retries don't leak threads/ports.
+        try:
+            loop_thread.run(cw.stop(), timeout=5)
+        except Exception:
+            pass
+        loop_thread.stop()
+        raise
+    cw.job_id = JobID.from_hex(reply["job_id"])
+    from ray_tpu.core.ids import TaskID
+
+    cw._root_task_id = TaskID.for_normal_task(cw.job_id)
+    cw._attached_loop_thread = loop_thread
+    object_ref_mod.set_core_worker(cw)
+    return cw
 
 
 def _connect_driver(node: HeadNode, config: Config, namespace: str
@@ -137,8 +233,18 @@ def shutdown():
                 _global_node.loop_thread.run(cw.stop(), timeout=5)
             except Exception:
                 pass
+        if cw is not None and _global_node is None:
+            # Remote-attached driver: stop its own loop thread.
+            lt = getattr(cw, "_attached_loop_thread", None)
+            if lt is not None:
+                try:
+                    lt.run(cw.stop(), timeout=5)
+                except Exception:
+                    pass
+                lt.stop()
         if _global_node is not None:
             _global_node.shutdown()
+            _clear_cluster_address()
         object_ref_mod.set_core_worker(None)
         _global_node = None
         _global_worker = None

@@ -1,0 +1,28 @@
+"""ray_tpu.autoscaler — declarative cluster scaling.
+
+Reference capability: python/ray/autoscaler (StandardAutoscaler v1 +
+the v2 declarative instance manager / GcsAutoscalerStateManager). The
+TPU-first delta: node types are pod-slice shaped — a node type carries
+whole-slice resources and scaling acquires/releases slices as units
+(gang-granular failure and scaling domains).
+"""
+
+from ray_tpu.autoscaler.autoscaler import (
+    AutoscalerConfig,
+    NodeType,
+    StandardAutoscaler,
+)
+from ray_tpu.autoscaler.providers import (
+    FakeNodeProvider,
+    NodeProvider,
+    TPUPodSliceProvider,
+)
+
+__all__ = [
+    "AutoscalerConfig",
+    "FakeNodeProvider",
+    "NodeProvider",
+    "NodeType",
+    "StandardAutoscaler",
+    "TPUPodSliceProvider",
+]

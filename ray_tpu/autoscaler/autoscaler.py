@@ -1,0 +1,184 @@
+"""StandardAutoscaler: demand-driven scaling loop.
+
+Reference: autoscaler/_private/autoscaler.py:171 (StandardAutoscaler,
+update :373) + resource_demand_scheduler.py (bin-packing pending demand
+onto node types) + monitor.py (the loop reading load from the GCS).
+Each update(): read pending demand + node utilization from the head,
+bin-pack unmet demand onto configured node types, launch up to the
+per-type max, and terminate nodes idle beyond the timeout (respecting
+min_workers).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import logging
+import time
+from typing import Dict, List, Optional
+
+from ray_tpu.autoscaler.providers import NodeProvider
+
+logger = logging.getLogger(__name__)
+
+
+@dataclasses.dataclass
+class NodeType:
+    """Reference: cluster YAML available_node_types entries."""
+
+    name: str
+    resources: Dict[str, float]
+    min_workers: int = 0
+    max_workers: int = 10
+    labels: Dict[str, str] = dataclasses.field(default_factory=dict)
+
+
+@dataclasses.dataclass
+class AutoscalerConfig:
+    node_types: List[NodeType]
+    idle_timeout_s: float = 60.0
+    upscaling_speed: float = 1.0  # max new nodes per update, as a
+    # fraction of current count (>=1 node always allowed)
+
+
+def _fits(demand: Dict[str, float], capacity: Dict[str, float]) -> bool:
+    return all(capacity.get(k, 0.0) >= v for k, v in demand.items())
+
+
+def _subtract(capacity: Dict[str, float], demand: Dict[str, float]):
+    for k, v in demand.items():
+        capacity[k] = capacity.get(k, 0.0) - v
+
+
+class StandardAutoscaler:
+    def __init__(self, config: AutoscalerConfig, provider: NodeProvider):
+        self.config = config
+        self.provider = provider
+        self._idle_since: Dict[str, float] = {}
+        self._launched_by_type: Dict[str, int] = {}
+
+    # -- load ----------------------------------------------------------
+    def _get_load(self) -> dict:
+        from ray_tpu.core.object_ref import get_core_worker
+
+        cw = get_core_worker()
+        if cw is None:
+            raise RuntimeError("ray_tpu not initialized")
+        return cw.loop_thread.run(cw.head.call("get_load", {}))
+
+    # -- planning ------------------------------------------------------
+    def plan(self, load: dict) -> tuple:
+        """Pure planning: (to_launch: {type: n}, to_terminate: [ids])."""
+        provider_nodes = self.provider.non_terminated_nodes()
+        counts: Dict[str, int] = {}
+        for n in provider_nodes:
+            counts[n["node_type"]] = counts.get(n["node_type"], 0) + 1
+
+        # Unmet demand: pending shapes that no ALIVE node's availability
+        # covers (simulate packing onto current availability first).
+        avail = [dict(n["available"]) for n in load["nodes"]
+                 if n["state"] == "ALIVE"]
+        unmet: List[Dict[str, float]] = []
+        for demand in load["pending"]:
+            placed = False
+            for cap in avail:
+                if _fits(demand, cap):
+                    _subtract(cap, demand)
+                    placed = True
+                    break
+            if not placed:
+                unmet.append(demand)
+
+        # Bin-pack unmet demand onto hypothetical new nodes by type
+        # (first type that fits each shape; reference: the demand
+        # scheduler's utilization-score packing, simplified).
+        to_launch: Dict[str, int] = {}
+        new_caps: List[tuple] = []  # (type_name, remaining capacity)
+        for demand in unmet:
+            placed = False
+            for tname, cap in new_caps:
+                if _fits(demand, cap):
+                    _subtract(cap, demand)
+                    placed = True
+                    break
+            if placed:
+                continue
+            for nt in self.config.node_types:
+                current = counts.get(nt.name, 0) + to_launch.get(nt.name, 0)
+                if current >= nt.max_workers:
+                    continue
+                if _fits(demand, dict(nt.resources)):
+                    cap = dict(nt.resources)
+                    _subtract(cap, demand)
+                    new_caps.append((nt.name, cap))
+                    to_launch[nt.name] = to_launch.get(nt.name, 0) + 1
+                    placed = True
+                    break
+            if not placed:
+                logger.warning("demand %s fits no node type", demand)
+
+        # min_workers floor.
+        for nt in self.config.node_types:
+            have = counts.get(nt.name, 0) + to_launch.get(nt.name, 0)
+            if have < nt.min_workers:
+                to_launch[nt.name] = (to_launch.get(nt.name, 0)
+                                      + nt.min_workers - have)
+
+        # Upscaling speed cap.
+        total = sum(counts.values()) or 1
+        cap_new = max(1, int(total * self.config.upscaling_speed))
+        budget = cap_new
+        for tname in list(to_launch):
+            take = min(to_launch[tname], budget)
+            budget -= take
+            if take == 0:
+                del to_launch[tname]
+            else:
+                to_launch[tname] = take
+
+        # Idle termination: provider nodes whose head node has no active
+        # leases and full availability, idle past the timeout, above
+        # min_workers.
+        now = time.time()
+        by_node_id = {n.get("node_id"): n for n in provider_nodes
+                      if n.get("node_id") is not None}
+        to_terminate: List[str] = []
+        idle_by_type: Dict[str, List[str]] = {}
+        for ln in load["nodes"]:
+            from ray_tpu.core.ids import NodeID
+
+            node_id = NodeID.from_hex(ln["node_id"])
+            pnode = by_node_id.get(node_id)
+            if pnode is None or ln["state"] != "ALIVE":
+                continue
+            busy = ln["active_leases"] > 0
+            pid = pnode["provider_node_id"]
+            if busy:
+                self._idle_since.pop(pid, None)
+                continue
+            first_idle = self._idle_since.setdefault(pid, now)
+            if now - first_idle >= self.config.idle_timeout_s:
+                idle_by_type.setdefault(pnode["node_type"], []).append(pid)
+        for nt in self.config.node_types:
+            idle = idle_by_type.get(nt.name, [])
+            keep = max(0, nt.min_workers - (counts.get(nt.name, 0)
+                                            - len(idle)))
+            removable = idle[:len(idle) - keep] if keep else idle
+            to_terminate.extend(removable)
+        return to_launch, to_terminate
+
+    # -- acting --------------------------------------------------------
+    def update(self) -> dict:
+        load = self._get_load()
+        to_launch, to_terminate = self.plan(load)
+        launched = []
+        for tname, n in to_launch.items():
+            nt = next(t for t in self.config.node_types
+                      if t.name == tname)
+            for _ in range(n):
+                launched.append(self.provider.create_node(
+                    tname, nt.resources, nt.labels))
+        for pid in to_terminate:
+            self._idle_since.pop(pid, None)
+            self.provider.terminate_node(pid)
+        return {"launched": launched, "terminated": to_terminate,
+                "pending_demand": len(load["pending"])}

@@ -1,0 +1,110 @@
+"""Node providers: how the autoscaler acquires/releases capacity.
+
+Reference: python/ray/autoscaler/node_provider.py (NodeProvider ABC) +
+_private/fake_multi_node/node_provider.py:237 (FakeMultiNodeProvider,
+the in-process provider used to test scaling logic without a cloud).
+``TPUPodSliceProvider`` is the TPU-shaped provider contract: create
+terminates in whole pod slices (the scheduling gang unit); concrete
+GCE/GKE implementations plug in by subclassing and implementing the
+two launch hooks.
+"""
+
+from __future__ import annotations
+
+import time
+import uuid
+from typing import Any, Dict, List, Optional
+
+
+class NodeProvider:
+    """Minimal provider contract (reference: node_provider.py)."""
+
+    def create_node(self, node_type: str,
+                    resources: Dict[str, float],
+                    labels: Dict[str, str]) -> str:
+        raise NotImplementedError
+
+    def terminate_node(self, provider_node_id: str) -> None:
+        raise NotImplementedError
+
+    def non_terminated_nodes(self) -> List[dict]:
+        """-> [{provider_node_id, node_type, node_id(optional)}]"""
+        raise NotImplementedError
+
+
+class FakeNodeProvider(NodeProvider):
+    """Creates logical nodes on the in-process head — the analog of
+    RAY_FAKE_CLUSTER=1 (fake_multi_node). Used for autoscaler tests."""
+
+    def __init__(self):
+        from ray_tpu import api as _api
+
+        if _api._global_node is None:
+            raise RuntimeError(
+                "FakeNodeProvider needs an in-process head "
+                "(ray_tpu.init() without address=)")
+        self._head = _api._global_node
+        self._nodes: Dict[str, dict] = {}
+
+    def create_node(self, node_type: str, resources: Dict[str, float],
+                    labels: Dict[str, str]) -> str:
+        node_id = self._head.add_node(dict(resources))
+        pid = f"fake-{uuid.uuid4().hex[:8]}"
+        self._nodes[pid] = {
+            "provider_node_id": pid,
+            "node_type": node_type,
+            "node_id": node_id,
+            "created_at": time.time(),
+        }
+        return pid
+
+    def terminate_node(self, provider_node_id: str) -> None:
+        info = self._nodes.pop(provider_node_id, None)
+        if info is not None:
+            self._head.remove_node(info["node_id"])
+
+    def non_terminated_nodes(self) -> List[dict]:
+        return list(self._nodes.values())
+
+
+class TPUPodSliceProvider(NodeProvider):
+    """Abstract pod-slice provider: each node type is a slice topology
+    (e.g. "v5e-16" = 4 hosts x 4 chips). Subclasses implement the cloud
+    calls; the autoscaler logic (slice-granular bin packing) is shared.
+
+    Reference analog: the GCP provider + TPU pod scheduling via the
+    synthetic TPU-<ver>-<n>-head resource (_private/accelerators/
+    tpu.py:335) — here the slice is a first-class node type.
+    """
+
+    #: topology -> (hosts per slice, chips per host)
+    TOPOLOGIES = {
+        "v4-8": (1, 4),
+        "v5e-4": (1, 4),
+        "v5e-8": (2, 4),
+        "v5e-16": (4, 4),
+        "v5e-64": (16, 4),
+        "v5p-8": (1, 4),
+    }
+
+    def slice_resources(self, topology: str) -> Dict[str, float]:
+        hosts, chips = self.TOPOLOGIES[topology]
+        return {
+            "CPU": 96.0 * hosts,
+            "TPU": float(chips * hosts),
+            f"TPU-{topology}-head": 1.0,
+        }
+
+    def launch_slice(self, topology: str) -> str:
+        """Cloud hook: acquire one slice, return its id."""
+        raise NotImplementedError
+
+    def release_slice(self, slice_id: str) -> None:
+        """Cloud hook: release one slice."""
+        raise NotImplementedError
+
+    def create_node(self, node_type: str, resources, labels) -> str:
+        return self.launch_slice(node_type)
+
+    def terminate_node(self, provider_node_id: str) -> None:
+        self.release_slice(provider_node_id)

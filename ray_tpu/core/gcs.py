@@ -205,6 +205,7 @@ class HeadService:
             "list_actors": self.h_list_actors,
             "list_objects": self.h_list_objects,
             "list_jobs": self.h_list_jobs,
+            "get_load": self.h_get_load,
             "ping": self.h_ping,
         }
 
@@ -847,6 +848,30 @@ class HeadService:
                                         if k != "address"}}
             for job_id, info in self.jobs.items()
         ]}
+
+    async def h_get_load(self, conn, payload):
+        """Autoscaler input (reference: GcsAutoscalerStateManager /
+        monitor.py update_load_metrics): pending demand shapes + per-node
+        utilization."""
+        pending = [lease.resources.to_dict()
+                   for lease in self.scheduler.pending]
+        leases_by_node: Dict[str, int] = {}
+        for (node_id, _res, _pg, _bi) in self.scheduler.active_leases.values():
+            leases_by_node[node_id.hex()] = \
+                leases_by_node.get(node_id.hex(), 0) + 1
+        nodes = []
+        for info in self.nodes_info.values():
+            node = self.scheduler.nodes.get(info.node_id)
+            nodes.append({
+                "node_id": info.node_id.hex(),
+                "state": info.state,
+                "total": dict(info.resources),
+                "available": (node.resources.available.to_dict()
+                              if node and info.state == "ALIVE" else {}),
+                "active_leases": leases_by_node.get(info.node_id.hex(), 0),
+                "labels": dict(info.labels),
+            })
+        return {"pending": pending, "nodes": nodes}
 
     async def h_ping(self, conn, payload):
         return {"ok": True, "time": time.time()}

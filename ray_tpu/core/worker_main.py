@@ -97,8 +97,10 @@ class Executor:
                         self._record_terminal(spec, result)
                         if not fut.done():
                             fut.set_result(result)
-                    except Exception as e:
-                        self.cw.record_task_event(spec, "FAILED")
+                    except BaseException as e:  # incl. ActorExitSignal
+                        self.cw.record_task_event(
+                            spec, "FINISHED"
+                            if isinstance(e, ActorExitSignal) else "FAILED")
                         if not fut.done():
                             fut.set_exception(e)
                     finally:

@@ -1,0 +1,140 @@
+"""Dashboard: JSON state + Prometheus metrics over HTTP.
+
+Reference: dashboard/head.py:81 (DashboardHead + modules serving REST
+state APIs) and _private/metrics_agent.py (the Prometheus re-exporter).
+The SPA frontend is out of scope; the API surface the reference's UI
+consumes — cluster status, nodes, actors, tasks, jobs, metrics — is
+served as JSON from an aiohttp actor.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import logging
+from typing import Optional
+
+logger = logging.getLogger(__name__)
+
+DASHBOARD_NAME = "RAY_TPU_DASHBOARD"
+
+
+class DashboardActor:
+    def __init__(self, host: str = "127.0.0.1", port: int = 8265):
+        self.host = host
+        self.port = port
+        self._runner = None
+        self._started = asyncio.get_event_loop().create_task(self._start())
+
+    async def _start(self):
+        from aiohttp import web
+
+        app = web.Application()
+        app.router.add_get("/api/cluster_status", self._cluster_status)
+        app.router.add_get("/api/nodes", self._nodes)
+        app.router.add_get("/api/actors", self._actors)
+        app.router.add_get("/api/tasks", self._tasks)
+        app.router.add_get("/api/task_summary", self._task_summary)
+        app.router.add_get("/api/workers", self._workers)
+        app.router.add_get("/api/jobs", self._jobs)
+        app.router.add_get("/api/objects", self._objects)
+        app.router.add_get("/metrics", self._metrics)
+        app.router.add_get("/healthz", self._healthz)
+        self._runner = web.AppRunner(app)
+        await self._runner.setup()
+        site = web.TCPSite(self._runner, self.host, self.port)
+        await site.start()
+        logger.info("dashboard at http://%s:%d", self.host, self.port)
+
+    async def ready(self) -> int:
+        await self._started
+        return self.port
+
+    async def _json(self, producer):
+        from aiohttp import web
+
+        loop = asyncio.get_event_loop()
+        try:
+            # State calls block; keep them off this actor's loop.
+            data = await loop.run_in_executor(None, producer)
+            return web.json_response(data)
+        except Exception as e:
+            return web.json_response({"error": str(e)}, status=500)
+
+    async def _cluster_status(self, request):
+        def produce():
+            import ray_tpu
+
+            return {
+                "cluster_resources": ray_tpu.cluster_resources(),
+                "available_resources": ray_tpu.available_resources(),
+            }
+
+        return await self._json(produce)
+
+    async def _nodes(self, request):
+        from ray_tpu.util import state as ust
+
+        return await self._json(ust.list_nodes)
+
+    async def _actors(self, request):
+        from ray_tpu.util import state as ust
+
+        return await self._json(ust.list_actors)
+
+    async def _tasks(self, request):
+        from ray_tpu.util import state as ust
+
+        return await self._json(ust.list_tasks)
+
+    async def _task_summary(self, request):
+        from ray_tpu.util import state as ust
+
+        return await self._json(ust.summarize_tasks)
+
+    async def _workers(self, request):
+        from ray_tpu.util import state as ust
+
+        return await self._json(ust.list_workers)
+
+    async def _jobs(self, request):
+        from ray_tpu.util import state as ust
+
+        return await self._json(ust.list_jobs)
+
+    async def _objects(self, request):
+        from ray_tpu.util import state as ust
+
+        return await self._json(ust.list_objects)
+
+    async def _metrics(self, request):
+        from aiohttp import web
+
+        from ray_tpu.util import metrics as um
+
+        loop = asyncio.get_event_loop()
+        try:
+            text = await loop.run_in_executor(None, um.prometheus_text)
+            return web.Response(text=text,
+                                content_type="text/plain")
+        except Exception as e:
+            return web.Response(status=500, text=str(e))
+
+    async def _healthz(self, request):
+        from aiohttp import web
+
+        return web.Response(text="success")
+
+    async def shutdown(self):
+        if self._runner is not None:
+            await self._runner.cleanup()
+
+
+def start_dashboard(port: int = 8265):
+    """Start (or get) the dashboard actor; returns the bound port."""
+    import ray_tpu
+
+    actor = (ray_tpu.remote(DashboardActor)
+             .options(name=DASHBOARD_NAME, lifetime="detached",
+                      get_if_exists=True, num_cpus=0.1)
+             .remote("127.0.0.1", port))
+    return ray_tpu.get(actor.ready.remote(), timeout=60)

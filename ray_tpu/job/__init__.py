@@ -1,0 +1,8 @@
+"""ray_tpu.job — job submission (reference: dashboard/modules/job)."""
+
+from ray_tpu.job.job_manager import (
+    JobStatus,
+    JobSubmissionClient,
+)
+
+__all__ = ["JobStatus", "JobSubmissionClient"]

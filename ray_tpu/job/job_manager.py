@@ -1,0 +1,224 @@
+"""Job submission: run entrypoint commands on the cluster.
+
+Reference: dashboard/modules/job/job_manager.py:525 (JobManager spawns a
+per-job JobSupervisor actor (:140) that runs the entrypoint as a
+subprocess) and sdk.py:39 (JobSubmissionClient). Job status and logs
+live in the head KV so any driver can query them; the entrypoint
+subprocess gets RAY_TPU_ADDRESS so it attaches to the same cluster.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import subprocess
+import time
+import uuid
+from typing import Any, Dict, List, Optional
+
+import ray_tpu
+
+
+class JobStatus:
+    PENDING = "PENDING"
+    RUNNING = "RUNNING"
+    SUCCEEDED = "SUCCEEDED"
+    FAILED = "FAILED"
+    STOPPED = "STOPPED"
+
+
+class _JobSupervisor:
+    """Per-job async actor: runs the entrypoint subprocess, streams logs
+    to a file, records status in the head KV (reference: JobSupervisor).
+    Async so stop() can interleave with a blocking run(); exits itself
+    once the job reaches a terminal state (the reference supervisor does
+    the same) so finished jobs hold no resources."""
+
+    def __init__(self, job_id: str, entrypoint: str,
+                 runtime_env: Optional[dict], cluster_address: str,
+                 log_dir: str):
+        self.job_id = job_id
+        self.entrypoint = entrypoint
+        self.runtime_env = runtime_env or {}
+        self.cluster_address = cluster_address
+        os.makedirs(log_dir, exist_ok=True)
+        self.log_path = os.path.join(log_dir, f"job-{job_id}.log")
+        self.proc: Optional[subprocess.Popen] = None
+        self._set_status(JobStatus.PENDING)
+
+    def _kv_submit(self, op: str, **kw):
+        """Fire-and-forget KV write. This actor is async: its methods
+        run ON the worker event loop, so a blocking loop_thread.run here
+        would deadlock the loop against itself."""
+        from ray_tpu.core.object_ref import get_core_worker
+
+        cw = get_core_worker()
+        cw.loop_thread.submit(cw.head.call(op, kw))
+
+    def _set_status(self, status: str, message: str = ""):
+        payload = {
+            "job_id": self.job_id,
+            "status": status,
+            "message": message,
+            "entrypoint": self.entrypoint,
+            "log_path": self.log_path,
+            "ts": time.time(),
+        }
+        self._kv_submit("kv_put", ns="jobs",
+                        key=f"job:{self.job_id}".encode(),
+                        value=json.dumps(payload).encode(),
+                        overwrite=True)
+
+    async def run(self) -> str:
+        import asyncio
+
+        import ray_tpu
+
+        env = dict(os.environ)
+        env["RAY_TPU_ADDRESS"] = self.cluster_address
+        env.update(self.runtime_env.get("env_vars", {}))
+        cwd = self.runtime_env.get("working_dir") or None
+        self._set_status(JobStatus.RUNNING)
+        loop = asyncio.get_event_loop()
+        try:
+            with open(self.log_path, "ab") as log_file:
+                self.proc = subprocess.Popen(
+                    self.entrypoint, shell=True, env=env, cwd=cwd,
+                    stdout=log_file, stderr=subprocess.STDOUT)
+                # Block off-loop so stop() stays responsive.
+                code = await loop.run_in_executor(None, self.proc.wait)
+        except Exception as e:
+            self._set_status(JobStatus.FAILED,
+                             f"{type(e).__name__}: {e}")
+            ray_tpu.actor_exit()
+        if code == 0:
+            self._set_status(JobStatus.SUCCEEDED)
+        elif code < 0:
+            self._set_status(JobStatus.STOPPED,
+                             f"terminated by signal {-code}")
+        else:
+            self._set_status(JobStatus.FAILED, f"exit code {code}")
+        # Terminal: release this supervisor's resources.
+        ray_tpu.actor_exit()
+
+    async def stop(self) -> bool:
+        import asyncio
+
+        if self.proc is not None and self.proc.poll() is None:
+            self.proc.terminate()
+            loop = asyncio.get_event_loop()
+            try:
+                await asyncio.wait_for(
+                    loop.run_in_executor(None, self.proc.wait), 10)
+            except asyncio.TimeoutError:
+                self.proc.kill()
+            return True
+        return False
+
+    async def ping(self) -> bool:
+        return True
+
+
+class JobSubmissionClient:
+    """Reference: dashboard/modules/job/sdk.py:39 — submit/status/logs/
+    stop/list against the connected cluster."""
+
+    def __init__(self, address: Optional[str] = None):
+        if not ray_tpu.is_initialized():
+            ray_tpu.init(address=address or "auto")
+        from ray_tpu import api as _api
+        from ray_tpu.core.object_ref import get_core_worker
+
+        self._cw = get_core_worker()
+        if _api._global_node is not None:
+            self._address = f"127.0.0.1:{_api._global_node.port}"
+            self._log_dir = os.path.join(
+                _api._global_node.session_dir, "logs")
+        else:
+            self._address = address or _api._read_cluster_address()
+            self._log_dir = os.path.join(
+                os.path.expanduser("~/.ray_tpu_jobs"))
+        self._supervisors: Dict[str, Any] = {}
+
+    def _kv(self, op: str, **kw):
+        return self._cw.loop_thread.run(self._cw.head.call(op, kw))
+
+    def submit_job(self, *, entrypoint: str,
+                   runtime_env: Optional[dict] = None,
+                   submission_id: Optional[str] = None) -> str:
+        job_id = submission_id or f"raysubmit_{uuid.uuid4().hex[:12]}"
+        supervisor = (ray_tpu.remote(_JobSupervisor)
+                      .options(num_cpus=0.1,
+                               name=f"_job_supervisor:{job_id}",
+                               lifetime="detached")
+                      .remote(job_id, entrypoint, runtime_env,
+                              self._address, self._log_dir))
+        # Fire the run; result arrives asynchronously.
+        supervisor.run.remote()
+        self._supervisors[job_id] = supervisor
+        return job_id
+
+    def get_job_info(self, job_id: str) -> dict:
+        reply = self._kv("kv_get", ns="jobs",
+                         key=f"job:{job_id}".encode())
+        blob = reply.get("value")
+        if not blob:
+            raise ValueError(f"no job {job_id!r}")
+        return json.loads(bytes(blob).decode())
+
+    def get_job_status(self, job_id: str) -> str:
+        return self.get_job_info(job_id)["status"]
+
+    def get_job_logs(self, job_id: str) -> str:
+        info = self.get_job_info(job_id)
+        try:
+            with open(info["log_path"]) as f:
+                return f.read()
+        except FileNotFoundError:
+            return ""
+
+    def stop_job(self, job_id: str) -> bool:
+        sup = self._supervisors.get(job_id)
+        if sup is None:
+            try:
+                sup = ray_tpu.get_actor(f"_job_supervisor:{job_id}")
+            except Exception:
+                return False
+        try:
+            return ray_tpu.get(sup.stop.remote(), timeout=30)
+        except Exception:
+            # The supervisor exits itself once the job goes terminal; a
+            # death racing the stop reply means the stop took effect.
+            try:
+                return self.get_job_status(job_id) in (
+                    JobStatus.STOPPED, JobStatus.FAILED,
+                    JobStatus.SUCCEEDED)
+            except ValueError:
+                return False
+
+    def list_jobs(self) -> List[dict]:
+        reply = self._kv("kv_keys", ns="jobs", prefix=b"job:")
+        out = []
+        for key in reply.get("keys", []):
+            blob = self._kv("kv_get", ns="jobs", key=key).get("value")
+            if blob:
+                out.append(json.loads(bytes(blob).decode()))
+        return sorted(out, key=lambda j: j["ts"])
+
+    def wait_until_finish(self, job_id: str, timeout: float = 300
+                          ) -> str:
+        deadline = time.time() + timeout
+        terminal = {JobStatus.SUCCEEDED, JobStatus.FAILED,
+                    JobStatus.STOPPED}
+        status = JobStatus.PENDING
+        while time.time() < deadline:
+            try:
+                status = self.get_job_status(job_id)
+            except ValueError:
+                # Supervisor actor still starting; its constructor
+                # writes the PENDING record once the worker is up.
+                status = JobStatus.PENDING
+            if status in terminal:
+                return status
+            time.sleep(0.5)
+        raise TimeoutError(f"job {job_id} still {status}")

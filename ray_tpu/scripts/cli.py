@@ -1,0 +1,211 @@
+"""Command-line interface (reference: python/ray/scripts/scripts.py —
+`ray start/status/timeline/list/submit/microbenchmark`).
+
+Invoke as ``python -m ray_tpu <command>``. Commands attach to the
+running cluster via the current-cluster file (ray_tpu.init(address=
+"auto")) except ``start`` which creates one.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import signal
+import sys
+import time
+
+
+def cmd_start(args):
+    if not args.block:
+        # The head lives in-process; without --block, daemonize by
+        # re-execing ourselves into a detached --block process (the
+        # reference `ray start` launches long-lived daemons the same
+        # way this CLI can't: out-of-process).
+        import subprocess
+
+        cmd = [sys.executable, "-m", "ray_tpu", "start", "--block"]
+        if args.num_cpus is not None:
+            cmd += ["--num-cpus", str(args.num_cpus)]
+        if args.num_tpus is not None:
+            cmd += ["--num-tpus", str(args.num_tpus)]
+        proc = subprocess.Popen(cmd, start_new_session=True)
+        from ray_tpu.api import ADDRESS_FILE
+
+        deadline = time.time() + 60
+        while time.time() < deadline:
+            try:
+                with open(ADDRESS_FILE) as f:
+                    addr = f.read().strip()
+                break
+            except FileNotFoundError:
+                time.sleep(0.2)
+        else:
+            print("head did not come up in 60s", file=sys.stderr)
+            sys.exit(1)
+        print(f"ray_tpu head started at {addr} (pid {proc.pid})")
+        print("attach with ray_tpu.init(address='auto'); stop with "
+              f"`kill {proc.pid}`")
+        return
+
+    import ray_tpu
+
+    ray_tpu.init(num_cpus=args.num_cpus, num_tpus=args.num_tpus)
+    from ray_tpu import api
+
+    addr = f"127.0.0.1:{api._global_node.port}"
+    print(f"ray_tpu head started at {addr}", flush=True)
+    stop = {"flag": False}
+
+    def on_sig(_s, _f):
+        stop["flag"] = True
+
+    signal.signal(signal.SIGINT, on_sig)
+    signal.signal(signal.SIGTERM, on_sig)
+    while not stop["flag"]:
+        time.sleep(0.5)
+    ray_tpu.shutdown()
+    print("head stopped")
+
+
+def _attach():
+    import ray_tpu
+
+    ray_tpu.init(address="auto")
+    return ray_tpu
+
+
+def cmd_status(args):
+    ray_tpu = _attach()
+    from ray_tpu.util import state as ust
+
+    total = ray_tpu.cluster_resources()
+    avail = ray_tpu.available_resources()
+    print("== cluster resources ==")
+    for k in sorted(total):
+        print(f"  {k}: {avail.get(k, 0.0):g}/{total[k]:g} available")
+    nodes = ust.list_nodes()
+    alive = [n for n in nodes if n["state"] == "ALIVE"]
+    print(f"== nodes: {len(alive)} alive / {len(nodes)} total ==")
+    workers = ust.list_workers()
+    by_state = {}
+    for w in workers:
+        by_state[w["state"]] = by_state.get(w["state"], 0) + 1
+    print(f"== workers: {by_state} ==")
+    ray_tpu.shutdown()
+
+
+def cmd_summary(args):
+    ray_tpu = _attach()
+    from ray_tpu.util import state as ust
+
+    print(json.dumps({
+        "tasks": ust.summarize_tasks(),
+        "actors": ust.summarize_actors(),
+    }, indent=2))
+    ray_tpu.shutdown()
+
+
+def cmd_list(args):
+    ray_tpu = _attach()
+    from ray_tpu.util import state as ust
+
+    fn = {
+        "actors": ust.list_actors,
+        "tasks": ust.list_tasks,
+        "nodes": ust.list_nodes,
+        "workers": ust.list_workers,
+        "objects": ust.list_objects,
+        "jobs": ust.list_jobs,
+        "placement-groups": ust.list_placement_groups,
+    }[args.kind]
+    print(json.dumps(fn(), indent=2, default=str))
+    ray_tpu.shutdown()
+
+
+def cmd_timeline(args):
+    ray_tpu = _attach()
+    from ray_tpu.util import timeline
+
+    events = timeline(args.output)
+    print(f"wrote {len(events)} spans to {args.output}")
+    ray_tpu.shutdown()
+
+
+def cmd_submit(args):
+    import ray_tpu
+    from ray_tpu.job import JobSubmissionClient
+
+    import shlex
+
+    entrypoint = list(args.entrypoint)
+    if entrypoint and entrypoint[0] == "--":
+        entrypoint = entrypoint[1:]
+    if not entrypoint:
+        print("no entrypoint given", file=sys.stderr)
+        sys.exit(2)
+    client = JobSubmissionClient()
+    job_id = client.submit_job(
+        entrypoint=shlex.join(entrypoint),
+        runtime_env={"working_dir": args.working_dir}
+        if args.working_dir else None)
+    print(f"submitted job {job_id}")
+    if args.wait:
+        status = client.wait_until_finish(job_id, timeout=args.timeout)
+        print(f"job {job_id}: {status}")
+        print(client.get_job_logs(job_id))
+        ray_tpu.shutdown()
+        sys.exit(0 if status == "SUCCEEDED" else 1)
+    ray_tpu.shutdown()
+
+
+def cmd_microbenchmark(args):
+    from ray_tpu.scripts import microbenchmark
+
+    microbenchmark.main()
+
+
+def main(argv=None):
+    parser = argparse.ArgumentParser(
+        prog="ray-tpu", description="ray_tpu cluster CLI")
+    sub = parser.add_subparsers(dest="command", required=True)
+
+    p = sub.add_parser("start", help="start a head node")
+    p.add_argument("--head", action="store_true", default=True)
+    p.add_argument("--num-cpus", type=float, default=None)
+    p.add_argument("--num-tpus", type=float, default=None)
+    p.add_argument("--block", action="store_true",
+                   help="stay in the foreground until SIGINT/SIGTERM")
+    p.set_defaults(fn=cmd_start)
+
+    p = sub.add_parser("status", help="cluster resource status")
+    p.set_defaults(fn=cmd_status)
+
+    p = sub.add_parser("summary", help="task/actor summaries")
+    p.set_defaults(fn=cmd_summary)
+
+    p = sub.add_parser("list", help="list cluster state")
+    p.add_argument("kind", choices=["actors", "tasks", "nodes", "workers",
+                                    "objects", "jobs",
+                                    "placement-groups"])
+    p.set_defaults(fn=cmd_list)
+
+    p = sub.add_parser("timeline", help="dump chrome-tracing timeline")
+    p.add_argument("--output", "-o", default="timeline.json")
+    p.set_defaults(fn=cmd_timeline)
+
+    p = sub.add_parser("submit", help="submit a job")
+    p.add_argument("--working-dir", default=None)
+    p.add_argument("--wait", action="store_true")
+    p.add_argument("--timeout", type=float, default=600)
+    p.add_argument("entrypoint", nargs=argparse.REMAINDER)
+    p.set_defaults(fn=cmd_submit)
+
+    p = sub.add_parser("microbenchmark", help="run the perf suite")
+    p.set_defaults(fn=cmd_microbenchmark)
+
+    args = parser.parse_args(argv)
+    args.fn(args)
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,92 @@
+"""Core microbenchmarks (reference: _private/ray_perf.py — the
+`ray microbenchmark` suite: task/actor throughput, put/get bandwidth).
+Prints one line per benchmark; also importable (run_all)."""
+
+from __future__ import annotations
+
+import time
+from typing import Dict
+
+import numpy as np
+
+
+def _timeit(name: str, fn, multiplier: int = 1,
+            duration: float = 2.0) -> float:
+    # Warmup.
+    fn()
+    start = time.perf_counter()
+    count = 0
+    while time.perf_counter() - start < duration:
+        fn()
+        count += 1
+    elapsed = time.perf_counter() - start
+    rate = count * multiplier / elapsed
+    print(f"{name}: {rate:,.1f} /s")
+    return rate
+
+
+def run_all(init: bool = True) -> Dict[str, float]:
+    import ray_tpu
+
+    if init and not ray_tpu.is_initialized():
+        ray_tpu.init(num_cpus=4, num_tpus=0)
+    results: Dict[str, float] = {}
+
+    @ray_tpu.remote
+    def tiny(x):
+        return x
+
+    # single-client task throughput (async submission, batched get)
+    N = 100
+
+    def tasks_batch():
+        ray_tpu.get([tiny.remote(i) for i in range(N)], timeout=120)
+
+    results["tasks_per_second"] = _timeit(
+        "single-client tasks", tasks_batch, multiplier=N)
+
+    class Counter:
+        def __init__(self):
+            self.v = 0
+
+        def inc(self):
+            self.v += 1
+            return self.v
+
+    actor = ray_tpu.remote(Counter).options(num_cpus=0.5).remote()
+    ray_tpu.get(actor.inc.remote(), timeout=60)
+
+    def actor_sync():
+        ray_tpu.get(actor.inc.remote(), timeout=60)
+
+    results["actor_calls_sync_per_second"] = _timeit(
+        "1:1 actor calls sync", actor_sync)
+
+    def actor_async_batch():
+        ray_tpu.get([actor.inc.remote() for _ in range(N)], timeout=120)
+
+    results["actor_calls_async_per_second"] = _timeit(
+        "1:1 actor calls async", actor_async_batch, multiplier=N)
+
+    # put/get bandwidth on 10MB arrays through the shm arena
+    data = np.random.default_rng(0).random(10 * 1024 * 1024 // 8)
+
+    def put_get():
+        ref = ray_tpu.put(data)
+        out = ray_tpu.get(ref, timeout=60)
+        assert out.shape == data.shape
+
+    rate = _timeit("10MB put+get roundtrips", put_get)
+    results["put_gigabytes_per_second"] = rate * 10 / 1024 * 2
+    print(f"object store bandwidth: "
+          f"{results['put_gigabytes_per_second']:.2f} GiB/s")
+    ray_tpu.kill(actor)
+    return results
+
+
+def main():
+    run_all()
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,220 @@
+"""Tests for autoscaler, job submission, CLI, and dashboard
+(reference strategy: autoscaler unit tests with fake providers,
+dashboard/modules/job/tests, ray CLI smoke tests)."""
+
+import json
+import os
+import subprocess
+import sys
+import time
+import urllib.request
+
+import pytest
+
+import ray_tpu
+
+
+@pytest.fixture()
+def tooling_cluster():
+    ray_tpu.init(num_cpus=2, num_tpus=0)
+    yield
+    ray_tpu.shutdown()
+
+
+def test_autoscaler_scales_up_for_demand(tooling_cluster):
+    from ray_tpu.autoscaler import (
+        AutoscalerConfig,
+        FakeNodeProvider,
+        NodeType,
+        StandardAutoscaler,
+    )
+
+    provider = FakeNodeProvider()
+    autoscaler = StandardAutoscaler(
+        AutoscalerConfig(node_types=[
+            NodeType("cpu_worker", {"CPU": 4.0}, min_workers=0,
+                     max_workers=3)],
+            idle_timeout_s=3600),
+        provider)
+
+    # No demand -> nothing happens.
+    report = autoscaler.update()
+    assert report["launched"] == []
+
+    # Submit tasks needing more CPUs than the cluster has: the head
+    # parks them as pending leases, which the autoscaler must see.
+    @ray_tpu.remote
+    def hold(sec):
+        time.sleep(sec)
+        return 1
+
+    refs = [hold.options(num_cpus=2).remote(8) for _ in range(4)]
+    time.sleep(1.0)
+    report = autoscaler.update()
+    assert len(report["launched"]) >= 1
+    assert report["pending_demand"] >= 1
+    # New capacity lets the queued tasks finish.
+    assert ray_tpu.get(refs, timeout=180) == [1, 1, 1, 1]
+
+
+def test_autoscaler_respects_max_and_min(tooling_cluster):
+    from ray_tpu.autoscaler import (
+        AutoscalerConfig,
+        FakeNodeProvider,
+        NodeType,
+        StandardAutoscaler,
+    )
+
+    provider = FakeNodeProvider()
+    autoscaler = StandardAutoscaler(
+        AutoscalerConfig(node_types=[
+            NodeType("w", {"CPU": 1.0}, min_workers=2, max_workers=2)],
+            idle_timeout_s=0.1, upscaling_speed=10),
+        provider)
+    report = autoscaler.update()
+    assert len(report["launched"]) == 2  # min_workers floor
+    # Idle nodes above min are kept because min_workers=2 == count.
+    time.sleep(0.3)
+    report = autoscaler.update()
+    assert report["terminated"] == []
+    assert len(provider.non_terminated_nodes()) == 2
+
+
+def test_autoscaler_terminates_idle(tooling_cluster):
+    from ray_tpu.autoscaler import (
+        AutoscalerConfig,
+        FakeNodeProvider,
+        NodeType,
+        StandardAutoscaler,
+    )
+
+    provider = FakeNodeProvider()
+    autoscaler = StandardAutoscaler(
+        AutoscalerConfig(node_types=[
+            NodeType("w", {"CPU": 1.0}, min_workers=0, max_workers=4)],
+            idle_timeout_s=0.2, upscaling_speed=10),
+        provider)
+    provider.create_node("w", {"CPU": 1.0}, {})
+    provider.create_node("w", {"CPU": 1.0}, {})
+    autoscaler.update()  # records idle-since
+    time.sleep(0.4)
+    report = autoscaler.update()
+    assert len(report["terminated"]) == 2
+    assert provider.non_terminated_nodes() == []
+
+
+def test_tpu_pod_slice_provider_resources():
+    from ray_tpu.autoscaler import TPUPodSliceProvider
+
+    p = TPUPodSliceProvider()
+    res = p.slice_resources("v5e-16")
+    assert res["TPU"] == 16.0
+    assert res["TPU-v5e-16-head"] == 1.0
+
+
+def test_job_submission(tooling_cluster, tmp_path):
+    from ray_tpu.job import JobSubmissionClient
+
+    client = JobSubmissionClient()
+    script = tmp_path / "job_script.py"
+    script.write_text(
+        "import os\n"
+        "os.environ['JAX_PLATFORMS'] = 'cpu'\n"
+        "import ray_tpu\n"
+        "ray_tpu.init(address='auto')\n"
+        "@ray_tpu.remote\n"
+        "def sq(x):\n"
+        "    return x * x\n"
+        "print('job result:', ray_tpu.get(sq.remote(7), timeout=60))\n"
+        "ray_tpu.shutdown()\n")
+    job_id = client.submit_job(
+        entrypoint=f"{sys.executable} {script}",
+        runtime_env={"env_vars": {"PYTHONPATH": "/root/repo"}})
+    status = client.wait_until_finish(job_id, timeout=180)
+    logs = client.get_job_logs(job_id)
+    assert status == "SUCCEEDED", logs
+    assert "job result: 49" in logs
+    jobs = client.list_jobs()
+    assert any(j["job_id"] == job_id for j in jobs)
+
+
+def test_job_failure_status(tooling_cluster):
+    from ray_tpu.job import JobSubmissionClient
+
+    client = JobSubmissionClient()
+    job_id = client.submit_job(entrypoint=f"{sys.executable} -c 'exit(3)'")
+    assert client.wait_until_finish(job_id, timeout=120) == "FAILED"
+
+
+def test_job_stop(tooling_cluster):
+    from ray_tpu.job import JobStatus, JobSubmissionClient
+
+    client = JobSubmissionClient()
+    job_id = client.submit_job(
+        entrypoint=f"{sys.executable} -c 'import time; time.sleep(600)'")
+    deadline = time.time() + 120
+    while time.time() < deadline:
+        try:
+            if client.get_job_status(job_id) == JobStatus.RUNNING:
+                break
+        except ValueError:
+            pass
+        time.sleep(0.3)
+    assert client.stop_job(job_id)
+    assert client.wait_until_finish(job_id, timeout=60) == \
+        JobStatus.STOPPED
+
+
+def test_dashboard_endpoints(tooling_cluster):
+    from ray_tpu.dashboard import start_dashboard
+
+    @ray_tpu.remote
+    def noop():
+        return 1
+
+    ray_tpu.get([noop.remote() for _ in range(3)], timeout=60)
+    port = start_dashboard(port=18912)
+
+    def get_json(path):
+        with urllib.request.urlopen(
+                f"http://127.0.0.1:{port}{path}", timeout=30) as r:
+            return json.loads(r.read())
+
+    status = get_json("/api/cluster_status")
+    assert status["cluster_resources"]["CPU"] == 2.0
+    assert isinstance(get_json("/api/nodes"), list)
+    assert isinstance(get_json("/api/workers"), list)
+    assert isinstance(get_json("/api/actors"), list)
+    with urllib.request.urlopen(
+            f"http://127.0.0.1:{port}/healthz", timeout=30) as r:
+        assert r.read() == b"success"
+
+
+def test_cli_status_and_list(tmp_path):
+    """CLI attaches to a head started by another process."""
+    env = {**os.environ, "PYTHONPATH": "/root/repo",
+           "JAX_PLATFORMS": "cpu"}
+    head = subprocess.Popen(
+        [sys.executable, "-m", "ray_tpu", "start", "--num-cpus", "3",
+         "--block"], env=env, stdout=subprocess.PIPE,
+        stderr=subprocess.STDOUT, text=True)
+    try:
+        deadline = time.time() + 60
+        while time.time() < deadline:
+            from ray_tpu.api import ADDRESS_FILE
+
+            if os.path.exists(ADDRESS_FILE):
+                break
+            time.sleep(0.3)
+        out = subprocess.run(
+            [sys.executable, "-m", "ray_tpu", "status"], env=env,
+            capture_output=True, text=True, timeout=90)
+        assert "cluster resources" in out.stdout, out.stderr[-500:]
+        assert "CPU" in out.stdout
+        out = subprocess.run(
+            [sys.executable, "-m", "ray_tpu", "list", "nodes"], env=env,
+            capture_output=True, text=True, timeout=90)
+        assert "node_id" in out.stdout
+    finally:
+        head.terminate()
+        head.wait(timeout=30)
