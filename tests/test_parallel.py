@@ -52,6 +52,31 @@ def test_flash_attention_matches_reference_cpu():
                                rtol=2e-2, atol=2e-3)
 
 
+def test_flash_attention_blockwise_backward_matches():
+    # The custom VJP must match reference gradients without ever
+    # building the [B, H, S, S] score tensor.
+    rng = jax.random.PRNGKey(3)
+    q, k, v = [jax.random.normal(kk, (2, 256, 4, 64), jnp.float32) * 0.3
+               for kk in jax.random.split(rng, 3)]
+    for causal in (True, False):
+        gf = jax.grad(lambda *a: jnp.sum(
+            flash_attention(*a, causal) ** 2), argnums=(0, 1, 2))(q, k, v)
+        gr = jax.grad(lambda *a: jnp.sum(
+            reference_attention(*a, causal) ** 2),
+            argnums=(0, 1, 2))(q, k, v)
+        for a, b in zip(gf, gr):
+            np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                       rtol=1e-3, atol=1e-4)
+
+
+def test_llama_remat_policy_validation():
+    from ray_tpu.models.llama import LlamaConfig
+
+    with pytest.raises(ValueError, match="remat_policy"):
+        LlamaConfig.tiny(remat_policy="dot")
+    LlamaConfig.tiny(remat_policy="dots")  # valid
+
+
 @pytest.mark.parametrize("kind", ["ring", "ulysses"])
 def test_sequence_parallel_attention(kind):
     mesh = create_mesh(MeshConfig(data=2, sequence=4))
