@@ -369,18 +369,26 @@ class RemoteFunction:
         opts = self._options
         function_key = cw.export_function(self._fn)
         task_args = cw.serialize_args(args, kwargs)
+        n = opts["num_returns"]
+        if n == "streaming":
+            if not inspect.isgeneratorfunction(self._fn):
+                raise TypeError(
+                    "num_returns='streaming' requires a generator "
+                    "function")
+            n = -1  # TaskSpec.STREAMING
         refs = cw.submit_task(
             function_key,
             task_args,
             name=opts["name"] or getattr(self._fn, "__name__", "task"),
-            num_returns=opts["num_returns"],
+            num_returns=n,
             resources=_build_resources(opts),
-            max_retries=opts["max_retries"],
+            max_retries=opts["max_retries"] if n != -1 else 0,
             retry_exceptions=opts["retry_exceptions"],
             scheduling_strategy=_build_strategy(opts),
             runtime_env=opts["runtime_env"],
         )
-        n = opts["num_returns"]
+        if n == -1:
+            return refs  # an ObjectRefGenerator
         if n == 0:
             return None
         if n == 1:
@@ -410,6 +418,10 @@ class ActorMethod:
         return ActorMethod(self._handle, self._method_name, num_returns)
 
     def remote(self, *args, **kwargs):
+        if self._num_returns == "streaming":
+            raise TypeError(
+                "num_returns='streaming' is not supported on actor "
+                "methods yet; use a streaming task")
         cw = _require_worker()
         task_args = cw.serialize_args(args, kwargs)
         refs = cw.submit_actor_task(

@@ -191,6 +191,79 @@ class PendingTask:
     cancelled: bool = False
 
 
+class ObjectRefGenerator:
+    """Iterator over a streaming task's return refs (reference:
+    task_manager.h:98 ObjectRefStream / TryReadObjectRefStream). Items
+    become ObjectRefs as the executing worker reports them; iteration
+    blocks until the next item or end-of-stream."""
+
+    def __init__(self, task_id: TaskID, cleanup=None):
+        self._task_id = task_id
+        self._items: List[ObjectRef] = []
+        self._read = 0
+        self._total: Optional[int] = None  # known once the task finishes
+        self._error: Optional[Exception] = None
+        self._cv = threading.Condition()
+        # Deregisters this stream from the owner once fully consumed;
+        # the registration must outlive the final task reply because
+        # item notifications can still be in flight behind it.
+        self._cleanup = cleanup or (lambda: None)
+
+    # -- producer side (CoreWorker) ------------------------------------
+    def _append(self, ref: ObjectRef):
+        with self._cv:
+            self._items.append(ref)
+            self._cv.notify_all()
+
+    def _finish(self, total: int, error: Optional[Exception] = None):
+        with self._cv:
+            self._total = total
+            self._error = error
+            self._cv.notify_all()
+
+    # -- consumer side --------------------------------------------------
+    def __iter__(self):
+        return self
+
+    def __next__(self) -> ObjectRef:
+        return self._next_internal(timeout=None)
+
+    def next_ready(self, timeout: Optional[float] = None) -> ObjectRef:
+        return self._next_internal(timeout=timeout)
+
+    def _next_internal(self, timeout: Optional[float]) -> ObjectRef:
+        deadline = (time.monotonic() + timeout
+                    if timeout is not None else None)
+        with self._cv:
+            while True:
+                if self._read < len(self._items):
+                    ref = self._items[self._read]
+                    self._read += 1
+                    return ref
+                if self._total is not None and self._read >= self._total:
+                    self._cleanup()
+                    if self._error is not None:
+                        raise self._error
+                    raise StopIteration
+                remaining = None
+                if deadline is not None:
+                    remaining = deadline - time.monotonic()
+                    if remaining <= 0:
+                        raise exc.GetTimeoutError(
+                            "stream item not ready in time")
+                self._cv.wait(timeout=remaining)
+
+    def __del__(self):
+        try:
+            self._cleanup()
+        except Exception:
+            pass
+
+    def completed(self) -> bool:
+        with self._cv:
+            return self._total is not None and self._read >= self._total
+
+
 @dataclass
 class LeasedWorker:
     worker_id: WorkerID
@@ -263,6 +336,8 @@ class CoreWorker:
         self._task_event_buf: List[dict] = []
         self._task_event_lock = threading.Lock()
         self._event_flush_scheduled = False
+        # Streaming-generator tasks: task id -> ObjectRefGenerator.
+        self._streams: Dict[TaskID, "ObjectRefGenerator"] = {}
         try:
             self.loop.call_soon_threadsafe(
                 lambda: setattr(self, "_loop_thread_ident",
@@ -283,8 +358,37 @@ class CoreWorker:
             "add_borrow": self.h_add_borrow,
             "remove_ref": self.h_remove_ref,
             "pubsub": self.h_pubsub,
+            "stream_item": self.h_stream_item,
             "ping": self.h_ping,
         }
+
+    def _ingest_return(self, ret: dict) -> ObjectID:
+        """Record one task-return payload (inline value or plasma
+        marker) into the local store with ownership."""
+        object_id = ObjectID(ret["object_id"])
+        if ret.get("in_plasma"):
+            self.memory_store.put(object_id, make_plasma_marker())
+            self.reference_counter.register_owned(object_id, True)
+        else:
+            obj = SerializedObject(
+                metadata=ret["metadata"], inband=ret["inband"],
+                buffers=list(ret.get("buffers", [])),
+            )
+            self.memory_store.put(object_id, obj)
+            self.reference_counter.register_owned(object_id, False)
+        return object_id
+
+    async def h_stream_item(self, conn, payload):
+        """A streaming task's executor reports one yielded item
+        (reference: the streaming-generator return path feeding
+        ObjectRefStream)."""
+        task_id = TaskID.from_hex(payload["task_id"])
+        gen = self._streams.get(task_id)
+        if gen is None:
+            return {"ok": False}
+        object_id = self._ingest_return(payload)
+        gen._append(ObjectRef(object_id, self.address, is_owned=True))
+        return {"ok": True}
 
     async def start_server(self, extra_handlers: Optional[dict] = None) -> int:
         handlers = self.handlers()
@@ -731,6 +835,12 @@ class CoreWorker:
         self.pending_tasks[task_id] = PendingTask(
             spec=spec, retries_left=max_retries
         )
+        if num_returns == TaskSpec.STREAMING:
+            gen = ObjectRefGenerator(
+                task_id, cleanup=lambda: self._streams.pop(task_id, None))
+            self._streams[task_id] = gen
+            self.loop.call_soon_threadsafe(self._submit_on_loop, spec)
+            return gen
         refs = [
             ObjectRef(oid, self.address, is_owned=True)
             for oid in spec.return_object_ids()
@@ -938,17 +1048,23 @@ class CoreWorker:
             self._submit_on_loop(spec)
             return
         for ret in reply.get("returns", []):
-            object_id = ObjectID(ret["object_id"])
-            if ret.get("in_plasma"):
-                self.memory_store.put(object_id, make_plasma_marker())
-                self.reference_counter.register_owned(object_id, True)
-            else:
-                obj = SerializedObject(
-                    metadata=ret["metadata"], inband=ret["inband"],
-                    buffers=list(ret.get("buffers", [])),
-                )
-                self.memory_store.put(object_id, obj)
-                self.reference_counter.register_owned(object_id, False)
+            self._ingest_return(ret)
+        if "stream_count" in reply:
+            gen = self._streams.get(spec.task_id)
+            if gen is not None:
+                err = None
+                if is_app_error:
+                    err = exc.RayTpuError(
+                        f"streaming task {spec.name} failed")
+                    ep = reply.get("error_payload")
+                    if ep is not None:
+                        try:
+                            err = serialization.deserialize_no_raise(
+                                ep["metadata"], ep["inband"],
+                                ep.get("buffers", []))[0]
+                        except Exception:
+                            pass
+                gen._finish(total=reply["stream_count"], error=err)
 
     def _on_task_worker_failure(self, spec: TaskSpec, error: Exception):
         pending = self.pending_tasks.get(spec.task_id)
@@ -971,6 +1087,9 @@ class CoreWorker:
         self.pending_tasks.pop(spec.task_id, None)
         self._ensure_sets()
         self._finished_task_ids.add(spec.task_id)
+        gen = self._streams.get(spec.task_id)
+        if gen is not None:
+            gen._finish(total=len(gen._items), error=error)
         obj = serialization.serialize_error(error, task_name=spec.name)
         for oid in spec.return_object_ids():
             self.memory_store.put(oid, obj)

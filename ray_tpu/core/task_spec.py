@@ -118,7 +118,11 @@ class TaskSpec:
             repr(sorted((self.runtime_env or {}).items())),
         )
 
+    STREAMING = -1  # num_returns sentinel: generator task, refs stream
+
     def return_object_ids(self) -> List[ObjectID]:
+        if self.num_returns == self.STREAMING:
+            return []
         return [
             ObjectID.for_task_return(self.task_id, i + 1)
             for i in range(self.num_returns)

@@ -57,6 +57,8 @@ class Executor:
         self._started = False
         self._max_concurrency = 1
         self._is_async = False
+        # task hex -> owner connection (streaming-generator item channel)
+        self._stream_conns = {}
         # task hex -> executing thread ident (for cancellation)
         self._running_threads = {}
         self._cancelled_tasks = set()
@@ -127,11 +129,15 @@ class Executor:
         self.cw.record_task_event(
             spec, "FAILED" if reply.get("is_error") else "FINISHED")
 
-    async def submit(self, spec: TaskSpec) -> dict:
+    async def submit(self, spec: TaskSpec, conn=None) -> dict:
         fut = asyncio.get_running_loop().create_future()
         self.cw.record_task_event(spec, "PENDING_EXECUTION")
-        await self._queue.put((spec, fut))
-        return await fut
+        self._stream_conns[spec.task_id.hex()] = conn
+        try:
+            await self._queue.put((spec, fut))
+            return await fut
+        finally:
+            self._stream_conns.pop(spec.task_id.hex(), None)
 
     # ---- execution paths ----
 
@@ -157,17 +163,74 @@ class Executor:
             self.cw.fetch_function(spec.function_key)
         )
 
+    @staticmethod
+    def _apply_runtime_env(runtime_env: Optional[dict]):
+        """Apply a task's runtime env; returns an undo callable.
+
+        Reference: _private/runtime_env plugins. Supported here:
+        env_vars (os.environ overlay), working_dir (chdir + sys.path),
+        py_modules (sys.path). pip/conda/container need package installs
+        and are gated out in this runtime.
+        """
+        if not runtime_env:
+            return lambda: None
+        unsupported = set(runtime_env) - {"env_vars", "working_dir",
+                                          "py_modules"}
+        if unsupported:
+            raise exc.RayTpuError(
+                f"unsupported runtime_env keys: {sorted(unsupported)}")
+        saved_env = {}
+        added_paths = []
+        saved_cwd = None
+        for k, v in (runtime_env.get("env_vars") or {}).items():
+            saved_env[k] = os.environ.get(k)
+            os.environ[k] = str(v)
+        wd = runtime_env.get("working_dir")
+        if wd:
+            saved_cwd = os.getcwd()
+            os.chdir(wd)
+            if wd not in sys.path:
+                sys.path.insert(0, wd)
+                added_paths.append(wd)
+        for mod_path in runtime_env.get("py_modules") or []:
+            if mod_path not in sys.path:
+                sys.path.insert(0, mod_path)
+                added_paths.append(mod_path)
+
+        def undo():
+            for k, old in saved_env.items():
+                if old is None:
+                    os.environ.pop(k, None)
+                else:
+                    os.environ[k] = old
+            if saved_cwd is not None:
+                try:
+                    os.chdir(saved_cwd)
+                except OSError:
+                    pass
+            for p in added_paths:
+                try:
+                    sys.path.remove(p)
+                except ValueError:
+                    pass
+
+        return undo
+
     def _execute_sync(self, spec: TaskSpec) -> dict:
         tid = spec.task_id
         self.cw.set_current_task_id(tid)
         self._running_threads[tid.hex()] = threading.get_ident()
         self.cw.record_task_event(spec, "RUNNING")
+        undo_env = lambda: None  # noqa: E731
         try:
             if tid.hex() in self._cancelled_tasks:
                 raise exc.TaskCancelledError(f"task {spec.name} cancelled")
+            undo_env = self._apply_runtime_env(spec.runtime_env)
             args, kwargs = self._resolve_args(spec)
             if spec.task_type == TaskType.NORMAL_TASK:
                 fn = self._load_callable(spec)
+                if spec.num_returns == TaskSpec.STREAMING:
+                    return self._execute_streaming(spec, fn, args, kwargs)
                 value = fn(*args, **kwargs)
             elif spec.task_type == TaskType.ACTOR_CREATION_TASK:
                 cls = self._load_callable(spec)
@@ -192,6 +255,11 @@ class Executor:
                 raise
             return self._package_error(spec, e)
         finally:
+            # Actors keep their runtime env for life (the dedicated
+            # worker is theirs); plain tasks restore the pristine env so
+            # a reused worker doesn't leak one task's env into the next.
+            if spec.task_type == TaskType.NORMAL_TASK:
+                undo_env()
             self._running_threads.pop(tid.hex(), None)
             self._cancelled_tasks.discard(tid.hex())
             self.cw.set_current_task_id(None)
@@ -205,6 +273,9 @@ class Executor:
                 None, lambda: self._resolve_args(spec)
             )
             if spec.task_type == TaskType.ACTOR_CREATION_TASK:
+                # The actor owns this worker; its runtime env applies
+                # for the worker's lifetime.
+                self._apply_runtime_env(spec.runtime_env)
                 # NB: must await (not _load_callable) — blocking the loop
                 # here would deadlock the worker.
                 cls = await self.cw.fetch_function(spec.function_key)
@@ -225,6 +296,46 @@ class Executor:
             self.cw.set_current_task_id(None)
 
     # ---- return packaging ----
+
+    def _execute_streaming(self, spec: TaskSpec, fn, args, kwargs) -> dict:
+        """Generator task: each yielded value becomes its own return
+        object, reported to the owner over the push connection as it is
+        produced (reference: streaming generator returns,
+        task_manager.h:98). The final reply carries the item count."""
+        conn = self._stream_conns.get(spec.task_id.hex())
+        if conn is None:
+            raise exc.RayTpuError("streaming task has no owner channel")
+        count = 0
+        try:
+            for value in fn(*args, **kwargs):
+                object_id = ObjectID.for_task_return(spec.task_id,
+                                                     count + 1)
+                obj = serialization.serialize(value)
+                ret = self._store_return(object_id, obj)
+                payload = {"task_id": spec.task_id.hex(), **ret}
+                # Ordered delivery: notifications ride the same TCP
+                # stream as the final reply, which is sent only after
+                # this method returns.
+                self.cw.loop_thread.submit(
+                    conn.notify("stream_item", payload))
+                count += 1
+                if spec.task_id.hex() in self._cancelled_tasks:
+                    raise exc.TaskCancelledError(
+                        f"stream {spec.name} cancelled")
+        except BaseException as e:  # noqa: B036
+            if isinstance(e, (KeyboardInterrupt, SystemExit,
+                              ActorExitSignal)):
+                raise
+            err = serialization.serialize_error(e, task_name=spec.name)
+            return {
+                "returns": [], "is_error": True, "stream_count": count,
+                "error_payload": {
+                    "metadata": err.metadata, "inband": err.inband,
+                    "buffers": [bytes(memoryview(b))
+                                for b in err.buffers],
+                },
+            }
+        return {"returns": [], "is_error": False, "stream_count": count}
 
     def _package_returns(self, spec: TaskSpec, value) -> dict:
         n = spec.num_returns
@@ -249,6 +360,18 @@ class Executor:
     def _package_error(self, spec: TaskSpec, error: BaseException) -> dict:
         logger.info("task %s failed: %r", spec.name, error)
         obj = serialization.serialize_error(error, task_name=spec.name)
+        if spec.num_returns == TaskSpec.STREAMING:
+            # A streaming task that failed before (or outside) its
+            # generator body still must close the owner's stream, or
+            # iteration would hang forever with the error lost.
+            return {
+                "returns": [], "is_error": True, "stream_count": 0,
+                "error_payload": {
+                    "metadata": obj.metadata, "inband": obj.inband,
+                    "buffers": [bytes(memoryview(b))
+                                for b in obj.buffers],
+                },
+            }
         returns = []
         for object_id in spec.return_object_ids():
             returns.append(self._store_return(object_id, obj))
@@ -332,7 +455,7 @@ async def _amain():
         # this covers plain tasks on a fresh worker.
         executor.ensure_started()
         try:
-            return await executor.submit(spec)
+            return await executor.submit(spec, conn)
         except ActorExitSignal:
             out = {"returns": [], "is_error": False}
             asyncio.get_running_loop().create_task(_graceful_actor_exit())

@@ -154,8 +154,12 @@ def _flash_bwd(causal, sm_scale, block_q, block_k, residuals, g):
     scale = sm_scale if sm_scale is not None else 1.0 / math.sqrt(d)
     bk = min(block_k, sk)
     if sk % bk:
-        bk = sk  # irregular sizes: single block (still no S x S tensor
-        # when sq is large and sk small; the common path is regular)
+        # Irregular length: largest divisor of sk that fits the block
+        # budget, keeping memory O(S * block) — collapsing to one block
+        # would materialize the full S x S tensor this path exists to
+        # avoid.
+        bk = max(d for d in range(1, min(block_k, sk) + 1)
+                 if sk % d == 0)
     nk = sk // bk
 
     # (B, S, H, D) -> (B*H, S, D), f32 accumulation.

@@ -1,0 +1,39 @@
+"""joblib backend over the cluster (reference: python/ray/util/joblib —
+register_ray() lets sklearn's n_jobs parallelism run on the cluster)."""
+
+from __future__ import annotations
+
+
+def register_ray_tpu() -> None:
+    """Register the 'ray_tpu' joblib parallel backend."""
+    from joblib import register_parallel_backend
+    from joblib._parallel_backends import MultiprocessingBackend
+
+    from ray_tpu.util.multiprocessing import Pool
+
+    class RayTpuBackend(MultiprocessingBackend):
+        supports_sharedmem = False
+
+        def effective_n_jobs(self, n_jobs):
+            import ray_tpu
+
+            if not ray_tpu.is_initialized():
+                ray_tpu.init()
+            cpus = int(ray_tpu.cluster_resources().get("CPU", 1))
+            if n_jobs is None or n_jobs < 0:
+                return max(1, cpus - 1)
+            return min(n_jobs, cpus)
+
+        def configure(self, n_jobs=1, parallel=None, prefer=None,
+                      require=None, **kwargs):
+            n_jobs = self.effective_n_jobs(n_jobs)
+            self._pool = Pool(processes=n_jobs)
+            self.parallel = parallel
+            return n_jobs
+
+        def terminate(self):
+            if getattr(self, "_pool", None) is not None:
+                self._pool.terminate()
+                self._pool = None
+
+    register_parallel_backend("ray_tpu", RayTpuBackend)

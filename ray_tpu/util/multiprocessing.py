@@ -1,0 +1,177 @@
+"""multiprocessing.Pool drop-in over the task substrate.
+
+Reference: python/ray/util/multiprocessing/ — a Pool whose workers are
+cluster tasks/actors, so existing multiprocessing code scales past one
+machine unchanged.
+"""
+
+from __future__ import annotations
+
+import itertools
+from typing import Any, Callable, Iterable, List, Optional
+
+import ray_tpu
+from ray_tpu.util.actor_pool import ActorPool
+
+
+class _PoolWorker:
+    def run(self, fn, args, kwargs):
+        return fn(*args, **(kwargs or {}))
+
+    def run_batch(self, fn, chunk):
+        return [fn(item) for item in chunk]
+
+    def starrun_batch(self, fn, chunk):
+        return [fn(*item) for item in chunk]
+
+
+class AsyncResult:
+    def __init__(self, refs: List[Any], flatten: bool = False,
+                 single: bool = False):
+        self._refs = refs
+        self._flatten = flatten
+        self._single = single
+
+    def get(self, timeout: Optional[float] = None):
+        out = ray_tpu.get(self._refs, timeout=timeout)
+        if self._single:
+            return out[0]
+        if self._flatten:
+            return [x for chunk in out for x in chunk]
+        return out
+
+    def wait(self, timeout: Optional[float] = None):
+        ray_tpu.wait(self._refs, num_returns=len(self._refs),
+                     timeout=timeout)
+
+    def ready(self) -> bool:
+        ready, _ = ray_tpu.wait(self._refs, num_returns=len(self._refs),
+                                timeout=0)
+        return len(ready) == len(self._refs)
+
+    def successful(self) -> bool:
+        try:
+            self.get(timeout=0.001)
+            return True
+        except Exception:
+            return False
+
+
+class Pool:
+    """Reference: ray.util.multiprocessing.Pool."""
+
+    def __init__(self, processes: Optional[int] = None, *,
+                 ray_remote_args: Optional[dict] = None):
+        if not ray_tpu.is_initialized():
+            ray_tpu.init()
+        if processes is None:
+            processes = max(1, int(
+                ray_tpu.cluster_resources().get("CPU", 2)) - 1)
+        opts = dict(ray_remote_args or {})
+        opts.setdefault("num_cpus", 1)
+        self._actors = [
+            ray_tpu.remote(_PoolWorker).options(**opts).remote()
+            for _ in range(processes)]
+        self._pool = ActorPool(self._actors)
+        self._closed = False
+        self._rr = itertools.cycle(range(processes))
+
+    def _check(self):
+        if self._closed:
+            raise ValueError("Pool not running")
+
+    @staticmethod
+    def _chunks(iterable, chunksize) -> List[list]:
+        items = list(iterable)
+        if chunksize is None:
+            chunksize = max(1, len(items) // 64 or 1)
+        return [items[i:i + chunksize]
+                for i in range(0, len(items), chunksize)]
+
+    # -- apply ----------------------------------------------------------
+    def apply(self, fn: Callable, args: tuple = (), kwds: dict = None):
+        return self.apply_async(fn, args, kwds).get(timeout=None)
+
+    def apply_async(self, fn: Callable, args: tuple = (),
+                    kwds: dict = None, callback: Callable = None,
+                    error_callback: Callable = None) -> AsyncResult:
+        self._check()
+        actor = self._actors[next(self._rr)]
+        ref = actor.run.remote(fn, args, kwds)
+        if callback is not None or error_callback is not None:
+            def fire(fut):
+                try:
+                    value = fut.result()
+                except Exception as e:
+                    if error_callback is not None:
+                        error_callback(e)
+                    return
+                if callback is not None:
+                    callback(value)
+
+            ref.future().add_done_callback(fire)
+        return AsyncResult([ref], single=True)
+
+    # -- map ------------------------------------------------------------
+    def map(self, fn: Callable, iterable: Iterable,
+            chunksize: Optional[int] = None) -> List[Any]:
+        return self.map_async(fn, iterable, chunksize).get(timeout=None)
+
+    def map_async(self, fn: Callable, iterable: Iterable,
+                  chunksize: Optional[int] = None) -> AsyncResult:
+        self._check()
+        refs = []
+        for i, chunk in enumerate(self._chunks(iterable, chunksize)):
+            actor = self._actors[i % len(self._actors)]
+            refs.append(actor.run_batch.remote(fn, chunk))
+        return AsyncResult(refs, flatten=True)
+
+    def starmap(self, fn: Callable, iterable: Iterable,
+                chunksize: Optional[int] = None) -> List[Any]:
+        self._check()
+        refs = []
+        for i, chunk in enumerate(self._chunks(iterable, chunksize)):
+            actor = self._actors[i % len(self._actors)]
+            refs.append(actor.starrun_batch.remote(fn, chunk))
+        return AsyncResult(refs, flatten=True).get(timeout=None)
+
+    def imap(self, fn: Callable, iterable: Iterable,
+             chunksize: int = 1):
+        self._check()
+        refs = []
+        for i, chunk in enumerate(self._chunks(iterable, chunksize)):
+            actor = self._actors[i % len(self._actors)]
+            refs.append(actor.run_batch.remote(fn, chunk))
+        for ref in refs:
+            yield from ray_tpu.get(ref, timeout=None)
+
+    def imap_unordered(self, fn: Callable, iterable: Iterable,
+                       chunksize: int = 1):
+        self._check()
+        chunks = self._chunks(iterable, chunksize)
+        for result in self._pool.map_unordered(
+                lambda a, c: a.run_batch.remote(fn, c), chunks):
+            yield from result
+
+    # -- lifecycle -------------------------------------------------------
+    def close(self):
+        self._closed = True
+
+    def terminate(self):
+        self._closed = True
+        for a in self._actors:
+            try:
+                ray_tpu.kill(a)
+            except Exception:
+                pass
+
+    def join(self):
+        if not self._closed:
+            raise ValueError("join() before close()")
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        self.terminate()
+        return False

@@ -1,0 +1,104 @@
+"""Tests for runtime envs, the multiprocessing Pool shim, and the joblib
+backend (reference strategy: python/ray/tests/test_runtime_env*.py,
+util/multiprocessing tests, util/joblib tests)."""
+
+import os
+
+import pytest
+
+import ray_tpu
+
+
+@pytest.fixture(scope="module")
+def re_cluster():
+    ray_tpu.init(num_cpus=4, num_tpus=0)
+    yield
+    ray_tpu.shutdown()
+
+
+def test_task_env_vars(re_cluster):
+    @ray_tpu.remote
+    def read_env(key):
+        return os.environ.get(key)
+
+    val = ray_tpu.get(
+        read_env.options(runtime_env={
+            "env_vars": {"RTPU_TEST_VAR": "hello"}}).remote("RTPU_TEST_VAR"),
+        timeout=60)
+    assert val == "hello"
+    # Plain task on a (possibly reused) worker must NOT see the var.
+    val2 = ray_tpu.get(read_env.remote("RTPU_TEST_VAR"), timeout=60)
+    assert val2 is None
+
+
+def test_task_working_dir(re_cluster, tmp_path):
+    (tmp_path / "data.txt").write_text("payload")
+
+    @ray_tpu.remote
+    def read_local():
+        with open("data.txt") as f:
+            return f.read()
+
+    out = ray_tpu.get(
+        read_local.options(runtime_env={
+            "working_dir": str(tmp_path)}).remote(), timeout=60)
+    assert out == "payload"
+
+
+def test_actor_keeps_env(re_cluster):
+    class EnvActor:
+        def read(self, key):
+            return os.environ.get(key)
+
+    a = (ray_tpu.remote(EnvActor)
+         .options(num_cpus=0.5,
+                  runtime_env={"env_vars": {"RTPU_ACTOR_VAR": "sticky"}})
+         .remote())
+    assert ray_tpu.get(a.read.remote("RTPU_ACTOR_VAR"), timeout=60) == \
+        "sticky"
+    # Still set on the second call (actors keep their env for life).
+    assert ray_tpu.get(a.read.remote("RTPU_ACTOR_VAR"), timeout=60) == \
+        "sticky"
+    ray_tpu.kill(a)
+
+
+def test_unsupported_runtime_env_key_errors(re_cluster):
+    @ray_tpu.remote
+    def noop():
+        return 1
+
+    from ray_tpu.exceptions import RayTpuError, TaskError
+
+    with pytest.raises((RayTpuError, TaskError)):
+        ray_tpu.get(noop.options(runtime_env={
+            "pip": ["requests"]}).remote(), timeout=60)
+
+
+def _square(x):
+    return x * x
+
+
+def test_multiprocessing_pool(re_cluster):
+    from ray_tpu.util.multiprocessing import Pool
+
+    with Pool(processes=2) as pool:
+        assert pool.map(_square, range(10)) == [i * i for i in range(10)]
+        assert pool.apply(_square, (7,)) == 49
+        r = pool.apply_async(_square, (9,))
+        assert r.get(timeout=60) == 81
+        assert sorted(pool.imap_unordered(_square, range(6))) == \
+            [0, 1, 4, 9, 16, 25]
+        assert list(pool.imap(_square, range(6))) == \
+            [0, 1, 4, 9, 16, 25]
+        assert pool.starmap(pow, [(2, 3), (3, 2)]) == [8, 9]
+
+
+def test_joblib_backend(re_cluster):
+    joblib = pytest.importorskip("joblib")
+    from ray_tpu.util.joblib_backend import register_ray_tpu
+
+    register_ray_tpu()
+    with joblib.parallel_backend("ray_tpu", n_jobs=2):
+        out = joblib.Parallel()(
+            joblib.delayed(_square)(i) for i in range(8))
+    assert out == [i * i for i in range(8)]

@@ -1,0 +1,114 @@
+"""Streaming-generator task tests (reference strategy:
+python/ray/tests/test_streaming_generator*.py)."""
+
+import time
+
+import numpy as np
+import pytest
+
+import ray_tpu
+
+
+@pytest.fixture(scope="module")
+def stream_cluster():
+    ray_tpu.init(num_cpus=4, num_tpus=0)
+    yield
+    ray_tpu.shutdown()
+
+
+def test_streaming_basic(stream_cluster):
+    @ray_tpu.remote
+    def gen(n):
+        for i in range(n):
+            yield i * 10
+
+    out = [ray_tpu.get(ref, timeout=60)
+           for ref in gen.options(num_returns="streaming").remote(5)]
+    assert out == [0, 10, 20, 30, 40]
+
+
+def test_streaming_incremental_delivery(stream_cluster):
+    @ray_tpu.remote
+    def slow_gen():
+        for i in range(4):
+            yield i
+            time.sleep(0.4)
+
+    g = slow_gen.options(num_returns="streaming").remote()
+    t0 = time.time()
+    first = ray_tpu.get(next(iter(g)), timeout=60)
+    first_latency = time.time() - t0
+    assert first == 0
+    # The first item must arrive well before the task finishes (~1.6s).
+    assert first_latency < 1.2, first_latency
+    rest = [ray_tpu.get(r, timeout=60) for r in g]
+    assert rest == [1, 2, 3]
+
+
+def test_streaming_large_items_through_store(stream_cluster):
+    @ray_tpu.remote
+    def big_gen():
+        for i in range(3):
+            yield np.full((300_000,), i, dtype=np.float64)  # > inline
+
+    vals = [ray_tpu.get(r, timeout=120)
+            for r in big_gen.options(num_returns="streaming").remote()]
+    assert [v[0] for v in vals] == [0.0, 1.0, 2.0]
+    assert all(v.shape == (300_000,) for v in vals)
+
+
+def test_streaming_error_mid_stream(stream_cluster):
+    @ray_tpu.remote
+    def bad_gen():
+        yield 1
+        yield 2
+        raise ValueError("stream exploded")
+
+    g = bad_gen.options(num_returns="streaming").remote()
+    assert ray_tpu.get(next(g), timeout=60) == 1
+    assert ray_tpu.get(next(g), timeout=60) == 2
+    with pytest.raises(Exception, match="stream exploded"):
+        next(g)  # the failure surfaces at end-of-stream
+
+
+def test_streaming_pre_generator_failure_closes_stream(stream_cluster):
+    @ray_tpu.remote
+    def gen_bad_env():
+        yield 1
+
+    g = (gen_bad_env
+         .options(num_returns="streaming",
+                  runtime_env={"pip": ["requests"]})
+         .remote())
+    with pytest.raises(Exception, match="runtime_env"):
+        next(g)  # setup error closes the stream instead of hanging
+
+
+def test_streaming_on_actor_method_raises(stream_cluster):
+    class A:
+        def gen(self):
+            yield 1
+
+    a = ray_tpu.remote(A).options(num_cpus=0.1).remote()
+    with pytest.raises(TypeError, match="streaming"):
+        a.gen.options(num_returns="streaming").remote()
+    ray_tpu.kill(a)
+
+
+def test_streaming_requires_generator(stream_cluster):
+    @ray_tpu.remote
+    def not_gen():
+        return 1
+
+    with pytest.raises(TypeError, match="generator"):
+        not_gen.options(num_returns="streaming").remote()
+
+
+def test_streaming_many_items(stream_cluster):
+    @ray_tpu.remote
+    def wide():
+        yield from range(200)
+
+    total = sum(ray_tpu.get(r, timeout=120)
+                for r in wide.options(num_returns="streaming").remote())
+    assert total == sum(range(200))
