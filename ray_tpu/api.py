@@ -170,6 +170,10 @@ def _connect_remote_driver(address: str, config: Config, namespace: str
         loop_thread.stop()
         raise
     cw.job_id = JobID.from_hex(reply["job_id"])
+    if reply.get("session_dir"):
+        # Spill files must resolve to the cluster's session dir, not a
+        # per-process default, or spilled objects are unreadable here.
+        os.environ["RAY_TPU_SESSION_DIR"] = reply["session_dir"]
     from ray_tpu.core.ids import TaskID
 
     cw._root_task_id = TaskID.for_normal_task(cw.job_id)

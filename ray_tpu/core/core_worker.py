@@ -378,6 +378,21 @@ class CoreWorker:
             self.reference_counter.register_owned(object_id, False)
         return object_id
 
+    def _release_stream(self, task_id: TaskID):
+        """Consumer dropped or exhausted the generator: deregister, and
+        cancel the producer if it is still running so an abandoned
+        stream doesn't keep yielding."""
+        if self._streams.pop(task_id, None) is None:
+            return
+        pending = self.pending_tasks.get(task_id)
+        if pending is not None and not pending.cancelled:
+            try:
+                ref = ObjectRef(ObjectID.for_task_return(task_id, 1),
+                                self.address, is_owned=False)
+                self.cancel_task(ref, force=False)
+            except Exception:
+                pass
+
     async def h_stream_item(self, conn, payload):
         """A streaming task's executor reports one yielded item
         (reference: the streaming-generator return path feeding
@@ -385,6 +400,13 @@ class CoreWorker:
         task_id = TaskID.from_hex(payload["task_id"])
         gen = self._streams.get(task_id)
         if gen is None:
+            # Abandoned stream: the consumer is gone, so this item has
+            # no owner. Free the sealed copy instead of leaking a
+            # pinned arena object.
+            if payload.get("in_plasma"):
+                object_id = ObjectID(payload["object_id"])
+                asyncio.ensure_future(self.head.call(
+                    "free_objects", {"object_ids": [object_id.hex()]}))
             return {"ok": False}
         object_id = self._ingest_return(payload)
         gen._append(ObjectRef(object_id, self.address, is_owned=True))
@@ -837,7 +859,7 @@ class CoreWorker:
         )
         if num_returns == TaskSpec.STREAMING:
             gen = ObjectRefGenerator(
-                task_id, cleanup=lambda: self._streams.pop(task_id, None))
+                task_id, cleanup=lambda: self._release_stream(task_id))
             self._streams[task_id] = gen
             self.loop.call_soon_threadsafe(self._submit_on_loop, spec)
             return gen

@@ -153,8 +153,15 @@ class NativeArena:
             self._base_addr + offset)
         return memoryview(buf).cast("B")
 
-    def create_and_seal(self, key20: bytes, data) -> bool:
-        """Returns False if the object already exists (idempotent)."""
+    def create_and_seal(self, key20: bytes, data,
+                        pin_primary: bool = True) -> bool:
+        """Returns False if the object already exists (idempotent).
+
+        ``pin_primary``: hold the primary-copy pin so LRU eviction never
+        drops an object whose owner still references it (the owner's
+        delete path ignores pins); capacity overflow then surfaces as
+        ObjectStoreFullError for the caller to spill to disk.
+        """
         mv = memoryview(data).cast("B")
         off = ctypes.c_uint64()
         rc = self._lib.ts_alloc(self._h, key20, mv.nbytes,
@@ -173,6 +180,8 @@ class NativeArena:
         rc = self._lib.ts_seal(self._h, key20)
         if rc != TS_OK:
             raise RuntimeError(f"ts_seal failed: {rc}")
+        if pin_primary:
+            self._lib.ts_pin(self._h, key20)
         return True
 
     def lookup(self, key20: bytes, *, pin_for_read: bool = True

@@ -61,6 +61,9 @@ class HeadNode:
         self.config = config
         self.session_dir = session_dir or _make_session_dir()
         os.makedirs(os.path.join(self.session_dir, "logs"), exist_ok=True)
+        # Driver-side spill path must match workers' (they inherit it
+        # through the spawn env).
+        os.environ["RAY_TPU_SESSION_DIR"] = self.session_dir
         capacity = config.object_store_memory or default_capacity(
             config.object_store_memory_proportion
         )

@@ -204,7 +204,11 @@ class ShmStore:
                     f"{self._used}/{self.capacity} used"
                 )
             self._used += size
-            self._entries[hex_id] = {"size": size, "sealed": False, "pins": 0}
+            # Primary-copy pin (matches the native arena): eviction must
+            # never drop an object its owner still references; overflow
+            # surfaces as ObjectStoreFullError and spills to disk.
+            self._entries[hex_id] = {"size": size, "sealed": False,
+                                     "pins": 1}
 
     def _release(self, hex_id: str):
         with self._lock:
@@ -268,7 +272,8 @@ class ShmStore:
             if hex_id not in self._entries:
                 self._evict_for(size)
                 self._used += size
-                self._entries[hex_id] = {"size": size, "sealed": True, "pins": 0}
+                self._entries[hex_id] = {"size": size, "sealed": True,
+                                         "pins": 1}  # primary-copy pin
             else:
                 self._entries[hex_id]["sealed"] = True
             self._entries.move_to_end(hex_id)
@@ -294,6 +299,7 @@ class ShmStore:
         hex_id = object_id.hex()
         self._release(hex_id)
         _unlink_segment(hex_id)
+        spill_delete(object_id)
 
     def used_bytes(self) -> int:
         with self._lock:
@@ -371,6 +377,7 @@ class NativeShmStore:
 
     def delete(self, object_id: ObjectID):
         self.arena.delete(object_id.binary())
+        spill_delete(object_id)
 
     def used_bytes(self) -> int:
         return self.arena.used_bytes()
@@ -382,22 +389,77 @@ class NativeShmStore:
         self.arena.destroy()
 
 
+def spill_dir() -> str:
+    """Directory for objects that overflow shared memory (reference:
+    fallback allocation + object spilling, local_object_manager.h:41 /
+    external_storage.py)."""
+    base = os.environ.get("RAY_TPU_SESSION_DIR")
+    if base:
+        return os.path.join(base, "spill")
+    import tempfile
+
+    return os.path.join(tempfile.gettempdir(), "ray_tpu", "spill")
+
+
+def _spill_path(object_id: ObjectID) -> str:
+    return os.path.join(spill_dir(), object_id.hex())
+
+
+def _spill_write(object_id: ObjectID, data: bytes) -> int:
+    path = _spill_path(object_id)
+    os.makedirs(os.path.dirname(path), exist_ok=True)
+    tmp = path + f".tmp{os.getpid()}"
+    with open(tmp, "wb") as f:
+        f.write(data)
+    os.replace(tmp, path)
+    return len(data)
+
+
+def _spill_open(object_id: ObjectID) -> Optional[SerializedObject]:
+    """mmap a spilled object — page-cache-backed zero-copy buffers."""
+    import mmap
+
+    path = _spill_path(object_id)
+    try:
+        f = open(path, "rb")
+    except FileNotFoundError:
+        return None
+    try:
+        mapped = mmap.mmap(f.fileno(), 0, access=mmap.ACCESS_READ)
+    finally:
+        f.close()
+    return parse_packed(memoryview(mapped))
+
+
+def spill_delete(object_id: ObjectID) -> None:
+    try:
+        os.remove(_spill_path(object_id))
+    except OSError:
+        pass
+
+
 def node_store_write(object_id: ObjectID, obj: SerializedObject) -> int:
     """Worker-side write of a large object to the node store (native
-    arena when enabled, else a per-object shm segment)."""
+    arena when enabled, else a per-object shm segment); overflows to a
+    disk spill file when shared memory can't fit the object."""
     from ray_tpu.core import native_store
 
     arena = native_store.get_attached_arena()
     data = ShmStore.pack(obj)
     if arena is not None:
-        arena.create_and_seal(object_id.binary(), data)
-        return len(data)
+        try:
+            arena.create_and_seal(object_id.binary(), data)
+            return len(data)
+        except ObjectStoreFullError:
+            return _spill_write(object_id, data)
     try:
         seg = shared_memory.SharedMemory(
             name=segment_name(object_id), create=True,
             size=max(len(data), 1))
     except FileExistsError:
         return len(data)
+    except OSError:
+        return _spill_write(object_id, data)
     try:
         seg.buf[:len(data)] = data
     finally:
@@ -406,7 +468,8 @@ def node_store_write(object_id: ObjectID, obj: SerializedObject) -> int:
 
 
 def node_store_open(object_id: ObjectID) -> Optional[SerializedObject]:
-    """Worker-side zero-copy read from the node store."""
+    """Worker-side zero-copy read from the node store (arena or
+    per-segment shm, falling back to the disk spill area)."""
     from ray_tpu.core import native_store
 
     arena = native_store.get_attached_arena()
@@ -414,8 +477,11 @@ def node_store_open(object_id: ObjectID) -> Optional[SerializedObject]:
         view = arena.lookup(object_id.binary())
         if view is not None:
             return parse_packed(view)
-        return None
-    return ShmStore.open_object(object_id)
+        return _spill_open(object_id)
+    obj = ShmStore.open_object(object_id)
+    if obj is not None:
+        return obj
+    return _spill_open(object_id)
 
 
 def _unlink_segment(hex_id: str):

@@ -153,14 +153,8 @@ def _flash_bwd(causal, sm_scale, block_q, block_k, residuals, g):
     _, sk, _, _ = k.shape
     scale = sm_scale if sm_scale is not None else 1.0 / math.sqrt(d)
     bk = min(block_k, sk)
-    if sk % bk:
-        # Irregular length: largest divisor of sk that fits the block
-        # budget, keeping memory O(S * block) — collapsing to one block
-        # would materialize the full S x S tensor this path exists to
-        # avoid.
-        bk = max(d for d in range(1, min(block_k, sk) + 1)
-                 if sk % d == 0)
-    nk = sk // bk
+    sk_pad = ((sk + bk - 1) // bk) * bk
+    nk = sk_pad // bk
 
     # (B, S, H, D) -> (B*H, S, D), f32 accumulation.
     def flat(x):
@@ -169,6 +163,14 @@ def _flash_bwd(causal, sm_scale, block_q, block_k, residuals, g):
                 .astype(jnp.float32))
 
     qf, kf, vf, of, gf = map(flat, (q, k, v, out, g))
+    if sk_pad != sk:
+        # Pad keys/values to a block multiple; padded positions are
+        # masked out of the scores in both passes (k_pos >= sk). This
+        # keeps memory O(S * block) for any length — a divisor-based
+        # fallback degenerates to tiny blocks on prime lengths.
+        pad = ((0, 0), (0, sk_pad - sk), (0, 0))
+        kf = jnp.pad(kf, pad)
+        vf = jnp.pad(vf, pad)
     q_pos = jnp.arange(sq)
 
     # delta_i = rowsum(dO_i * O_i)  (flash-attention bwd identity).
@@ -179,10 +181,14 @@ def _flash_bwd(causal, sm_scale, block_q, block_k, residuals, g):
         m_run, l_run = carry
         kb = jax.lax.dynamic_slice_in_dim(kf, j * bk, bk, axis=1)
         s = jnp.einsum("bqd,bkd->bqk", qf, kb) * scale
+        kp = j * bk + jnp.arange(bk)
+        valid = kp < sk
         if causal:
-            kp = j * bk + jnp.arange(bk)
-            s = jnp.where(q_pos[None, :, None] >= kp[None, None, :],
-                          s, _NEG_INF)
+            valid = valid[None, None, :] & (
+                q_pos[None, :, None] >= kp[None, None, :])
+        else:
+            valid = jnp.broadcast_to(valid[None, None, :], s.shape)
+        s = jnp.where(valid, s, _NEG_INF)
         m_cur = jnp.max(s, axis=-1)
         m_new = jnp.maximum(m_run, m_cur)
         l_run = (l_run * jnp.exp(m_run - m_new)
@@ -202,10 +208,14 @@ def _flash_bwd(causal, sm_scale, block_q, block_k, residuals, g):
         kb = jax.lax.dynamic_slice_in_dim(kf, j * bk, bk, axis=1)
         vb = jax.lax.dynamic_slice_in_dim(vf, j * bk, bk, axis=1)
         s = jnp.einsum("bqd,bkd->bqk", qf, kb) * scale
+        kp = j * bk + jnp.arange(bk)
+        valid = kp < sk
         if causal:
-            kp = j * bk + jnp.arange(bk)
-            s = jnp.where(q_pos[None, :, None] >= kp[None, None, :],
-                          s, _NEG_INF)
+            valid = valid[None, None, :] & (
+                q_pos[None, :, None] >= kp[None, None, :])
+        else:
+            valid = jnp.broadcast_to(valid[None, None, :], s.shape)
+        s = jnp.where(valid, s, _NEG_INF)
         p = jnp.exp(s - lse[..., None])  # (BH, Sq, bk)
         dv_j = jnp.einsum("bqk,bqd->bkd", p, gf)
         dp = jnp.einsum("bqd,bkd->bqk", gf, vb)
@@ -216,8 +226,8 @@ def _flash_bwd(causal, sm_scale, block_q, block_k, residuals, g):
 
     dq, (dk_blocks, dv_blocks) = jax.lax.scan(
         grad_step, jnp.zeros_like(qf), jnp.arange(nk))
-    dk = jnp.moveaxis(dk_blocks, 0, 1).reshape(bh, sk, d)
-    dv = jnp.moveaxis(dv_blocks, 0, 1).reshape(bh, sk, d)
+    dk = jnp.moveaxis(dk_blocks, 0, 1).reshape(bh, sk_pad, d)[:, :sk]
+    dv = jnp.moveaxis(dv_blocks, 0, 1).reshape(bh, sk_pad, d)[:, :sk]
 
     def unflat(x, dtype, s):
         return (x.reshape(batch, heads, s, d)

@@ -82,9 +82,17 @@ def local_mesh(**axis_sizes) -> Mesh:
     parallelism) build a custom mesh directly."""
     if all(a in AXES for a in axis_sizes):
         return create_mesh(MeshConfig(**axis_sizes))
+    # Custom axes have no "-1 means the rest" resolution.
+    bad = {a: s for a, s in axis_sizes.items() if s < 1}
+    if bad:
+        raise ValueError(f"custom mesh axes need explicit sizes >= 1: {bad}")
     names = tuple(axis_sizes)
     shape = tuple(axis_sizes[a] for a in names)
     n = math.prod(shape)
+    if n > len(jax.devices()):
+        raise ValueError(
+            f"mesh {dict(axis_sizes)} needs {n} devices, "
+            f"have {len(jax.devices())}")
     dev_array = np.array(jax.devices()[:n]).reshape(shape)
     return Mesh(dev_array, names)
 

@@ -50,8 +50,10 @@ class AsyncResult:
         return len(ready) == len(self._refs)
 
     def successful(self) -> bool:
+        if not self.ready():
+            raise ValueError("result is not ready")
         try:
-            self.get(timeout=0.001)
+            self.get(timeout=1.0)
             return True
         except Exception:
             return False
@@ -75,6 +77,7 @@ class Pool:
         self._pool = ActorPool(self._actors)
         self._closed = False
         self._rr = itertools.cycle(range(processes))
+        self._outstanding: List[Any] = []
 
     def _check(self):
         if self._closed:
@@ -98,6 +101,7 @@ class Pool:
         self._check()
         actor = self._actors[next(self._rr)]
         ref = actor.run.remote(fn, args, kwds)
+        self._outstanding.append(ref)
         if callback is not None or error_callback is not None:
             def fire(fut):
                 try:
@@ -124,6 +128,7 @@ class Pool:
         for i, chunk in enumerate(self._chunks(iterable, chunksize)):
             actor = self._actors[i % len(self._actors)]
             refs.append(actor.run_batch.remote(fn, chunk))
+        self._outstanding.extend(refs)
         return AsyncResult(refs, flatten=True)
 
     def starmap(self, fn: Callable, iterable: Iterable,
@@ -166,8 +171,20 @@ class Pool:
                 pass
 
     def join(self):
+        """Wait for outstanding work, then release the worker actors —
+        the standard close()+join() lifecycle must not leak actors."""
         if not self._closed:
             raise ValueError("join() before close()")
+        if self._outstanding:
+            ray_tpu.wait(self._outstanding,
+                         num_returns=len(self._outstanding), timeout=None)
+            self._outstanding = []
+        for a in self._actors:
+            try:
+                ray_tpu.kill(a)
+            except Exception:
+                pass
+        self._actors = []
 
     def __enter__(self):
         return self

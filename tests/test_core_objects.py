@@ -102,8 +102,12 @@ def test_shm_store_eviction():
     store = ShmStore(capacity_bytes=10_000)
     a = ObjectID.from_random()
     store.mark_sealed(a, 6_000)
+    # Sealed objects carry the primary-copy pin: they are NOT evictable
+    # while their owner may still reference them (overflow spills to
+    # disk instead). Only an unpinned object can be evicted.
+    store.unpin(a)
     b = ObjectID.from_random()
-    store.mark_sealed(b, 6_000)  # evicts a
+    store.mark_sealed(b, 6_000)  # evicts a (unpinned)
     assert store.used_bytes() <= 10_000
     assert store.contains(b)
     assert not store.contains(a)

@@ -55,9 +55,9 @@ def test_delete_frees_space(arena):
 def test_lru_eviction_and_pinning(arena):
     pinned = b"p" * 20
     arena.create_and_seal(pinned, b"precious")
-    arena.pin(pinned)
     for i in range(60):
-        arena.create_and_seal(i.to_bytes(20, "little"), os.urandom(40000))
+        arena.create_and_seal(i.to_bytes(20, "little"), os.urandom(40000),
+                              pin_primary=False)
     assert arena.num_evicted() > 0
     assert arena.contains(pinned)  # pinned survived the pressure
     arena.unpin(pinned)
@@ -65,13 +65,14 @@ def test_lru_eviction_and_pinning(arena):
 
 def test_lookup_bumps_lru(arena):
     hot = b"h" * 20
-    arena.create_and_seal(hot, os.urandom(1000))
+    arena.create_and_seal(hot, os.urandom(1000), pin_primary=False)
     cold = b"c" * 20
-    arena.create_and_seal(cold, os.urandom(1000))
+    arena.create_and_seal(cold, os.urandom(1000), pin_primary=False)
     # Touch hot repeatedly while filling; cold should evict first.
     for i in range(50):
-        arena.lookup(hot)
-        arena.create_and_seal(i.to_bytes(20, "big"), os.urandom(30000))
+        arena.lookup(hot, pin_for_read=False)
+        arena.create_and_seal(i.to_bytes(20, "big"), os.urandom(30000),
+                              pin_primary=False)
     if arena.num_evicted() > 0 and arena.contains(hot):
         assert not arena.contains(cold) or arena.contains(hot)
 
