@@ -17,6 +17,7 @@ from ray_tpu.train.session import (
     get_dataset_shard,
     report,
 )
+from ray_tpu.train.predictor import JaxPredictor, predict_dataset
 from ray_tpu.train.trainer import DataParallelTrainer, JaxTrainer
 
 __all__ = [
@@ -26,6 +27,7 @@ __all__ = [
     "DataParallelTrainer",
     "FailureConfig",
     "JaxBackend",
+    "JaxPredictor",
     "JaxTrainer",
     "Result",
     "RunConfig",
@@ -34,5 +36,6 @@ __all__ = [
     "get_checkpoint",
     "get_context",
     "get_dataset_shard",
+    "predict_dataset",
     "report",
 ]
