@@ -1,0 +1,21 @@
+"""Data: distributed ETL -> shuffle -> batched iteration into JAX.
+
+Run: JAX_PLATFORMS=cpu python examples/data_pipeline.py
+"""
+import ray_tpu
+from ray_tpu import data as rd
+
+if __name__ == "__main__":
+    ray_tpu.init(num_cpus=4, num_tpus=0)
+    ds = (rd.range(10_000, parallelism=8)
+          .map_batches(lambda b: {"item": b["item"],
+                                  "sq": b["item"] ** 2})
+          .filter(lambda r: r["item"] % 3 == 0)
+          .random_shuffle(seed=0))
+    print("rows:", ds.count())
+    print("mean of squares:", ds.mean(on="sq"))
+    for i, batch in enumerate(ds.iter_batches(batch_size=512,
+                                              batch_format="jax")):
+        if i == 0:
+            print("first batch:", {k: v.shape for k, v in batch.items()})
+    ray_tpu.shutdown()

@@ -1,0 +1,21 @@
+"""RLlib: PPO on the built-in vectorized CartPole.
+
+Run: JAX_PLATFORMS=cpu python examples/rllib_ppo_cartpole.py
+"""
+import ray_tpu
+from ray_tpu import rllib as rl
+
+if __name__ == "__main__":
+    ray_tpu.init(num_cpus=4, num_tpus=0)
+    algo = (rl.PPOConfig()
+            .environment("CartPole-v1", num_envs_per_env_runner=8)
+            .env_runners(num_env_runners=2, rollout_fragment_length=64,
+                         num_cpus_per_env_runner=0.5)
+            .training(lr=1e-3)
+            .debugging(seed=0)
+            .build())
+    for i in range(5):
+        result = algo.step()
+        print(f"iter {i}: return={result.get('episode_return_mean')}")
+    algo.cleanup()
+    ray_tpu.shutdown()

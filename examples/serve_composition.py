@@ -1,0 +1,41 @@
+"""Serve: HTTP ingress + model composition + dynamic batching.
+
+Run: JAX_PLATFORMS=cpu python examples/serve_composition.py
+"""
+import json
+import urllib.request
+
+import ray_tpu
+from ray_tpu import serve
+
+
+@serve.deployment(num_cpus=0.2)
+class Scorer:
+    @serve.batch(max_batch_size=8, batch_wait_timeout_s=0.02)
+    async def __call__(self, texts):
+        return [len(t) % 10 for t in texts]
+
+
+@serve.deployment
+class Router:
+    def __init__(self, scorer):
+        self.scorer = scorer
+
+    async def __call__(self, request):
+        text = request.json()["text"]
+        score = await self.scorer.remote(text)
+        return {"text": text, "score": score}
+
+
+if __name__ == "__main__":
+    ray_tpu.init(num_cpus=4, num_tpus=0)
+    serve.run(Router.bind(Scorer.bind()), name="scoring",
+              route_prefix="/score", http_port=18925)
+    req = urllib.request.Request(
+        "http://127.0.0.1:18925/score",
+        data=json.dumps({"text": "hello ray_tpu"}).encode(),
+        method="POST")
+    with urllib.request.urlopen(req, timeout=60) as resp:
+        print("response:", json.loads(resp.read()))
+    serve.shutdown()
+    ray_tpu.shutdown()

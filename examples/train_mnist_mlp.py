@@ -1,0 +1,61 @@
+"""Data-parallel training of an MLP with JaxTrainer (the SURVEY §7.2
+minimum end-to-end slice): 2 workers, synthetic data, checkpoint+report.
+
+Run: JAX_PLATFORMS=cpu python examples/train_mnist_mlp.py
+"""
+import numpy as np
+
+import ray_tpu
+from ray_tpu import train
+from ray_tpu.train import JaxTrainer, ScalingConfig
+
+
+def train_loop(config):
+    import jax
+    import jax.numpy as jnp
+    import optax
+    from flax import linen as nn
+
+    ctx = train.get_context()
+
+    class MLP(nn.Module):
+        @nn.compact
+        def __call__(self, x):
+            x = nn.relu(nn.Dense(128)(x))
+            return nn.Dense(10)(x)
+
+    model = MLP()
+    rng = np.random.default_rng(ctx.world_rank)
+    x = rng.normal(size=(512, 784)).astype(np.float32)
+    y = rng.integers(0, 10, size=(512,))
+
+    params = model.init(jax.random.PRNGKey(0), jnp.zeros((1, 784)))
+    tx = optax.adam(config["lr"])
+    opt_state = tx.init(params)
+
+    @jax.jit
+    def step(params, opt_state, xb, yb):
+        def loss_fn(p):
+            logits = model.apply(p, xb)
+            return optax.softmax_cross_entropy_with_integer_labels(
+                logits, yb).mean()
+
+        loss, grads = jax.value_and_grad(loss_fn)(params)
+        updates, opt_state = tx.update(grads, opt_state)
+        return optax.apply_updates(params, updates), opt_state, loss
+
+    for epoch in range(config["epochs"]):
+        params, opt_state, loss = step(params, opt_state, x, y)
+        train.report({"epoch": epoch, "loss": float(loss)})
+
+
+if __name__ == "__main__":
+    ray_tpu.init(num_cpus=4, num_tpus=0)
+    trainer = JaxTrainer(
+        train_loop,
+        train_loop_config={"lr": 1e-3, "epochs": 5},
+        scaling_config=ScalingConfig(num_workers=2, cpus_per_worker=1),
+    )
+    result = trainer.fit()
+    print("final:", result.metrics)
+    ray_tpu.shutdown()

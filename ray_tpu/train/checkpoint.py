@@ -107,8 +107,7 @@ class Checkpoint:
             # A single-shard (replicated) checkpoint restores on any rank.
             # But if other per-rank shards exist, a missing one means real
             # data loss — never silently substitute another rank's data.
-            shards = [f for f in os.listdir(self.path)
-                      if f.startswith("shard_") and f.endswith(".msgpack")]
+            shards = self.shard_files()
             if shards == ["shard_0.msgpack"]:
                 shard_file = os.path.join(self.path, "shard_0.msgpack")
             else:
@@ -120,6 +119,11 @@ class Checkpoint:
             loaded = serialization.msgpack_restore(f.read())
         leaves = [loaded[str(i)] for i in range(len(loaded))]
         return jax.tree.unflatten(meta["treedef"], leaves)
+
+    def shard_files(self) -> list:
+        """Names of per-rank shard files in this checkpoint."""
+        return sorted(f for f in os.listdir(self.path)
+                      if f.startswith("shard_") and f.endswith(".msgpack"))
 
     @property
     def user_meta(self) -> dict:

@@ -31,10 +31,7 @@ class JaxPredictor:
         """checkpoint: ray_tpu.train.Checkpoint written by from_pytree.
         Multi-shard (per-rank) checkpoints are rejected — silently using
         one rank's partial parameters would produce wrong predictions."""
-        import os
-
-        shards = [f for f in os.listdir(checkpoint.path)
-                  if f.startswith("shard_") and f.endswith(".msgpack")]
+        shards = checkpoint.shard_files()
         if len(shards) > 1:
             raise ValueError(
                 f"checkpoint {checkpoint.path} has {len(shards)} "
@@ -63,22 +60,30 @@ def predict_dataset(dataset, *, checkpoint, apply_fn: Callable,
                     output_column: str = "predictions"):
     """Distributed batch inference: predictor replicas as stateful
     dataset actors (each compiles once, streams batches through the
-    cached executable)."""
+    cached executable).
+
+    ``apply_fn`` must be row-independent: ragged trailing batches are
+    zero-padded to ``batch_size`` to avoid jit retraces, so a function
+    that mixes information across the batch axis (train-mode batchnorm,
+    batch-axis softmax) would see the padding rows.
+    """
+    if num_tpus_per_replica:
+        from ray_tpu.core.accelerators import TPUAcceleratorManager
+
+        # Fail at the API boundary, not deep inside actor creation.
+        TPUAcceleratorManager.validate_chip_request(num_tpus_per_replica)
 
     class _PredictorUDF:
-        def __init__(self, ckpt_path, output_col, bs):
-            from ray_tpu.train.checkpoint import Checkpoint
-
+        def __init__(self, ckpt, output_col, bs):
             self.predictor = JaxPredictor.from_checkpoint(
-                Checkpoint(ckpt_path), apply_fn,
-                output_column=output_col)
+                ckpt, apply_fn, output_column=output_col)
             self.bs = bs
-            self.output_col = output_col
 
         def __call__(self, batch):
             # Pad ragged trailing batches to the full batch size so the
             # jit executable compiles once (a new shape would retrace);
-            # slice the outputs back.
+            # slice the outputs back. predict() handles the single-column
+            # dict unwrap.
             data = batch
             if isinstance(data, dict) and len(data) == 1:
                 data = next(iter(data.values()))
@@ -101,5 +106,5 @@ def predict_dataset(dataset, *, checkpoint, apply_fn: Callable,
         kwargs["num_tpus"] = num_tpus_per_replica
     return dataset.map_batches(
         _PredictorUDF,
-        fn_constructor_args=(checkpoint.path, output_column, batch_size),
+        fn_constructor_args=(checkpoint, output_column, batch_size),
         batch_size=batch_size, concurrency=concurrency, **kwargs)
