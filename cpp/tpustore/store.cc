@@ -17,6 +17,7 @@
 #include <errno.h>
 #include <fcntl.h>
 #include <pthread.h>
+#include <signal.h>
 #include <stdint.h>
 #include <string.h>
 #include <sys/mman.h>
@@ -27,10 +28,11 @@
 
 namespace {
 
-constexpr uint64_t kMagic = 0x7470757374307245ull;  // "tpust0rE"
+constexpr uint64_t kMagic = 0x7470757374307246ull;  // "tpust0rF" (layout v2)
 constexpr uint32_t kKeyLen = 20;
 constexpr uint32_t kEntryCap = 32768;         // max live objects per node
 constexpr uint32_t kExtentCap = kEntryCap + 8;
+constexpr uint32_t kPinLogCap = 8192;         // outstanding read pins
 constexpr uint64_t kAlign = 64;
 
 constexpr int TS_OK = 0;
@@ -46,6 +48,11 @@ enum EntryState : uint32_t {
   kCreated = 1,
   kSealed = 2,
   kTombstone = 3,
+  // Deleted while readers still hold zero-copy views: invisible to
+  // lookup/contains/eviction, memory retained until the last read pin
+  // drops (plasma never reclaims buffers clients hold,
+  // src/ray/object_manager/plasma/object_lifecycle_manager.h:101).
+  kZombie = 4,
 };
 
 struct Entry {
@@ -53,13 +60,23 @@ struct Entry {
   uint64_t offset;
   uint64_t size;
   uint32_t state;
-  uint32_t pin;
+  uint32_t pin;    // read pins: outstanding zero-copy views (+ write hold)
+  uint32_t guard;  // eviction guard: owner/primary-copy pins
+  uint32_t pad;
   uint64_t lru;
 };
 
 struct Extent {
   uint64_t offset;
   uint64_t size;
+};
+
+// One outstanding read pin, attributed to the pinning process so pins
+// leaked by a crashed reader can be reaped (plasma analog: releasing a
+// dead client's object references on disconnect). pid 0 = free slot.
+struct PinRec {
+  int32_t pid;
+  uint32_t idx;  // entry index
 };
 
 struct Header {
@@ -73,9 +90,10 @@ struct Header {
   uint64_t num_objects;
   uint64_t num_evicted;     // stats
   uint32_t num_extents;     // live free extents
-  uint32_t pad;
+  uint32_t pin_log_hint;    // next-free-slot cursor into pin_log
   Entry entries[kEntryCap];
   Extent extents[kExtentCap];  // sorted by offset
+  PinRec pin_log[kPinLogCap];
 };
 
 struct Handle {
@@ -114,13 +132,23 @@ class Locker {
 };
 
 // ---- entry table (open addressing, linear probe) ----
+//
+// A zombie keeps its slot (its extent is still allocated) but is dead to
+// every key-based path: a re-put of the same key inserts a NEW live entry
+// further down the probe chain and the two coexist until the zombie's
+// last read pin drops. Index-based ops (seal/unpin_read) therefore name
+// entries by slot index, never by key.
+
+bool IsLive(const Entry* e) {
+  return e->state == kCreated || e->state == kSealed;
+}
 
 Entry* FindEntry(Header* hdr, const uint8_t* key) {
   uint64_t idx = HashKey(key) % kEntryCap;
   for (uint32_t probe = 0; probe < kEntryCap; probe++) {
     Entry* e = &hdr->entries[(idx + probe) % kEntryCap];
     if (e->state == kEmpty) return nullptr;
-    if (e->state != kTombstone && memcmp(e->key, key, kKeyLen) == 0) {
+    if (IsLive(e) && memcmp(e->key, key, kKeyLen) == 0) {
       return e;
     }
   }
@@ -129,16 +157,16 @@ Entry* FindEntry(Header* hdr, const uint8_t* key) {
 
 Entry* FindSlot(Header* hdr, const uint8_t* key) {
   uint64_t idx = HashKey(key) % kEntryCap;
-  Entry* first_tomb = nullptr;
+  Entry* first_free = nullptr;
   for (uint32_t probe = 0; probe < kEntryCap; probe++) {
     Entry* e = &hdr->entries[(idx + probe) % kEntryCap];
-    if (e->state == kEmpty) return first_tomb ? first_tomb : e;
-    if (e->state == kTombstone && !first_tomb) first_tomb = e;
-    if (e->state != kTombstone && memcmp(e->key, key, kKeyLen) == 0) {
+    if (e->state == kEmpty) return first_free ? first_free : e;
+    if (e->state == kTombstone && !first_free) first_free = e;
+    if (IsLive(e) && memcmp(e->key, key, kKeyLen) == 0) {
       return e;  // existing
     }
   }
-  return first_tomb;
+  return first_free;
 }
 
 // ---- free-extent allocator (array sorted by offset) ----
@@ -193,15 +221,66 @@ void DeleteEntryLocked(Header* hdr, Entry* e) {
   hdr->num_objects--;
   e->state = kTombstone;
   e->pin = 0;
+  e->guard = 0;
 }
 
-// Evict the least-recently-used unpinned sealed object. Returns freed
-// bytes, or 0 if nothing evictable.
+// ---- read-pin attribution log ----
+
+void PinLogAdd(Header* hdr, uint32_t entry_idx) {
+  for (uint32_t probe = 0; probe < kPinLogCap; probe++) {
+    PinRec* r = &hdr->pin_log[(hdr->pin_log_hint + probe) % kPinLogCap];
+    if (r->pid == 0) {
+      r->pid = static_cast<int32_t>(getpid());
+      r->idx = entry_idx;
+      hdr->pin_log_hint = (hdr->pin_log_hint + probe + 1) % kPinLogCap;
+      return;
+    }
+  }
+  // Log full: the pin is still held, just unattributed — a crash of
+  // this process then leaks it (pre-reap behavior), nothing worse.
+}
+
+void PinLogRemove(Header* hdr, uint32_t entry_idx) {
+  int32_t pid = static_cast<int32_t>(getpid());
+  for (uint32_t i = 0; i < kPinLogCap; i++) {
+    PinRec* r = &hdr->pin_log[i];
+    if (r->pid == pid && r->idx == entry_idx) {
+      r->pid = 0;
+      return;
+    }
+  }
+}
+
+void UnpinEntryLocked(Header* hdr, Entry* e) {
+  if (e->pin > 0) e->pin--;
+  if (e->pin == 0 && e->state == kZombie) DeleteEntryLocked(hdr, e);
+}
+
+// Release read pins recorded by processes that no longer exist, so a
+// crashed reader cannot wedge entries forever (plasma frees a dead
+// client's references on disconnect). Returns pins released.
+uint32_t ReapDeadLocked(Header* hdr) {
+  uint32_t reaped = 0;
+  int32_t self = static_cast<int32_t>(getpid());
+  for (uint32_t i = 0; i < kPinLogCap; i++) {
+    PinRec* r = &hdr->pin_log[i];
+    if (r->pid == 0 || r->pid == self) continue;
+    if (kill(r->pid, 0) != 0 && errno == ESRCH) {
+      UnpinEntryLocked(hdr, &hdr->entries[r->idx]);
+      r->pid = 0;
+      reaped++;
+    }
+  }
+  return reaped;
+}
+
+// Evict the least-recently-used sealed object that nobody reads or
+// guards. Returns freed bytes, or 0 if nothing evictable.
 uint64_t EvictOne(Header* hdr) {
   Entry* victim = nullptr;
   for (uint32_t i = 0; i < kEntryCap; i++) {
     Entry* e = &hdr->entries[i];
-    if (e->state == kSealed && e->pin == 0) {
+    if (e->state == kSealed && e->pin == 0 && e->guard == 0) {
       if (!victim || e->lru < victim->lru) victim = e;
     }
   }
@@ -287,9 +366,11 @@ void ts_detach(void* handle) {
 int ts_destroy(const char* name) { return shm_unlink(name); }
 
 // Allocate space for an object; evicts LRU unpinned sealed objects as
-// needed. On success writes the data offset to *out_offset.
-int ts_alloc(void* handle, const uint8_t* key, uint64_t size,
-             uint64_t* out_offset) {
+// needed (reaping pins of dead readers before giving up). On success
+// writes the data offset to *out_offset and returns the entry index
+// (>= 0); negative = error.
+int64_t ts_alloc(void* handle, const uint8_t* key, uint64_t size,
+                 uint64_t* out_offset) {
   Handle* h = static_cast<Handle*>(handle);
   uint64_t need = AlignUp(size);
   if (need > h->hdr->data_size) return TS_EFULL;
@@ -300,29 +381,51 @@ int ts_alloc(void* handle, const uint8_t* key, uint64_t size,
   Entry* slot = FindSlot(hdr, key);
   if (!slot) return TS_ETABLE;
   int64_t off = AllocFromExtents(hdr, need);
+  bool reaped = false;
   while (off < 0) {
-    if (EvictOne(hdr) == 0) return TS_EFULL;
+    if (EvictOne(hdr) == 0) {
+      if (reaped) return TS_EFULL;
+      reaped = true;
+      if (ReapDeadLocked(hdr) == 0) return TS_EFULL;
+      continue;
+    }
     off = AllocFromExtents(hdr, need);
   }
   memcpy(slot->key, key, kKeyLen);
   slot->offset = static_cast<uint64_t>(off);
   slot->size = need;
   slot->state = kCreated;
-  slot->pin = 0;
+  // Write hold: the producer fills the buffer outside the lock; a
+  // concurrent delete must defer the free (zombie) instead of handing
+  // the extent to another allocation mid-write.
+  slot->pin = 1;
+  slot->guard = 0;
   slot->lru = hdr->lru_tick++;
   hdr->used_bytes += need;
   hdr->num_objects++;
   *out_offset = slot->offset;
-  return TS_OK;
+  return slot - hdr->entries;
 }
 
-int ts_seal(void* handle, const uint8_t* key) {
+// Seal the created entry at `idx` (from ts_alloc), releasing the write
+// hold; with guard != 0 also takes the owner/primary eviction guard in
+// the same critical section. Returns TS_ESTATE if the object was
+// deleted mid-write (the entry is then freed here, once the write hold
+// drops).
+int ts_seal_idx(void* handle, int64_t idx, const uint8_t* key, int guard) {
   Handle* h = static_cast<Handle*>(handle);
+  if (idx < 0 || idx >= kEntryCap) return TS_ENOENT;
   Locker lock(h->hdr);
-  Entry* e = FindEntry(h->hdr, key);
-  if (!e) return TS_ENOENT;
+  Entry* e = &h->hdr->entries[idx];
+  if (memcmp(e->key, key, kKeyLen) != 0) return TS_ENOENT;
+  if (e->state == kZombie) {
+    UnpinEntryLocked(h->hdr, e);
+    return TS_ESTATE;
+  }
   if (e->state != kCreated) return TS_ESTATE;
   e->state = kSealed;
+  if (guard) e->guard++;
+  if (e->pin > 0) e->pin--;
   return TS_OK;
 }
 
@@ -346,12 +449,43 @@ int ts_contains(void* handle, const uint8_t* key) {
   return (e && e->state == kSealed) ? 1 : 0;
 }
 
+// Atomically look up a sealed object and take a read pin on it, so the
+// caller's zero-copy view can never alias memory freed by a concurrent
+// delete/eviction (lookup-then-pin as two calls would race). Returns
+// the entry index (>= 0) for the matching ts_unpin_read; negative =
+// error.
+int64_t ts_lookup_pin(void* handle, const uint8_t* key,
+                      uint64_t* out_offset, uint64_t* out_size) {
+  Handle* h = static_cast<Handle*>(handle);
+  Locker lock(h->hdr);
+  Entry* e = FindEntry(h->hdr, key);
+  if (!e || e->state != kSealed) return TS_ENOENT;
+  e->lru = h->hdr->lru_tick++;
+  e->pin++;
+  PinLogAdd(h->hdr, static_cast<uint32_t>(e - h->hdr->entries));
+  *out_offset = e->offset;
+  *out_size = e->size;
+  return e - h->hdr->entries;
+}
+
+// Drop the read pin taken by ts_lookup_pin on entry `idx`; frees the
+// entry when it was deleted while pinned.
+int ts_unpin_read(void* handle, int64_t idx) {
+  Handle* h = static_cast<Handle*>(handle);
+  if (idx < 0 || idx >= kEntryCap) return TS_ENOENT;
+  Locker lock(h->hdr);
+  PinLogRemove(h->hdr, static_cast<uint32_t>(idx));
+  UnpinEntryLocked(h->hdr, &h->hdr->entries[idx]);
+  return TS_OK;
+}
+
+// Owner/primary eviction guard (plasma primary-copy pinning analog).
 int ts_pin(void* handle, const uint8_t* key) {
   Handle* h = static_cast<Handle*>(handle);
   Locker lock(h->hdr);
   Entry* e = FindEntry(h->hdr, key);
   if (!e) return TS_ENOENT;
-  e->pin++;
+  e->guard++;
   return TS_OK;
 }
 
@@ -360,16 +494,24 @@ int ts_unpin(void* handle, const uint8_t* key) {
   Locker lock(h->hdr);
   Entry* e = FindEntry(h->hdr, key);
   if (!e) return TS_ENOENT;
-  if (e->pin > 0) e->pin--;
+  if (e->guard > 0) e->guard--;
   return TS_OK;
 }
 
+// Owner-driven delete: drops the eviction guard and removes the object
+// from the table. If readers still hold views (pin > 0) the memory is
+// retained as a zombie and freed on the last ts_unpin_read.
 int ts_delete(void* handle, const uint8_t* key) {
   Handle* h = static_cast<Handle*>(handle);
   Locker lock(h->hdr);
   Entry* e = FindEntry(h->hdr, key);
   if (!e) return TS_ENOENT;
-  DeleteEntryLocked(h->hdr, e);
+  e->guard = 0;
+  if (e->pin > 0) {
+    e->state = kZombie;
+  } else {
+    DeleteEntryLocked(h->hdr, e);
+  }
   return TS_OK;
 }
 

@@ -16,6 +16,7 @@ import logging
 import os
 import subprocess
 import threading
+import weakref
 from typing import Optional
 
 logger = logging.getLogger(__name__)
@@ -81,16 +82,23 @@ def _load_library(so_path: str):
         lib.ts_attach.argtypes = [ctypes.c_char_p]
         lib.ts_detach.argtypes = [ctypes.c_void_p]
         lib.ts_destroy.argtypes = [ctypes.c_char_p]
+        lib.ts_alloc.restype = ctypes.c_int64
         lib.ts_alloc.argtypes = [ctypes.c_void_p, ctypes.c_char_p,
                                  ctypes.c_uint64,
                                  ctypes.POINTER(ctypes.c_uint64)]
-        lib.ts_seal.argtypes = [ctypes.c_void_p, ctypes.c_char_p]
+        lib.ts_seal_idx.argtypes = [ctypes.c_void_p, ctypes.c_int64,
+                                    ctypes.c_char_p, ctypes.c_int]
         lib.ts_lookup.argtypes = [ctypes.c_void_p, ctypes.c_char_p,
                                   ctypes.POINTER(ctypes.c_uint64),
                                   ctypes.POINTER(ctypes.c_uint64)]
+        lib.ts_lookup_pin.restype = ctypes.c_int64
+        lib.ts_lookup_pin.argtypes = [ctypes.c_void_p, ctypes.c_char_p,
+                                      ctypes.POINTER(ctypes.c_uint64),
+                                      ctypes.POINTER(ctypes.c_uint64)]
         lib.ts_contains.argtypes = [ctypes.c_void_p, ctypes.c_char_p]
         lib.ts_pin.argtypes = [ctypes.c_void_p, ctypes.c_char_p]
         lib.ts_unpin.argtypes = [ctypes.c_void_p, ctypes.c_char_p]
+        lib.ts_unpin_read.argtypes = [ctypes.c_void_p, ctypes.c_int64]
         lib.ts_delete.argtypes = [ctypes.c_void_p, ctypes.c_char_p]
         lib.ts_base.restype = ctypes.POINTER(ctypes.c_uint8)
         lib.ts_base.argtypes = [ctypes.c_void_p]
@@ -105,6 +113,7 @@ TS_OK = 0
 TS_EEXIST = -1
 TS_ENOENT = -2
 TS_EFULL = -3
+TS_ESTATE = -5
 
 
 class NativeArena:
@@ -117,14 +126,9 @@ class NativeArena:
         self._owner = owner
         self._base_addr = ctypes.cast(
             lib.ts_base(handle), ctypes.c_void_p).value
-        # Objects this process has handed out zero-copy views of. Each is
-        # pinned once in the arena so LRU eviction can never reuse memory
-        # a live view may alias (the per-segment python store got this for
-        # free from POSIX unlink semantics; an arena does not). The
-        # owner-driven delete path ignores pins — deletion only happens
-        # when the owner has proven no refs remain.
-        self._read_pinned: set = set()
-        self._pin_lock = threading.Lock()
+        # Serializes view finalizers against destroy() so a late unpin
+        # can never touch an unmapped arena.
+        self._detach_lock = threading.Lock()
 
     @classmethod
     def create(cls, name: str, capacity_bytes: int
@@ -155,63 +159,83 @@ class NativeArena:
 
     def create_and_seal(self, key20: bytes, data,
                         pin_primary: bool = True) -> bool:
-        """Returns False if the object already exists (idempotent).
+        """Returns False if the object already exists (idempotent) or was
+        deleted while being written.
 
-        ``pin_primary``: hold the primary-copy pin so LRU eviction never
-        drops an object whose owner still references it (the owner's
-        delete path ignores pins); capacity overflow then surfaces as
-        ObjectStoreFullError for the caller to spill to disk.
+        ``pin_primary``: take the owner/primary eviction guard (in the
+        same critical section as the seal) so LRU eviction never drops an
+        object its owner still references; capacity overflow then
+        surfaces as ObjectStoreFullError for the caller to spill to disk.
         """
         mv = memoryview(data).cast("B")
         off = ctypes.c_uint64()
-        rc = self._lib.ts_alloc(self._h, key20, mv.nbytes,
-                                ctypes.byref(off))
-        if rc == TS_EEXIST:
+        idx = self._lib.ts_alloc(self._h, key20, mv.nbytes,
+                                 ctypes.byref(off))
+        if idx == TS_EEXIST:
             return False
-        if rc == TS_EFULL:
+        if idx == TS_EFULL:
             from ray_tpu.exceptions import ObjectStoreFullError
 
             raise ObjectStoreFullError(
                 f"object of {mv.nbytes} bytes does not fit in arena "
                 f"({self.used_bytes()}/{self.capacity()} used)")
-        if rc != TS_OK:
-            raise RuntimeError(f"ts_alloc failed: {rc}")
+        if idx < 0:
+            raise RuntimeError(f"ts_alloc failed: {idx}")
         self._view(off.value, mv.nbytes)[:] = mv
-        rc = self._lib.ts_seal(self._h, key20)
+        rc = self._lib.ts_seal_idx(self._h, idx, key20,
+                                   1 if pin_primary else 0)
+        if rc == TS_ESTATE:
+            # Deleted while being written (owner already released every
+            # reference, so no consumer can exist); the arena freed it.
+            return False
         if rc != TS_OK:
             raise RuntimeError(f"ts_seal failed: {rc}")
-        if pin_primary:
-            self._lib.ts_pin(self._h, key20)
         return True
+
+    def _unpin_view(self, idx: int):
+        # weakref.finalize callback: last view over this lookup died.
+        with self._detach_lock:
+            if self._h:
+                self._lib.ts_unpin_read(self._h, idx)
 
     def lookup(self, key20: bytes, *, pin_for_read: bool = True
                ) -> Optional[memoryview]:
+        """Zero-copy view of a sealed object.
+
+        The default path takes an atomic read pin (ts_lookup_pin) and
+        releases it when the last view/slice of the returned buffer is
+        garbage-collected; a concurrent delete defers the free until
+        then. ``pin_for_read=False`` skips pinning — only safe for
+        transient reads that don't outlive the caller's frame.
+        """
         off = ctypes.c_uint64()
         size = ctypes.c_uint64()
-        rc = self._lib.ts_lookup(self._h, key20, ctypes.byref(off),
-                                 ctypes.byref(size))
-        if rc != TS_OK:
+        if not pin_for_read:
+            rc = self._lib.ts_lookup(self._h, key20, ctypes.byref(off),
+                                     ctypes.byref(size))
+            if rc != TS_OK:
+                return None
+            return self._view(off.value, size.value)
+        idx = self._lib.ts_lookup_pin(self._h, key20, ctypes.byref(off),
+                                      ctypes.byref(size))
+        if idx < 0:
             return None
-        if pin_for_read:
-            with self._pin_lock:
-                if key20 not in self._read_pinned:
-                    self._lib.ts_pin(self._h, key20)
-                    self._read_pinned.add(key20)
-        return self._view(off.value, size.value)
+        mv = self._view(off.value, size.value)
+        weakref.finalize(mv.obj, self._unpin_view, idx)
+        return mv
 
     def contains(self, key20: bytes) -> bool:
         return bool(self._lib.ts_contains(self._h, key20))
 
-    def pin(self, key20: bytes):
-        self._lib.ts_pin(self._h, key20)
+    def pin(self, key20: bytes) -> bool:
+        """Owner/primary eviction guard (not a read pin)."""
+        return self._lib.ts_pin(self._h, key20) == TS_OK
 
-    def unpin(self, key20: bytes):
-        self._lib.ts_unpin(self._h, key20)
+    def unpin(self, key20: bytes) -> bool:
+        return self._lib.ts_unpin(self._h, key20) == TS_OK
 
     def delete(self, key20: bytes):
         self._lib.ts_delete(self._h, key20)
-        with self._pin_lock:
-            self._read_pinned.discard(key20)
 
     def used_bytes(self) -> int:
         return int(self._lib.ts_used_bytes(self._h))
@@ -226,9 +250,10 @@ class NativeArena:
         return int(self._lib.ts_capacity(self._h))
 
     def destroy(self):
-        if self._h:
-            self._lib.ts_detach(self._h)
-            self._h = None
+        with self._detach_lock:
+            if self._h:
+                h, self._h = self._h, None
+                self._lib.ts_detach(h)
         if self._owner:
             self._lib.ts_destroy(self.name.encode())
 
