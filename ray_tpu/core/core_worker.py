@@ -22,6 +22,7 @@ from __future__ import annotations
 
 import asyncio
 import logging
+import os
 import threading
 import time
 from collections import deque
@@ -29,7 +30,7 @@ from dataclasses import dataclass, field
 from typing import Any, Callable, Dict, List, Optional, Tuple
 
 from ray_tpu import exceptions as exc
-from ray_tpu.core import object_store, rpc, serialization
+from ray_tpu.core import object_store, object_transfer, rpc, serialization
 from ray_tpu.core.config import Config
 from ray_tpu.core.ids import (
     ActorID,
@@ -297,7 +298,8 @@ class ActorState:
 class CoreWorker:
     def __init__(self, config: Config, loop_thread: rpc.EventLoopThread,
                  head: HeadClient, job_id: JobID, worker_id: WorkerID,
-                 mode: str, host: str = "127.0.0.1"):
+                 mode: str, host: str = "127.0.0.1",
+                 advertise_host: Optional[str] = None):
         self.config = config
         self.loop_thread = loop_thread
         self.loop = loop_thread.loop
@@ -305,7 +307,11 @@ class CoreWorker:
         self.job_id = job_id
         self.worker_id = worker_id
         self.mode = mode  # "driver" | "worker"
-        self.host = host
+        self.host = host  # bind address
+        # Address peers should dial (refs carry it as the owner address);
+        # differs from the bind host when binding 0.0.0.0 on remote hosts.
+        self.advertise_host = advertise_host or (
+            host if host != "0.0.0.0" else "127.0.0.1")
         self.port: Optional[int] = None
         self.address: Optional[Address] = None
 
@@ -338,6 +344,12 @@ class CoreWorker:
         self._event_flush_scheduled = False
         # Streaming-generator tasks: task id -> ObjectRefGenerator.
         self._streams: Dict[TaskID, "ObjectRefGenerator"] = {}
+        # This process's node (for object-directory reports); workers get
+        # it from the spawn env, the driver from the head's default node.
+        node_hex = os.environ.get("RAY_TPU_NODE_ID")
+        self.node_id_hex: Optional[str] = node_hex
+        # Cross-node pull manager (lazy: only touched on a local miss).
+        self._puller = object_transfer.ObjectPuller(self.get_connection)
         try:
             self.loop.call_soon_threadsafe(
                 lambda: setattr(self, "_loop_thread_ident",
@@ -418,7 +430,8 @@ class CoreWorker:
             handlers.update(extra_handlers)
         self.server = rpc.Server(handlers, name=f"cw-{self.worker_id.hex()[:8]}")
         self.port = await self.server.start(self.host, 0)
-        self.address = Address(self.host, self.port, self.worker_id.hex())
+        self.address = Address(self.advertise_host, self.port,
+                               self.worker_id.hex())
         return self.port
 
     def current_task_id(self) -> TaskID:
@@ -465,7 +478,8 @@ class CoreWorker:
             self.memory_store.put(object_id, make_plasma_marker())
             self.loop_thread.submit(
                 self.head.call("object_sealed",
-                               {"object_id": object_id.hex(), "size": size})
+                               {"object_id": object_id.hex(), "size": size,
+                                "node_id": self.node_id_hex})
             )
         else:
             self.memory_store.put(object_id, obj)
@@ -581,7 +595,33 @@ class CoreWorker:
             )
         obj = object_store.node_store_open(object_id)
         if obj is None:
+            # Sealed somewhere, but not in this node's store: pull it over
+            # the network from a holder (reference: pull_manager.h:52).
+            obj = await self._pull_remote(object_id)
+        if obj is None:
             raise exc.ObjectLostError(object_id.hex())
+        return obj
+
+    async def _pull_remote(self, object_id: ObjectID
+                           ) -> Optional[SerializedObject]:
+        try:
+            reply = await self.head.call(
+                "locate_object", {"object_id": object_id.hex()})
+        except Exception:
+            return None
+        if not reply.get("found") or not reply.get("locations"):
+            return None
+        locations = [tuple(a) for a in reply["locations"]]
+        if not await self._puller.pull(object_id, locations):
+            return None
+        obj = object_store.node_store_open(object_id)
+        if obj is not None and self.node_id_hex:
+            # Tell the directory this node now holds a copy, so nearby
+            # consumers pull locally instead of re-crossing the network.
+            asyncio.ensure_future(self.head.call(
+                "object_location_added",
+                {"object_id": object_id.hex(),
+                 "node_id": self.node_id_hex}))
         return obj
 
     def wait(self, refs: List[ObjectRef], num_returns: int,
@@ -918,11 +958,13 @@ class CoreWorker:
             try:
                 conn = await self.get_connection(address)
             except Exception:
+                # Granted a worker we can't reach (e.g. it died and the
+                # head hadn't noticed when it re-idled it). Hand the lease
+                # back; the finally-pump below re-requests.
                 await self.head.call("return_worker", {
                     "lease_id": reply["lease_id"],
                     "worker_id": reply["worker_id"],
                 })
-                self._pump_scheduling_key(key, state)
                 return
             lw = LeasedWorker(
                 worker_id=worker_id, address=address,
@@ -935,6 +977,13 @@ class CoreWorker:
                 asyncio.ensure_future(self._maybe_return_lease(key, state, lw))
         finally:
             state.inflight_lease_requests -= 1
+            # Re-pump AFTER the inflight decrement: a pump run from inside
+            # the body still counts this request as inflight and will
+            # refuse to issue a replacement, stranding queued tasks when
+            # this request failed (dead-worker grant, head error, raced
+            # queue). Harmless when the queue is empty.
+            if state.queue:
+                self._pump_scheduling_key(key, state)
 
     def _push_task_to_worker(self, key: tuple, state: SchedulingKeyState,
                              lw: LeasedWorker, spec: TaskSpec):

@@ -27,7 +27,7 @@ import time
 from collections import deque
 from typing import Dict, List, Optional, Set
 
-from ray_tpu.core import serialization
+from ray_tpu.core import object_transfer, serialization
 from ray_tpu.core.config import Config
 from ray_tpu.core.ids import ActorID, JobID, NodeID, ObjectID, PlacementGroupID, WorkerID
 from ray_tpu.core.object_store import ShmStore
@@ -65,8 +65,13 @@ class HeadService:
         self._pg_waiters: Dict[PlacementGroupID, List[asyncio.Future]] = {}
         # pubsub: channel -> set of peers
         self.subscribers: Dict[str, Set] = {}
-        # object directory: hex id -> size (sealed objects on this node)
+        # object directory: hex id -> size (sealed objects, cluster-wide)
         self.sealed_objects: Dict[str, int] = {}
+        # hex id -> node ids holding a copy (reference:
+        # ownership_based_object_directory.h location sets)
+        self.object_locations: Dict[str, Set[NodeID]] = {}
+        # agent connections for remote nodes: node_id -> rpc.Connection
+        self._node_agents: Dict[NodeID, object] = {}
         self._object_waiters: Dict[str, List[asyncio.Future]] = {}
         # worker connection -> WorkerHandle
         self._conn_to_worker: Dict[object, WorkerHandle] = {}
@@ -90,12 +95,52 @@ class HeadService:
         """Called once the RPC server is listening."""
         self.port = port
         self.pool = WorkerPool(self.host, port, self.session_dir)
+        self.pool.spawn_remote = self._spawn_remote
+        self.pool.kill_remote = self._kill_remote
         self.scheduler = ClusterScheduler(
             self.pool, spread_threshold=self.config.scheduler_spread_threshold
         )
         self._pump_task = asyncio.get_running_loop().create_task(
             self._periodic_pump()
         )
+
+    def _spawn_remote(self, node_id: NodeID, worker_id: WorkerID) -> bool:
+        """WorkerPool hook: spawn on a remote host via its node agent.
+        Returns False ONLY for head-host nodes (pool forks locally) — a
+        remote node whose agent is gone must never fall back to a local
+        fork (the task would run on the wrong machine)."""
+        info = self.nodes_info.get(node_id)
+        if info is None or info.agent_address is None:
+            return False
+        agent = self._node_agents.get(node_id)
+
+        async def go():
+            try:
+                if agent is None:
+                    raise RuntimeError("node agent disconnected")
+                await agent.call("spawn_worker",
+                                 {"worker_id": worker_id.hex()})
+            except Exception:
+                logger.warning("spawn_worker on node %s failed",
+                               node_id.hex()[:12])
+                handle = self.pool.workers.get(worker_id)
+                if handle is not None and handle.state == "STARTING":
+                    self.pool.mark_dead(worker_id)
+                    delay = min(
+                        self._spawn_backoff_s.get(node_id, 0.5) * 2, 30.0)
+                    self._spawn_backoff_s[node_id] = delay
+                    self._spawn_backoff_until[node_id] = (
+                        time.monotonic() + delay)
+                    self._pump()
+
+        asyncio.ensure_future(go())
+        return True
+
+    def _kill_remote(self, node_id: NodeID, worker_id: WorkerID) -> None:
+        agent = self._node_agents.get(node_id)
+        if agent is not None:
+            asyncio.ensure_future(agent.notify(
+                "kill_worker", {"worker_id": worker_id.hex()}))
 
     async def _periodic_pump(self):
         while not self._shutdown:
@@ -140,14 +185,22 @@ class HeadService:
         )
 
     def add_node(self, resources: Dict[str, float],
-                 labels: Optional[Dict[str, str]] = None) -> NodeID:
+                 labels: Optional[Dict[str, str]] = None,
+                 agent_address: Optional[tuple] = None,
+                 agent_conn=None) -> NodeID:
         node_id = NodeID.from_random()
         node = Node(node_id, ResourceSet(resources), labels)
         self.scheduler.add_node(node)
         self.nodes_info[node_id] = NodeInfo(
-            node_id=node_id, address=self.host,
+            node_id=node_id,
+            address=agent_address[0] if agent_address else self.host,
             resources=dict(resources), labels=labels or {},
+            agent_address=tuple(agent_address) if agent_address else None,
         )
+        if not hasattr(self, "default_node_id"):
+            self.default_node_id = node_id
+        if agent_conn is not None:
+            self._node_agents[node_id] = agent_conn
         self._publish("node_state", {
             "node_id": node_id.hex(), "state": "ALIVE",
             "resources": dict(resources),
@@ -160,17 +213,40 @@ class HeadService:
         info = self.nodes_info.get(node_id)
         if info:
             info.state = "DEAD"
+        self._node_agents.pop(node_id, None)
+        # Re-route grants parked waiting for a worker on this node: hand
+        # their reserved resources back and resubmit to the scheduler (a
+        # request only this node could ever satisfy then fails as
+        # infeasible through the normal path).
+        for lease, lease_id in self._waiting_grants.pop(node_id, ()):
+            self.scheduler.release_lease(lease_id)
+            if not lease.future.done():
+                self.scheduler.submit(lease)
         # Kill that node's workers; their deaths cascade to actors/leases.
         for handle in list(self.pool.workers.values()):
             if handle.node_id == node_id:
                 self.pool.kill(handle.worker_id)
                 self._on_worker_dead(handle)
+        # Every object copy on the node is gone with its store.
+        for hex_id, nodes in list(self.object_locations.items()):
+            nodes.discard(node_id)
+            if not nodes:
+                self.object_locations.pop(hex_id, None)
+                # Keep sealed_objects: a head-host copy may still exist in
+                # self.shm only for head nodes; if no locations remain the
+                # object is lost and get() surfaces ObjectLostError.
+                if not self.shm.contains(ObjectID.from_hex(hex_id)):
+                    self.sealed_objects.pop(hex_id, None)
         self._publish("node_state", {"node_id": node_id.hex(), "state": "DEAD"})
 
     def handlers(self) -> dict:
         return {
             "register_worker": self.h_register_worker,
             "register_driver": self.h_register_driver,
+            "register_node": self.h_register_node,
+            "worker_exited_early": self.h_worker_exited_early,
+            "locate_object": self.h_locate_object,
+            "object_location_added": self.h_object_location_added,
             "request_lease": self.h_request_lease,
             "return_worker": self.h_return_worker,
             "register_actor": self.h_register_actor,
@@ -207,6 +283,8 @@ class HeadService:
             "list_jobs": self.h_list_jobs,
             "get_load": self.h_get_load,
             "ping": self.h_ping,
+            # Serve the head-host node store for cross-node pulls.
+            **object_transfer.serve_handlers(),
         }
 
     # ------------------------------------------------------------------
@@ -234,6 +312,50 @@ class HeadService:
         self._match_waiting_grants(handle.node_id)
         self._pump()
         return {"ok": True, "node_id": handle.node_id.hex()}
+
+    async def h_register_node(self, conn, payload):
+        """A node agent (remote host) joins the cluster. Its connection
+        doubles as the health channel: close ⇒ node death (reference:
+        node_manager.cc heartbeats / gcs_node_manager death handling)."""
+        node_id = self.add_node(
+            payload["resources"], payload.get("labels"),
+            agent_address=(payload["host"], payload["port"]),
+            agent_conn=conn,
+        )
+        prev_close = conn.on_close
+
+        def on_close(c, _prev=prev_close, _nid=node_id):
+            if _prev:
+                _prev(c)
+            logger.warning("node agent %s disconnected; removing node",
+                           _nid.hex()[:12])
+            self.remove_node(_nid)
+
+        conn.on_close = on_close
+        return {"ok": True, "node_id": node_id.hex()}
+
+    async def h_worker_exited_early(self, conn, payload):
+        """Agent-reported death of a spawned worker that never registered
+        (the remote analog of reap_exited_starting)."""
+        worker_id = WorkerID.from_hex(payload["worker_id"])
+        handle = self.pool.workers.get(worker_id)
+        if handle is not None and handle.state == "STARTING":
+            self.pool.mark_dead(worker_id)
+            delay = min(self._spawn_backoff_s.get(handle.node_id, 0.5) * 2,
+                        30.0)
+            self._spawn_backoff_s[handle.node_id] = delay
+            self._spawn_backoff_until[handle.node_id] = (
+                time.monotonic() + delay)
+            self._pump()
+        return {"ok": True}
+
+    async def h_object_location_added(self, conn, payload):
+        """A node pulled a copy of a sealed object into its local store."""
+        hex_id = payload["object_id"]
+        if hex_id in self.sealed_objects:
+            self.object_locations.setdefault(hex_id, set()).add(
+                NodeID.from_hex(payload["node_id"]))
+        return {"ok": True}
 
     async def h_register_driver(self, conn, payload):
         self._job_counter += 1
@@ -389,6 +511,13 @@ class HeadService:
         # not free a worker that has since been re-leased to someone else.
         alive = (handle is not None and handle.connection is not None
                  and not getattr(handle.connection, "closed", False))
+        if alive and handle.pid != -1:
+            # The owner often notices a worker death (its push conn drops)
+            # before the head's EOF is processed; poll the process so a
+            # dead worker is never re-idled and re-granted.
+            proc = self.pool._procs.get(worker_id)
+            if proc is not None and proc.poll() is not None:
+                alive = False
         if (handle and alive and handle.state == "LEASED"
                 and handle.lease_id == lease_id):
             self.pool.push_idle(handle)
@@ -663,11 +792,44 @@ class HeadService:
         hex_id = payload["object_id"]
         size = payload["size"]
         self.sealed_objects[hex_id] = size
-        self.shm.mark_sealed(ObjectID.from_hex(hex_id), size)
+        node_id = self._sealing_node(conn, payload)
+        self.object_locations.setdefault(hex_id, set()).add(node_id)
+        if self._node_agents.get(node_id) is None:
+            # Head-host store: account the seal in the head's shm book.
+            self.shm.mark_sealed(ObjectID.from_hex(hex_id), size)
         for fut in self._object_waiters.pop(hex_id, []):
             if not fut.done():
                 fut.set_result(True)
         return {"ok": True}
+
+    def _sealing_node(self, conn, payload) -> NodeID:
+        node_hex = payload.get("node_id")
+        if node_hex:
+            return NodeID.from_hex(node_hex)
+        handle = self._conn_to_worker.get(conn)
+        if handle is not None:
+            return handle.node_id
+        return self.default_node_id
+
+    async def h_locate_object(self, conn, payload):
+        """Object-directory lookup: which nodes hold a sealed copy, and
+        where to pull it from (fetch-server addresses)."""
+        hex_id = payload["object_id"]
+        if hex_id not in self.sealed_objects:
+            return {"found": False}
+        locations = []
+        for node_id in self.object_locations.get(hex_id, set()):
+            info = self.nodes_info.get(node_id)
+            if info is None or info.state != "ALIVE":
+                continue
+            if info.agent_address is not None:
+                locations.append(list(info.agent_address))
+            else:
+                locations.append([self.host, self.port])
+        return {"found": True, "size": self.sealed_objects[hex_id],
+                "locations": locations,
+                "nodes": [n.hex() for n in
+                          self.object_locations.get(hex_id, set())]}
 
     async def h_wait_object(self, conn, payload):
         hex_id = payload["object_id"]
@@ -683,9 +845,19 @@ class HeadService:
             return {"sealed": False}
 
     async def h_free_objects(self, conn, payload):
+        remote_by_agent: Dict[object, List[str]] = {}
         for hex_id in payload["object_ids"]:
             self.sealed_objects.pop(hex_id, None)
             self.shm.delete(ObjectID.from_hex(hex_id))
+            for node_id in self.object_locations.pop(hex_id, set()):
+                agent = self._node_agents.get(node_id)
+                if agent is not None:
+                    remote_by_agent.setdefault(agent, []).append(hex_id)
+        for agent, hex_ids in remote_by_agent.items():
+            try:
+                await agent.notify("free_objects", {"object_ids": hex_ids})
+            except Exception:
+                pass  # agent death cleans its whole store anyway
         return {"ok": True}
 
     async def h_pin_object(self, conn, payload):

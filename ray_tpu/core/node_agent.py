@@ -1,0 +1,262 @@
+"""Node agent — the per-host daemon that joins a remote machine to the
+cluster.
+
+Reference mapping: the raylet's node-manager role (src/ray/raylet/
+node_manager.cc) minus scheduling, which stays central in this topology:
+
+- registers the host with the head (``register_node``) and holds the
+  connection open as the health channel (close ⇒ node death),
+- forks/pools worker processes on this host at the head's request
+  (worker_pool.h:156 analog; the head's WorkerPool delegates via its
+  spawn_remote hook),
+- owns this host's shared-memory object arena and serves cross-node
+  object pulls from it (object_manager.cc chunk reads),
+- reaps worker processes that die before registering and reports them
+  (``worker_exited_early``) so the head's backoff/respawn logic applies.
+
+Run on each additional host:
+
+    python -m ray_tpu.core.node_agent --head-host <ip> --head-port <p> \
+        --num-cpus 8 [--host <this-host-ip>]
+
+The test substrate runs several agents on one machine with distinct shm
+namespaces, which exercises the full cross-node protocol (distinct
+stores, network pulls) without needing two machines.
+"""
+
+from __future__ import annotations
+
+import argparse
+import asyncio
+import logging
+import os
+import subprocess
+import sys
+import tempfile
+import time
+from typing import Dict, Optional
+
+from ray_tpu.core import native_store, object_store, object_transfer, rpc
+from ray_tpu.core.config import get_config
+from ray_tpu.core.ids import ObjectID, WorkerID
+
+logger = logging.getLogger(__name__)
+
+
+class NodeAgent:
+    def __init__(self, head_host: str, head_port: int,
+                 resources: Dict[str, float], host: str = "127.0.0.1",
+                 labels: Optional[Dict[str, str]] = None,
+                 object_store_memory: Optional[int] = None):
+        self.head_host = head_host
+        self.head_port = head_port
+        self.host = host
+        self.resources = resources
+        self.labels = labels or {}
+        self.session_dir = _make_session_dir()
+        self.node_id_hex: Optional[str] = None
+        self.server: Optional[rpc.Server] = None
+        self.port: Optional[int] = None
+        self.head_conn: Optional[rpc.Connection] = None
+        self._procs: Dict[str, subprocess.Popen] = {}
+        self._exit = asyncio.Event()
+
+        capacity = object_store_memory or object_store.default_capacity(
+            get_config().object_store_memory_proportion)
+        name = f"rtpu_arena_{os.getpid()}_{int(time.time())}"
+        self.arena = native_store.NativeArena.create(name, capacity)
+        self.arena_name = name if self.arena is not None else None
+        if self.arena is not None:
+            native_store.set_attached_arena(self.arena)
+            os.environ["RAY_TPU_ARENA"] = name
+        else:
+            # Never fall back to an inherited arena: per-node store
+            # isolation is the point of the agent.
+            native_store.set_attached_arena(None)
+            os.environ.pop("RAY_TPU_ARENA", None)
+        # Workers must spill to this host's disk, not the head's path.
+        os.environ["RAY_TPU_SESSION_DIR"] = self.session_dir
+
+    # ---- rpc handlers ----
+
+    def handlers(self) -> dict:
+        return {
+            "spawn_worker": self.h_spawn_worker,
+            "kill_worker": self.h_kill_worker,
+            "free_objects": self.h_free_objects,
+            "ping": self.h_ping,
+            "shutdown_node": self.h_shutdown_node,
+            **object_transfer.serve_handlers(),
+        }
+
+    async def h_ping(self, conn, payload):
+        return {"ok": True, "node_id": self.node_id_hex}
+
+    async def h_spawn_worker(self, conn, payload):
+        worker_id = payload["worker_id"]
+        env = dict(os.environ)
+        env["RAY_TPU_HEAD_HOST"] = self.head_host
+        env["RAY_TPU_HEAD_PORT"] = str(self.head_port)
+        env["RAY_TPU_WORKER_ID"] = worker_id
+        env["RAY_TPU_NODE_ID"] = self.node_id_hex or ""
+        env["RAY_TPU_SESSION_DIR"] = self.session_dir
+        env["RAY_TPU_ADVERTISE_HOST"] = self.host
+        env["RAY_TPU_BIND_HOST"] = "0.0.0.0" if self.host not in (
+            "127.0.0.1", "localhost") else "127.0.0.1"
+        if self.arena_name:
+            env["RAY_TPU_ARENA"] = self.arena_name
+        else:
+            env.pop("RAY_TPU_ARENA", None)
+        import ray_tpu
+
+        pkg_root = os.path.dirname(os.path.dirname(ray_tpu.__file__))
+        existing = env.get("PYTHONPATH", "")
+        env["PYTHONPATH"] = (
+            pkg_root + (os.pathsep + existing if existing else ""))
+        log_path = os.path.join(self.session_dir, "logs",
+                                f"worker-{worker_id[:12]}.log")
+        os.makedirs(os.path.dirname(log_path), exist_ok=True)
+        with open(log_path, "ab") as log_file:
+            proc = subprocess.Popen(
+                [sys.executable, "-m", "ray_tpu.core.worker_main"],
+                env=env, stdout=log_file, stderr=subprocess.STDOUT,
+                start_new_session=True,
+            )
+        self._procs[worker_id] = proc
+        return {"ok": True, "pid": proc.pid}
+
+    async def h_kill_worker(self, conn, payload):
+        proc = self._procs.pop(payload["worker_id"], None)
+        if proc is not None and proc.poll() is None:
+            try:
+                proc.kill()
+            except Exception:
+                pass
+        return {"ok": True}
+
+    async def h_free_objects(self, conn, payload):
+        for hex_id in payload["object_ids"]:
+            if self.arena is not None:
+                self.arena.delete(ObjectID.from_hex(hex_id).binary())
+            else:
+                # Python fallback store: objects live as per-object shm
+                # segments that nothing else on this host will unlink.
+                object_store._unlink_segment(hex_id)
+            object_store.spill_delete(ObjectID.from_hex(hex_id))
+        return {"ok": True}
+
+    async def h_shutdown_node(self, conn, payload):
+        self._exit.set()
+        return {"ok": True}
+
+    # ---- lifecycle ----
+
+    async def start(self):
+        self.server = rpc.Server(self.handlers(), name="node-agent")
+        bind = "0.0.0.0" if self.host not in ("127.0.0.1",
+                                              "localhost") else "127.0.0.1"
+        self.port = await self.server.start(bind, 0)
+        self.head_conn = await rpc.connect(
+            self.head_host, self.head_port, self.handlers(),
+            name="agent-head")
+        self.head_conn.on_close = lambda c: self._exit.set()
+        reply = await self.head_conn.call("register_node", {
+            "host": self.host,
+            "port": self.port,
+            "resources": self.resources,
+            "labels": self.labels,
+        })
+        if not reply.get("ok"):
+            raise RuntimeError(f"node registration rejected: {reply}")
+        self.node_id_hex = reply["node_id"]
+        logger.info("node %s registered (%s:%s), %s",
+                    self.node_id_hex[:12], self.host, self.port,
+                    self.resources)
+        asyncio.get_running_loop().create_task(self._reap_loop())
+
+    async def _reap_loop(self):
+        while not self._exit.is_set():
+            for worker_id, proc in list(self._procs.items()):
+                if proc.poll() is not None:
+                    self._procs.pop(worker_id, None)
+                    try:
+                        await self.head_conn.call(
+                            "worker_exited_early",
+                            {"worker_id": worker_id})
+                    except Exception:
+                        pass
+            await asyncio.sleep(0.5)
+
+    async def run_forever(self):
+        await self._exit.wait()
+        self.shutdown()
+
+    def shutdown(self):
+        for proc in self._procs.values():
+            if proc.poll() is None:
+                try:
+                    proc.kill()
+                except Exception:
+                    pass
+        self._procs.clear()
+        if self.arena is not None:
+            native_store.set_attached_arena(None)
+            self.arena.destroy()
+            self.arena = None
+
+
+def _make_session_dir() -> str:
+    base = os.path.join(tempfile.gettempdir(), "ray_tpu")
+    os.makedirs(base, exist_ok=True)
+    path = os.path.join(
+        base, f"node_{time.strftime('%Y%m%d_%H%M%S')}_{os.getpid()}")
+    os.makedirs(os.path.join(path, "logs"), exist_ok=True)
+    return path
+
+
+async def _amain(args) -> int:
+    resources = {"CPU": float(args.num_cpus)}
+    if args.num_tpus:
+        resources["TPU"] = float(args.num_tpus)
+    if args.memory:
+        resources["memory"] = float(args.memory)
+    if args.resources:
+        import json
+
+        resources.update({k: float(v)
+                          for k, v in json.loads(args.resources).items()})
+    agent = NodeAgent(
+        head_host=args.head_host, head_port=args.head_port,
+        resources=resources, host=args.host,
+        object_store_memory=args.object_store_memory,
+    )
+    await agent.start()
+    await agent.run_forever()
+    return 0
+
+
+def main():
+    logging.basicConfig(
+        level=logging.INFO,
+        format="%(asctime)s %(levelname)s agent %(name)s: %(message)s",
+    )
+    p = argparse.ArgumentParser(description=__doc__)
+    p.add_argument("--head-host", required=True)
+    p.add_argument("--head-port", type=int, required=True)
+    p.add_argument("--num-cpus", type=float, default=os.cpu_count() or 1)
+    p.add_argument("--num-tpus", type=float, default=0)
+    p.add_argument("--memory", type=float, default=0)
+    p.add_argument("--host", default="127.0.0.1")
+    p.add_argument("--object-store-memory", type=int, default=None)
+    p.add_argument("--resources", default=None,
+                   help='extra custom resources as JSON, e.g. \'{"hostB":1}\'')
+    args = p.parse_args()
+    try:
+        code = asyncio.run(_amain(args))
+    except KeyboardInterrupt:
+        code = 0
+    os._exit(code or 0)
+
+
+if __name__ == "__main__":
+    main()

@@ -442,13 +442,24 @@ def node_store_write(object_id: ObjectID, obj: SerializedObject) -> int:
     """Worker-side write of a large object to the node store (native
     arena when enabled, else a per-object shm segment); overflows to a
     disk spill file when shared memory can't fit the object."""
+    return node_store_write_packed(object_id, ShmStore.pack(obj))
+
+
+def node_store_write_packed(object_id: ObjectID, data,
+                            primary: bool = True) -> int:
+    """Write an already-packed payload to the node store (the local write
+    path and the cross-node pull ingest both land here).
+
+    ``primary=False`` marks a borrowed copy pulled from another node: it
+    carries no eviction guard, so local memory pressure can drop it and a
+    consumer re-pulls (the authoritative copy lives with the owner)."""
     from ray_tpu.core import native_store
 
     arena = native_store.get_attached_arena()
-    data = ShmStore.pack(obj)
     if arena is not None:
         try:
-            arena.create_and_seal(object_id.binary(), data)
+            arena.create_and_seal(object_id.binary(), data,
+                                  pin_primary=primary)
             return len(data)
         except ObjectStoreFullError:
             return _spill_write(object_id, data)
@@ -482,6 +493,49 @@ def node_store_open(object_id: ObjectID) -> Optional[SerializedObject]:
     if obj is not None:
         return obj
     return _spill_open(object_id)
+
+
+def node_store_read_packed(object_id: ObjectID):
+    """Raw packed payload of a sealed object in this node's store, as a
+    zero-copy buffer when possible (serve side of cross-node transfer).
+    Returns None if the object is not on this node."""
+    from ray_tpu.core import native_store
+
+    arena = native_store.get_attached_arena()
+    if arena is not None:
+        view = arena.lookup(object_id.binary())
+        if view is not None:
+            return view
+    else:
+        name = segment_name(object_id)
+        with ShmStore._open_lock:
+            seg = ShmStore._open_segments.get(name)
+        if seg is None:
+            try:
+                seg = shared_memory.SharedMemory(name=name)
+            except (FileNotFoundError, OSError):
+                seg = None
+            else:
+                with ShmStore._open_lock:
+                    ShmStore._open_segments.setdefault(name, seg)
+        if seg is not None and bytes(seg.buf[:4]) == ShmStore.HEADER_MAGIC:
+            return seg.buf
+    # Spilled: mmap so per-chunk serves slice lazily instead of re-reading
+    # the whole file per request.
+    import mmap
+
+    path = _spill_path(object_id)
+    try:
+        f = open(path, "rb")
+    except OSError:
+        return None
+    try:
+        mapped = mmap.mmap(f.fileno(), 0, access=mmap.ACCESS_READ)
+    except ValueError:  # empty file
+        return b""
+    finally:
+        f.close()
+    return memoryview(mapped)
 
 
 def _unlink_segment(hex_id: str):

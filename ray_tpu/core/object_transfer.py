@@ -1,0 +1,157 @@
+"""Cross-node object transfer: the DCN data plane.
+
+Reference mapping:
+- ``ObjectPuller`` ≈ src/ray/object_manager/pull_manager.h:52 — on a local
+  store miss, locate holders via the head's object directory, then fetch
+  the packed payload in chunks with admission control (bounded in-flight
+  bytes) and dedup of concurrent pulls of the same object.
+- The serve side ≈ push_manager.h:30 / object_manager.cc chunk reads: any
+  process holding the node's store (head or node agent) answers
+  ``fetch_object_chunk`` with zero-copy slices of the sealed payload.
+- The head's location table ≈ ownership_based_object_directory.h — the
+  object directory lives with the GCS in this topology (single control
+  plane), populated by ``object_sealed`` reports that carry the sealing
+  node's id.
+
+Transport is the framework's length-prefixed msgpack RPC (rpc.py); chunks
+ride as msgpack bin payloads over the same connections the control plane
+uses, which keeps the implementation transport-agnostic (TCP today,
+anything rpc.py learns tomorrow).
+"""
+
+from __future__ import annotations
+
+import asyncio
+import logging
+from typing import Awaitable, Callable, Dict, List, Optional, Tuple
+
+from ray_tpu.core import object_store
+from ray_tpu.core.ids import ObjectID
+
+logger = logging.getLogger(__name__)
+
+# 1 MiB chunks: large enough to amortize rpc framing, small enough that a
+# handful of concurrent pulls can't head-of-line-block the control plane.
+CHUNK_BYTES = 1 << 20
+# Admission control: total bytes in flight across all pulls.
+MAX_INFLIGHT_BYTES = 64 << 20
+
+
+def serve_handlers() -> dict:
+    """RPC handlers a node-store holder (head / node agent) registers so
+    peers can pull sealed objects from this node."""
+
+    async def h_fetch_object_meta(conn, payload):
+        object_id = ObjectID.from_hex(payload["object_id"])
+        data = object_store.node_store_read_packed(object_id)
+        if data is None:
+            return {"found": False}
+        return {"found": True, "size": len(data)}
+
+    async def h_fetch_object_chunk(conn, payload):
+        object_id = ObjectID.from_hex(payload["object_id"])
+        data = object_store.node_store_read_packed(object_id)
+        if data is None:
+            return {"found": False}
+        off = int(payload["offset"])
+        ln = int(payload["length"])
+        return {"found": True, "data": bytes(data[off:off + ln]),
+                "total": len(data)}
+
+    return {
+        "fetch_object_meta": h_fetch_object_meta,
+        "fetch_object_chunk": h_fetch_object_chunk,
+    }
+
+
+class ObjectPuller:
+    """Pulls remote sealed objects into the local node store.
+
+    One instance per process. Concurrent pulls of the same object are
+    coalesced onto one in-flight future; total in-flight bytes are
+    bounded (pull_manager.h admission control).
+    """
+
+    def __init__(self, get_connection: Callable[[Tuple[str, int]],
+                                                Awaitable]):
+        self._get_connection = get_connection
+        self._inflight: Dict[str, asyncio.Future] = {}
+        self._budget = asyncio.Semaphore(MAX_INFLIGHT_BYTES // CHUNK_BYTES)
+
+    async def pull(self, object_id: ObjectID,
+                   locations: List[Tuple[str, int]]) -> bool:
+        """Fetch ``object_id`` from one of ``locations`` (fetch-server
+        addresses) into the local node store. Returns True on success.
+        Safe to call concurrently for the same object."""
+        hex_id = object_id.hex()
+        fut = self._inflight.get(hex_id)
+        if fut is not None:
+            return await asyncio.shield(fut)
+        fut = asyncio.get_running_loop().create_future()
+        self._inflight[hex_id] = fut
+        try:
+            ok = await self._pull_once(object_id, locations)
+            fut.set_result(ok)
+            return ok
+        except Exception as e:
+            fut.set_exception(e)
+            # Consume the exception if nobody else awaits this future.
+            fut.exception()
+            raise
+        finally:
+            self._inflight.pop(hex_id, None)
+
+    async def _pull_once(self, object_id: ObjectID,
+                         locations: List[Tuple[str, int]]) -> bool:
+        last_error: Optional[Exception] = None
+        for address in locations:
+            try:
+                if await self._pull_from(object_id, tuple(address)):
+                    return True
+            except Exception as e:  # holder died mid-pull: try the next
+                last_error = e
+                logger.debug("pull of %s from %s failed: %s",
+                             object_id.hex()[:12], address, e)
+        if last_error is not None:
+            logger.info("pull of %s failed from all %d holders: %s",
+                        object_id.hex()[:12], len(locations), last_error)
+        return False
+
+    async def _pull_from(self, object_id: ObjectID,
+                         address: Tuple[str, int]) -> bool:
+        conn = await self._get_connection(address)
+        meta = await conn.call("fetch_object_meta",
+                               {"object_id": object_id.hex()})
+        if not meta.get("found"):
+            return False
+        total = meta["size"]
+        chunks: List[bytes] = []
+        offset = 0
+        while offset < total:
+            ln = min(CHUNK_BYTES, total - offset)
+            async with _sem_guard(self._budget):
+                reply = await conn.call("fetch_object_chunk", {
+                    "object_id": object_id.hex(),
+                    "offset": offset, "length": ln,
+                })
+            if not reply.get("found"):
+                return False  # holder evicted it mid-pull
+            chunk = reply["data"]
+            chunks.append(chunk)
+            offset += len(chunk)
+            if len(chunk) < ln:
+                return False  # truncated: holder's copy shrank?
+        data = b"".join(chunks)
+        object_store.node_store_write_packed(object_id, data, primary=False)
+        return True
+
+
+class _sem_guard:
+    def __init__(self, sem: asyncio.Semaphore):
+        self._sem = sem
+
+    async def __aenter__(self):
+        await self._sem.acquire()
+
+    async def __aexit__(self, *exc):
+        self._sem.release()

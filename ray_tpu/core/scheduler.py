@@ -95,10 +95,24 @@ class WorkerPool:
         self.starting: Dict[WorkerID, WorkerHandle] = {}
         self._procs: Dict[WorkerID, subprocess.Popen] = {}
         self.on_worker_exit = on_worker_exit
+        # Remote-node hooks (set by the head): spawn_remote(node_id,
+        # worker_id) -> bool returns True when the node's agent handles
+        # the fork; kill_remote(node_id, worker_id) forwards a kill.
+        self.spawn_remote: Optional[Callable] = None
+        self.kill_remote: Optional[Callable] = None
 
     def spawn(self, node_id: NodeID, env_overrides: Optional[dict] = None
               ) -> WorkerHandle:
         worker_id = WorkerID.from_random()
+        if self.spawn_remote is not None and self.spawn_remote(node_id,
+                                                               worker_id):
+            # pid -1 marks an agent-managed process: no local Popen to
+            # poll; early deaths arrive as worker_exited_early reports.
+            handle = WorkerHandle(worker_id=worker_id, node_id=node_id,
+                                  pid=-1)
+            self.workers[worker_id] = handle
+            self.starting[worker_id] = handle
+            return handle
         env = dict(os.environ)
         env.update(env_overrides or {})
         env["RAY_TPU_HEAD_HOST"] = self.head_host
@@ -157,12 +171,20 @@ class WorkerPool:
         return sum(1 for h in self.starting.values()
                    if h.node_id == node_id)
 
+    # Remote workers whose agent never reports back (e.g. agent wedged)
+    # are reaped on a generous registration deadline.
+    REMOTE_REGISTER_TIMEOUT_S = 120.0
+
     def reap_exited_starting(self) -> List[WorkerHandle]:
         """Collect starting workers whose process died before registering."""
         dead = []
+        now = time.monotonic()
         for wid, h in list(self.starting.items()):
             proc = self._procs.get(wid)
             if proc is not None and proc.poll() is not None:
+                dead.append(self.mark_dead(wid))
+            elif (proc is None and h.pid == -1 and
+                  now - h.started_at > self.REMOTE_REGISTER_TIMEOUT_S):
                 dead.append(self.mark_dead(wid))
         return [h for h in dead if h is not None]
 
@@ -202,6 +224,10 @@ class WorkerPool:
                 proc.kill()
             except Exception:
                 pass
+        handle = self.workers.get(worker_id)
+        if (proc is None and handle is not None and handle.pid == -1
+                and self.kill_remote is not None):
+            self.kill_remote(handle.node_id, worker_id)
         self.mark_dead(worker_id)
 
     def shutdown(self):

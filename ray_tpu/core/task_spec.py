@@ -155,6 +155,10 @@ class NodeInfo:
     resources: Dict[str, float]
     labels: Dict[str, str] = field(default_factory=dict)
     state: str = "ALIVE"  # ALIVE | DEAD
+    # Remote hosts (node-agent processes): the agent's RPC address, which
+    # doubles as the node's object fetch server for cross-node pulls.
+    # None for head-host (virtual) nodes, whose store the head serves.
+    agent_address: Optional[tuple] = None
 
 
 @dataclass

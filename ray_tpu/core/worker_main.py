@@ -383,7 +383,8 @@ class Executor:
             self.cw.loop_thread.submit(
                 self.cw.head.call(
                     "object_sealed",
-                    {"object_id": object_id.hex(), "size": size},
+                    {"object_id": object_id.hex(), "size": size,
+                     "node_id": self.cw.node_id_hex},
                 )
             )
             return {"object_id": object_id.binary(), "in_plasma": True}
@@ -442,6 +443,8 @@ async def _amain():
         job_id=JobID.from_int(0),
         worker_id=worker_id,
         mode="worker",
+        host=os.environ.get("RAY_TPU_BIND_HOST", "127.0.0.1"),
+        advertise_host=os.environ.get("RAY_TPU_ADVERTISE_HOST"),
     )
     executor = Executor(cw)
     cw.executor = executor
@@ -525,7 +528,9 @@ async def _amain():
 
     reply = await head_conn.call("register_worker", {
         "worker_id": worker_id.hex(),
-        "host": "127.0.0.1",
+        # Remote-host workers advertise their host's address so owners on
+        # other machines can reach the task server (head-host default).
+        "host": os.environ.get("RAY_TPU_ADVERTISE_HOST", "127.0.0.1"),
         "port": port,
         "pid": os.getpid(),
     })

@@ -21,6 +21,19 @@ import jax  # noqa: E402
 # Tests run on a virtual 8-device CPU mesh; override after import.
 jax.config.update("jax_platforms", "cpu")
 
+# Persistent compilation cache: the dominant suite cost is re-jitting the
+# same tiny models in every test process; cache compiled executables
+# across tests AND across suite runs.
+_cache_dir = os.environ.setdefault(
+    "JAX_COMPILATION_CACHE_DIR",
+    os.path.join(os.path.dirname(__file__), ".jit_cache"))
+# Env (not jax.config) so spawned worker processes inherit the cache.
+os.environ.setdefault("JAX_PERSISTENT_CACHE_MIN_COMPILE_TIME_SECS", "0")
+os.environ.setdefault("JAX_PERSISTENT_CACHE_MIN_ENTRY_SIZE_BYTES", "-1")
+jax.config.update("jax_compilation_cache_dir", _cache_dir)
+jax.config.update("jax_persistent_cache_min_compile_time_secs", 0.0)
+jax.config.update("jax_persistent_cache_min_entry_size_bytes", -1)
+
 import pytest  # noqa: E402
 
 

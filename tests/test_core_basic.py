@@ -122,6 +122,27 @@ def test_retry_on_worker_death(ray_start):
     assert ray_tpu.get(die_once.remote(marker), timeout=240) == "survived"
 
 
+def test_retry_on_worker_death_stress(ray_start):
+    """Several concurrent worker-suicide tasks: exercises the
+    return-lease-before-death-detected race (a dead worker must never be
+    re-idled and re-granted, and a failed lease request must re-pump)."""
+    @ray_tpu.remote(max_retries=2)
+    def die_once(marker):
+        import os
+
+        path = f"/tmp/ray_tpu_die_once_{marker}"
+        if not os.path.exists(path):
+            open(path, "w").close()
+            os._exit(1)
+        os.remove(path)
+        return marker
+
+    base = str(time.time()).replace(".", "")
+    markers = [f"{base}_{i}" for i in range(5)]
+    refs = [die_once.remote(m) for m in markers]
+    assert ray_tpu.get(refs, timeout=240) == markers
+
+
 def test_no_retry_exhausted(ray_start):
     @ray_tpu.remote(max_retries=0)
     def always_die():
