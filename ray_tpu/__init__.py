@@ -25,6 +25,10 @@ from ray_tpu.api import (
     init,
     is_initialized,
     kill,
+    kv_del,
+    kv_exists,
+    kv_get,
+    kv_put,
     method,
     nodes,
     placement_group,
@@ -56,6 +60,10 @@ __all__ = [
     "init",
     "is_initialized",
     "kill",
+    "kv_del",
+    "kv_exists",
+    "kv_get",
+    "kv_put",
     "method",
     "nodes",
     "placement_group",

@@ -174,6 +174,26 @@ def _connect_remote_driver(address: str, config: Config, namespace: str
         # Spill files must resolve to the cluster's session dir, not a
         # per-process default, or spilled objects are unreadable here.
         os.environ["RAY_TPU_SESSION_DIR"] = reply["session_dir"]
+    attached_arena = False
+    if reply.get("arena"):
+        # Same host as the head: map its arena for zero-copy object IO.
+        from ray_tpu.core import native_store
+
+        arena = native_store.NativeArena.attach(reply["arena"])
+        if arena is not None:
+            native_store.set_attached_arena(arena)
+            os.environ["RAY_TPU_ARENA"] = reply["arena"]
+            attached_arena = True
+    if attached_arena and reply.get("default_node_id"):
+        # Sharing the head's store means sharing its node identity.
+        cw.node_id_hex = cw.node_id_hex or reply["default_node_id"]
+    elif not attached_arena:
+        # Different machine (or arena unavailable): this driver has no
+        # node store. Big values stay in its in-process memory store and
+        # consumers fetch them from the owner over RPC — claiming the
+        # head's node id would poison the object directory with
+        # locations that don't hold the data.
+        cw.no_node_store = True
     from ray_tpu.core.ids import TaskID
 
     cw._root_task_id = TaskID.for_normal_task(cw.job_id)
@@ -680,3 +700,44 @@ def placement_group(bundles: List[Dict[str, float]], strategy: str = "PACK",
 def remove_placement_group(pg: PlacementGroup):
     cw = _require_worker()
     cw.loop_thread.run(cw.head.call("remove_pg", {"pg_id": pg.id_hex}))
+
+
+# ---------------------------------------------------------------------------
+# internal KV (reference: ray.experimental.internal_kv._internal_kv_*) —
+# durable under GCS fault tolerance (persisted write-through to the
+# session's sqlite store and reloaded on head restart).
+# ---------------------------------------------------------------------------
+
+
+def kv_put(key: bytes, value: bytes, *, namespace: str = "",
+           overwrite: bool = True) -> bool:
+    cw = _require_worker()
+    reply = cw.loop_thread.run(cw.head.call("kv_put", {
+        "ns": namespace, "key": key, "value": value,
+        "overwrite": overwrite,
+    }))
+    return bool(reply.get("added"))
+
+
+def kv_get(key: bytes, *, namespace: str = "") -> Optional[bytes]:
+    cw = _require_worker()
+    reply = cw.loop_thread.run(cw.head.call("kv_get", {
+        "ns": namespace, "key": key,
+    }))
+    return reply.get("value")
+
+
+def kv_del(key: bytes, *, namespace: str = "") -> bool:
+    cw = _require_worker()
+    reply = cw.loop_thread.run(cw.head.call("kv_del", {
+        "ns": namespace, "key": key,
+    }))
+    return bool(reply.get("deleted"))
+
+
+def kv_exists(key: bytes, *, namespace: str = "") -> bool:
+    cw = _require_worker()
+    reply = cw.loop_thread.run(cw.head.call("kv_exists", {
+        "ns": namespace, "key": key,
+    }))
+    return bool(reply.get("exists"))

@@ -72,6 +72,15 @@ class Config:
     # Max lineage entries retained per owner for object reconstruction
     # (reference: task_manager.h:202 max_lineage_bytes).
     max_lineage_entries: int = 10_000
+    # Byte budget for retained creating-task specs used to reconstruct
+    # lost shm objects (reference: task_manager.h:202 max_lineage_bytes).
+    max_lineage_bytes: int = 64 * 1024 * 1024
+    # How long a recovery resubmission may take to re-seal a lost object.
+    object_recovery_timeout_s: float = 120.0
+    # Persist control-plane tables (detached actors, PGs, KV, jobs) to
+    # sqlite in the session dir so a restarted head recovers them
+    # (reference: redis-backed GCS fault tolerance).
+    gcs_fault_tolerance: bool = True
 
     # --- rpc ---
     rpc_connect_timeout_s: float = 10.0

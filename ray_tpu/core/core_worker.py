@@ -25,7 +25,7 @@ import logging
 import os
 import threading
 import time
-from collections import deque
+from collections import OrderedDict, deque
 from dataclasses import dataclass, field
 from typing import Any, Callable, Dict, List, Optional, Tuple
 
@@ -190,6 +190,14 @@ class PendingTask:
     retries_left: int
     pushed_to: Optional[WorkerID] = None
     cancelled: bool = False
+    # True once the executing worker acked the push (sent before user
+    # code runs). A worker failure with accepted=False means the task
+    # never started, so its retry is free — a push written into a
+    # dead worker's socket must not drain the retry budget.
+    accepted: bool = False
+    # Safety cap on free retries (a worker that reliably dies between
+    # push and ack would otherwise loop forever).
+    free_retries: int = 10
 
 
 class ObjectRefGenerator:
@@ -350,6 +358,14 @@ class CoreWorker:
         self.node_id_hex: Optional[str] = node_hex
         # Cross-node pull manager (lazy: only touched on a local miss).
         self._puller = object_transfer.ObjectPuller(self.get_connection)
+        # Lineage: creating-task specs of owned plasma objects, retained
+        # under a byte budget so a lost object can be reconstructed by
+        # resubmitting its task (object_recovery_manager.h:41, budget:
+        # task_manager.h:202). Ordered for FIFO eviction.
+        self._lineage: "OrderedDict[ObjectID, tuple]" = OrderedDict()
+        self._lineage_bytes = 0
+        # task_id -> in-flight recovery future (coalesces racing gets).
+        self._recovering: Dict[TaskID, asyncio.Future] = {}
         try:
             self.loop.call_soon_threadsafe(
                 lambda: setattr(self, "_loop_thread_ident",
@@ -371,8 +387,15 @@ class CoreWorker:
             "remove_ref": self.h_remove_ref,
             "pubsub": self.h_pubsub,
             "stream_item": self.h_stream_item,
+            "task_accepted": self.h_task_accepted,
             "ping": self.h_ping,
         }
+
+    async def h_task_accepted(self, conn, payload):
+        pending = self.pending_tasks.get(
+            TaskID.from_hex(payload["task_id"]))
+        if pending is not None:
+            pending.accepted = True
 
     def _ingest_return(self, ret: dict) -> ObjectID:
         """Record one task-return payload (inline value or plasma
@@ -472,7 +495,8 @@ class CoreWorker:
         return ObjectRef(object_id, self.address, is_owned=True)
 
     def put_serialized(self, object_id: ObjectID, obj: SerializedObject):
-        in_shm = obj.total_size() > self.config.max_direct_call_object_size
+        in_shm = (obj.total_size() > self.config.max_direct_call_object_size
+                  and not getattr(self, "no_node_store", False))
         if in_shm:
             size = self._seal_to_shm(object_id, obj)
             self.memory_store.put(object_id, make_plasma_marker())
@@ -599,7 +623,66 @@ class CoreWorker:
             # the network from a holder (reference: pull_manager.h:52).
             obj = await self._pull_remote(object_id)
         if obj is None:
+            # Every copy is gone (evicted / worker died / segment deleted):
+            # rebuild by resubmitting the creating task (reference:
+            # object_recovery_manager.h:63-72).
+            obj = await self._recover_object(object_id, timeout)
+        if obj is None:
             raise exc.ObjectLostError(object_id.hex())
+        return obj
+
+    async def _recover_object(self, object_id: ObjectID,
+                              timeout: Optional[float]
+                              ) -> Optional[SerializedObject]:
+        entry = self._lineage.get(object_id)
+        if entry is None:
+            return None
+        spec = entry[0]
+        fut = self._recovering.get(spec.task_id)
+        if fut is None:
+            fut = asyncio.get_running_loop().create_future()
+            self._recovering[spec.task_id] = fut
+            logger.info("recovering lost object %s by resubmitting task %s",
+                        object_id.hex()[:12], spec.name or
+                        spec.task_id.hex()[:12])
+            # Reset terminal state so the reply path treats this as a
+            # fresh attempt of the same task (same return object ids).
+            self._finished_task_ids.discard(spec.task_id)
+            self.pending_tasks[spec.task_id] = PendingTask(
+                spec=spec, retries_left=max(spec.max_retries, 1))
+            # Clear the stale seal record so wait_object below blocks
+            # until the resubmitted task seals a fresh copy.
+            try:
+                await self.head.call("object_lost",
+                                     {"object_id": object_id.hex()})
+            except Exception:
+                pass
+            self._submit_on_loop(spec)
+
+            async def wait_reseal(task_id=spec.task_id):
+                try:
+                    reply = await self.head.call("wait_object", {
+                        "object_id": object_id.hex(),
+                        "timeout": self.config.object_recovery_timeout_s,
+                    })
+                    ok = bool(reply.get("sealed"))
+                except Exception:
+                    ok = False
+                f = self._recovering.pop(task_id, None)
+                if f is not None and not f.done():
+                    f.set_result(ok)
+
+            asyncio.ensure_future(wait_reseal())
+        try:
+            ok = await asyncio.wait_for(asyncio.shield(fut), timeout)
+        except asyncio.TimeoutError:
+            raise exc.GetTimeoutError(
+                f"timed out recovering object {object_id.hex()}")
+        if not ok:
+            return None
+        obj = object_store.node_store_open(object_id)
+        if obj is None:
+            obj = await self._pull_remote(object_id)
         return obj
 
     async def _pull_remote(self, object_id: ObjectID
@@ -666,12 +749,14 @@ class CoreWorker:
         hex_ids = [r.hex() for r in refs]
         for ref in refs:
             self.memory_store.delete(ref.id)
+            self._drop_lineage(ref.id)
         self.loop_thread.submit(
             self.head.call("free_objects", {"object_ids": hex_ids})
         )
 
     def _free_owned_object(self, object_id: ObjectID, in_shm: bool):
         self.memory_store.delete(object_id)
+        self._drop_lineage(object_id)
         if in_shm and not self._shutdown:
             try:
                 self.loop_thread.submit(
@@ -982,7 +1067,7 @@ class CoreWorker:
             # refuse to issue a replacement, stranding queued tasks when
             # this request failed (dead-worker grant, head error, raced
             # queue). Harmless when the queue is empty.
-            if state.queue:
+            if state.queue and not self._shutdown:
                 self._pump_scheduling_key(key, state)
 
     def _push_task_to_worker(self, key: tuple, state: SchedulingKeyState,
@@ -991,6 +1076,7 @@ class CoreWorker:
         if pending is None or pending.cancelled:
             return
         pending.pushed_to = lw.worker_id
+        pending.accepted = False
         lw.busy += 1
 
         async def push():
@@ -1104,12 +1190,42 @@ class CoreWorker:
         except Exception:
             pass
 
+    def _record_lineage(self, spec: TaskSpec, reply: dict):
+        """Retain the creating-task spec of plasma-sealed returns so a
+        lost copy can be rebuilt by resubmission. Only deterministic
+        normal tasks qualify (re-running an actor method would replay
+        side effects)."""
+        if spec.task_type != TaskType.NORMAL_TASK:
+            return
+        if not any(r.get("in_plasma") for r in reply.get("returns", [])):
+            return
+        try:
+            nbytes = len(serialization.dumps_control(spec))
+        except Exception:
+            return
+        for ret in reply["returns"]:
+            if ret.get("in_plasma"):
+                oid = ObjectID(ret["object_id"])
+                if oid not in self._lineage:
+                    self._lineage[oid] = (spec, nbytes)
+                    self._lineage_bytes += nbytes
+        while (self._lineage_bytes > self.config.max_lineage_bytes
+               and self._lineage):
+            _, (_, evicted_bytes) = self._lineage.popitem(last=False)
+            self._lineage_bytes -= evicted_bytes
+
+    def _drop_lineage(self, object_id: ObjectID):
+        entry = self._lineage.pop(object_id, None)
+        if entry is not None:
+            self._lineage_bytes -= entry[1]
+
     def _on_task_reply(self, spec: TaskSpec, reply: dict):
         pending = self.pending_tasks.pop(spec.task_id, None)
         self._ensure_sets()
         self._finished_task_ids.add(spec.task_id)
         if len(self._finished_task_ids) > self.config.max_lineage_entries:
             self._finished_task_ids.clear()
+        self._record_lineage(spec, reply)
         is_app_error = reply.get("is_error", False)
         if is_app_error and pending is not None and spec.retry_exceptions \
                 and pending.retries_left > 0:
@@ -1140,6 +1256,22 @@ class CoreWorker:
     def _on_task_worker_failure(self, spec: TaskSpec, error: Exception):
         pending = self.pending_tasks.get(spec.task_id)
         if pending is None:
+            return
+        # Free-retry decision. Two signals:
+        # - error.sent is False: the push was never written to the socket,
+        #   so the task PROVABLY never ran — always safe to requeue.
+        # - ack missing (pending.accepted False): the worker almost
+        #   certainly died before user code started, but a lost-ack window
+        #   exists where execution began; honor strict at-most-once for
+        #   max_retries=0 tasks by not using it there.
+        provably_unsent = getattr(error, "sent", True) is False
+        likely_unstarted = (not pending.accepted
+                            and spec.max_retries != 0)
+        if ((provably_unsent or likely_unstarted)
+                and not pending.cancelled and pending.free_retries > 0):
+            pending.free_retries -= 1
+            pending.pushed_to = None
+            self._submit_on_loop(spec)
             return
         if pending.retries_left > 0 and not pending.cancelled:
             pending.retries_left -= 1

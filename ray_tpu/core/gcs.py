@@ -46,11 +46,14 @@ logger = logging.getLogger(__name__)
 
 class HeadService:
     def __init__(self, config: Config, shm_store: ShmStore, session_dir: str,
-                 host: str = "127.0.0.1"):
+                 host: str = "127.0.0.1", storage=None):
         self.config = config
         self.shm = shm_store
         self.session_dir = session_dir
         self.host = host
+        # Durable backing store (gcs_storage.GcsStorage) — None disables
+        # persistence (reference: in-memory store_client fallback).
+        self.storage = storage
         self.port: Optional[int] = None
         self.pool: Optional[WorkerPool] = None
         self.scheduler: Optional[ClusterScheduler] = None
@@ -86,6 +89,8 @@ class HeadService:
         self.task_events: deque = deque(maxlen=config.task_events_max_buffer_size)
         self._pump_task: Optional[asyncio.Task] = None
         self._shutdown = False
+        # Actors restored from storage, recreated once a node joins.
+        self._recreate_on_node_join: List[ActorID] = []
 
     # ------------------------------------------------------------------
     # lifecycle
@@ -100,9 +105,106 @@ class HeadService:
         self.scheduler = ClusterScheduler(
             self.pool, spread_threshold=self.config.scheduler_spread_threshold
         )
+        self._load_persisted()
         self._pump_task = asyncio.get_running_loop().create_task(
             self._periodic_pump()
         )
+
+    # ------------------------------------------------------------------
+    # persistence (reference: gcs_table_storage.h:242 over store_client)
+    # ------------------------------------------------------------------
+
+    def _persist_actor(self, info: ActorInfo):
+        if self.storage is None:
+            return
+        spec = info.creation_spec
+        # Only detached actors outlive their driver; everything else dies
+        # with the job and would be garbage after a restart.
+        if spec is None or not getattr(spec, "detached", False):
+            return
+        try:
+            if info.state == "DEAD":
+                self.storage.delete("actors", info.actor_id.hex())
+            else:
+                self.storage.put("actors", info.actor_id.hex(), info)
+        except Exception:
+            logger.exception("actor persistence failed")
+
+    def _persist_pg(self, info: PlacementGroupInfo):
+        if self.storage is None:
+            return
+        try:
+            if info.state == "REMOVED":
+                self.storage.delete("pgs", info.pg_id.hex())
+            else:
+                self.storage.put("pgs", info.pg_id.hex(), info)
+        except Exception:
+            logger.exception("pg persistence failed")
+
+    def _persist_job(self, job_id: JobID, job: dict):
+        if self.storage is None:
+            return
+        try:
+            self.storage.put("jobs", job_id.hex(), {
+                "counter": self._job_counter,
+                "state": job.get("state"),
+                "start_time": job.get("start_time"),
+                "end_time": job.get("end_time"),
+            })
+        except Exception:
+            logger.exception("job persistence failed")
+
+    def _bump_spawn_backoff(self, node_id: NodeID):
+        delay = min(self._spawn_backoff_s.get(node_id, 0.5) * 2, 30.0)
+        self._spawn_backoff_s[node_id] = delay
+        self._spawn_backoff_until[node_id] = time.monotonic() + delay
+
+    def _persist_kv(self, ns: str, key, value, deleted: bool = False):
+        if self.storage is None:
+            return
+        row_key = f"{ns}\x00{key!r}"
+        try:
+            if deleted:
+                self.storage.delete("kv", row_key)
+            else:
+                self.storage.put("kv", row_key, (ns, key, value))
+        except Exception:
+            logger.exception("kv persistence failed")
+
+    def _load_persisted(self):
+        """Reload durable tables on head (re)start. Loaded actors lost
+        their workers with the previous head; they re-enter RESTARTING
+        and are recreated once a node joins (node_manager.cc:1122
+        HandleNotifyGCSRestart analog — here workers are respawned rather
+        than reattached, since they die with the head)."""
+        if self.storage is None:
+            return
+        for _, (ns, key, value) in self.storage.items("kv"):
+            self.kv.setdefault(ns, {})[key] = value
+        self._recreate_on_node_join: List[ActorID] = []
+        for _, info in self.storage.items("actors"):
+            if info.state == "DEAD":
+                continue
+            info.state = "RESTARTING"
+            info.address = None
+            info.node_id = None
+            self.actors[info.actor_id] = info
+            if info.name:
+                self.named_actors[(info.namespace, info.name)] = info.actor_id
+            self._recreate_on_node_join.append(info.actor_id)
+        for _, info in self.storage.items("pgs"):
+            info.state = "PENDING"  # re-place once nodes register
+            for b in info.bundles:
+                b.node_id = None
+            self.placement_groups[info.pg_id] = info
+        for _, job in self.storage.items("jobs"):
+            self._job_counter = max(self._job_counter,
+                                    job.get("counter", 0))
+        if self.actors or self.placement_groups:
+            logger.info(
+                "restored %d actor(s), %d placement group(s) from %s",
+                len(self.actors), len(self.placement_groups),
+                getattr(self.storage, "path", "?"))
 
     def _spawn_remote(self, node_id: NodeID, worker_id: WorkerID) -> bool:
         """WorkerPool hook: spawn on a remote host via its node agent.
@@ -126,11 +228,7 @@ class HeadService:
                 handle = self.pool.workers.get(worker_id)
                 if handle is not None and handle.state == "STARTING":
                     self.pool.mark_dead(worker_id)
-                    delay = min(
-                        self._spawn_backoff_s.get(node_id, 0.5) * 2, 30.0)
-                    self._spawn_backoff_s[node_id] = delay
-                    self._spawn_backoff_until[node_id] = (
-                        time.monotonic() + delay)
+                    self._bump_spawn_backoff(node_id)
                     self._pump()
 
         asyncio.ensure_future(go())
@@ -149,14 +247,7 @@ class HeadService:
                 for handle in reaped:
                     logger.warning("worker %s exited before registering",
                                    handle.worker_id.hex()[:12])
-                    delay = min(
-                        self._spawn_backoff_s.get(handle.node_id, 0.5) * 2,
-                        30.0,
-                    )
-                    self._spawn_backoff_s[handle.node_id] = delay
-                    self._spawn_backoff_until[handle.node_id] = (
-                        time.monotonic() + delay
-                    )
+                    self._bump_spawn_backoff(handle.node_id)
                 self._pump()
             except Exception:
                 logger.exception("scheduler pump failed")
@@ -205,6 +296,12 @@ class HeadService:
             "node_id": node_id.hex(), "state": "ALIVE",
             "resources": dict(resources),
         })
+        if self._recreate_on_node_join:
+            restored, self._recreate_on_node_join = (
+                self._recreate_on_node_join, [])
+            for actor_id in restored:
+                asyncio.get_running_loop().create_task(
+                    self._create_actor(actor_id))
         self._pump()
         return node_id
 
@@ -247,6 +344,7 @@ class HeadService:
             "worker_exited_early": self.h_worker_exited_early,
             "locate_object": self.h_locate_object,
             "object_location_added": self.h_object_location_added,
+            "object_lost": self.h_object_lost,
             "request_lease": self.h_request_lease,
             "return_worker": self.h_return_worker,
             "register_actor": self.h_register_actor,
@@ -341,12 +439,27 @@ class HeadService:
         handle = self.pool.workers.get(worker_id)
         if handle is not None and handle.state == "STARTING":
             self.pool.mark_dead(worker_id)
-            delay = min(self._spawn_backoff_s.get(handle.node_id, 0.5) * 2,
-                        30.0)
-            self._spawn_backoff_s[handle.node_id] = delay
-            self._spawn_backoff_until[handle.node_id] = (
-                time.monotonic() + delay)
+            self._bump_spawn_backoff(handle.node_id)
             self._pump()
+        return {"ok": True}
+
+    async def h_object_lost(self, conn, payload):
+        """Owner-reported loss of every reachable copy (before lineage
+        recovery): forget the seal so wait_object blocks until the
+        re-seal, and tell any still-listed remote holder to drop its
+        copy — a transiently unreachable holder may hold a pinned
+        primary that would otherwise leak until node death."""
+        hex_id = payload["object_id"]
+        self.sealed_objects.pop(hex_id, None)
+        self.shm.delete(ObjectID.from_hex(hex_id))
+        for node_id in self.object_locations.pop(hex_id, set()):
+            agent = self._node_agents.get(node_id)
+            if agent is not None:
+                try:
+                    await agent.notify("free_objects",
+                                       {"object_ids": [hex_id]})
+                except Exception:
+                    pass
         return {"ok": True}
 
     async def h_object_location_added(self, conn, payload):
@@ -366,6 +479,7 @@ class HeadService:
             "state": "RUNNING",
             "start_time": time.time(),
         }
+        self._persist_job(job_id, self.jobs[job_id])
         if conn is not None and hasattr(conn, "on_close"):
             prev_close = conn.on_close
             def on_close(c, _prev=prev_close, _job=job_id):
@@ -376,6 +490,11 @@ class HeadService:
         return {
             "job_id": job_id.hex(),
             "session_dir": self.session_dir,
+            # Same-host drivers can map the head's arena directly; remote
+            # ones fail the shm attach and use the pull plane instead.
+            "arena": os.environ.get("RAY_TPU_ARENA"),
+            "default_node_id": (self.default_node_id.hex()
+                                if hasattr(self, "default_node_id") else None),
             "nodes": [
                 {"node_id": n.node_id.hex(), "resources": n.resources}
                 for n in self.nodes_info.values()
@@ -387,6 +506,7 @@ class HeadService:
         if job:
             job["state"] = "FINISHED"
             job["end_time"] = time.time()
+            self._persist_job(job_id, job)
         # Kill non-detached actors of the job.
         for actor_id, info in list(self.actors.items()):
             if info.job_id == job_id and info.state in ("ALIVE", "PENDING",
@@ -553,6 +673,7 @@ class HeadService:
         self.actors[actor_id] = info
         if name_key:
             self.named_actors[name_key] = actor_id
+        self._persist_actor(info)
         asyncio.get_running_loop().create_task(self._create_actor(actor_id))
         return {"ok": True}
 
@@ -604,6 +725,7 @@ class HeadService:
                 return
             if info.state != "DEAD":
                 info.state = "ALIVE"
+                self._persist_actor(info)
                 self._publish_actor(info)
         finally:
             self._creating_actors.discard(actor_id)
@@ -625,6 +747,7 @@ class HeadService:
         info.state = "DEAD"
         info.death_cause = reason
         info.address = None
+        self._persist_actor(info)
         self._publish_actor(info)
 
     def _publish_actor(self, info: ActorInfo):
@@ -735,6 +858,7 @@ class HeadService:
         if not payload.get("overwrite", True) and key in ns:
             return {"added": False}
         ns[key] = payload["value"]
+        self._persist_kv(payload.get("ns", ""), key, payload["value"])
         return {"added": True}
 
     async def h_kv_get(self, conn, payload):
@@ -744,6 +868,9 @@ class HeadService:
     async def h_kv_del(self, conn, payload):
         ns = self.kv.get(payload.get("ns", ""), {})
         existed = ns.pop(payload["key"], None) is not None
+        if existed:
+            self._persist_kv(payload.get("ns", ""), payload["key"], None,
+                             deleted=True)
         return {"deleted": existed}
 
     async def h_kv_exists(self, conn, payload):
@@ -892,6 +1019,7 @@ class HeadService:
                 if not fut.done():
                     fut.set_result(True)
         # else: stays PENDING; _retry_pending_pgs retries on every pump.
+        self._persist_pg(info)
         return {"pg_id": pg_id.hex(), "state": info.state}
 
     def _retry_pending_pgs(self):
@@ -907,6 +1035,7 @@ class HeadService:
                 for fut in self._pg_waiters.pop(pg_id, []):
                     if not fut.done():
                         fut.set_result(True)
+                self._persist_pg(info)
 
     async def h_remove_pg(self, conn, payload):
         pg_id = PlacementGroupID.from_hex(payload["pg_id"])
@@ -914,6 +1043,7 @@ class HeadService:
         if info:
             info.state = "REMOVED"
             self.scheduler.remove_pg(pg_id)
+            self._persist_pg(info)
             self._pump()
         return {"ok": True}
 

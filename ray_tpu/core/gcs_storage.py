@@ -1,0 +1,130 @@
+"""Durable backing store for the head's control-plane tables.
+
+Reference mapping: src/ray/gcs/store_client/store_client.h (the pluggable
+KV behind gcs_table_storage.h:242) and the redis-backed GCS fault
+tolerance story. Here the store is a sqlite file in the session dir:
+every mutation is written through synchronously (sqlite WAL), and a
+restarted head (same ``--session-dir``) reloads actors, placement
+groups, KV, jobs and named-actor bindings before serving.
+
+What survives a head restart:
+- internal KV (function table, named refs, user KV),
+- detached/named actor records with their creation specs — recreated on
+  fresh workers after restart (their old workers died with the head),
+- placement-group specs — re-placed once nodes re-register,
+- job table (finished-job history).
+
+What intentionally does not: leases, in-flight tasks, object directory
+entries (objects died with the node stores; owners recover via lineage).
+"""
+
+from __future__ import annotations
+
+import logging
+import os
+import pickle
+import queue
+import sqlite3
+import threading
+from typing import Any, List, Optional, Tuple
+
+logger = logging.getLogger(__name__)
+
+
+class GcsStorage:
+    """Durable control-plane tables.
+
+    Mutations are enqueued to a dedicated writer thread (FIFO, so
+    put/delete ordering is preserved) and committed there — the head's
+    event loop never blocks on disk. Reads (`get`/`items`) run at boot or
+    in tests; they flush the queue first so they observe every enqueued
+    write (read-your-writes)."""
+
+    TABLES = ("kv", "actors", "pgs", "jobs")
+
+    def __init__(self, path: str):
+        os.makedirs(os.path.dirname(path), exist_ok=True)
+        self.path = path
+        self._lock = threading.Lock()  # guards _db across threads
+        self._db = sqlite3.connect(path, check_same_thread=False)
+        self._db.execute("PRAGMA journal_mode=WAL")
+        self._db.execute("PRAGMA synchronous=NORMAL")
+        for table in self.TABLES:
+            self._db.execute(
+                f"CREATE TABLE IF NOT EXISTS {table} "
+                "(k TEXT PRIMARY KEY, v BLOB)")
+        self._db.commit()
+        self._queue: "queue.Queue" = queue.Queue()
+        self._writer = threading.Thread(
+            target=self._writer_loop, name="gcs-storage", daemon=True)
+        self._writer.start()
+
+    # -- generic row ops --------------------------------------------------
+
+    def put(self, table: str, key: str, value: Any) -> None:
+        # Pickle on the caller (cheap, and value may mutate later).
+        self._queue.put(("put", table, key,
+                         pickle.dumps(value, protocol=5)))
+
+    def delete(self, table: str, key: str) -> None:
+        self._queue.put(("del", table, key, None))
+
+    def _writer_loop(self):
+        while True:
+            op = self._queue.get()
+            if op is None:
+                return
+            kind, table, key, blob = op
+            try:
+                with self._lock:
+                    if kind == "put":
+                        self._db.execute(
+                            f"INSERT INTO {table} (k, v) VALUES (?, ?) "
+                            "ON CONFLICT(k) DO UPDATE SET v = excluded.v",
+                            (key, blob))
+                    else:
+                        self._db.execute(
+                            f"DELETE FROM {table} WHERE k = ?", (key,))
+                    self._db.commit()
+            except Exception:
+                logger.exception("gcs storage write failed")
+            finally:
+                self._queue.task_done()
+
+    def flush(self) -> None:
+        """Block until every enqueued mutation is committed."""
+        self._queue.join()
+
+    def get(self, table: str, key: str) -> Optional[Any]:
+        self.flush()
+        with self._lock:
+            row = self._db.execute(
+                f"SELECT v FROM {table} WHERE k = ?", (key,)).fetchone()
+        return pickle.loads(row[0]) if row else None
+
+    def items(self, table: str) -> List[Tuple[str, Any]]:
+        self.flush()
+        with self._lock:
+            rows = self._db.execute(f"SELECT k, v FROM {table}").fetchall()
+        out = []
+        for k, v in rows:
+            try:
+                out.append((k, pickle.loads(v)))
+            except Exception:
+                continue  # skip rows written by an incompatible version
+        return out
+
+    def close(self) -> None:
+        self.flush()
+        self._queue.put(None)
+        self._writer.join(timeout=5)
+        with self._lock:
+            try:
+                self._db.commit()
+                self._db.close()
+            except Exception:
+                pass
+
+
+def storage_path(session_dir: str) -> str:
+    return os.path.join(session_dir, "gcs_state.sqlite")

@@ -1,0 +1,78 @@
+"""Standalone head process — run the cluster control plane outside any
+driver.
+
+Reference: the forked gcs_server + head raylet of `ray start --head`
+(python/ray/scripts/scripts.py start). Drivers attach with
+``ray_tpu.init(address="host:port")``; additional machines join with
+``python -m ray_tpu.core.node_agent --head-host ... --head-port ...``.
+
+With a pinned ``--port`` and ``--session-dir``, a head killed and
+restarted on the same paths recovers its durable state (detached actors,
+placement groups, KV, jobs) from the session's sqlite store and
+recreates detached actors on fresh workers — the framework's GCS
+fault-tolerance story (reference: redis-backed GCS restart +
+node_manager.cc:1122 HandleNotifyGCSRestart).
+"""
+
+from __future__ import annotations
+
+import argparse
+import asyncio
+import logging
+import os
+
+logger = logging.getLogger(__name__)
+
+
+def main():
+    logging.basicConfig(
+        level=logging.INFO,
+        format="%(asctime)s %(levelname)s head %(name)s: %(message)s",
+    )
+    p = argparse.ArgumentParser(description=__doc__)
+    p.add_argument("--port", type=int, default=0,
+                   help="fixed control-plane port (0 = ephemeral); pin it "
+                        "to survive restarts")
+    p.add_argument("--host", default="127.0.0.1")
+    p.add_argument("--num-cpus", type=float, default=os.cpu_count() or 1)
+    p.add_argument("--num-tpus", type=float, default=None)
+    p.add_argument("--resources", default=None,
+                   help="extra custom resources as JSON")
+    p.add_argument("--session-dir", default=None,
+                   help="pin to reuse durable state across restarts")
+    p.add_argument("--object-store-memory", type=int, default=None)
+    args = p.parse_args()
+
+    from ray_tpu.core.config import get_config
+    from ray_tpu.core.node import HeadNode, detect_node_resources
+
+    config = get_config()
+    if args.object_store_memory:
+        config.object_store_memory = args.object_store_memory
+    resources = detect_node_resources(args.num_cpus, args.num_tpus)
+    if args.resources:
+        import json
+
+        resources.update({k: float(v)
+                          for k, v in json.loads(args.resources).items()})
+
+    node = HeadNode(config, resources, session_dir=args.session_dir,
+                    host=args.host, port=args.port)
+    print(f"ray_tpu head listening on {args.host}:{node.port} "
+          f"(session {node.session_dir})", flush=True)
+
+    stop = asyncio.Event()
+
+    async def wait_forever():
+        await stop.wait()
+
+    try:
+        node.loop_thread.run(wait_forever())
+    except KeyboardInterrupt:
+        pass
+    finally:
+        node.shutdown()
+
+
+if __name__ == "__main__":
+    main()

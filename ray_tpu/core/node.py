@@ -57,8 +57,10 @@ class HeadNode:
     """Owns the head's event loop, RPC server, shm store and services."""
 
     def __init__(self, config: Config, resources: Dict[str, float],
-                 session_dir: Optional[str] = None):
+                 session_dir: Optional[str] = None,
+                 host: str = "127.0.0.1", port: int = 0):
         self.config = config
+        self.host = host
         self.session_dir = session_dir or _make_session_dir()
         os.makedirs(os.path.join(self.session_dir, "logs"), exist_ok=True)
         # Driver-side spill path must match workers' (they inherit it
@@ -84,16 +86,26 @@ class HeadNode:
         if self.shm_store is None:
             self.shm_store = ShmStore(capacity)
         self.loop_thread = rpc.EventLoopThread(name="ray-tpu-head")
-        self.service = HeadService(config, self.shm_store, self.session_dir)
+        storage = None
+        if config.gcs_fault_tolerance:
+            from ray_tpu.core.gcs_storage import GcsStorage, storage_path
+
+            try:
+                storage = GcsStorage(storage_path(self.session_dir))
+            except Exception:
+                logger.exception("gcs persistence unavailable; running "
+                                 "with in-memory state only")
+        self.service = HeadService(config, self.shm_store, self.session_dir,
+                                   host=host, storage=storage)
         self.server: Optional[rpc.Server] = None
         self.port: Optional[int] = None
         self.node_ids: List[NodeID] = []
 
         async def boot():
             self.server = rpc.Server(self.service.handlers(), name="head")
-            port = await self.server.start("127.0.0.1", 0)
-            self.service.attach(port)
-            return port
+            bound = await self.server.start(host, port)
+            self.service.attach(bound)
+            return bound
 
         self.port = self.loop_thread.run(boot())
         self.default_node_id = self.add_node(resources)

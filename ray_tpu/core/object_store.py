@@ -431,7 +431,16 @@ def _spill_open(object_id: ObjectID) -> Optional[SerializedObject]:
     return parse_packed(memoryview(mapped))
 
 
+# Serve-side cache of spill mmaps (object hex -> memoryview); dropped on
+# spill_delete. The mapping keeps the file's pages reachable even after
+# unlink, which is exactly the hand-a-view-out semantics readers need.
+_spill_mmaps: Dict[str, memoryview] = {}
+_spill_mmap_lock = threading.Lock()
+
+
 def spill_delete(object_id: ObjectID) -> None:
+    with _spill_mmap_lock:
+        _spill_mmaps.pop(object_id.hex(), None)
     try:
         os.remove(_spill_path(object_id))
     except OSError:
@@ -520,8 +529,13 @@ def node_store_read_packed(object_id: ObjectID):
                     ShmStore._open_segments.setdefault(name, seg)
         if seg is not None and bytes(seg.buf[:4]) == ShmStore.HEADER_MAGIC:
             return seg.buf
-    # Spilled: mmap so per-chunk serves slice lazily instead of re-reading
-    # the whole file per request.
+    # Spilled: mmap once per object and serve every chunk request from
+    # the cached mapping (mirrors ShmStore._open_segments for shm).
+    hex_id = object_id.hex()
+    with _spill_mmap_lock:
+        cached = _spill_mmaps.get(hex_id)
+    if cached is not None:
+        return cached
     import mmap
 
     path = _spill_path(object_id)
@@ -535,7 +549,10 @@ def node_store_read_packed(object_id: ObjectID):
         return b""
     finally:
         f.close()
-    return memoryview(mapped)
+    view = memoryview(mapped)
+    with _spill_mmap_lock:
+        _spill_mmaps[hex_id] = view
+    return view
 
 
 def _unlink_segment(hex_id: str):

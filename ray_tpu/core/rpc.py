@@ -39,7 +39,14 @@ class RpcError(Exception):
 
 
 class ConnectionLost(RpcError):
-    pass
+    """Peer connection died. ``sent=False`` means the request was never
+    written to the socket (connection already closed), so the callee
+    definitely never saw it — callers may retry without side-effect or
+    at-most-once concerns."""
+
+    def __init__(self, msg: str = "", sent: bool = True):
+        super().__init__(msg)
+        self.sent = sent
 
 
 class Connection:
@@ -113,7 +120,7 @@ class Connection:
         data = msgpack.packb(msg, use_bin_type=True)
         async with self._send_lock:
             if self._closed:
-                raise ConnectionLost(self.name)
+                raise ConnectionLost(self.name, sent=False)
             self.writer.write(len(data).to_bytes(4, "little"))
             self.writer.write(data)
             await self.writer.drain()

@@ -454,6 +454,13 @@ async def _amain():
 
     async def h_push_task(conn, payload):
         spec: TaskSpec = serialization.loads_control(payload["spec"])
+        # Ack receipt BEFORE any user code can run: the owner frees the
+        # retry of an unacked push (the task provably never started).
+        try:
+            await conn.notify("task_accepted",
+                              {"task_id": spec.task_id.hex()})
+        except Exception:
+            pass
         # Actor executors are configured by create_actor (reconfigure);
         # this covers plain tasks on a fresh worker.
         executor.ensure_started()
