@@ -96,12 +96,36 @@ def main():
     flops = model_flops_per_step(cfg, batch, seq)
     achieved = flops / dt
     mfu = achieved / peak_flops_per_chip()
+    _run_core_bench()
     print(json.dumps({
         "metric": "train_mfu",
         "value": round(mfu, 4),
         "unit": "mfu",
         "vs_baseline": round(mfu / 0.40, 4),
     }))
+
+
+def _run_core_bench():
+    """Side artifact: core control-plane throughput (tasks/s, actor
+    calls/s, store bandwidth) written to BENCH_CORE.json so regressions
+    on the task path are visible per round (BASELINE.md microbenchmark
+    table is the floor). Never allowed to break the headline metric."""
+    import os
+    import subprocess
+    import sys
+
+    out = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                       "BENCH_CORE.json")
+    try:
+        subprocess.run(
+            [sys.executable, "-m", "ray_tpu.scripts.microbenchmark",
+             "--json", out],
+            timeout=300, stdout=subprocess.DEVNULL,
+            stderr=subprocess.DEVNULL,
+            env={**os.environ, "JAX_PLATFORMS": "cpu"},
+        )
+    except Exception:
+        pass
 
 
 if __name__ == "__main__":

@@ -72,6 +72,10 @@ class Config:
     # Max lineage entries retained per owner for object reconstruction
     # (reference: task_manager.h:202 max_lineage_bytes).
     max_lineage_entries: int = 10_000
+    # Tasks pushed to one leased worker before its replies drain — hides
+    # the push/reply RTT behind execution (reference:
+    # max_tasks_in_flight_per_worker, direct_task_transport.h).
+    max_tasks_in_flight_per_worker: int = 10
     # Byte budget for retained creating-task specs used to reconstruct
     # lost shm objects (reference: task_manager.h:202 max_lineage_bytes).
     max_lineage_bytes: int = 64 * 1024 * 1024

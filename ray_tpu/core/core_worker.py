@@ -366,6 +366,10 @@ class CoreWorker:
         self._lineage_bytes = 0
         # task_id -> in-flight recovery future (coalesces racing gets).
         self._recovering: Dict[TaskID, asyncio.Future] = {}
+        # Burst-coalesced submission queue (API thread -> loop).
+        self._submit_buf: List[TaskSpec] = []
+        self._submit_lock = threading.Lock()
+        self._submit_wake_pending = False
         try:
             self.loop.call_soon_threadsafe(
                 lambda: setattr(self, "_loop_thread_ident",
@@ -565,9 +569,15 @@ class CoreWorker:
         fut = self.loop.create_future()
 
         def cb(obj):
-            self.loop.call_soon_threadsafe(
-                lambda: fut.set_result(obj) if not fut.done() else None
-            )
+            def fire():
+                if not fut.done():
+                    fut.set_result(obj)
+            # Most waiters resolve from the loop thread itself (reply
+            # ingestion); skip the self-pipe wakeup syscall there.
+            if threading.get_ident() == self._loop_thread_ident:
+                fire()
+            else:
+                self.loop.call_soon_threadsafe(fire)
 
         self.memory_store.add_waiter(object_id, cb)
         try:
@@ -986,14 +996,32 @@ class CoreWorker:
             gen = ObjectRefGenerator(
                 task_id, cleanup=lambda: self._release_stream(task_id))
             self._streams[task_id] = gen
-            self.loop.call_soon_threadsafe(self._submit_on_loop, spec)
+            self._submit_threadsafe(spec)
             return gen
         refs = [
             ObjectRef(oid, self.address, is_owned=True)
             for oid in spec.return_object_ids()
         ]
-        self.loop.call_soon_threadsafe(self._submit_on_loop, spec)
+        self._submit_threadsafe(spec)
         return refs
+
+    def _submit_threadsafe(self, spec: TaskSpec):
+        """Queue a spec for the loop with one wakeup per burst: rapid
+        submissions from an API thread coalesce onto a single self-pipe
+        write instead of one syscall each."""
+        with self._submit_lock:
+            self._submit_buf.append(spec)
+            if self._submit_wake_pending:
+                return
+            self._submit_wake_pending = True
+        self.loop.call_soon_threadsafe(self._drain_submits)
+
+    def _drain_submits(self):
+        with self._submit_lock:
+            specs, self._submit_buf = self._submit_buf, []
+            self._submit_wake_pending = False
+        for spec in specs:
+            self._submit_on_loop(spec)
 
     def _submit_on_loop(self, spec: TaskSpec):
         key = spec.scheduling_key()
@@ -1002,11 +1030,14 @@ class CoreWorker:
         self._pump_scheduling_key(key, state)
 
     def _pump_scheduling_key(self, key: tuple, state: SchedulingKeyState):
-        # Push queued tasks onto idle leased workers.
+        # Push queued tasks onto leased workers, keeping each worker's
+        # pipeline fed up to the in-flight cap (the worker executes FIFO;
+        # queued pushes hide the RTT behind execution).
+        cap = max(1, self.config.max_tasks_in_flight_per_worker)
         for lw in list(state.workers.values()):
             while state.queue and lw.conn is not None and not lw.conn.closed:
-                if lw.busy >= 1:
-                    break  # one task at a time per worker (matches reference)
+                if lw.busy >= cap:
+                    break
                 spec = state.queue.popleft()
                 self._push_task_to_worker(key, state, lw, spec)
         # Request more leases if there is a backlog.

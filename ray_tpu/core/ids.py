@@ -28,6 +28,28 @@ UNIQUE_ID_SIZE = 16
 _MAX_INDEX = 2**32 - 1
 
 
+class _EntropyPool:
+    """Buffered os.urandom: one syscall per ~16k ids instead of one per
+    id (TaskID minting is on the task-submission hot path)."""
+
+    def __init__(self):
+        self._buf = b""
+        self._off = 0
+        self._lock = threading.Lock()
+
+    def take(self, n: int) -> bytes:
+        with self._lock:
+            if self._off + n > len(self._buf):
+                self._buf = os.urandom(65536)
+                self._off = 0
+            out = self._buf[self._off:self._off + n]
+            self._off += n
+            return out
+
+
+_entropy = _EntropyPool()
+
+
 class BaseID:
     __slots__ = ("_bytes",)
     SIZE = UNIQUE_ID_SIZE
@@ -112,14 +134,21 @@ class ActorID(BaseID):
 class TaskID(BaseID):
     SIZE = TASK_ID_SIZE
 
+    _nil_actor_suffix: dict = {}  # job binary -> nil-actor suffix bytes
+
     @classmethod
     def for_normal_task(cls, job_id: JobID):
-        actor = ActorID.nil_for_job(job_id)
-        return cls(os.urandom(TASK_ID_SIZE - ACTOR_ID_SIZE) + actor.binary())
+        suffix = cls._nil_actor_suffix.get(job_id._bytes)
+        if suffix is None:
+            suffix = (b"\xff" * (ACTOR_ID_SIZE - JOB_ID_SIZE)
+                      + job_id._bytes)
+            cls._nil_actor_suffix[job_id._bytes] = suffix
+        return cls(_entropy.take(TASK_ID_SIZE - ACTOR_ID_SIZE) + suffix)
 
     @classmethod
     def for_actor_task(cls, actor_id: ActorID):
-        return cls(os.urandom(TASK_ID_SIZE - ACTOR_ID_SIZE) + actor_id.binary())
+        return cls(_entropy.take(TASK_ID_SIZE - ACTOR_ID_SIZE)
+                   + actor_id.binary())
 
     @classmethod
     def for_actor_creation(cls, actor_id: ActorID):

@@ -52,6 +52,9 @@ class ConnectionLost(RpcError):
 class Connection:
     """One bidirectional peer connection."""
 
+    # Above this many buffered bytes, senders await drain (backpressure).
+    WRITE_HIGH_WATER = 4 << 20
+
     def __init__(self, reader: asyncio.StreamReader, writer: asyncio.StreamWriter,
                  handlers: Dict[str, Handler], name: str = ""):
         self.reader = reader
@@ -61,9 +64,12 @@ class Connection:
         self._req_counter = itertools.count(1)
         self._pending: Dict[int, asyncio.Future] = {}
         self._closed = False
-        self._send_lock = asyncio.Lock()
         self._read_task: Optional[asyncio.Task] = None
         self.on_close: Optional[Callable[["Connection"], None]] = None
+        # Write coalescing: frames queued within one loop tick flush as a
+        # single writer.write (one syscall for a burst of small RPCs).
+        self._outbuf: list = []
+        self._flush_scheduled = False
         # Arbitrary per-connection state (e.g. registered worker id).
         self.state: Dict[str, Any] = {}
 
@@ -117,13 +123,31 @@ class Connection:
             await self._send({"t": "res", "i": msg["i"], "d": result, "e": error})
 
     async def _send(self, msg: dict):
+        if self._closed:
+            raise ConnectionLost(self.name, sent=False)
         data = msgpack.packb(msg, use_bin_type=True)
-        async with self._send_lock:
-            if self._closed:
-                raise ConnectionLost(self.name, sent=False)
-            self.writer.write(len(data).to_bytes(4, "little"))
-            self.writer.write(data)
+        # Both appends happen before any await: the frame is atomic.
+        self._outbuf.append(len(data).to_bytes(4, "little"))
+        self._outbuf.append(data)
+        if not self._flush_scheduled:
+            self._flush_scheduled = True
+            asyncio.get_running_loop().call_soon(self._flush)
+        transport = self.writer.transport
+        if (transport is not None and
+                transport.get_write_buffer_size() > self.WRITE_HIGH_WATER):
+            self._flush()
             await self.writer.drain()
+
+    def _flush(self):
+        self._flush_scheduled = False
+        if self._closed or not self._outbuf:
+            return
+        data = b"".join(self._outbuf)
+        self._outbuf.clear()
+        try:
+            self.writer.write(data)
+        except Exception:
+            pass  # the read loop notices the broken pipe and tears down
 
     async def call(self, method: str, payload: Any = None,
                    timeout: Optional[float] = None) -> Any:
@@ -145,6 +169,10 @@ class Connection:
     async def _teardown(self):
         if self._closed:
             return
+        # Flush frames _send already accepted before marking closed: a
+        # graceful close in the same tick as a final reply/notify must
+        # not drop it (the pre-coalescing code wrote synchronously).
+        self._flush()
         self._closed = True
         for fut in self._pending.values():
             if not fut.done():

@@ -62,6 +62,16 @@ class Executor:
         # task hex -> executing thread ident (for cancellation)
         self._running_threads = {}
         self._cancelled_tasks = set()
+        # Fast path (sync, max_concurrency=1): a dedicated thread pulls
+        # from a plain queue and batches acks/completions onto the loop
+        # with a single wakeup per burst — pipelined small tasks then
+        # cost one self-pipe syscall per burst instead of two per task.
+        self._sync_queue = None
+        self._sync_thread = None
+        self._loop = None
+        self._pending_events: list = []
+        self._events_lock = threading.Lock()
+        self._events_wake = False
 
     def reconfigure(self, max_concurrency: int, is_async: bool):
         """Restart consumers with new settings (safe only while no task is
@@ -70,6 +80,10 @@ class Executor:
         for t in self._consumers:
             t.cancel()
         self._consumers = []
+        if self._sync_queue is not None:
+            self._sync_queue.put(None)
+            self._sync_queue = None
+            self._sync_thread = None
         self._started = False
         self.ensure_started(max_concurrency, is_async)
 
@@ -79,17 +93,92 @@ class Executor:
         self._started = True
         self._max_concurrency = max(1, max_concurrency)
         self._is_async = is_async
+        self._loop = asyncio.get_running_loop()
+        if not is_async and self._max_concurrency == 1:
+            import queue as _queue
+
+            self._sync_queue = _queue.Queue()
+            self._sync_thread = threading.Thread(
+                target=self._sync_loop, name="task-executor", daemon=True)
+            self._sync_thread.start()
+            return
         n = self._max_concurrency if not is_async else 1
         for _ in range(n):
             self._consumers.append(
                 asyncio.get_running_loop().create_task(self._consume())
             )
 
+    # ---- sync fast path ----
+
+    def _sync_loop(self):
+        q = self._sync_queue
+        while True:
+            item = q.get()
+            if item is None or q is not self._sync_queue:
+                return
+            spec, fut = item
+            # Ack execution start through the batched channel: flushed by
+            # the loop (usually while the task still runs), so a worker
+            # death mid-task is distinguishable from died-in-queue.
+            self._post_event(("ack", spec, None, None))
+            try:
+                result = self._execute_sync(spec)
+            except BaseException as e:  # incl. ActorExitSignal
+                self._post_event(("done", spec, fut, e))
+            else:
+                self._post_event(("result", spec, fut, result))
+
+    def _post_event(self, event):
+        with self._events_lock:
+            self._pending_events.append(event)
+            if self._events_wake:
+                return
+            self._events_wake = True
+        self._loop.call_soon_threadsafe(self._drain_events)
+
+    def _drain_events(self):
+        with self._events_lock:
+            events, self._pending_events = self._pending_events, []
+            self._events_wake = False
+        for kind, spec, fut, payload in events:
+            if kind == "ack":
+                conn = self._stream_conns.get(spec.task_id.hex())
+                if conn is not None:
+                    asyncio.ensure_future(self._notify_quiet(
+                        conn, spec.task_id.hex()))
+            elif kind == "result":
+                self._record_terminal(spec, payload)
+                if not fut.done():
+                    fut.set_result(payload)
+            else:  # done-with-exception
+                self.cw.record_task_event(
+                    spec, "FINISHED"
+                    if isinstance(payload, ActorExitSignal) else "FAILED")
+                if not fut.done():
+                    fut.set_exception(payload)
+
+    @staticmethod
+    async def _notify_quiet(conn, task_hex):
+        try:
+            await conn.notify("task_accepted", {"task_id": task_hex})
+        except Exception:
+            pass
+
+    async def _ack_accepted(self, spec: TaskSpec):
+        """Tell the owner execution is starting. Sent at dequeue time,
+        not push receipt: with pipelined pushes, tasks still sitting in
+        this queue when the worker dies provably never ran, and the
+        missing ack lets the owner retry them for free."""
+        conn = self._stream_conns.get(spec.task_id.hex())
+        if conn is not None:
+            await self._notify_quiet(conn, spec.task_id.hex())
+
     async def _consume(self):
         loop = asyncio.get_running_loop()
         sem = asyncio.Semaphore(self._max_concurrency)
         while True:
             spec, fut = await self._queue.get()
+            await self._ack_accepted(spec)
             if self._is_async:
                 await sem.acquire()
 
@@ -134,7 +223,10 @@ class Executor:
         self.cw.record_task_event(spec, "PENDING_EXECUTION")
         self._stream_conns[spec.task_id.hex()] = conn
         try:
-            await self._queue.put((spec, fut))
+            if self._sync_queue is not None:
+                self._sync_queue.put((spec, fut))
+            else:
+                await self._queue.put((spec, fut))
             return await fut
         finally:
             self._stream_conns.pop(spec.task_id.hex(), None)
@@ -411,6 +503,20 @@ class Executor:
 
 
 async def _amain():
+    # Restore documented JAX env semantics: some PJRT plugin site hooks
+    # (e.g. the tunneled-TPU axon plugin) call
+    # jax.config.update("jax_platforms", ...) at interpreter start,
+    # which silently overrides JAX_PLATFORMS. The driver's platform
+    # choice must hold in its workers — a CPU-only test cluster must not
+    # route every worker's jax dispatch through a tunneled TPU.
+    plat = os.environ.get("JAX_PLATFORMS")
+    if plat:
+        try:
+            import jax
+
+            jax.config.update("jax_platforms", plat)
+        except Exception:
+            pass
     config = get_config()
     head_host = os.environ["RAY_TPU_HEAD_HOST"]
     head_port = int(os.environ["RAY_TPU_HEAD_PORT"])
@@ -454,15 +560,9 @@ async def _amain():
 
     async def h_push_task(conn, payload):
         spec: TaskSpec = serialization.loads_control(payload["spec"])
-        # Ack receipt BEFORE any user code can run: the owner frees the
-        # retry of an unacked push (the task provably never started).
-        try:
-            await conn.notify("task_accepted",
-                              {"task_id": spec.task_id.hex()})
-        except Exception:
-            pass
         # Actor executors are configured by create_actor (reconfigure);
-        # this covers plain tasks on a fresh worker.
+        # this covers plain tasks on a fresh worker. The execution-start
+        # ack (task_accepted) is sent by the executor at dequeue time.
         executor.ensure_started()
         try:
             return await executor.submit(spec, conn)

@@ -1,0 +1,117 @@
+"""Arrow-backed blocks.
+
+Reference: python/ray/data/_internal/arrow_block.py (ArrowBlockAccessor).
+A block may be a ``pyarrow.Table`` instead of a numpy-dict; the accessor
+dispatch in block.py routes table blocks here. Columnar file reads
+(parquet/csv/json) produce table blocks, and slicing / splitting /
+concatenation / writes stay zero-copy in Arrow — rows are only
+materialized at UDF and iteration boundaries (``to_batch`` converts to
+the numpy-dict form the TPU ingest path consumes).
+"""
+
+from __future__ import annotations
+
+import bisect
+from typing import Any, Dict, Iterator, List, Optional
+
+import numpy as np
+
+from ray_tpu.data import block as block_mod
+
+
+def is_arrow_block(block: Any) -> bool:
+    try:
+        import pyarrow as pa
+    except ImportError:  # pragma: no cover
+        return False
+    return isinstance(block, pa.Table)
+
+
+def block_to_arrow(block: Any):
+    """Convert any block to a pyarrow.Table (no-op for table blocks)."""
+    import pyarrow as pa
+
+    if isinstance(block, pa.Table):
+        return block
+    return pa.table({
+        k: (list(v) if getattr(v, "ndim", 1) > 1 else v)
+        for k, v in block.items()
+    })
+
+
+def arrow_to_numpy_block(table) -> Dict[str, np.ndarray]:
+    return {c: table[c].to_numpy(zero_copy_only=False)
+            for c in table.column_names}
+
+
+class ArrowBlockAccessor(block_mod.BlockAccessor):
+    """BlockAccessor over a pyarrow.Table (zero-copy slice/take/concat)."""
+
+    def __init__(self, block):
+        self._table = block
+        # note: self._block intentionally not set; every base method that
+        # touches it is overridden below.
+
+    def num_rows(self) -> int:
+        return self._table.num_rows
+
+    def size_bytes(self) -> int:
+        return self._table.nbytes
+
+    def schema(self) -> Optional[Dict[str, str]]:
+        if self._table.num_columns == 0:
+            return None
+        return {f.name: str(f.type) for f in self._table.schema}
+
+    def iter_rows(self) -> Iterator[Any]:
+        names = self._table.column_names
+        simple = names == [block_mod.ITEM_COL]
+        for batch in self._table.to_batches():
+            for row in batch.to_pylist():
+                yield row[block_mod.ITEM_COL] if simple else row
+
+    def slice(self, start: int, end: int):
+        return self._table.slice(start, max(0, end - start))
+
+    def take_indices(self, idx: np.ndarray):
+        return self._table.take(idx)
+
+    def to_batch(self) -> Dict[str, np.ndarray]:
+        return arrow_to_numpy_block(self._table)
+
+    def to_pandas(self):
+        return self._table.to_pandas()
+
+    def sample(self, n: int, sort_key: Optional[str]) -> np.ndarray:
+        nrows = self.num_rows()
+        if nrows == 0:
+            return np.array([])
+        key = sort_key or self._sort_column()
+        idx = np.random.randint(0, nrows, size=min(n, nrows))
+        return self._table[key].take(idx).to_numpy(zero_copy_only=False)
+
+    def _sort_column(self) -> str:
+        names = self._table.column_names
+        if block_mod.ITEM_COL in names:
+            return block_mod.ITEM_COL
+        return names[0]
+
+    # Sorting requires a full permutation anyway; hand back numpy blocks
+    # so the downstream grouped/shuffle code sees its canonical form.
+    def sort(self, key: Optional[str], descending: bool = False):
+        return block_mod.BlockAccessor(self.to_batch()).sort(
+            key or self._sort_column(), descending)
+
+    def sort_partitions(self, boundaries: np.ndarray, key: Optional[str],
+                        descending: bool) -> List[Any]:
+        return block_mod.BlockAccessor(self.to_batch()).sort_partitions(
+            boundaries, key or self._sort_column(), descending)
+
+
+def concat_arrow(tables: List[Any]):
+    import pyarrow as pa
+
+    tables = [t for t in tables if t.num_rows > 0]
+    if not tables:
+        return pa.table({})
+    return pa.concat_tables(tables, promote_options="default")

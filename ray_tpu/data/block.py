@@ -64,6 +64,13 @@ def block_from_rows(rows: List[Any]) -> Block:
 
 def block_from_batch(batch: Any) -> Block:
     """Normalize a user map_batches return value into a Block."""
+    try:
+        import pyarrow as pa
+
+        if isinstance(batch, pa.Table):
+            return batch  # arrow table IS a block
+    except ImportError:  # pragma: no cover
+        pass
     if isinstance(batch, dict):
         out = {k: np.asarray(v) for k, v in batch.items()}
         lens = {k: len(v) for k, v in out.items()}
@@ -88,7 +95,23 @@ def block_from_batch(batch: Any) -> Block:
 
 
 class BlockAccessor:
-    """Uniform view over a block (reference: block.py BlockAccessor)."""
+    """Uniform view over a block (reference: block.py BlockAccessor).
+
+    Dispatches on block kind: numpy-dict blocks use this class directly;
+    ``pyarrow.Table`` blocks get an ArrowBlockAccessor
+    (data/arrow_block.py), mirroring the reference's per-format accessor
+    registry."""
+
+    def __new__(cls, block):
+        if cls is BlockAccessor and type(block) is not dict:
+            from ray_tpu.data.arrow_block import (
+                ArrowBlockAccessor,
+                is_arrow_block,
+            )
+
+            if is_arrow_block(block):
+                return super().__new__(ArrowBlockAccessor)
+        return super().__new__(cls)
 
     def __init__(self, block: Block):
         self._block = block
@@ -191,6 +214,13 @@ def concat_blocks(blocks: List[Block]) -> Block:
     blocks = [b for b in blocks if BlockAccessor(b).num_rows() > 0]
     if not blocks:
         return {}
+    if any(type(b) is not dict for b in blocks):
+        from ray_tpu.data.arrow_block import concat_arrow, is_arrow_block
+
+        if all(is_arrow_block(b) for b in blocks):
+            return concat_arrow(blocks)  # zero-copy chunked concat
+        # Mixed kinds: normalize to numpy-dict.
+        blocks = [BlockAccessor(b).to_batch() for b in blocks]
     keys = list(blocks[0])
     for b in blocks[1:]:
         if list(b) != keys:

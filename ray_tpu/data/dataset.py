@@ -298,6 +298,15 @@ class Dataset:
 
     # -- aggregates -----------------------------------------------------
     @staticmethod
+    def _fetch_batch(block_ref) -> Block:
+        """Fetch a block and normalize to the numpy-dict form (Arrow
+        table blocks materialize their columns here)."""
+        block = ray_tpu.get(block_ref)
+        if type(block) is not dict:
+            block = BlockAccessor(block).to_batch()
+        return block
+
+    @staticmethod
     def _agg_target(on: Optional[str], block: Block) -> str:
         if on is not None:
             return on
@@ -312,7 +321,7 @@ class Dataset:
     def _agg_column(self, col: Optional[str], red, finalize=None):
         vals = []
         for block_ref, _ in self._execute():
-            block = ray_tpu.get(block_ref)
+            block = self._fetch_batch(block_ref)
             if not block:
                 continue
             col_used = self._agg_target(col, block)
@@ -326,7 +335,7 @@ class Dataset:
     def sum(self, on: Optional[str] = None):
         per_block = []
         for block_ref, _ in self._execute():
-            block = ray_tpu.get(block_ref)
+            block = self._fetch_batch(block_ref)
             if block:
                 c = self._agg_target(on, block)
                 if len(block[c]):
@@ -345,7 +354,7 @@ class Dataset:
     def mean(self, on: Optional[str] = None):
         total, count = 0.0, 0
         for block_ref, _ in self._execute():
-            block = ray_tpu.get(block_ref)
+            block = self._fetch_batch(block_ref)
             if block:
                 c = self._agg_target(on, block)
                 total += float(np.sum(block[c]))
@@ -366,7 +375,7 @@ class Dataset:
     def unique(self, column: str) -> List[Any]:
         out = set()
         for block_ref, _ in self._execute():
-            block = ray_tpu.get(block_ref)
+            block = self._fetch_batch(block_ref)
             if block and column in block:
                 out.update(np.unique(block[column]).tolist())
         return sorted(out)
@@ -410,6 +419,16 @@ class Dataset:
 
 
 def _format_batch(block: Block, batch_format: str, device, sharding):
+    if batch_format == "pyarrow":
+        from ray_tpu.data.arrow_block import block_to_arrow
+
+        return block_to_arrow(block)
+    if type(block) is not dict:
+        # Arrow table block: materialize columns for the numpy-family
+        # formats (pandas goes through the accessor natively).
+        if batch_format == "pandas":
+            return BlockAccessor(block).to_pandas()
+        block = BlockAccessor(block).to_batch()
     if batch_format == "numpy":
         if list(block) == [ITEM_COL]:
             return block[ITEM_COL]

@@ -75,27 +75,24 @@ def range_tensor_tasks(n: int, shape, parallelism: int) -> List[ReadTask]:
 # -- file formats -----------------------------------------------------------
 
 def _read_parquet_file(path: str, columns) -> Block:
+    # Table blocks stay Arrow end-to-end (zero-copy slice/concat/write);
+    # rows materialize only at UDF / iteration boundaries
+    # (reference: _internal/arrow_block.py).
     import pyarrow.parquet as pq
 
-    table = pq.read_table(path, columns=columns)
-    return {c: table[c].to_numpy(zero_copy_only=False)
-            for c in table.column_names}
+    return pq.read_table(path, columns=columns)
 
 
 def _read_csv_file(path: str) -> Block:
     import pyarrow.csv as pcsv
 
-    table = pcsv.read_csv(path)
-    return {c: table[c].to_numpy(zero_copy_only=False)
-            for c in table.column_names}
+    return pcsv.read_csv(path)
 
 
 def _read_json_file(path: str) -> Block:
     import pyarrow.json as pjson
 
-    table = pjson.read_json(path)
-    return {c: table[c].to_numpy(zero_copy_only=False)
-            for c in table.column_names}
+    return pjson.read_json(path)
 
 
 def _read_text_file(path: str) -> Block:
@@ -169,12 +166,11 @@ def write_block(fmt: str, block: Block, path: str, index: int) -> str:
     acc = BlockAccessor(block)
     fname = os.path.join(path, f"part-{index:05d}.{fmt}")
     if fmt == "parquet":
-        import pyarrow as pa
         import pyarrow.parquet as pq
 
-        table = pa.table({k: list(v) if v.ndim > 1 else v
-                          for k, v in block.items()})
-        pq.write_table(table, fname)
+        from ray_tpu.data.arrow_block import block_to_arrow
+
+        pq.write_table(block_to_arrow(block), fname)
     elif fmt == "csv":
         acc.to_pandas().to_csv(fname, index=False)
     elif fmt == "json":

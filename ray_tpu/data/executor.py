@@ -108,6 +108,98 @@ def _shuffle_reduce(seed, *parts):
     return out, BlockAccessor(out).metadata()
 
 
+def _push_shuffle_map(block: Block, reducers, shuffle_id: str,
+                      map_idx: int, n_out: int, seed):
+    """Push-shuffle map: partition the block and push each fragment
+    directly to the reducer actor owning its partition (reference:
+    _internal/planner/exchange/push_based_shuffle_task_scheduler.py —
+    fragments flow to mergers while other maps still run, instead of
+    parking n_in x n_out objects for a later pull phase). Each reducer
+    owns n_out/len(reducers) partitions, so the actor count tracks the
+    cluster size rather than the output block count."""
+    acc = BlockAccessor(block)
+    n = acc.num_rows()
+    rng = np.random.default_rng(seed)
+    assign = rng.integers(0, n_out, size=n)
+    acks = []
+    for j in range(n_out):
+        part = acc.take_indices(np.nonzero(assign == j)[0])
+        acks.append(reducers[j % len(reducers)].accept.remote(
+            shuffle_id, map_idx, j, part))
+    # Delivery barrier: the map only reports done once every reducer has
+    # its fragments, so finish() can never race a straggler fragment.
+    ray_tpu.get(acks, timeout=600)
+    return n
+
+
+class _ShuffleReducer:
+    """Accumulates pushed fragments for the partitions it owns; emits
+    one shuffled output block per partition. Fragments are namespaced by
+    shuffle id so one cached reducer pool serves any number of
+    (possibly concurrent) shuffles."""
+
+    def __init__(self):
+        self.parts: dict = {}  # (shuffle_id, partition) -> fragments
+
+    def ping(self) -> bool:
+        return True
+
+    def accept(self, shuffle_id: str, map_key, j: int,
+               part: Block) -> int:
+        """Idempotent per (shuffle, map, partition): a map task retried
+        after its worker died re-pushes fragments that may already have
+        landed; duplicates must not inflate the shuffle output."""
+        seen = self.parts.setdefault((shuffle_id, "seen"), set())
+        if (map_key, j) in seen:
+            return 0
+        seen.add((map_key, j))
+        frags = self.parts.setdefault((shuffle_id, j), [])
+        frags.append(part)
+        # Incremental merge keeps buffers at O(rows), not O(fragments).
+        if len(frags) >= 16:
+            self.parts[(shuffle_id, j)] = [concat_blocks(frags)]
+        return len(frags)
+
+    def finish(self, shuffle_id: str, j: int, seed):
+        out = concat_blocks(self.parts.pop((shuffle_id, j), []))
+        self.parts.pop((shuffle_id, "seen"), None)
+        acc = BlockAccessor(out)
+        rng = np.random.default_rng(seed)
+        out = acc.take_indices(rng.permutation(acc.num_rows()))
+        return out, BlockAccessor(out).metadata()
+
+
+# Session-cached reducer pool: reducer actors are reusable across
+# shuffles (fragments are shuffle-id-namespaced), so only the first
+# push shuffle pays actor startup (the reference similarly reuses its
+# merge workers across rounds within a shuffle).
+_reducer_pool: List[Any] = []
+
+
+def _get_reducer_pool(n: int) -> List[Any]:
+    global _reducer_pool
+    alive = []
+    for r in _reducer_pool:
+        try:
+            if ray_tpu.get(r.ping.remote(), timeout=5):
+                alive.append(r)
+        except Exception:
+            pass
+    _reducer_pool = alive
+    reducer_cls = ray_tpu.remote(_ShuffleReducer)
+    created = []
+    while len(_reducer_pool) + len(created) < n:
+        created.append(reducer_cls.options(num_cpus=0.01).remote())
+    if created:
+        # Barrier: reducers MUST be alive before any map is submitted.
+        # Maps hold a full CPU while blocking on accept() delivery; if
+        # the reducer creations queue behind them, nothing can ever
+        # place the actors and the shuffle deadlocks.
+        ray_tpu.get([r.ping.remote() for r in created], timeout=300)
+        _reducer_pool.extend(created)
+    return _reducer_pool[:n]
+
+
 def _sort_sample(block: Block, n: int, key):
     return BlockAccessor(block).sample(n, key)
 
@@ -130,6 +222,8 @@ def _truncate(block: Block, n: int):
 
 
 def _zip_blocks(left: Block, right: Block):
+    left = BlockAccessor(left).to_batch()
+    right = BlockAccessor(right).to_batch()
     out = dict(left)
     for k, v in right.items():
         name = k
@@ -324,6 +418,11 @@ class StreamingExecutor:
         n_out = num_out or n_in
         if n_in == 0:
             return iter([])
+        import os
+
+        strategy = os.environ.get("RAY_TPU_SHUFFLE_STRATEGY", "auto")
+        if strategy == "push" or (strategy == "auto" and n_in >= 8):
+            return self._push_shuffle(stage, bundles, n_out)
         map_fn = ray_tpu.remote(_shuffle_map).options(num_returns=n_out)
         reduce_fn = ray_tpu.remote(_shuffle_reduce).options(num_returns=2)
         parts: List[List[Any]] = []
@@ -339,6 +438,39 @@ class StreamingExecutor:
                     seed, *[parts[i][j] for i in range(n_in)]))
 
         return self._windowed(submits())
+
+    def _push_shuffle(self, stage: RandomShuffle, bundles: List[Bundle],
+                      n_out: int) -> Iterator[Bundle]:
+        """Push-based shuffle: map fragments stream to reducer actors as
+        each map finishes (no pull phase, no n_in x n_out parked
+        objects). Scales where the pull shuffle's object count
+        explodes."""
+        import uuid
+
+        try:
+            cpus = int(ray_tpu.cluster_resources().get("CPU", 4))
+        except Exception:
+            cpus = 4
+        n_reducers = max(1, min(n_out, cpus))
+        reducers = _get_reducer_pool(n_reducers)
+        shuffle_id = uuid.uuid4().hex[:12]
+        map_fn = ray_tpu.remote(_push_shuffle_map)
+        acks = []
+        for i, (ref, _) in enumerate(bundles):
+            seed = None if stage.seed is None else stage.seed + i
+            acks.append(map_fn.remote(ref, reducers, shuffle_id,
+                                      i, n_out, seed))
+        ray_tpu.get(acks, timeout=1200)  # all fragments delivered
+
+        def submits():
+            for j in range(n_out):
+                seed = (None if stage.seed is None
+                        else stage.seed * 7919 + j)
+                yield tuple(
+                    reducers[j % n_reducers].finish
+                    .options(num_returns=2).remote(shuffle_id, j, seed))
+
+        yield from self._windowed(submits())
 
     def _sort(self, stage: Sort, bundles: List[Bundle]) -> Iterator[Bundle]:
         if not bundles:

@@ -80,10 +80,11 @@ class MapTransform:
         if self.kind == "batches":
             if self.batch_size is None or acc.num_rows() <= self.batch_size:
                 return block_from_batch(
-                    self.fn(block, *self.fn_args, **self.fn_kwargs))
+                    self.fn(acc.to_batch(), *self.fn_args, **self.fn_kwargs))
             outs = []
             for start in range(0, acc.num_rows(), self.batch_size):
-                piece = acc.slice(start, start + self.batch_size)
+                piece = BlockAccessor(
+                    acc.slice(start, start + self.batch_size)).to_batch()
                 outs.append(block_from_batch(
                     self.fn(piece, *self.fn_args, **self.fn_kwargs)))
             from ray_tpu.data.block import concat_blocks
@@ -96,8 +97,7 @@ class MapTransform:
         if self.kind == "filter":
             rows = [r for r in acc.iter_rows()
                     if self.fn(r, *self.fn_args, **self.fn_kwargs)]
-            return block_from_rows(rows) if rows else {
-                k: v[:0] for k, v in block.items()}
+            return block_from_rows(rows) if rows else acc.slice(0, 0)
         if self.kind == "flat_map":
             out: List[Any] = []
             for r in acc.iter_rows():

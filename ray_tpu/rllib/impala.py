@@ -26,7 +26,7 @@ def impala_loss(fwd, batch, *, gamma: float = 0.99,
     """V-trace actor-critic loss. Batch keeps [T, B] structure (the
     recurrence needs time ordering)."""
     T, B = batch["actions"].shape
-    obs = batch["obs"].reshape(T * B, -1)
+    obs = batch["obs"].reshape((T * B,) + batch["obs"].shape[2:])
     out = fwd(obs)
     logits = out["logits"].reshape(T, B, -1)
     values = out["vf"].reshape(T, B)

@@ -106,7 +106,10 @@ class PPO(Algorithm):
                 gamma=cfg.gamma, lam=cfg.lam)
             T, B = batch["actions"].shape
             steps += T * B
-            flat["obs"].append(batch["obs"].reshape(T * B, -1))
+            # Flatten time x batch only; feature dims (flat vectors OR
+            # image HxWxC for conv encoders) pass through unchanged.
+            flat["obs"].append(
+                batch["obs"].reshape((T * B,) + batch["obs"].shape[2:]))
             flat["actions"].append(batch["actions"].reshape(-1))
             flat["logp"].append(batch["logp"].reshape(-1))
             flat["advantages"].append(np.asarray(adv).reshape(-1))

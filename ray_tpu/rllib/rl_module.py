@@ -38,6 +38,32 @@ class ActorCriticMLP(nn.Module):
         return {"logits": logits, "vf": jnp.squeeze(vf, -1)}
 
 
+class ActorCriticConv(nn.Module):
+    """Conv encoder for pixel observations (reference: rllib's CNN
+    catalog encoders). Strided 3x3 convs feed shared dense heads; uint8
+    inputs are normalized in-graph so rollouts ship raw bytes."""
+
+    num_actions: int
+    channels: Sequence[int] = (16, 32)
+    hidden: int = 128
+
+    @nn.compact
+    def __call__(self, obs):
+        x = obs.astype(jnp.float32) / 255.0
+        lead = x.shape[:-3]  # accept [..., H, W, C]
+        x = x.reshape((-1,) + x.shape[-3:])
+        for ch in self.channels:
+            x = nn.relu(nn.Conv(ch, (3, 3), strides=(2, 2))(x))
+        x = x.reshape((x.shape[0], -1))
+        x = nn.tanh(nn.Dense(self.hidden)(x))
+        logits = nn.Dense(self.num_actions)(x)
+        vf = nn.Dense(1)(x)
+        return {
+            "logits": logits.reshape(lead + (self.num_actions,)),
+            "vf": jnp.squeeze(vf, -1).reshape(lead),
+        }
+
+
 @dataclasses.dataclass
 class RLModuleSpec:
     """Reference: SingleAgentRLModuleSpec."""
@@ -48,7 +74,12 @@ class RLModuleSpec:
     module_class: Optional[type] = None
 
     def build(self) -> "RLModule":
-        cls = self.module_class or ActorCriticMLP
+        if self.module_class is not None:
+            cls = self.module_class
+        elif len(self.observation_space.shape) == 3:
+            cls = ActorCriticConv  # pixel obs -> conv tower
+        else:
+            cls = ActorCriticMLP
         net = cls(num_actions=self.action_space.n,
                   **self.model_config)
         return RLModule(net, self.observation_space)

@@ -85,7 +85,20 @@ def run_all(init: bool = True) -> Dict[str, float]:
 
 
 def main():
-    run_all()
+    import argparse
+
+    p = argparse.ArgumentParser()
+    p.add_argument("--json", default=None,
+                   help="also write results as JSON to this path")
+    args = p.parse_args()
+    results = run_all()
+    if args.json:
+        import json
+
+        with open(args.json, "w") as f:
+            json.dump({k: round(v, 1) for k, v in results.items()}, f,
+                      indent=1)
+            f.write("\n")
 
 
 if __name__ == "__main__":

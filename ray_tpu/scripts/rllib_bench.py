@@ -1,0 +1,130 @@
+"""RLlib throughput benchmark.
+
+Measures, per BASELINE.json's "PPO >= 50k env-steps/s/chip" target:
+- raw vectorized env stepping (numpy dynamics only),
+- env-runner sampling throughput (env stepping + batched policy
+  forwards + rollout assembly),
+- PPO end-to-end env-steps/s (sampling + learner updates),
+both on state obs (CartPole-v1) and pixel obs (PixelGridWorld-v0, conv
+tower). Run: python -m ray_tpu.scripts.rllib_bench [--json PATH]
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import time
+
+import numpy as np
+
+
+def bench_env_stepping(env_name: str, num_envs: int = 256,
+                       seconds: float = 3.0) -> float:
+    from ray_tpu.rllib.env import make_vec
+
+    env = make_vec(env_name, num_envs=num_envs, seed=0)
+    env.reset()
+    n = env.action_space.n
+    rng = np.random.default_rng(0)
+    actions = rng.integers(0, n, size=(64, num_envs)).astype(np.int32)
+    env.step(actions[0])  # warm
+    steps = 0
+    start = time.perf_counter()
+    while time.perf_counter() - start < seconds:
+        for i in range(8):
+            env.step(actions[i % 64])
+        steps += 8 * num_envs
+    return steps / (time.perf_counter() - start)
+
+
+def bench_sampling(env_name: str, num_envs: int = 256,
+                   rollout: int = 64, seconds: float = 5.0) -> float:
+    from ray_tpu.rllib.env import make_vec
+    from ray_tpu.rllib.env_runner import EnvRunner
+    from ray_tpu.rllib.rl_module import RLModuleSpec
+
+    probe = make_vec(env_name, num_envs=1)
+    spec = RLModuleSpec(observation_space=probe.observation_space,
+                        action_space=probe.action_space)
+    runner = EnvRunner(env_name, num_envs=num_envs,
+                       rollout_length=rollout, module_spec=spec, seed=0)
+    runner.sample()  # compile + warm
+    steps = 0
+    start = time.perf_counter()
+    while time.perf_counter() - start < seconds:
+        batch = runner.sample()
+        steps += batch["obs"].shape[0] * batch["obs"].shape[1]
+    return steps / (time.perf_counter() - start)
+
+
+def bench_ppo(env_name: str, seconds: float = 20.0) -> float:
+    from ray_tpu.rllib import PPOConfig
+
+    config = (PPOConfig()
+              .environment(env_name)
+              .env_runners(num_env_runners=2,
+                           rollout_fragment_length=64)
+              .training(train_batch_size=16384, num_epochs=2,
+                        minibatch_size=4096))
+    config.num_envs_per_env_runner = 128
+    algo = config.build()
+    try:
+        algo.train()  # compile + warm
+        steps = 0
+        start = time.perf_counter()
+        while time.perf_counter() - start < seconds:
+            result = algo.train()
+            steps += result["num_env_steps_sampled_this_iter"]
+        return steps / (time.perf_counter() - start)
+    finally:
+        algo.stop()
+
+
+def main():
+    p = argparse.ArgumentParser()
+    p.add_argument("--json", default=None)
+    p.add_argument("--quick", action="store_true",
+                   help="shorter measurement windows")
+    p.add_argument("--platform", default=None,
+                   help="force a jax platform (e.g. cpu) — the tunneled "
+                        "axon TPU adds a WAN round-trip per forward that "
+                        "swamps throughput numbers")
+    args = p.parse_args()
+    scale = 0.3 if args.quick else 1.0
+    if args.platform:
+        import os as _os
+
+        _os.environ["JAX_PLATFORMS"] = args.platform
+        import jax
+
+        jax.config.update("jax_platforms", args.platform)
+
+    import ray_tpu
+
+    if not ray_tpu.is_initialized():
+        ray_tpu.init(num_cpus=4, num_tpus=0)
+
+    results = {}
+    results["env_steps_per_s_cartpole"] = bench_env_stepping(
+        "CartPole-v1", seconds=3 * scale)
+    results["env_steps_per_s_pixel"] = bench_env_stepping(
+        "PixelGridWorld-v0", num_envs=64, seconds=3 * scale)
+    results["sampling_steps_per_s_cartpole"] = bench_sampling(
+        "CartPole-v1", seconds=5 * scale)
+    results["sampling_steps_per_s_pixel"] = bench_sampling(
+        "PixelGridWorld-v0", num_envs=64, seconds=5 * scale)
+    results["ppo_end_to_end_steps_per_s"] = bench_ppo(
+        "CartPole-v1", seconds=20 * scale)
+    results = {k: round(v, 1) for k, v in results.items()}
+    results["target_ppo_steps_per_s"] = 50_000
+    results["vs_target"] = round(
+        results["ppo_end_to_end_steps_per_s"] / 50_000, 3)
+    print(json.dumps(results, indent=1))
+    if args.json:
+        with open(args.json, "w") as f:
+            json.dump(results, f, indent=1)
+            f.write("\n")
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,63 @@
+"""Shuffle benchmark: push-based vs pull-based random_shuffle.
+
+Reference comparison point: the push-based shuffle scheduler
+(_internal/planner/exchange/push_based_shuffle_task_scheduler.py) exists
+because the pull shuffle's n_in x n_out object fan-out stops scaling.
+Run: python -m ray_tpu.scripts.shuffle_bench [--rows N] [--blocks B]
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import time
+
+
+def run_one(strategy: str, rows: int, blocks: int) -> float:
+    from ray_tpu import data
+
+    os.environ["RAY_TPU_SHUFFLE_STRATEGY"] = strategy
+    try:
+        start = time.perf_counter()
+        ds = data.range(rows, parallelism=blocks).random_shuffle(seed=0)
+        ds.materialize() if hasattr(ds, "materialize") else list(
+            ds._execute())
+        return time.perf_counter() - start
+    finally:
+        os.environ.pop("RAY_TPU_SHUFFLE_STRATEGY", None)
+
+
+def main():
+    p = argparse.ArgumentParser()
+    p.add_argument("--rows", type=int, default=200_000)
+    p.add_argument("--blocks", type=int, default=16)
+    p.add_argument("--json", default=None)
+    args = p.parse_args()
+
+    import ray_tpu
+
+    if not ray_tpu.is_initialized():
+        ray_tpu.init(num_cpus=4, num_tpus=0)
+
+    # Warmup both paths (worker spawn + import; reducer-pool startup).
+    run_one("pull", 1000, 2)
+    run_one("push", 1000, args.blocks)
+    pull_s = run_one("pull", args.rows, args.blocks)
+    push_s = run_one("push", args.rows, args.blocks)
+    result = {
+        "rows": args.rows,
+        "blocks": args.blocks,
+        "pull_seconds": round(pull_s, 3),
+        "push_seconds": round(push_s, 3),
+        "push_speedup": round(pull_s / push_s, 3),
+    }
+    print(json.dumps(result))
+    if args.json:
+        with open(args.json, "w") as f:
+            json.dump(result, f, indent=1)
+            f.write("\n")
+
+
+if __name__ == "__main__":
+    main()

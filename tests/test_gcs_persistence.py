@@ -18,8 +18,13 @@ import pytest
 import ray_tpu
 
 
+_head_starts = [0]
+
+
 def _start_head(port, session_dir):
-    log = open(os.path.join(session_dir, "head_stdout.log"), "ab")
+    _head_starts[0] += 1
+    path = os.path.join(session_dir, f"head_stdout_{_head_starts[0]}.log")
+    log = open(path, "wb")
     proc = subprocess.Popen(
         [sys.executable, "-m", "ray_tpu.core.head_main",
          "--port", str(port), "--num-cpus", "4",
@@ -29,7 +34,6 @@ def _start_head(port, session_dir):
     )
     log.close()
     deadline = time.monotonic() + 90
-    path = os.path.join(session_dir, "head_stdout.log")
     while time.monotonic() < deadline:
         with open(path, "rb") as f:
             if b"listening" in f.read():
@@ -43,7 +47,8 @@ def _start_head(port, session_dir):
 
 
 def _dump_session(session_dir):
-    """Diagnostics on failure: head output + worker logs."""
+    """Diagnostics on failure: head output + worker logs (also copied to
+    /tmp/persist_fail_dump.txt so truncated captures keep the evidence)."""
     out = []
     for root, _, files in os.walk(session_dir):
         for name in files:
@@ -52,10 +57,16 @@ def _dump_session(session_dir):
                 try:
                     with open(p, "rb") as f:
                         out.append(f"==== {p} ====\n"
-                                   f"{f.read()[-3000:].decode(errors='replace')}")
+                                   f"{f.read()[-8000:].decode(errors='replace')}")
                 except OSError:
                     pass
-    return "\n".join(out)
+    text = "\n".join(out)
+    try:
+        with open("/tmp/persist_fail_dump.txt", "w") as f:
+            f.write(text)
+    except OSError:
+        pass
+    return text
 
 
 def _free_port():

@@ -96,17 +96,26 @@ def test_lineage_budget_eviction(ray_start_isolated):
     from ray_tpu import api
 
     cw = api._global_worker
-    cw.config.max_lineage_bytes = 4096
 
     @ray_tpu.remote(max_retries=1)
     def produce(i):
         return np.full(BIG, float(i))
 
+    # Budget sized to hold ~3 specs, measured (spec encoding size is an
+    # implementation detail that must not silently break eviction).
+    from ray_tpu.core import serialization as _ser
+
+    probe = produce.remote(0)
+    ray_tpu.get(probe, timeout=120)
+    spec_bytes = len(_ser.dumps_control(cw._lineage[probe.id][0]))
+    budget = spec_bytes * 3 + spec_bytes // 2
+    cw.config.max_lineage_bytes = budget
+
     refs = [produce.remote(i) for i in range(8)]
     for i, r in enumerate(refs):
         assert float(ray_tpu.get(r, timeout=120)[0]) == float(i)
 
-    assert cw._lineage_bytes <= 4096
+    assert cw._lineage_bytes <= budget
     # The newest object must still be recoverable...
     _delete_local_copies(refs[-1])
     assert float(ray_tpu.get(refs[-1], timeout=180)[0]) == 7.0
