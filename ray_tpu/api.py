@@ -441,6 +441,14 @@ class ActorMethod:
     def options(self, num_returns: int = 1, **_ignored) -> "ActorMethod":
         return ActorMethod(self._handle, self._method_name, num_returns)
 
+    def bind(self, *args, **kwargs):
+        """Build a lazy actor-method DAG node (reference: ray.dag
+        method.bind); compile with node.experimental_compile()."""
+        from ray_tpu.dag import ClassMethodNode
+
+        return ClassMethodNode(self._handle, self._method_name, args,
+                               kwargs)
+
     def remote(self, *args, **kwargs):
         if self._num_returns == "streaming":
             raise TypeError(

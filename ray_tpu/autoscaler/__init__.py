@@ -15,6 +15,7 @@ from ray_tpu.autoscaler.autoscaler import (
 from ray_tpu.autoscaler.providers import (
     FakeNodeProvider,
     NodeProvider,
+    GcpTpuPodSliceProvider,
     TPUPodSliceProvider,
 )
 
@@ -24,5 +25,6 @@ __all__ = [
     "NodeProvider",
     "NodeType",
     "StandardAutoscaler",
+    "GcpTpuPodSliceProvider",
     "TPUPodSliceProvider",
 ]

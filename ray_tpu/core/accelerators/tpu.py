@@ -29,14 +29,31 @@ class TPUAcceleratorManager:
 
     @staticmethod
     def detect_num_chips() -> int:
-        # Prefer the live JAX runtime.
+        # Explicit override — set by operators and propagated to child
+        # processes so only one process ever probes the hardware.
+        raw = os.environ.get("RAY_TPU_NUM_CHIPS")
+        if raw:
+            try:
+                return int(raw)
+            except ValueError:
+                pass
+        # Consult the JAX runtime only if THIS process already
+        # initialized it. A cold jax backend init grabs the TPU runtime
+        # (libtpu is single-client per chip); a control-plane process —
+        # head, node agent — cold-probing here would block startup on a
+        # chip another process holds. Compute processes that own the
+        # chip have the backend live and get the authoritative count.
         try:
-            import jax
+            import sys
 
-            devices = jax.local_devices()
-            n = sum(1 for d in devices if d.platform != "cpu")
-            if n > 0:
-                return n
+            xb = sys.modules.get("jax._src.xla_bridge")
+            if xb is not None and getattr(xb, "_backends", None):
+                import jax
+
+                n = sum(1 for d in jax.local_devices()
+                        if d.platform != "cpu")
+                if n > 0:
+                    return n
         except Exception:
             pass
         # GCE metadata env (set on TPU VMs).

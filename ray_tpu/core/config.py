@@ -39,13 +39,21 @@ class Config:
     # mirroring the reference's default_object_store_memory_proportion).
     object_store_memory: int = 0
     object_store_memory_proportion: float = 0.3
-    # Directory for shared-memory segments and spill files.
+    # Spill-file directory override (default: <session dir>/spill).
+    # Exported as RAY_TPU_OBJECT_SPILLING_DIR so workers share it.
     object_spilling_dir: str = ""
-    # Spill to disk when the shm store exceeds this fraction of capacity.
+    # Soft high-water mark: LRU eviction of unpinned copies starts at
+    # this fraction of shm capacity, keeping headroom before writers
+    # overflow to disk spill files at the hard cap.
     object_spilling_threshold: float = 0.8
     # Back large objects with the native C++ arena (cpp/tpustore);
     # falls back to the python per-segment store if the build fails.
     use_native_object_store: bool = True
+
+    # --- memory monitor (reference: memory_monitor.h:52) ---
+    # Kill a worker when host used/limit memory crosses this fraction.
+    memory_monitor_enabled: bool = True
+    memory_usage_threshold: float = 0.95
 
     # --- scheduler ---
     # Max worker leases requested in parallel per scheduling key

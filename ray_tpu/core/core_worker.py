@@ -301,6 +301,10 @@ class ActorState:
     inflight: int = 0
     death_cause: str = ""
     max_task_retries: int = 0
+    poller: Optional[asyncio.Task] = None  # reconciliation loop (see below)
+    # Same-tick submissions coalesce into one batched push frame.
+    push_buf: List["TaskSpec"] = field(default_factory=list)
+    push_flush_scheduled: bool = False
 
 
 class CoreWorker:
@@ -352,6 +356,11 @@ class CoreWorker:
         self._event_flush_scheduled = False
         # Streaming-generator tasks: task id -> ObjectRefGenerator.
         self._streams: Dict[TaskID, "ObjectRefGenerator"] = {}
+        # Pushed-but-unreplied tasks: task_id hex -> ("task", spec, lw,
+        # key, state, conn) | ("actor", spec, actor_state, conn). Results
+        # stream back as task_done notifications (h_task_done); a
+        # connection close fails exactly the entries for that conn.
+        self._outstanding_pushes: Dict[str, tuple] = {}
         # This process's node (for object-directory reports); workers get
         # it from the spawn env, the driver from the head's default node.
         node_hex = os.environ.get("RAY_TPU_NODE_ID")
@@ -392,6 +401,7 @@ class CoreWorker:
             "pubsub": self.h_pubsub,
             "stream_item": self.h_stream_item,
             "task_accepted": self.h_task_accepted,
+            "task_done": self.h_task_done,
             "ping": self.h_ping,
         }
 
@@ -484,6 +494,18 @@ class CoreWorker:
                 name=f"peer-{address[1]}",
                 timeout=self.config.rpc_connect_timeout_s,
             )
+            # Streamed task replies (task_done) need a close hook: when
+            # the peer dies, every outstanding push on it must fail NOW
+            # rather than hang awaiting a notification that won't come.
+            prev_close = conn.on_close
+
+            def on_close(c, _prev=prev_close):
+                if _prev:
+                    _prev(c)
+                self._fail_worker_conn(
+                    c, rpc.ConnectionLost(f"peer-{address[1]}"))
+
+            conn.on_close = on_close
             self._conn_cache[address] = conn
             return conn
 
@@ -545,7 +567,14 @@ class CoreWorker:
         object_id = ref.id
         obj = self.memory_store.get_if_exists(object_id)
         if obj is None:
-            if self._owns(object_id):
+            # Ownership is by ADDRESS first: a ref whose owner is another
+            # process must be fetched from it even when the task-id
+            # heuristic matches one of ours (e.g. an object ray.put() by
+            # a still-running actor task we submitted — its task id is in
+            # our pending set, but the object lives with the worker).
+            owner = ref.owner_address
+            owner_is_self = owner is None or owner.key() == self.address.key()
+            if owner_is_self and self._owns(object_id):
                 obj = await self._wait_local(object_id, timeout)
             else:
                 obj = await self._fetch_from_owner(ref, timeout)
@@ -645,9 +674,45 @@ class CoreWorker:
                               timeout: Optional[float]
                               ) -> Optional[SerializedObject]:
         entry = self._lineage.get(object_id)
-        if entry is None:
+        spec = entry[0] if entry is not None else None
+        fut = (self._recovering.get(spec.task_id)
+               if spec is not None else None)
+        if fut is None:
+            # A transient RPC blip to a live holder must not destroy
+            # intact copies: object_lost below deletes the head's copy
+            # and tells every holder to drop theirs, and the lineage
+            # resubmit re-executes even max_retries=0 tasks. Re-probe
+            # the directory and retry the pull first (reference:
+            # object_recovery_manager.cc pins existing copies before
+            # falling back to reconstruction). Bounded by the caller's
+            # timeout, and skipped once the directory reports no copies
+            # (then reconstruction is the only path).
+            deadline = (None if timeout is None
+                        else time.monotonic() + timeout)
+            # Probe FIRST: if the directory already reports zero
+            # copies, reconstruction starts with no added latency; the
+            # sleeps only buy time when copies allegedly exist.
+            for delay in (0.0, 0.3, 1.0):
+                if (deadline is not None
+                        and time.monotonic() + delay >= deadline):
+                    break
+                if delay:
+                    await asyncio.sleep(delay)
+                try:
+                    reply = await self.head.call(
+                        "locate_object", {"object_id": object_id.hex()})
+                except Exception:
+                    continue
+                if not reply.get("found") or not reply.get("locations"):
+                    break  # no copies exist anywhere: reconstruct
+                if await self._puller.pull(
+                        object_id,
+                        [tuple(a) for a in reply["locations"]]):
+                    obj = object_store.node_store_open(object_id)
+                    if obj is not None:
+                        return obj
+        if spec is None:
             return None
-        spec = entry[0]
         fut = self._recovering.get(spec.task_id)
         if fut is None:
             fut = asyncio.get_running_loop().create_future()
@@ -1002,6 +1067,14 @@ class CoreWorker:
             ObjectRef(oid, self.address, is_owned=True)
             for oid in spec.return_object_ids()
         ]
+        # Ownership starts at SUBMIT, not at result ingest: a ref
+        # serialized into another task's args while this task is still
+        # running must take the owned +1-borrow path locally. Routing it
+        # through a self-RPC lets the submitter's own ref GC race in a
+        # spurious remove_ref and free the value under the borrower
+        # (reference: reference_count.h owns from task submission).
+        for oid in spec.return_object_ids():
+            self.reference_counter.register_owned(oid, False)
         self._submit_threadsafe(spec)
         return refs
 
@@ -1032,14 +1105,20 @@ class CoreWorker:
     def _pump_scheduling_key(self, key: tuple, state: SchedulingKeyState):
         # Push queued tasks onto leased workers, keeping each worker's
         # pipeline fed up to the in-flight cap (the worker executes FIFO;
-        # queued pushes hide the RTT behind execution).
+        # queued pushes hide the RTT behind execution). A burst drains as
+        # ONE batched RPC per worker — at tiny-task rates the msgpack
+        # envelope + loop wakeups per frame are the throughput ceiling.
         cap = max(1, self.config.max_tasks_in_flight_per_worker)
         for lw in list(state.workers.values()):
-            while state.queue and lw.conn is not None and not lw.conn.closed:
-                if lw.busy >= cap:
-                    break
-                spec = state.queue.popleft()
-                self._push_task_to_worker(key, state, lw, spec)
+            if not state.queue:
+                break
+            if lw.conn is None or lw.conn.closed or lw.busy >= cap:
+                continue
+            batch: List[TaskSpec] = []
+            while state.queue and lw.busy + len(batch) < cap:
+                batch.append(state.queue.popleft())
+            if batch:
+                self._push_tasks_to_worker(key, state, lw, batch)
         # Request more leases if there is a backlog.
         limit = self.config.max_pending_lease_requests_per_scheduling_category
         backlog = len(state.queue)
@@ -1101,38 +1180,84 @@ class CoreWorker:
             if state.queue and not self._shutdown:
                 self._pump_scheduling_key(key, state)
 
-    def _push_task_to_worker(self, key: tuple, state: SchedulingKeyState,
-                             lw: LeasedWorker, spec: TaskSpec):
-        pending = self.pending_tasks.get(spec.task_id)
-        if pending is None or pending.cancelled:
+    def _push_tasks_to_worker(self, key: tuple, state: SchedulingKeyState,
+                              lw: LeasedWorker, specs: List[TaskSpec]):
+        """One batched frame out; per-task ``task_done`` notifications
+        back (h_task_done). Outstanding entries double as the failure
+        ledger: a worker-connection close fails exactly the tasks whose
+        results haven't arrived."""
+        live: List[TaskSpec] = []
+        for spec in specs:
+            pending = self.pending_tasks.get(spec.task_id)
+            if pending is None or pending.cancelled:
+                continue
+            pending.pushed_to = lw.worker_id
+            pending.accepted = False
+            live.append(spec)
+        if not live:
             return
-        pending.pushed_to = lw.worker_id
-        pending.accepted = False
-        lw.busy += 1
+        lw.busy += len(live)
+        conn = lw.conn
+        for spec in live:
+            self._outstanding_pushes[spec.task_id.hex()] = (
+                "task", spec, lw, key, state, conn)
 
         async def push():
             try:
-                reply = await lw.conn.call(
-                    "push_task",
-                    {"spec": serialization.dumps_control(spec)},
+                await conn.notify(
+                    "push_tasks",
+                    {"specs": [serialization.dumps_control(s)
+                               for s in live]},
                 )
-            except (rpc.ConnectionLost, rpc.RpcError, OSError) as e:
-                if state.workers.get(lw.worker_id) is lw:
-                    state.workers.pop(lw.worker_id, None)
-                    # Hand the lease back so the head can release its
-                    # resources even if it hasn't noticed the death yet.
-                    asyncio.ensure_future(
-                        self._return_lease_quietly(lw))
-                self._on_task_worker_failure(spec, e)
-                return
+            except Exception as e:
+                self._fail_worker_conn(conn, e)
+
+        asyncio.ensure_future(push())
+
+    def _fail_worker_conn(self, conn, error: Exception):
+        """Fail every outstanding push on a dead worker connection."""
+        dead = [hex_id for hex_id, entry in self._outstanding_pushes.items()
+                if entry[-1] is conn]
+        seen_lw = set()
+        for hex_id in dead:
+            entry = self._outstanding_pushes.pop(hex_id, None)
+            if entry is None:
+                continue
+            if entry[0] == "task":
+                _, spec, lw, key, state, _ = entry
+                lw.busy -= 1
+                if id(lw) not in seen_lw:
+                    seen_lw.add(id(lw))
+                    if state.workers.get(lw.worker_id) is lw:
+                        state.workers.pop(lw.worker_id, None)
+                        # Hand the lease back so the head can release its
+                        # resources even before it notices the death.
+                        asyncio.ensure_future(
+                            self._return_lease_quietly(lw))
+                self._on_task_worker_failure(spec, error)
+            else:
+                _, spec, astate, _ = entry
+                astate.inflight -= 1
+                self._on_actor_call_failure(astate, spec, error)
+
+    async def h_task_done(self, conn, payload):
+        entry = self._outstanding_pushes.pop(payload["task_id"], None)
+        if entry is None:
+            return  # already failed via connection close, or cancelled
+        reply = payload["reply"]
+        if entry[0] == "task":
+            _, spec, lw, key, state, _ = entry
             lw.busy -= 1
             lw.idle_since = time.monotonic()
             self._on_task_reply(spec, reply)
             self._pump_scheduling_key(key, state)
             if lw.busy == 0 and not state.queue:
-                asyncio.ensure_future(self._maybe_return_lease(key, state, lw))
-
-        asyncio.ensure_future(push())
+                asyncio.ensure_future(
+                    self._maybe_return_lease(key, state, lw))
+        else:
+            _, spec, astate, _ = entry
+            astate.inflight -= 1
+            self._on_task_reply(spec, reply)
 
     # ------------------------------------------------------------------
     # task events (reference: core_worker/task_event_buffer.h -> the
@@ -1311,11 +1436,42 @@ class CoreWorker:
                         spec.name or spec.task_id.hex()[:12])
             self._submit_on_loop(spec)
         else:
-            self._store_task_error(
-                spec, exc.WorkerCrashedError(
-                    f"worker died while running task {spec.name}: {error}"
-                )
-            )
+            # Ask the head whether this was a memory-monitor kill so the
+            # terminal error names the cause (reference: raylet attaches
+            # the OOM-killer detail to the task failure).
+            worker_hex = (pending.pushed_to.hex()
+                          if pending.pushed_to else None)
+
+            async def finalize():
+                reason = None
+                if worker_hex is not None:
+                    # The kill reason races this query: a node agent's
+                    # report_oom_kill travels to the head concurrently
+                    # with the dead worker's TCP reset reaching us.
+                    for delay in (0.0, 0.5, 1.0):
+                        if delay:
+                            await asyncio.sleep(delay)
+                        try:
+                            reply = await asyncio.wait_for(
+                                self.head.call(
+                                    "worker_death_reason",
+                                    {"worker_id": worker_hex}),
+                                timeout=5)
+                            reason = reply.get("reason")
+                        except Exception:
+                            reason = None
+                        if reason:
+                            break
+                if reason and "memory monitor" in reason:
+                    err: Exception = exc.OutOfMemoryError(
+                        f"task {spec.name} failed: {reason}")
+                else:
+                    err = exc.WorkerCrashedError(
+                        f"worker died while running task {spec.name}: "
+                        f"{error}" + (f" ({reason})" if reason else ""))
+                self._store_task_error(spec, err)
+
+            asyncio.ensure_future(finalize())
 
     def _store_task_error(self, spec: TaskSpec, error: Exception):
         self.pending_tasks.pop(spec.task_id, None)
@@ -1451,10 +1607,35 @@ class CoreWorker:
         except Exception as e:
             logger.warning("connect to actor %s failed: %s",
                            state.actor_id.hex()[:12], e)
+            self._ensure_actor_poller(state)  # re-drive via reconciliation
             return
         while state.queue and state.state == "ALIVE":
             spec = state.queue.popleft()
             self._push_actor_task(state, spec)
+
+    def _ensure_actor_poller(self, state: ActorState):
+        """Reconcile queued calls against the head's actor table. Pubsub
+        delivery can race the subscription (e.g. a driver reconnecting
+        after a head restart subscribes while the recreated actor flips
+        to ALIVE), so parked tasks must never depend on catching the
+        state event — poll until the queue drains or the actor dies
+        (reference: core_worker's actor_task_submitter resubscribing via
+        GetActorInfo on reconnect)."""
+        if state.poller is not None and not state.poller.done():
+            return
+
+        async def poll():
+            while (state.queue and state.state != "DEAD"
+                   and not self._shutdown):
+                await asyncio.sleep(0.5)
+                if not state.queue:
+                    return
+                try:
+                    await self._refresh_actor_info(state.actor_id)
+                except Exception:
+                    pass  # head briefly unreachable; keep polling
+
+        state.poller = asyncio.ensure_future(poll())
 
     def _fail_actor_queue(self, state: ActorState):
         while state.queue:
@@ -1496,6 +1677,9 @@ class CoreWorker:
             ObjectRef(oid, self.address, is_owned=True)
             for oid in spec.return_object_ids()
         ]
+        # Owned from submit — see submit_task for why.
+        for oid in spec.return_object_ids():
+            self.reference_counter.register_owned(oid, False)
 
         def go():
             spec.seqno = state.seqno
@@ -1509,6 +1693,7 @@ class CoreWorker:
                 )
             else:
                 state.queue.append(spec)
+                self._ensure_actor_poller(state)
 
         self.loop.call_soon_threadsafe(go)
         return refs
@@ -1523,19 +1708,40 @@ class CoreWorker:
             self._on_actor_state(reply)
 
     def _push_actor_task(self, state: ActorState, spec: TaskSpec):
-        state.inflight += 1
+        """Buffer the call; all calls submitted in the same loop tick go
+        out as ONE batched frame (the worker executes them FIFO — per-
+        actor ordering rides the buffer order, which follows seqno)."""
+        state.push_buf.append(spec)
+        if state.push_flush_scheduled:
+            return
+        state.push_flush_scheduled = True
+        self.loop.call_soon(self._flush_actor_pushes, state)
+
+    def _flush_actor_pushes(self, state: ActorState):
+        state.push_flush_scheduled = False
+        specs, state.push_buf = state.push_buf, []
+        if not specs:
+            return
+        if state.conn is None or state.conn.closed:
+            for spec in specs:
+                self._on_actor_call_failure(
+                    state, spec, rpc.ConnectionLost("actor connection"))
+            return
+        state.inflight += len(specs)
+        conn = state.conn
+        for spec in specs:
+            self._outstanding_pushes[spec.task_id.hex()] = (
+                "actor", spec, state, conn)
 
         async def push():
             try:
-                reply = await state.conn.call(
-                    "push_task", {"spec": serialization.dumps_control(spec)}
+                await conn.notify(
+                    "push_tasks",
+                    {"specs": [serialization.dumps_control(s)
+                               for s in specs]},
                 )
-            except (rpc.ConnectionLost, rpc.RpcError, OSError) as e:
-                state.inflight -= 1
-                self._on_actor_call_failure(state, spec, e)
-                return
-            state.inflight -= 1
-            self._on_task_reply(spec, reply)
+            except Exception as e:
+                self._fail_worker_conn(conn, e)
 
         asyncio.ensure_future(push())
 
@@ -1547,10 +1753,12 @@ class CoreWorker:
         if state.max_task_retries != 0 and pending.retries_left != 0:
             pending.retries_left -= 1
             state.queue.append(spec)  # retried when actor is ALIVE again
+            self._ensure_actor_poller(state)
             return
         # If the actor may restart, park the call; otherwise fail it.
         if state.state in ("RESTARTING", "PENDING"):
             state.queue.append(spec)
+            self._ensure_actor_poller(state)
         else:
             self._store_task_error(
                 spec,

@@ -91,6 +91,10 @@ class HeadService:
         self._shutdown = False
         # Actors restored from storage, recreated once a node joins.
         self._recreate_on_node_join: List[ActorID] = []
+        # Memory watchdog (reference: memory_monitor.h) + kill reasons
+        # (worker_id hex -> human-readable cause, served to owners).
+        self._mem_monitor = None
+        self._death_reasons: Dict[str, str] = {}
 
     # ------------------------------------------------------------------
     # lifecycle
@@ -197,9 +201,25 @@ class HeadService:
             for b in info.bundles:
                 b.node_id = None
             self.placement_groups[info.pg_id] = info
-        for _, job in self.storage.items("jobs"):
+        for key, job in self.storage.items("jobs"):
             self._job_counter = max(self._job_counter,
                                     job.get("counter", 0))
+            # Rehydrate finished-job history so list_jobs() shows jobs
+            # that ran before the restart (reference: GCS job-table
+            # reload). A job live at crash time died with the head.
+            try:
+                job_id = JobID.from_hex(key)
+            except Exception:
+                continue
+            self.jobs[job_id] = {
+                # A job still RUNNING at crash time died with the head —
+                # reporting it FINISHED would label a crashed job as
+                # having completed.
+                "state": ("FINISHED" if job.get("state") == "FINISHED"
+                          else "DEAD"),
+                "start_time": job.get("start_time"),
+                "end_time": job.get("end_time"),
+            }
         if self.actors or self.placement_groups:
             logger.info(
                 "restored %d actor(s), %d placement group(s) from %s",
@@ -240,6 +260,67 @@ class HeadService:
             asyncio.ensure_future(agent.notify(
                 "kill_worker", {"worker_id": worker_id.hex()}))
 
+    def _memory_monitor(self):
+        """Lazy so tests can flip the threshold per-head via config."""
+        if self._mem_monitor is None:
+            from ray_tpu.core import memory_monitor as mm
+
+            def candidates():
+                # Actors restart for free only if restarts remain; a
+                # max_restarts=0 actor holds irreplaceable state and must
+                # be the last resort (worker_killing_policy_group_by_
+                # owner.cc ranks the same way).
+                actor_restartable = {}
+                for info in self.actors.values():
+                    if info.address is not None:
+                        actor_restartable[info.address.worker_id_hex] = \
+                            self._actor_can_restart(info)
+                out = []
+                for h in self.pool.workers.values():
+                    if h.pid <= 0 or h.state in ("DEAD", "STARTING"):
+                        continue  # agent-managed or not yet running work
+                    hexid = h.worker_id.hex()
+                    if h.state == "ACTOR":
+                        retriable = actor_restartable.get(hexid, False)
+                    elif h.state == "LEASED":
+                        retriable = h.task_retriable
+                    else:
+                        retriable = True  # idle
+                    out.append(mm.VictimCandidate(
+                        worker_id_hex=hexid, pid=h.pid,
+                        retriable=retriable,
+                        is_actor=h.state == "ACTOR",
+                        started_at=h.task_started_at or h.started_at,
+                    ))
+                return out
+
+            def kill(victim, reason):
+                worker_id = WorkerID.from_hex(victim.worker_id_hex)
+                self.record_death_reason(victim.worker_id_hex, reason)
+                handle = self.pool.workers.get(worker_id)
+                self.pool.kill(worker_id)
+                if handle is not None:
+                    self._on_worker_dead(handle)
+
+            self._mem_monitor = mm.MemoryMonitor(
+                threshold=self.config.memory_usage_threshold,
+                candidates=candidates, kill=kill)
+        return self._mem_monitor
+
+    def record_death_reason(self, worker_id_hex: str, reason: str):
+        self._death_reasons[worker_id_hex] = reason
+        while len(self._death_reasons) > 256:
+            self._death_reasons.pop(next(iter(self._death_reasons)))
+
+    async def h_worker_death_reason(self, conn, payload):
+        return {"reason": self._death_reasons.get(payload["worker_id"])}
+
+    async def h_report_oom_kill(self, conn, payload):
+        """A node agent killed one of its workers under memory pressure;
+        park the reason so the owner's terminal error can name it."""
+        self.record_death_reason(payload["worker_id"], payload["reason"])
+        return {"ok": True}
+
     async def _periodic_pump(self):
         while not self._shutdown:
             try:
@@ -249,6 +330,8 @@ class HeadService:
                                    handle.worker_id.hex()[:12])
                     self._bump_spawn_backoff(handle.node_id)
                 self._pump()
+                if self.config.memory_monitor_enabled:
+                    self._memory_monitor().maybe_kill()
             except Exception:
                 logger.exception("scheduler pump failed")
             if os.environ.get("RAY_TPU_DEBUG_PUMP"):
@@ -380,6 +463,8 @@ class HeadService:
             "list_objects": self.h_list_objects,
             "list_jobs": self.h_list_jobs,
             "get_load": self.h_get_load,
+            "worker_death_reason": self.h_worker_death_reason,
+            "report_oom_kill": self.h_report_oom_kill,
             "ping": self.h_ping,
             # Serve the head-host node store for cross-node pulls.
             **object_transfer.serve_handlers(),
@@ -600,6 +685,8 @@ class HeadService:
                   flush=True)
         worker.state = "LEASED"
         worker.lease_id = lease_id
+        worker.task_retriable = lease.spec.max_retries != 0
+        worker.task_started_at = time.monotonic()
         if not lease.future.done():
             lease.future.set_result((worker, lease_id))
         else:
@@ -674,61 +761,109 @@ class HeadService:
         if name_key:
             self.named_actors[name_key] = actor_id
         self._persist_actor(info)
+        if getattr(spec, "detached", False):
+            await self._commit_barrier()  # durable before the owner's ack
         asyncio.get_running_loop().create_task(self._create_actor(actor_id))
         return {"ok": True}
 
     async def _create_actor(self, actor_id: ActorID):
-        """Lease a worker and push the creation task (reference:
-        gcs_actor_scheduler.h:111,259)."""
+        """Lease a worker and push the creation task, retrying on worker
+        failure while restarts remain (reference: gcs_actor_scheduler.h:
+        111,259 and gcs_actor_manager.cc:684 idempotent restart). The
+        retry loop lives HERE rather than in _on_actor_worker_died so a
+        worker crash mid-creation (push raises ConnectionLost) cannot be
+        lost to the _creating_actors re-entrancy guard."""
         if actor_id in self._creating_actors:
             return
         self._creating_actors.add(actor_id)
         try:
-            info = self.actors.get(actor_id)
-            if info is None or info.state == "DEAD":
-                return
-            spec = info.creation_spec
-            fut = asyncio.get_running_loop().create_future()
-            lease = PendingLease(
-                spec=spec, resources=ResourceSet(spec.resources), future=fut,
-                is_actor_creation=True,
-            )
-            self.scheduler.submit(lease)
-            self._pump()
-            try:
-                worker, lease_id = await fut
-            except ValueError as e:
-                self._mark_actor_dead(actor_id, f"unschedulable: {e}")
-                return
-            worker.state = "ACTOR"
-            from ray_tpu.core.task_spec import Address
-
-            info.address = Address(
-                host=worker.address[0], port=worker.address[1],
-                worker_id_hex=worker.worker_id.hex(),
-            )
-            info.node_id = worker.node_id
-            try:
-                result = await worker.connection.call(
-                    "create_actor",
-                    {"spec": serialization.dumps_control(spec)},
-                    timeout=None,
-                )
-            except Exception as e:
-                self._mark_actor_dead(actor_id, f"creation push failed: {e}")
-                return
-            if not result.get("ok"):
-                # Creation raised in __init__ — actor is dead; the error
-                # object was already delivered to the owner.
-                self._mark_actor_dead(actor_id,
-                                      result.get("error", "creation failed"))
-                return
-            if info.state != "DEAD":
-                info.state = "ALIVE"
-                self._persist_actor(info)
-                self._publish_actor(info)
+            while True:
+                outcome = await self._create_actor_attempt(actor_id)
+                if outcome != "retry":
+                    return
+                await asyncio.sleep(0.5)
         finally:
             self._creating_actors.discard(actor_id)
+
+    async def _create_actor_attempt(self, actor_id: ActorID) -> str:
+        info = self.actors.get(actor_id)
+        if info is None or info.state == "DEAD":
+            return "done"
+        spec = info.creation_spec
+        fut = asyncio.get_running_loop().create_future()
+        lease = PendingLease(
+            spec=spec, resources=ResourceSet(spec.resources), future=fut,
+            is_actor_creation=True,
+        )
+        self.scheduler.submit(lease)
+        self._pump()
+        try:
+            worker, lease_id = await fut
+        except ValueError as e:
+            self._mark_actor_dead(actor_id, f"unschedulable: {e}")
+            return "done"
+        if info.state == "DEAD":  # killed while the lease was pending
+            self.scheduler.release_lease(lease_id)
+            self.pool.push_idle(worker)
+            return "done"
+        worker.state = "ACTOR"
+        from ray_tpu.core.task_spec import Address
+
+        info.address = Address(
+            host=worker.address[0], port=worker.address[1],
+            worker_id_hex=worker.worker_id.hex(),
+        )
+        info.node_id = worker.node_id
+        try:
+            result = await worker.connection.call(
+                "create_actor",
+                {"spec": serialization.dumps_control(spec)},
+                timeout=None,
+            )
+        except Exception as e:
+            # The worker died under the creation push (startup crash, OOM,
+            # node loss). That is a restartable fault, not a user error.
+            if info.state == "DEAD":
+                # _on_actor_worker_died already spent the last restart
+                # credit and resolved the actor.
+                return "done"
+            if info.address is None and info.state == "RESTARTING":
+                # _on_actor_worker_died beat us to this fault (it clears
+                # the address): the restart credit is already charged —
+                # charging again here would burn two credits per fault.
+                logger.warning(
+                    "actor %s creation push failed (%s); retrying "
+                    "(restart %d)", actor_id.hex()[:12], e,
+                    info.num_restarts)
+                return "retry"
+            if self._actor_can_restart(info):
+                info.num_restarts += 1
+                info.state = "RESTARTING"
+                info.address = None
+                self._publish_actor(info)
+                logger.warning(
+                    "actor %s creation push failed (%s); retrying "
+                    "(restart %d)", actor_id.hex()[:12], e,
+                    info.num_restarts)
+                return "retry"
+            self._mark_actor_dead(actor_id, f"creation push failed: {e}")
+            return "done"
+        if not result.get("ok"):
+            # Creation raised in __init__ — actor is dead; the error
+            # object was already delivered to the owner.
+            self._mark_actor_dead(actor_id,
+                                  result.get("error", "creation failed"))
+            return "done"
+        if info.state != "DEAD":
+            info.state = "ALIVE"
+            self._persist_actor(info)
+            self._publish_actor(info)
+        return "done"
+
+    @staticmethod
+    def _actor_can_restart(info: ActorInfo) -> bool:
+        return (info.max_restarts == -1
+                or info.num_restarts < info.max_restarts)
 
     def _on_actor_worker_died(self, actor_id: ActorID, info: ActorInfo):
         if info.num_restarts < info.max_restarts or info.max_restarts == -1:
@@ -852,6 +987,16 @@ class HeadService:
     # KV
     # ------------------------------------------------------------------
 
+    async def _commit_barrier(self):
+        """Block the reply (not the loop) until every enqueued storage
+        mutation is committed. Durable writes must be durable before the
+        client sees the ack (reference: GCS acks after the redis write) —
+        otherwise kv_put → head SIGKILL loses an acknowledged write."""
+        if self.storage is None:
+            return
+        await asyncio.get_running_loop().run_in_executor(
+            None, self.storage.flush)
+
     async def h_kv_put(self, conn, payload):
         ns = self.kv.setdefault(payload.get("ns", ""), {})
         key = payload["key"]
@@ -859,6 +1004,7 @@ class HeadService:
             return {"added": False}
         ns[key] = payload["value"]
         self._persist_kv(payload.get("ns", ""), key, payload["value"])
+        await self._commit_barrier()
         return {"added": True}
 
     async def h_kv_get(self, conn, payload):
@@ -871,6 +1017,7 @@ class HeadService:
         if existed:
             self._persist_kv(payload.get("ns", ""), payload["key"], None,
                              deleted=True)
+            await self._commit_barrier()
         return {"deleted": existed}
 
     async def h_kv_exists(self, conn, payload):
@@ -1020,6 +1167,7 @@ class HeadService:
                     fut.set_result(True)
         # else: stays PENDING; _retry_pending_pgs retries on every pump.
         self._persist_pg(info)
+        await self._commit_barrier()
         return {"pg_id": pg_id.hex(), "state": info.state}
 
     def _retry_pending_pgs(self):

@@ -73,6 +73,9 @@ class GcsStorage:
         while True:
             op = self._queue.get()
             if op is None:
+                # Balance the join() accounting or a later flush() blocks
+                # forever on the never-finished sentinel.
+                self._queue.task_done()
                 return
             kind, table, key, blob = op
             try:

@@ -1,0 +1,173 @@
+"""Node memory watchdog + worker-killing policy.
+
+Reference: src/ray/common/memory_monitor.h:52 (cgroup/system usage
+polling with a usage-fraction threshold) and the raylet's killing
+policies (src/ray/raylet/worker_killing_policy_retriable_fifo.cc — kill
+retriable work first, newest first, so long-running progress and
+non-retriable work survive; worker_killing_policy_group_by_owner.cc).
+
+A monitor runs on every host that spawns workers (the head and each
+node agent). When used/limit crosses the threshold it kills ONE victim
+worker per poll — retriable tasks before non-retriable, tasks before
+actors, newest-started first within a class — records the reason, and
+lets the runtime's normal worker-death cascade retry the task on
+another worker. The owner's terminal error names the OOM kill instead
+of a bare "worker died".
+"""
+
+from __future__ import annotations
+
+import logging
+import os
+import time
+from dataclasses import dataclass
+from typing import Callable, List, Optional, Tuple
+
+logger = logging.getLogger(__name__)
+
+_CGROUP_V2_USED = "/sys/fs/cgroup/memory.current"
+_CGROUP_V2_LIMIT = "/sys/fs/cgroup/memory.max"
+_CGROUP_V1_USED = "/sys/fs/cgroup/memory/memory.usage_in_bytes"
+_CGROUP_V1_LIMIT = "/sys/fs/cgroup/memory/memory.limit_in_bytes"
+# cgroup v1 reports "no limit" as a huge page-rounded sentinel.
+_NO_LIMIT = 1 << 60
+
+
+def _read_int(path: str) -> Optional[int]:
+    try:
+        with open(path) as f:
+            raw = f.read().strip()
+    except OSError:
+        return None
+    if raw == "max":
+        return None
+    try:
+        return int(raw)
+    except ValueError:
+        return None
+
+
+def node_memory() -> Tuple[int, int]:
+    """(used_bytes, limit_bytes) for this host — cgroup limit when the
+    container has one, /proc/meminfo otherwise. The test override
+    RAY_TPU_MEMORY_LIMIT_BYTES narrows the limit so chaos tests can
+    trigger pressure without exhausting the machine."""
+    override = os.environ.get("RAY_TPU_MEMORY_LIMIT_BYTES")
+    used = _read_int(_CGROUP_V2_USED)
+    if used is None:
+        used = _read_int(_CGROUP_V1_USED)
+    limit = _read_int(_CGROUP_V2_LIMIT)
+    if limit is None:
+        limit = _read_int(_CGROUP_V1_LIMIT)
+    if limit is not None and limit >= _NO_LIMIT:
+        limit = None
+    if used is None or limit is None:
+        total = avail = None
+        try:
+            with open("/proc/meminfo") as f:
+                for line in f:
+                    if line.startswith("MemTotal:"):
+                        total = int(line.split()[1]) * 1024
+                    elif line.startswith("MemAvailable:"):
+                        avail = int(line.split()[1]) * 1024
+        except OSError:
+            pass
+        if total is None:
+            return 0, 1
+        if limit is None:
+            limit = total
+        if used is None:
+            used = total - (avail or 0)
+    if override:
+        try:
+            limit = int(override)
+        except ValueError:
+            pass
+    return used, limit
+
+
+def process_rss(pid: int) -> int:
+    """Resident set size of one process (bytes); 0 if gone."""
+    try:
+        with open(f"/proc/{pid}/statm") as f:
+            fields = f.read().split()
+        return int(fields[1]) * os.sysconf("SC_PAGE_SIZE")
+    except (OSError, IndexError, ValueError):
+        return 0
+
+
+@dataclass
+class VictimCandidate:
+    worker_id_hex: str
+    pid: int
+    retriable: bool       # current task has retries left (or is idle)
+    is_actor: bool
+    started_at: float     # when the current task/lease began
+
+
+def pick_victim(cands: List[VictimCandidate]) -> Optional[VictimCandidate]:
+    """Retriable tasks first, then non-retriable tasks, then actors;
+    newest-started first within each class (the newest task has the
+    least sunk progress — reference: retriable_fifo kills the least-
+    recently-submitted retriable task; we invert to newest because a
+    single-queue FIFO kill repeatedly starves the oldest task on a
+    loaded node)."""
+    cands = [c for c in cands if c.pid > 0]
+    if not cands:
+        return None
+
+    def key(c: VictimCandidate):
+        return (
+            0 if (c.retriable and not c.is_actor) else
+            1 if not c.is_actor else
+            2 if c.retriable else 3,
+            # Within a class, the process actually holding the memory
+            # goes first — killing an idle bystander frees nothing and
+            # the monitor would cycle through the pool.
+            -process_rss(c.pid),
+            -c.started_at,
+        )
+
+    return sorted(cands, key=key)[0]
+
+
+class MemoryMonitor:
+    """Poll loop body. The host embeds ``maybe_kill`` into its own
+    event loop (asyncio task on the head, thread on the node agent)."""
+
+    def __init__(self, threshold: float,
+                 candidates: Callable[[], List[VictimCandidate]],
+                 kill: Callable[[VictimCandidate, str], None],
+                 min_kill_interval_s: float = 1.0):
+        self.threshold = threshold
+        self.candidates = candidates
+        self.kill = kill
+        self.min_kill_interval_s = min_kill_interval_s
+        self._last_kill = 0.0
+
+    def maybe_kill(self) -> Optional[str]:
+        """One poll: returns the killed worker id hex, or None."""
+        used, limit = node_memory()
+        if limit <= 0 or used / limit < self.threshold:
+            return None
+        now = time.monotonic()
+        if now - self._last_kill < self.min_kill_interval_s:
+            return None  # give the previous kill time to free memory
+        victim = pick_victim(self.candidates())
+        if victim is None:
+            logger.warning(
+                "memory pressure (%.0f%% of %d bytes) but no killable "
+                "worker", 100 * used / limit, limit)
+            return None
+        self._last_kill = now
+        reason = (
+            f"worker killed by the memory monitor: node memory usage "
+            f"{used / (1 << 20):.0f} MiB exceeded "
+            f"{100 * self.threshold:.0f}% of {limit / (1 << 20):.0f} MiB "
+            f"(rss {process_rss(victim.pid) / (1 << 20):.0f} MiB). "
+            f"Task was {'retriable' if victim.retriable else 'NOT retriable'}."
+        )
+        logger.warning("OOM kill: worker %s pid %d — %s",
+                       victim.worker_id_hex[:12], victim.pid, reason)
+        self.kill(victim, reason)
+        return victim.worker_id_hex

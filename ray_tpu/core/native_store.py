@@ -192,6 +192,34 @@ class NativeArena:
             raise RuntimeError(f"ts_seal failed: {rc}")
         return True
 
+    def create_reserve(self, key20: bytes, nbytes: int):
+        """Two-phase write: allocate a slot and return (idx, view) for
+        the caller to fill in place (saves the intermediate packed-bytes
+        copy of create_and_seal). Returns None if the key exists."""
+        off = ctypes.c_uint64()
+        idx = self._lib.ts_alloc(self._h, key20, nbytes, ctypes.byref(off))
+        if idx == TS_EEXIST:
+            return None
+        if idx == TS_EFULL:
+            from ray_tpu.exceptions import ObjectStoreFullError
+
+            raise ObjectStoreFullError(
+                f"object of {nbytes} bytes does not fit in arena "
+                f"({self.used_bytes()}/{self.capacity()} used)")
+        if idx < 0:
+            raise RuntimeError(f"ts_alloc failed: {idx}")
+        return idx, self._view(off.value, nbytes)
+
+    def seal_reserved(self, idx: int, key20: bytes,
+                      pin_primary: bool = True) -> bool:
+        rc = self._lib.ts_seal_idx(self._h, idx, key20,
+                                   1 if pin_primary else 0)
+        if rc == TS_ESTATE:
+            return False
+        if rc != TS_OK:
+            raise RuntimeError(f"ts_seal failed: {rc}")
+        return True
+
     def _unpin_view(self, idx: int):
         # weakref.finalize callback: last view over this lookup died.
         with self._detach_lock:

@@ -66,6 +66,17 @@ class HeadNode:
         # Driver-side spill path must match workers' (they inherit it
         # through the spawn env).
         os.environ["RAY_TPU_SESSION_DIR"] = self.session_dir
+        if not resources.get("TPU"):
+            # No chips on this node: keep accelerator site hooks (e.g. a
+            # tunneled-TPU PJRT plugin registered via sitecustomize) out
+            # of worker processes — they cost milliseconds per wakeup in
+            # processes that never touch a chip (see scheduler.spawn).
+            os.environ.setdefault("RAY_TPU_WORKER_PYTHONPATH_EXCLUDE",
+                                  "axon_site")
+        if config.object_spilling_dir:
+            # Workers inherit through the spawn env; spill_dir() reads it.
+            os.environ["RAY_TPU_OBJECT_SPILLING_DIR"] = \
+                config.object_spilling_dir
         capacity = config.object_store_memory or default_capacity(
             config.object_store_memory_proportion
         )
@@ -84,7 +95,9 @@ class HeadNode:
                 native_store.set_attached_arena(self.arena)
                 self.shm_store = NativeShmStore(self.arena)
         if self.shm_store is None:
-            self.shm_store = ShmStore(capacity)
+            self.shm_store = ShmStore(
+                capacity,
+                spill_threshold=config.object_spilling_threshold)
         self.loop_thread = rpc.EventLoopThread(name="ray-tpu-head")
         storage = None
         if config.gcs_fault_tolerance:

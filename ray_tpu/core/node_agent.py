@@ -76,6 +76,11 @@ class NodeAgent:
             os.environ.pop("RAY_TPU_ARENA", None)
         # Workers must spill to this host's disk, not the head's path.
         os.environ["RAY_TPU_SESSION_DIR"] = self.session_dir
+        if not resources.get("TPU"):
+            # Same policy as the head node (core/node.py): chip-less
+            # workers don't load accelerator site hooks.
+            os.environ.setdefault("RAY_TPU_WORKER_PYTHONPATH_EXCLUDE",
+                                  "axon_site")
 
     # ---- rpc handlers ----
 
@@ -111,8 +116,12 @@ class NodeAgent:
 
         pkg_root = os.path.dirname(os.path.dirname(ray_tpu.__file__))
         existing = env.get("PYTHONPATH", "")
-        env["PYTHONPATH"] = (
-            pkg_root + (os.pathsep + existing if existing else ""))
+        parts = [pkg_root] + (existing.split(os.pathsep) if existing
+                              else [])
+        from ray_tpu.core.scheduler import filter_worker_pythonpath
+
+        env["PYTHONPATH"] = os.pathsep.join(
+            filter_worker_pythonpath(parts))
         log_path = os.path.join(self.session_dir, "logs",
                                 f"worker-{worker_id[:12]}.log")
         os.makedirs(os.path.dirname(log_path), exist_ok=True)
@@ -175,6 +184,25 @@ class NodeAgent:
         asyncio.get_running_loop().create_task(self._reap_loop())
 
     async def _reap_loop(self):
+        from ray_tpu.core import memory_monitor as mm
+
+        config = get_config()
+        monitor = None
+        if config.memory_monitor_enabled:
+            monitor = mm.MemoryMonitor(
+                threshold=config.memory_usage_threshold,
+                candidates=lambda: [
+                    mm.VictimCandidate(
+                        worker_id_hex=wid, pid=proc.pid,
+                        # The agent doesn't see task specs; the head's
+                        # retry machinery decides survivability. Rank by
+                        # recency only.
+                        retriable=True, is_actor=False,
+                        started_at=0.0)
+                    for wid, proc in self._procs.items()
+                    if proc.poll() is None
+                ],
+                kill=self._oom_kill)
         while not self._exit.is_set():
             for worker_id, proc in list(self._procs.items()):
                 if proc.poll() is not None:
@@ -185,7 +213,31 @@ class NodeAgent:
                             {"worker_id": worker_id})
                     except Exception:
                         pass
+            if monitor is not None:
+                try:
+                    killed = monitor.maybe_kill()
+                except Exception:
+                    logger.exception("memory monitor poll failed")
+                    killed = None
+                if killed is not None:
+                    reason = self._last_oom_reason or "memory monitor kill"
+                    try:
+                        await self.head_conn.call("report_oom_kill", {
+                            "worker_id": killed, "reason": reason})
+                    except Exception:
+                        pass
             await asyncio.sleep(0.5)
+
+    _last_oom_reason: Optional[str] = None
+
+    def _oom_kill(self, victim, reason: str):
+        self._last_oom_reason = reason
+        proc = self._procs.pop(victim.worker_id_hex, None)
+        if proc is not None and proc.poll() is None:
+            try:
+                proc.kill()
+            except Exception:
+                pass
 
     async def run_forever(self):
         await self._exit.wait()

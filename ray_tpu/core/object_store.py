@@ -116,10 +116,10 @@ class ShmStore:
 
     HEADER_MAGIC = b"RTPU"
 
-    def __init__(self, capacity_bytes: int, spill_dir: Optional[str] = None,
-                 spill_threshold: float = 0.8):
+    def __init__(self, capacity_bytes: int, spill_threshold: float = 0.8):
         self.capacity = capacity_bytes
-        self.spill_dir = spill_dir
+        # Spill files land in the module-level spill_dir() (overridable
+        # via RAY_TPU_OBJECT_SPILLING_DIR, exported by the head node).
         self.spill_threshold = spill_threshold
         self._used = 0
         self._lock = threading.Lock()
@@ -158,6 +158,32 @@ class ShmStore:
         return b"".join(parts)
 
     @staticmethod
+    def pack_into(obj: SerializedObject, out) -> int:
+        """Write the flat layout of ``pack`` directly into a writable
+        buffer of at least ``packed_size`` bytes (an arena slot or shm
+        segment) — one memcpy per payload buffer instead of join-then-
+        copy. Returns the packed length."""
+        header = {
+            "metadata": obj.metadata,
+            "inband_len": len(obj.inband),
+            "buffer_lens": [memoryview(b).nbytes for b in obj.buffers],
+        }
+        hbytes = msgpack.packb(header)
+        out = memoryview(out).cast("B")
+        out[0:4] = ShmStore.HEADER_MAGIC
+        out[4:8] = len(hbytes).to_bytes(4, "little")
+        out[8:8 + len(hbytes)] = hbytes
+        offset = _aligned(8 + len(hbytes))
+        out[offset:offset + len(obj.inband)] = obj.inband
+        offset += len(obj.inband)
+        for buf in obj.buffers:
+            start = _aligned(offset)
+            mv = memoryview(buf).cast("B")
+            out[start:start + mv.nbytes] = mv
+            offset = start + mv.nbytes
+        return offset
+
+    @staticmethod
     def packed_size(obj: SerializedObject) -> int:
         header = {
             "metadata": obj.metadata,
@@ -173,8 +199,7 @@ class ShmStore:
 
     def create_and_seal(self, object_id: ObjectID, obj: SerializedObject) -> int:
         """Write an object into a new shm segment. Returns its size."""
-        data = self.pack(obj)
-        size = len(data)
+        size = self.packed_size(obj)
         self._reserve(object_id.hex(), size)
         try:
             seg = shared_memory.SharedMemory(
@@ -185,7 +210,7 @@ class ShmStore:
             self._release(object_id.hex())
             return size
         try:
-            seg.buf[:size] = data
+            self.pack_into(obj, seg.buf)
         finally:
             seg.close()
         with self._lock:
@@ -217,12 +242,16 @@ class ShmStore:
                 self._used -= entry["size"]
 
     def _evict_for(self, size: int):
-        """LRU-evict unpinned sealed objects until `size` fits. Lock held."""
-        if self._used + size <= self.capacity:
+        """LRU-evict unpinned sealed objects until `size` fits under the
+        soft limit (``spill_threshold`` × capacity — headroom so writers
+        rarely hit the hard cap; reference: local_object_manager spilling
+        at the high-water mark). Lock held."""
+        soft = int(self.capacity * self.spill_threshold)
+        if self._used + size <= soft:
             return
         victims = []
         for hex_id, entry in self._entries.items():
-            if self._used + size <= self.capacity:
+            if self._used + size <= soft:
                 break
             if entry["sealed"] and entry["pins"] == 0:
                 victims.append(hex_id)
@@ -318,6 +347,21 @@ class ShmStore:
             _unlink_segment(hex_id)
 
 
+def packed_length(buf) -> Optional[int]:
+    """Exact byte length of a packed payload, from its header. Segment /
+    arena slots are page- or alignment-rounded above the payload; serving
+    the rounded view would transfer trailing garbage and make the pulled
+    copy's size disagree with the directory's sealed size."""
+    if bytes(buf[:4]) != ShmStore.HEADER_MAGIC:
+        return None
+    hlen = int.from_bytes(buf[4:8], "little")
+    header = msgpack.unpackb(bytes(buf[8:8 + hlen]))
+    offset = _aligned(8 + hlen) + header["inband_len"]
+    for blen in header["buffer_lens"]:
+        offset = _aligned(offset) + blen
+    return offset
+
+
 def parse_packed(buf) -> Optional[SerializedObject]:
     """Parse the flat packed layout (ShmStore.pack) from any buffer —
     an shm segment or a native-arena view — keeping payload buffers
@@ -351,9 +395,17 @@ class NativeShmStore:
 
     def create_and_seal(self, object_id: ObjectID,
                         obj: SerializedObject) -> int:
-        data = ShmStore.pack(obj)
-        self.arena.create_and_seal(object_id.binary(), data)
-        return len(data)
+        size = ShmStore.packed_size(obj)
+        reserved = self.arena.create_reserve(object_id.binary(), size)
+        if reserved is None:
+            return size  # idempotent re-produce
+        idx, view = reserved
+        try:
+            ShmStore.pack_into(obj, view)
+        finally:
+            del view
+        self.arena.seal_reserved(idx, object_id.binary())
+        return size
 
     def mark_sealed(self, object_id: ObjectID, size: int):
         # The arena is authoritative; the seal already happened in the
@@ -393,6 +445,9 @@ def spill_dir() -> str:
     """Directory for objects that overflow shared memory (reference:
     fallback allocation + object spilling, local_object_manager.h:41 /
     external_storage.py)."""
+    override = os.environ.get("RAY_TPU_OBJECT_SPILLING_DIR")
+    if override:
+        return override
     base = os.environ.get("RAY_TPU_SESSION_DIR")
     if base:
         return os.path.join(base, "spill")
@@ -448,10 +503,15 @@ def spill_delete(object_id: ObjectID) -> None:
 
 
 def node_store_write(object_id: ObjectID, obj: SerializedObject) -> int:
-    """Worker-side write of a large object to the node store (native
-    arena when enabled, else a per-object shm segment); overflows to a
-    disk spill file when shared memory can't fit the object."""
-    return node_store_write_packed(object_id, ShmStore.pack(obj))
+    """Worker-side write of a large object to the node store. Packs IN
+    PLACE into the destination slot (pack_into) — the single memcpy per
+    payload buffer is the whole write cost, which is what put bandwidth
+    is made of."""
+    return _node_store_put(
+        object_id, ShmStore.packed_size(obj),
+        fill=lambda view: ShmStore.pack_into(obj, view),
+        pack_bytes=lambda: ShmStore.pack(obj),
+        primary=True)
 
 
 def node_store_write_packed(object_id: ObjectID, data,
@@ -462,29 +522,54 @@ def node_store_write_packed(object_id: ObjectID, data,
     ``primary=False`` marks a borrowed copy pulled from another node: it
     carries no eviction guard, so local memory pressure can drop it and a
     consumer re-pulls (the authoritative copy lives with the owner)."""
+    mv = memoryview(data).cast("B")
+
+    def fill(view):
+        view = memoryview(view).cast("B")
+        view[:mv.nbytes] = mv
+
+    return _node_store_put(object_id, mv.nbytes, fill=fill,
+                           pack_bytes=lambda: data, primary=primary)
+
+
+def _node_store_put(object_id: ObjectID, size: int, fill, pack_bytes,
+                    primary: bool) -> int:
+    """One store-selection policy for both the local write path
+    (pack-into-slot) and the pull-ingest path (copy packed bytes):
+    native arena when attached, else a per-object shm segment, spilling
+    to disk when neither fits. ``fill(view)`` writes the payload in
+    place; ``pack_bytes()`` materializes it only if the spill path
+    needs a bytes object."""
     from ray_tpu.core import native_store
 
     arena = native_store.get_attached_arena()
     if arena is not None:
         try:
-            arena.create_and_seal(object_id.binary(), data,
-                                  pin_primary=primary)
-            return len(data)
+            reserved = arena.create_reserve(object_id.binary(), size)
         except ObjectStoreFullError:
-            return _spill_write(object_id, data)
+            return _spill_write(object_id, pack_bytes())
+        if reserved is None:
+            return size  # idempotent re-produce
+        idx, view = reserved
+        try:
+            fill(view)
+        finally:
+            del view  # release the slot view before sealing
+        arena.seal_reserved(idx, object_id.binary(),
+                            pin_primary=primary)
+        return size
     try:
         seg = shared_memory.SharedMemory(
-            name=segment_name(object_id), create=True,
-            size=max(len(data), 1))
+            name=segment_name(object_id), create=True, size=max(size, 1))
     except FileExistsError:
-        return len(data)
+        return size
     except OSError:
-        return _spill_write(object_id, data)
+        return _spill_write(object_id, pack_bytes())
     try:
-        seg.buf[:len(data)] = data
+        fill(seg.buf)
     finally:
         seg.close()
-    return len(data)
+    return size
 
 
 def node_store_open(object_id: ObjectID) -> Optional[SerializedObject]:
@@ -514,7 +599,8 @@ def node_store_read_packed(object_id: ObjectID):
     if arena is not None:
         view = arena.lookup(object_id.binary())
         if view is not None:
-            return view
+            exact = packed_length(view)
+            return view if exact is None else view[:exact]
     else:
         name = segment_name(object_id)
         with ShmStore._open_lock:
@@ -528,7 +614,8 @@ def node_store_read_packed(object_id: ObjectID):
                 with ShmStore._open_lock:
                     ShmStore._open_segments.setdefault(name, seg)
         if seg is not None and bytes(seg.buf[:4]) == ShmStore.HEADER_MAGIC:
-            return seg.buf
+            exact = packed_length(seg.buf)
+            return seg.buf if exact is None else seg.buf[:exact]
     # Spilled: mmap once per object and serve every chunk request from
     # the cached mapping (mirrors ShmStore._open_segments for shm).
     hex_id = object_id.hex()

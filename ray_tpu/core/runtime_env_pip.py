@@ -1,0 +1,168 @@
+"""Pip runtime environments: venv-per-dependency-hash with a refcounted
+URI cache.
+
+Reference: python/ray/_private/runtime_env/pip.py (virtualenv per env
+hash, ``--system-site-packages`` so the base install is shared),
+uri_cache.py (refcounted, size-bounded cache keyed by env URI) and
+agent/runtime_env_agent.py:161 (create-or-reuse on task lease).
+
+Design here: the env is materialized once per hash under the session
+dir; a task whose ``runtime_env`` carries ``{"pip": [...]}`` gets the
+venv's site-packages PREPENDED to ``sys.path`` for the task's duration
+(workers are per-task-env processes in the reference; here the worker
+injects/ejects the path, which gives the same import isolation for
+pure-python deps without a respawn — two tasks with conflicting deps
+run concurrently in different workers because the env hash is part of
+the scheduling key).
+
+Offline-friendly: ``pip_find_links`` (or RAY_TPU_PIP_FIND_LINKS) routes
+installs through ``--no-index --find-links`` so air-gapped hosts (and
+this repo's tests) install from local wheels.
+"""
+
+from __future__ import annotations
+
+import hashlib
+import json
+import logging
+import os
+import shutil
+import site
+import subprocess
+import sys
+import threading
+import time
+from typing import Dict, List, Optional
+
+logger = logging.getLogger(__name__)
+
+_lock = threading.Lock()
+# env hash -> refcount (live tasks using it)
+_refs: Dict[str, int] = {}
+
+
+def env_hash(pip_packages: List[str]) -> str:
+    canon = json.dumps(sorted(pip_packages)).encode()
+    return hashlib.sha256(canon).hexdigest()[:16]
+
+
+def _envs_root(session_dir: Optional[str] = None) -> str:
+    base = session_dir or os.environ.get("RAY_TPU_SESSION_DIR") or "/tmp"
+    return os.path.join(base, "runtime_envs", "pip")
+
+
+def env_dir(pip_packages: List[str],
+            session_dir: Optional[str] = None) -> str:
+    return os.path.join(_envs_root(session_dir), env_hash(pip_packages))
+
+
+def ensure_env(pip_packages: List[str],
+               session_dir: Optional[str] = None,
+               find_links: Optional[str] = None,
+               timeout_s: float = 600.0) -> str:
+    """Create (or reuse) the venv for this dependency set; returns its
+    site-packages directory. Concurrent creators on one host coordinate
+    through an atomic rename: the env is built in a temp dir and only
+    the winner's rename lands (losers reuse it)."""
+    target = env_dir(pip_packages, session_dir)
+    sp = _site_packages(target)
+    if os.path.exists(os.path.join(target, ".ready")):
+        return sp
+    os.makedirs(os.path.dirname(target), exist_ok=True)
+    tmp = f"{target}.tmp.{os.getpid()}.{time.time_ns()}"
+    try:
+        subprocess.run(
+            [sys.executable, "-m", "venv", "--system-site-packages",
+             "--without-pip", tmp],
+            check=True, capture_output=True, timeout=timeout_s)
+        # Transitive deps install too (the reference's pip plugin
+        # resolves full trees); offline hosts must stage EVERY needed
+        # wheel in find_links.
+        cmd = [sys.executable, "-m", "pip", "install",
+               "--target", _site_packages(tmp)]
+        links = (find_links
+                 or os.environ.get("RAY_TPU_PIP_FIND_LINKS"))
+        if links:
+            cmd += ["--no-index", "--find-links", links]
+        cmd += list(pip_packages)
+        out = subprocess.run(cmd, capture_output=True, text=True,
+                             timeout=timeout_s)
+        if out.returncode != 0:
+            raise RuntimeError(
+                f"pip install {pip_packages} failed: {out.stderr[-800:]}")
+        with open(os.path.join(tmp, ".ready"), "w") as f:
+            f.write(json.dumps(sorted(pip_packages)))
+        try:
+            os.rename(tmp, target)
+        except OSError:
+            # Lost the race: another creator landed first. Use theirs.
+            shutil.rmtree(tmp, ignore_errors=True)
+        return sp
+    except Exception:
+        shutil.rmtree(tmp, ignore_errors=True)
+        raise
+
+
+def _site_packages(venv_dir: str) -> str:
+    v = sys.version_info
+    return os.path.join(venv_dir, "lib", f"python{v.major}.{v.minor}",
+                        "site-packages")
+
+
+class PipEnvContext:
+    """Task-scoped activation: prepend the env's site-packages, drop
+    cached modules it shadows on exit so the next task resolves its own
+    deps (the refcount keeps the env from being GCed while active)."""
+
+    def __init__(self, pip_packages: List[str],
+                 session_dir: Optional[str] = None):
+        self.packages = list(pip_packages)
+        self.hash = env_hash(pip_packages)
+        self.site_dir = ensure_env(pip_packages, session_dir)
+        self._shadowed: List[str] = []
+
+    def __enter__(self):
+        with _lock:
+            _refs[self.hash] = _refs.get(self.hash, 0) + 1
+        sys.path.insert(0, self.site_dir)
+        site.addsitedir(self.site_dir)
+        return self
+
+    def __exit__(self, *exc):
+        try:
+            sys.path.remove(self.site_dir)
+        except ValueError:
+            pass
+        # Evict modules imported from this env: a later task with a
+        # DIFFERENT version of the same dep must re-import, not reuse.
+        for name, mod in list(sys.modules.items()):
+            origin = getattr(mod, "__file__", None) or ""
+            if origin.startswith(self.site_dir):
+                sys.modules.pop(name, None)
+        with _lock:
+            _refs[self.hash] = _refs.get(self.hash, 1) - 1
+        return False
+
+
+def gc_unused(session_dir: Optional[str] = None,
+              max_envs: int = 8) -> List[str]:
+    """Drop least-recently-created envs above the cache budget whose
+    refcount is zero (reference: uri_cache.py's size-bounded eviction).
+    Returns the deleted env dirs."""
+    root = _envs_root(session_dir)
+    try:
+        entries = [os.path.join(root, d) for d in os.listdir(root)]
+    except OSError:
+        return []
+    entries = [e for e in entries if os.path.isdir(e)]
+    entries.sort(key=lambda e: os.path.getmtime(e))
+    deleted = []
+    with _lock:
+        live = {h for h, n in _refs.items() if n > 0}
+    while len(entries) > max_envs:
+        victim = entries.pop(0)
+        if os.path.basename(victim).split(".")[0] in live:
+            continue
+        shutil.rmtree(victim, ignore_errors=True)
+        deleted.append(victim)
+    return deleted

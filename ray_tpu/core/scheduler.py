@@ -48,6 +48,10 @@ class WorkerHandle:
     state: str = "STARTING"  # STARTING | IDLE | LEASED | ACTOR | DEAD
     lease_id: Optional[str] = None
     started_at: float = field(default_factory=time.monotonic)
+    # Memory-monitor victim ranking: does the current work survive a
+    # kill for free (task with retries left / restartable actor)?
+    task_retriable: bool = True
+    task_started_at: float = 0.0
 
 
 @dataclass
@@ -78,6 +82,23 @@ class Node:
         self.resources = NodeResources(resources)
         self.labels = labels or {}
         self.state = "ALIVE"
+
+
+def filter_worker_pythonpath(parts: List[str]) -> List[str]:
+    """Drop PYTHONPATH entries matched by RAY_TPU_WORKER_PYTHONPATH_
+    EXCLUDE (comma-separated substrings) from worker environments.
+
+    Chip-less workers must not load accelerator site hooks (PJRT plugin
+    registration via sitecustomize): a tunneled-TPU hook in a pure
+    control-plane process adds ~4ms to every cross-process wakeup. The
+    head (and node agents) set the exclusion when the node contributes
+    no TPU resource — one process per chip owns the accelerator
+    runtime; everyone else stays lean."""
+    exclude = os.environ.get("RAY_TPU_WORKER_PYTHONPATH_EXCLUDE")
+    if not exclude:
+        return parts
+    subs = [s for s in exclude.split(",") if s]
+    return [p for p in parts if not any(s in p for s in subs)]
 
 
 class WorkerPool:
@@ -137,7 +158,8 @@ class WorkerPool:
             if p not in seen:
                 seen.add(p)
                 ordered.append(p)
-        env["PYTHONPATH"] = os.pathsep.join(ordered)
+        env["PYTHONPATH"] = os.pathsep.join(
+            filter_worker_pythonpath(ordered))
         log_path = os.path.join(self.session_dir, "logs",
                                 f"worker-{worker_id.hex()[:12]}.log")
         os.makedirs(os.path.dirname(log_path), exist_ok=True)

@@ -335,8 +335,21 @@ class Executor:
                         spec.actor_id.hex() if spec.actor_id else "",
                         "actor instance missing",
                     )
-                method = getattr(self.actor_instance, spec.method_name)
-                value = method(*args, **kwargs)
+                if spec.method_name == "__rtpu_channel_loop__":
+                    # Compiled-DAG execution loop: pins this actor's
+                    # execution thread to its channels until torn down
+                    # (reference: compiled_dag_node.py's do_exec_tasks
+                    # loop on the actor).
+                    from ray_tpu.experimental.compiled_dag import (
+                        run_channel_loop,
+                    )
+
+                    value = run_channel_loop(self.actor_instance,
+                                             args[0])
+                else:
+                    method = getattr(self.actor_instance,
+                                     spec.method_name)
+                    value = method(*args, **kwargs)
             return self._package_returns(spec, value)
         except ActorExitSignal:
             raise
@@ -571,6 +584,49 @@ async def _amain():
             asyncio.get_running_loop().create_task(_graceful_actor_exit())
             return out
 
+    async def h_push_tasks(conn, payload):
+        """Batched push (a notification): N specs arrive in one frame;
+        each task's result streams back as its own ``task_done``
+        notification the moment it finishes. Batching amortizes the RPC
+        envelope + loop wakeups that dominate tiny-task throughput,
+        while per-task completion keeps results independent — task B in
+        a batch may resolve an owner-held ref produced by task A of the
+        same batch, so replies must NOT wait for the batch (reference:
+        one PushTask RPC per task, direct_task_transport.h:63; here one
+        frame carries many)."""
+        # A notification handler's exceptions vanish in rpc._dispatch —
+        # the owner would hang on every task in the batch. Every failure
+        # mode must therefore surface as a task_done carrying an error
+        # reply (a spec that cannot even be deserialized is a protocol
+        # bug; it is logged loudly and the rest of the batch proceeds).
+        specs = []
+        for blob in payload["specs"]:
+            try:
+                specs.append(serialization.loads_control(blob))
+            except Exception:
+                logging.getLogger(__name__).exception(
+                    "undecodable task spec in push_tasks batch")
+        executor.ensure_started()
+
+        async def one(spec):
+            try:
+                reply = await executor.submit(spec, conn)
+            except ActorExitSignal:
+                asyncio.get_running_loop().create_task(
+                    _graceful_actor_exit())
+                reply = {"returns": [], "is_error": False}
+            except BaseException as e:  # noqa: B036 — must reach owner
+                reply = executor._package_error(spec, e)
+            try:
+                await conn.notify("task_done", {
+                    "task_id": spec.task_id.hex(), "reply": reply})
+            except Exception:
+                pass  # owner gone; its failure handling owns the task
+
+        for spec in specs:
+            asyncio.get_running_loop().create_task(one(spec))
+        return {"ok": True}
+
     async def h_create_actor(conn, payload):
         spec: TaskSpec = serialization.loads_control(payload["spec"])
         cw.job_id = spec.job_id
@@ -617,6 +673,7 @@ async def _amain():
 
     port = await cw.start_server(extra_handlers={
         "push_task": h_push_task,
+        "push_tasks": h_push_tasks,
         "create_actor": h_create_actor,
         "cancel_task": h_cancel_task,
         "exit_worker": h_exit_worker,
@@ -654,6 +711,16 @@ def main():
         level=logging.INFO,
         format="%(asctime)s %(levelname)s worker %(name)s: %(message)s",
     )
+    # SIGUSR1 dumps all thread stacks to stderr (the worker log) — the
+    # on-demand profiling hook (reference: ray stack / py-spy dump via
+    # dashboard/modules/reporter/profile_manager.py).
+    import faulthandler
+    import signal as _signal
+
+    try:
+        faulthandler.register(_signal.SIGUSR1, all_threads=True)
+    except (AttributeError, ValueError):
+        pass
     try:
         code = asyncio.run(_amain())
     except KeyboardInterrupt:

@@ -45,6 +45,17 @@ class DAGNode:
     def execute(self, *input_args, **input_kwargs):
         return _ExecutionState(input_args, input_kwargs).submit(self)
 
+    def experimental_compile(self, buffer_size_bytes: int = 1 << 20,
+                             max_inflight: int = 8):
+        """Compile this DAG onto pre-allocated shm channels with pinned
+        actor loops (reference: dag.experimental_compile,
+        compiled_dag_node.py:19). Returns a CompiledDag whose
+        ``execute()`` skips the task path entirely."""
+        from ray_tpu.experimental.compiled_dag import CompiledDag
+
+        return CompiledDag(self, buffer_size_bytes=buffer_size_bytes,
+                           max_inflight=max_inflight)
+
     def _children(self) -> List["DAGNode"]:
         out: List[DAGNode] = []
         for a in list(self.args) + list(self.kwargs.values()):
@@ -103,6 +114,23 @@ class FunctionNode(DAGNode):
         return f"FunctionNode({self.name})"
 
 
+class ClassMethodNode(DAGNode):
+    """Lazy actor-method call (reference: dag/class_node.py's
+    ClassMethodNode) — the node type the compiled-DAG path pins into
+    channel loops."""
+
+    def __init__(self, actor_handle, method_name: str, args: tuple,
+                 kwargs: dict):
+        self.actor_handle = actor_handle
+        self.method_name = method_name
+        self.args = args
+        self.kwargs = kwargs
+        self.index = next(_node_counter)
+
+    def __repr__(self):
+        return f"ClassMethodNode({self.method_name})"
+
+
 class _ExecutionState:
     def __init__(self, input_args: tuple, input_kwargs: dict):
         if input_kwargs:
@@ -141,5 +169,10 @@ class _ExecutionState:
                 continue
             args = tuple(self.resolve(a) for a in node.args)
             kwargs = {k: self.resolve(v) for k, v in node.kwargs.items()}
-            self.results[id(node)] = node.remote_fn.remote(*args, **kwargs)
+            if isinstance(node, ClassMethodNode):
+                method = getattr(node.actor_handle, node.method_name)
+                self.results[id(node)] = method.remote(*args, **kwargs)
+            else:
+                self.results[id(node)] = node.remote_fn.remote(
+                    *args, **kwargs)
         return self.results[id(root)]

@@ -140,6 +140,11 @@ class _ShuffleReducer:
 
     def __init__(self):
         self.parts: dict = {}  # (shuffle_id, partition) -> fragments
+        # Shuffles fully finished on this reducer: straggler duplicate
+        # pushes (free-retry double execution) for them are dropped, not
+        # accumulated into orphaned fragment lists. Bounded history.
+        self.done = collections.deque(maxlen=128)
+        self.done_set: set = set()
 
     def ping(self) -> bool:
         return True
@@ -149,6 +154,8 @@ class _ShuffleReducer:
         """Idempotent per (shuffle, map, partition): a map task retried
         after its worker died re-pushes fragments that may already have
         landed; duplicates must not inflate the shuffle output."""
+        if shuffle_id in self.done_set:
+            return 0
         seen = self.parts.setdefault((shuffle_id, "seen"), set())
         if (map_key, j) in seen:
             return 0
@@ -160,9 +167,21 @@ class _ShuffleReducer:
             self.parts[(shuffle_id, j)] = [concat_blocks(frags)]
         return len(frags)
 
-    def finish(self, shuffle_id: str, j: int, seed):
+    def finish(self, shuffle_id: str, j: int, seed, last: bool = False):
+        """Emit partition j. `last` marks this reducer's final owned
+        partition of the shuffle (actor calls run in submission order,
+        so it arrives after every other finish): only then is the dedup
+        set dropped — popping it on the first finish would let a
+        straggler duplicate push double-count rows in partitions this
+        reducer still owns."""
         out = concat_blocks(self.parts.pop((shuffle_id, j), []))
-        self.parts.pop((shuffle_id, "seen"), None)
+        if last:
+            self.parts.pop((shuffle_id, "seen"), None)
+            if shuffle_id not in self.done_set:
+                if len(self.done) == self.done.maxlen:
+                    self.done_set.discard(self.done[0])
+                self.done.append(shuffle_id)
+                self.done_set.add(shuffle_id)
         acc = BlockAccessor(out)
         rng = np.random.default_rng(seed)
         out = acc.take_indices(rng.permutation(acc.num_rows()))
@@ -468,7 +487,9 @@ class StreamingExecutor:
                         else stage.seed * 7919 + j)
                 yield tuple(
                     reducers[j % n_reducers].finish
-                    .options(num_returns=2).remote(shuffle_id, j, seed))
+                    .options(num_returns=2).remote(
+                        shuffle_id, j, seed,
+                        j + n_reducers >= n_out))  # reducer's last owned j
 
         yield from self._windowed(submits())
 

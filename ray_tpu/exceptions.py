@@ -34,6 +34,12 @@ class WorkerCrashedError(RayTpuError):
     """The worker process executing the task died unexpectedly."""
 
 
+class OutOfMemoryError(WorkerCrashedError):
+    """The worker was killed by the node memory monitor (reference:
+    ray.exceptions.OutOfMemoryError surfaced by the raylet's
+    memory_monitor.h watchdog)."""
+
+
 class ActorDiedError(RayTpuError):
     """The actor owning the called method is dead."""
 

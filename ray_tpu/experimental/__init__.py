@@ -1,0 +1,11 @@
+"""Experimental substrate: pre-allocated shared-memory channels and the
+compiled-DAG execution path built on them (reference:
+python/ray/experimental/channel.py, python/ray/dag/compiled_dag_node.py).
+"""
+
+from ray_tpu.experimental.channel import (  # noqa: F401
+    ChannelClosed,
+    ShmChannel,
+)
+
+__all__ = ["ShmChannel", "ChannelClosed"]

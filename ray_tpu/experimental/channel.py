@@ -1,0 +1,322 @@
+"""Pre-allocated shared-memory ring-buffer channels.
+
+Reference: python/ray/experimental/channel.py:49 (``Channel`` — a
+buffer allocated once in the object store that accelerated-DAG actors
+write/read without per-message RPCs or allocations). Here the channel
+is a fixed ring of slots in ONE posix shm segment, single writer /
+single reader (SPSC): the compiled-DAG layer gives every producer →
+consumer edge its own channel, which is how MPMC patterns are built
+(reference does the same: one channel per reader).
+
+Synchronization is two monotonically-increasing u64 sequence cursors
+(write_seq, read_seq) in the segment header. Aligned 8-byte loads and
+stores are atomic on every platform CPython runs on, and the payload
+is written strictly before the cursor publish (x86 TSO / ARM release
+semantics via the interpreter's own barriers), so a reader that
+observes ``write_seq > read_seq`` also observes the slot contents.
+Waiting is adaptive: a short spin (latency path — the whole point of
+channels is the microsecond hop) then escalating sleeps (cpu path).
+
+Values larger than a slot overflow to the object store: the slot then
+carries a pickled ObjectRef and the reader dereferences it — the same
+escape hatch the reference uses for dynamically-sized returns.
+"""
+
+from __future__ import annotations
+
+import ctypes
+import ctypes.util
+import errno
+import os
+import pickle
+import struct
+import time
+from multiprocessing import shared_memory
+from typing import Any, Optional
+
+
+class _Sems:
+    """Named POSIX semaphores (sem_open/post/timedwait via ctypes) —
+    real blocking wakeups between unrelated processes. A spin-sleep
+    ladder burns half a scheduler quantum per hop on a busy host;
+    sem_post hands the CPU straight to the waiter, which is where the
+    channel's microsecond latency comes from."""
+
+    _lib = None
+
+    @classmethod
+    def lib(cls):
+        if cls._lib is None:
+            path = ctypes.util.find_library("pthread") or \
+                ctypes.util.find_library("rt")
+            lib = ctypes.CDLL(path, use_errno=True) if path \
+                else ctypes.CDLL(None, use_errno=True)
+            lib.sem_open.restype = ctypes.c_void_p
+            lib.sem_open.argtypes = [ctypes.c_char_p, ctypes.c_int,
+                                     ctypes.c_uint, ctypes.c_uint]
+            for fn in ("sem_post", "sem_trywait", "sem_close"):
+                getattr(lib, fn).restype = ctypes.c_int
+                getattr(lib, fn).argtypes = [ctypes.c_void_p]
+            lib.sem_timedwait.restype = ctypes.c_int
+            lib.sem_timedwait.argtypes = [ctypes.c_void_p,
+                                          ctypes.c_void_p]
+            lib.sem_unlink.restype = ctypes.c_int
+            lib.sem_unlink.argtypes = [ctypes.c_char_p]
+            cls._lib = lib
+        return cls._lib
+
+
+class _Timespec(ctypes.Structure):
+    _fields_ = [("tv_sec", ctypes.c_long), ("tv_nsec", ctypes.c_long)]
+
+
+_O_CREAT = getattr(os, "O_CREAT", 64)
+_SEM_FAILED = ctypes.c_void_p(0).value
+
+
+class _NamedSem:
+    def __init__(self, name: str, create: bool, value: int = 0):
+        lib = _Sems.lib()
+        self.name = name.encode()
+        self._lib = lib
+        if create:
+            lib.sem_unlink(self.name)  # stale from a crashed run
+            handle = lib.sem_open(self.name, _O_CREAT, 0o600, value)
+        else:
+            # sem_open is variadic; mode/value are ignored without
+            # O_CREAT but ctypes' argtypes demand them.
+            handle = lib.sem_open(self.name, 0, 0, 0)
+        if not handle:
+            raise OSError(ctypes.get_errno(),
+                          f"sem_open({name}) failed")
+        self._h = ctypes.c_void_p(handle)
+        self._owner = create
+
+    def post(self):
+        self._lib.sem_post(self._h)
+
+    def try_acquire(self) -> bool:
+        if self._lib.sem_trywait(self._h) == 0:
+            return True
+        return False
+
+    def acquire(self, timeout: Optional[float]) -> bool:
+        """Blocking (GIL released inside ctypes). False on timeout."""
+        if self.try_acquire():
+            return True
+        if os.environ.get("RAY_TPU_CHANNEL_POLL"):
+            deadline = (None if timeout is None
+                        else time.monotonic() + timeout)
+            delay = 10e-6
+            while True:
+                if self.try_acquire():
+                    return True
+                if (deadline is not None
+                        and time.monotonic() > deadline):
+                    return False
+                time.sleep(delay)
+                delay = min(delay * 2, 1e-3)
+        deadline = None if timeout is None else time.time() + timeout
+        while True:
+            abs_t = time.time() + 3600 if deadline is None else deadline
+            ts = _Timespec(int(abs_t), int((abs_t % 1) * 1e9))
+            rc = self._lib.sem_timedwait(self._h, ctypes.byref(ts))
+            if rc == 0:
+                return True
+            err = ctypes.get_errno()
+            if err == errno.EINTR:
+                continue
+            if err == errno.ETIMEDOUT:
+                if deadline is None:
+                    continue  # periodic re-arm for infinite waits
+                return False
+            raise OSError(err, "sem_timedwait failed")
+
+    def close(self):
+        try:
+            self._lib.sem_close(self._h)
+        except Exception:
+            pass
+        if self._owner:
+            try:
+                self._lib.sem_unlink(self.name)
+            except Exception:
+                pass
+
+_MAGIC = 0x52435448  # "RCTH"
+_HDR = 64
+# header offsets
+_OFF_MAGIC = 0
+_OFF_NSLOTS = 4
+_OFF_SLOT_BYTES = 8
+_OFF_WRITE_SEQ = 16
+_OFF_READ_SEQ = 24
+_OFF_CLOSED = 32
+
+_KIND_INLINE = 0
+_KIND_REF = 1
+_SLOT_HDR = 8  # u32 len | u8 kind | pad
+
+
+class ChannelClosed(Exception):
+    """The writer closed the channel; no further values will arrive."""
+
+
+class ShmChannel:
+    """SPSC shared-memory ring channel.
+
+    One process calls :meth:`create`, every peer calls :meth:`attach`
+    with the returned name. Exactly one process may write; exactly one
+    may read (the compiled-DAG layer enforces this by construction).
+    """
+
+    def __init__(self, seg: shared_memory.SharedMemory, owner: bool):
+        self._seg = seg
+        self._owner = owner
+        self._buf = seg.buf
+        self.nslots = struct.unpack_from("<I", self._buf, _OFF_NSLOTS)[0]
+        self.slot_bytes = struct.unpack_from(
+            "<Q", self._buf, _OFF_SLOT_BYTES)[0]
+        # Blocking wakeups: `items` counts readable slots, `spaces` free
+        # ones. Created with the segment; peers attach by name.
+        self._items = _NamedSem(f"/{seg.name}.i", create=owner, value=0)
+        self._spaces = _NamedSem(f"/{seg.name}.s", create=owner,
+                                 value=self.nslots if owner else 0)
+
+    # -- lifecycle -----------------------------------------------------
+
+    @classmethod
+    def create(cls, name: str, nslots: int = 8,
+               slot_bytes: int = 1 << 20) -> "ShmChannel":
+        size = _HDR + nslots * (slot_bytes + _SLOT_HDR)
+        seg = shared_memory.SharedMemory(name=name, create=True, size=size)
+        struct.pack_into("<I", seg.buf, _OFF_NSLOTS, nslots)
+        struct.pack_into("<Q", seg.buf, _OFF_SLOT_BYTES, slot_bytes)
+        struct.pack_into("<Q", seg.buf, _OFF_WRITE_SEQ, 0)
+        struct.pack_into("<Q", seg.buf, _OFF_READ_SEQ, 0)
+        seg.buf[_OFF_CLOSED] = 0
+        inst = cls(seg, owner=True)  # creates the semaphores
+        # Magic LAST — after header AND semaphores exist: attach() spins
+        # on it, so a partially-initialized channel is never observed.
+        struct.pack_into("<I", seg.buf, _OFF_MAGIC, _MAGIC)
+        return inst
+
+    @classmethod
+    def attach(cls, name: str, timeout: float = 30.0) -> "ShmChannel":
+        deadline = time.monotonic() + timeout
+        while True:
+            try:
+                seg = shared_memory.SharedMemory(name=name)
+            except FileNotFoundError:
+                if time.monotonic() > deadline:
+                    raise
+                time.sleep(0.005)
+                continue
+            if struct.unpack_from("<I", seg.buf, _OFF_MAGIC)[0] == _MAGIC:
+                return cls(seg, owner=False)
+            seg.close()
+            if time.monotonic() > deadline:
+                raise TimeoutError(f"channel {name} never initialized")
+            time.sleep(0.005)
+
+    @property
+    def name(self) -> str:
+        return self._seg.name
+
+    def close(self):
+        """Writer-side: signal end-of-stream to the reader."""
+        try:
+            self._buf[_OFF_CLOSED] = 1
+        except (TypeError, ValueError):
+            pass  # segment already destroyed
+        # Phantom post: wake a blocked reader so it can observe EOS
+        # (it re-checks the cursors and raises ChannelClosed).
+        try:
+            self._items.post()
+        except Exception:
+            pass
+
+    def destroy(self):
+        self._buf = None
+        for sem in (self._items, self._spaces):
+            try:
+                sem.close()
+            except Exception:
+                pass
+        try:
+            self._seg.close()
+        except Exception:
+            pass
+        if self._owner:
+            try:
+                self._seg.unlink()
+            except Exception:
+                pass
+
+    # -- cursors -------------------------------------------------------
+
+    def _load(self, off: int) -> int:
+        return struct.unpack_from("<Q", self._buf, off)[0]
+
+    def _store(self, off: int, value: int):
+        struct.pack_into("<Q", self._buf, off, value)
+
+    def _slot_off(self, seq: int) -> int:
+        return _HDR + (seq % self.nslots) * (self.slot_bytes + _SLOT_HDR)
+
+    # -- data path -----------------------------------------------------
+
+    def write_bytes(self, payload: bytes, kind: int = _KIND_INLINE,
+                    timeout: Optional[float] = None):
+        if len(payload) > self.slot_bytes:
+            raise ValueError(
+                f"payload {len(payload)}B exceeds slot {self.slot_bytes}B")
+        if not self._spaces.acquire(timeout):
+            raise TimeoutError("channel write timed out (ring full)")
+        wseq = self._load(_OFF_WRITE_SEQ)
+        off = self._slot_off(wseq)
+        struct.pack_into("<IB", self._buf, off, len(payload), kind)
+        self._buf[off + _SLOT_HDR:off + _SLOT_HDR + len(payload)] = payload
+        # Publish AFTER the payload is in place, THEN wake the reader.
+        self._store(_OFF_WRITE_SEQ, wseq + 1)
+        self._items.post()
+
+    def read_bytes(self, timeout: Optional[float] = None):
+        while True:
+            if not self._items.acquire(timeout):
+                raise TimeoutError("channel read timed out")
+            rseq = self._load(_OFF_READ_SEQ)
+            if self._load(_OFF_WRITE_SEQ) > rseq:
+                break
+            # Phantom wakeup from close(): drained + closed ⇒ EOS.
+            if self._buf[_OFF_CLOSED] == 1:
+                self._items.post()  # keep EOS observable for re-reads
+                raise ChannelClosed()
+        off = self._slot_off(rseq)
+        length, kind = struct.unpack_from("<IB", self._buf, off)
+        payload = bytes(
+            self._buf[off + _SLOT_HDR:off + _SLOT_HDR + length])
+        self._store(_OFF_READ_SEQ, rseq + 1)
+        self._spaces.post()
+        return payload, kind
+
+    def write(self, value: Any, timeout: Optional[float] = None):
+        """Serialize and write one value; values that don't fit a slot
+        overflow to the object store and ship as a ref."""
+        payload = pickle.dumps(value, protocol=5)
+        if len(payload) <= self.slot_bytes:
+            self.write_bytes(payload, _KIND_INLINE, timeout)
+            return
+        import ray_tpu
+
+        ref = ray_tpu.put(value)
+        self.write_bytes(pickle.dumps(ref, protocol=5), _KIND_REF, timeout)
+
+    def read(self, timeout: Optional[float] = None) -> Any:
+        payload, kind = self.read_bytes(timeout)
+        value = pickle.loads(payload)
+        if kind == _KIND_REF:
+            import ray_tpu
+
+            value = ray_tpu.get(value, timeout=timeout)
+        return value

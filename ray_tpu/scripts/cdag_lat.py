@@ -1,0 +1,44 @@
+"""Compiled-DAG latency probe: p50 of a 1-stage echo tick.
+
+Run AFTER the cluster is warm — a cold worker pool's import CPU
+poisons µs-scale latency (see microbenchmark.py's _warm)."""
+
+import statistics
+import time
+
+import ray_tpu
+from ray_tpu.dag import InputNode
+
+
+def main():
+    ray_tpu.init(num_cpus=4, num_tpus=0)
+
+    @ray_tpu.remote
+    def _warm():
+        time.sleep(0.5)
+        return 1
+
+    ray_tpu.get([_warm.remote() for _ in range(4)], timeout=180)
+    time.sleep(2)
+
+    @ray_tpu.remote
+    class _Echo:
+        def fwd(self, x):
+            return x
+
+    echo = _Echo.options(num_cpus=0.01).remote()
+    ray_tpu.get(echo.fwd.remote(0), timeout=60)
+    cd = echo.fwd.bind(InputNode()).experimental_compile()
+    cd.execute(0, timeout=60)
+    lats = []
+    for i in range(300):
+        t0 = time.perf_counter()
+        cd.execute(i, timeout=60)
+        lats.append(time.perf_counter() - t0)
+    cd.teardown()
+    print(f"p50 {statistics.median(lats)*1e6:.0f}us")
+    ray_tpu.shutdown()
+
+
+if __name__ == "__main__":
+    main()

@@ -31,10 +31,31 @@ def run_all(init: bool = True) -> Dict[str, float]:
     if init and not ray_tpu.is_initialized():
         ray_tpu.init(num_cpus=4, num_tpus=0)
     results: Dict[str, float] = {}
+    # Debug bisect knob: RAY_TPU_MB_SKIP=tasks,actor,putget skips
+    # sections (used to isolate cross-section interference).
+    import os as _os
+
+    _skip = set(filter(None, _os.environ.get(
+        "RAY_TPU_MB_SKIP", "").split(",")))
 
     @ray_tpu.remote
     def tiny(x):
         return x
+
+    # Warm the worker pool to its steady state FIRST: a worker spawn
+    # costs seconds of import CPU (ray_tpu + jax) on a small host, and a
+    # background import competing for the core poisons every number
+    # below — most brutally the µs-scale channel latency, where each
+    # semaphore wakeup then eats a full scheduler rotation (~8ms).
+    @ray_tpu.remote
+    def _warm():
+        import time as _t
+
+        _t.sleep(0.5)
+        return 1
+
+    ray_tpu.get([_warm.remote() for _ in range(4)], timeout=180)
+    time.sleep(2)  # prestart replacements finish importing
 
     # single-client task throughput (async submission, batched get)
     N = 100
@@ -42,8 +63,9 @@ def run_all(init: bool = True) -> Dict[str, float]:
     def tasks_batch():
         ray_tpu.get([tiny.remote(i) for i in range(N)], timeout=120)
 
-    results["tasks_per_second"] = _timeit(
-        "single-client tasks", tasks_batch, multiplier=N)
+    if "tasks" not in _skip:
+        results["tasks_per_second"] = _timeit(
+            "single-client tasks", tasks_batch, multiplier=N)
 
     class Counter:
         def __init__(self):
@@ -59,14 +81,16 @@ def run_all(init: bool = True) -> Dict[str, float]:
     def actor_sync():
         ray_tpu.get(actor.inc.remote(), timeout=60)
 
-    results["actor_calls_sync_per_second"] = _timeit(
-        "1:1 actor calls sync", actor_sync)
+    if "actor" not in _skip:
+        results["actor_calls_sync_per_second"] = _timeit(
+            "1:1 actor calls sync", actor_sync)
 
     def actor_async_batch():
         ray_tpu.get([actor.inc.remote() for _ in range(N)], timeout=120)
 
-    results["actor_calls_async_per_second"] = _timeit(
-        "1:1 actor calls async", actor_async_batch, multiplier=N)
+    if "actor" not in _skip:
+        results["actor_calls_async_per_second"] = _timeit(
+            "1:1 actor calls async", actor_async_batch, multiplier=N)
 
     # put/get bandwidth on 10MB arrays through the shm arena
     data = np.random.default_rng(0).random(10 * 1024 * 1024 // 8)
@@ -76,10 +100,59 @@ def run_all(init: bool = True) -> Dict[str, float]:
         out = ray_tpu.get(ref, timeout=60)
         assert out.shape == data.shape
 
-    rate = _timeit("10MB put+get roundtrips", put_get)
-    results["put_gigabytes_per_second"] = rate * 10 / 1024 * 2
-    print(f"object store bandwidth: "
-          f"{results['put_gigabytes_per_second']:.2f} GiB/s")
+    if "putget" not in _skip:
+        rate = _timeit("10MB put+get roundtrips", put_get)
+        results["put_gigabytes_per_second"] = rate * 10 / 1024 * 2
+        print(f"object store bandwidth: "
+              f"{results['put_gigabytes_per_second']:.2f} GiB/s")
+
+    # compiled-DAG channel path vs the task path (reference:
+    # compiled_dag_node.py's raison d'être — p50, since the channel hop
+    # is microseconds while scheduler noise is milliseconds)
+    import statistics
+
+    from ray_tpu.dag import InputNode
+
+    # Let the put/get bench's ~GBs of dead refs finish freeing (arena
+    # deletes + free RPCs drain on the driver loop thread and would
+    # poison a microsecond-scale latency measurement with GIL stalls).
+    time.sleep(3)
+
+    def actor_sync_once():
+        ray_tpu.get(actor.inc.remote(), timeout=60)
+
+    lats = []
+    for _ in range(300):
+        t0 = time.perf_counter()
+        actor_sync_once()
+        lats.append(time.perf_counter() - t0)
+    task_p50 = statistics.median(lats)
+    # Echo DAG on a dedicated actor (Counter.inc takes no arg).
+
+    @ray_tpu.remote
+    class _Echo:
+        def fwd(self, x):
+            return x
+
+    echo = _Echo.options(num_cpus=0.01).remote()
+    ray_tpu.get(echo.fwd.remote(0), timeout=60)
+    cd = echo.fwd.bind(InputNode()).experimental_compile()
+    cd.execute(0, timeout=60)
+    lats = []
+    for i in range(300):
+        t0 = time.perf_counter()
+        cd.execute(i, timeout=60)
+        lats.append(time.perf_counter() - t0)
+    cd.teardown()
+    compiled_p50 = statistics.median(lats)
+    results["compiled_dag_p50_us"] = compiled_p50 * 1e6
+    results["compiled_dag_speedup_vs_task_path"] = task_p50 / compiled_p50
+    srt = sorted(lats)
+    print(f"compiled dag p50: {compiled_p50*1e6:.0f}us "
+          f"(p10 {srt[len(srt)//10]*1e6:.0f} "
+          f"p90 {srt[9*len(srt)//10]*1e6:.0f}) vs task-path "
+          f"{task_p50*1e6:.0f}us "
+          f"({results['compiled_dag_speedup_vs_task_path']:.1f}x)")
     ray_tpu.kill(actor)
     return results
 

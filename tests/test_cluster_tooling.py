@@ -218,3 +218,64 @@ def test_cli_status_and_list(tmp_path):
     finally:
         head.terminate()
         head.wait(timeout=30)
+
+
+def test_gcp_tpu_provider_drives_gcloud():
+    """The concrete GCE slice provider issues create/delete with the
+    right accelerator type and a startup script that installs the
+    package then joins the cluster (reference: gcp node_provider + TPU
+    VM API); its node list reconciles against the cloud."""
+    from ray_tpu.autoscaler import GcpTpuPodSliceProvider
+
+    calls = []
+    cloud = set()
+
+    def runner(args):
+        calls.append(args)
+        if args[3] == "create":
+            cloud.add(args[4])
+        elif args[3] == "delete":
+            cloud.discard(args[4])
+        elif args[3] == "list":
+            return "\n".join(sorted(cloud))
+        return ""
+
+    p = GcpTpuPodSliceProvider(
+        project="proj", zone="us-central2-b",
+        head_address="10.0.0.2:6379",
+        setup_commands=["pip install /mnt/ray_tpu.whl"],
+        runner=runner)
+    sid = p.launch_slice("v5e-16")
+    assert sid.startswith("ray-tpu-v5e-16-")
+    create = calls[0]
+    assert create[:4] == ["compute", "tpus", "tpu-vm", "create"]
+    assert "v5litepod-16" in create
+    script = create[create.index("--metadata") + 1]
+    # Custom delimiter: metadata values with commas (version specs)
+    # must not be split into bogus KEY=VALUE pairs by gcloud.
+    assert script.startswith("^:::^startup-script=")
+    assert "pip install /mnt/ray_tpu.whl" in script
+    assert "--head-host 10.0.0.2" in script
+    assert "--head-port 6379" in script
+    nodes = p.non_terminated_nodes()
+    assert nodes and nodes[0]["node_type"] == "v5e-16"
+    p.release_slice(sid)
+    assert any(c[:4] == ["compute", "tpus", "tpu-vm", "delete"]
+               for c in calls)
+    p._listed_at = 0.0  # expire the TTL cache
+    assert p.non_terminated_nodes() == []
+
+    # Orphan adoption: a slice in the cloud but not in memory (process
+    # restarted) is adopted, not leaked.
+    cloud.add("ray-tpu-v4-8-deadbeef")
+    p._listed_at = 0.0
+    adopted = p.non_terminated_nodes()
+    assert adopted and adopted[0]["node_type"] == "v4-8"
+
+    import pytest
+
+    with pytest.raises(ValueError):
+        p.launch_slice("v9-999")
+    # Accelerator names derive from the single TOPOLOGIES table.
+    for topo in GcpTpuPodSliceProvider.TOPOLOGIES:
+        assert GcpTpuPodSliceProvider.accelerator_type(topo)

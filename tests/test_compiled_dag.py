@@ -1,0 +1,182 @@
+"""Compiled DAG + shm channels (reference strategy:
+python/ray/dag/tests/experimental/test_accelerated_dag.py — correctness
+of compiled execution, teardown, and multi-actor pipelines;
+python/ray/tests/test_channel.py — channel semantics)."""
+
+import threading
+import time
+
+import numpy as np
+import pytest
+
+import ray_tpu
+from ray_tpu.dag import InputNode
+from ray_tpu.experimental import ChannelClosed, ShmChannel
+
+
+# ---------------------------------------------------------------------------
+# channel unit tests (no cluster)
+# ---------------------------------------------------------------------------
+
+
+def test_channel_roundtrip_and_order():
+    ch = ShmChannel.create(f"rtpu_t_{time.time_ns()}", nslots=4,
+                           slot_bytes=4096)
+    try:
+        for i in range(10):  # wraps the 4-slot ring
+            ch.write({"i": i})
+            assert ch.read(timeout=5) == {"i": i}
+    finally:
+        ch.destroy()
+
+
+def test_channel_backpressure_blocks_writer():
+    ch = ShmChannel.create(f"rtpu_t_{time.time_ns()}", nslots=2,
+                           slot_bytes=1024)
+    try:
+        ch.write(1)
+        ch.write(2)
+        with pytest.raises(TimeoutError):
+            ch.write_bytes(b"x", timeout=0.2)  # ring full
+        assert ch.read(timeout=5) == 1
+        ch.write(3)  # slot freed
+        assert ch.read(timeout=5) == 2
+        assert ch.read(timeout=5) == 3
+    finally:
+        ch.destroy()
+
+
+def test_channel_close_ends_stream():
+    ch = ShmChannel.create(f"rtpu_t_{time.time_ns()}", nslots=2,
+                           slot_bytes=1024)
+    try:
+        ch.write("last")
+        ch.close()
+        assert ch.read(timeout=5) == "last"  # drained before EOS
+        with pytest.raises(ChannelClosed):
+            ch.read(timeout=5)
+    finally:
+        ch.destroy()
+
+
+def test_channel_threaded_pingpong():
+    a = ShmChannel.create(f"rtpu_t_{time.time_ns()}", nslots=4,
+                          slot_bytes=1 << 16)
+
+    def echo():
+        while True:
+            try:
+                v = a.read(timeout=10)
+            except ChannelClosed:
+                return
+
+    t = threading.Thread(target=echo, daemon=True)
+    t.start()
+    for i in range(1000):
+        a.write(i)
+    a.close()
+    t.join(timeout=10)
+    assert not t.is_alive()
+    a.destroy()
+
+
+# ---------------------------------------------------------------------------
+# compiled DAG over a cluster
+# ---------------------------------------------------------------------------
+
+
+@ray_tpu.remote
+class Adder:
+    def __init__(self, add):
+        self.add = add
+
+    def fwd(self, x):
+        return x + self.add
+
+    def combine(self, a, b):
+        return a + b
+
+
+def test_compiled_chain_matches_classic(ray_start):
+    a = Adder.remote(1)
+    b = Adder.remote(10)
+    ray_tpu.get([a.fwd.remote(0), b.fwd.remote(0)], timeout=60)
+    with InputNode() as inp:
+        node = b.fwd.bind(a.fwd.bind(inp))
+    classic = ray_tpu.get(node.execute(5), timeout=60)
+    cd = node.experimental_compile()
+    try:
+        assert cd.execute(5, timeout=60) == classic == 16
+        # Repeated ticks reuse the same channels — no per-call tasks.
+        for i in range(50):
+            assert cd.execute(i, timeout=60) == i + 11
+    finally:
+        cd.teardown()
+    # The loop released the actors: plain calls work again.
+    assert ray_tpu.get(a.fwd.remote(1), timeout=60) == 2
+
+
+def test_compiled_join_two_upstreams(ray_start):
+    a = Adder.remote(1)
+    b = Adder.remote(2)
+    c = Adder.remote(0)
+    ray_tpu.get([x.fwd.remote(0) for x in (a, b, c)], timeout=60)
+    with InputNode() as inp:
+        node = c.combine.bind(a.fwd.bind(inp), b.fwd.bind(inp))
+    cd = node.experimental_compile()
+    try:
+        # (x+1) + (x+2)
+        assert cd.execute(0, timeout=60) == 3
+        assert cd.execute(10, timeout=60) == 23
+    finally:
+        cd.teardown()
+
+
+def test_compiled_large_values_overflow_to_store(ray_start):
+    a = Adder.remote(1.0)
+    ray_tpu.get(a.fwd.remote(0), timeout=60)
+    with InputNode() as inp:
+        node = a.fwd.bind(inp)
+    cd = node.experimental_compile(buffer_size_bytes=4096)
+    try:
+        big = np.ones(100_000)  # ~800KB > 4KB slot: ships as a ref
+        out = cd.execute(big, timeout=120)
+        assert out.shape == big.shape
+        assert float(out[0]) == 2.0
+    finally:
+        cd.teardown()
+
+
+def test_compiled_rejects_plain_tasks(ray_start):
+    @ray_tpu.remote
+    def f(x):
+        return x
+
+    with InputNode() as inp:
+        node = f.bind(inp)
+    with pytest.raises(ValueError):
+        node.experimental_compile()
+
+
+def test_compiled_kwarg_nodes_are_wired(ray_start):
+    a = Adder.remote(5)
+    c = Adder.remote(0)
+    ray_tpu.get([a.fwd.remote(0), c.fwd.remote(0)], timeout=60)
+    with InputNode() as inp:
+        # DAG node passed by KEYWORD — must ride a channel, not pickle
+        # as a constant.
+        node = c.combine.bind(0, b=a.fwd.bind(inp))
+    cd = node.experimental_compile()
+    try:
+        assert cd.execute(1, timeout=60) == 6  # 0 + (1+5)
+        assert cd.execute(10, timeout=60) == 15
+    finally:
+        cd.teardown()
+
+
+def test_compiled_requires_input_edge(ray_start):
+    a = Adder.remote(1)
+    ray_tpu.get(a.fwd.remote(0), timeout=60)
+    node = a.fwd.bind(3)  # constant-only graph: nothing drives ticks
+    with pytest.raises(ValueError):
+        node.experimental_compile()

@@ -1,0 +1,72 @@
+"""Compiled-DAG pipeline across two node agents (separate arenas /
+sessions on one machine) — the cross-process pipeline-parallel shape
+(reference: test_accelerated_dag.py multi-actor pipelines)."""
+
+import time
+
+import pytest
+
+import ray_tpu
+from ray_tpu.dag import InputNode
+
+
+@ray_tpu.remote
+class Adder:
+    def __init__(self, add):
+        self.add = add
+
+    def fwd(self, x):
+        return x + self.add
+
+
+@pytest.fixture(scope="module")
+def two_agent_cluster():
+    """Head (hostA) + one node-agent subprocess (hostB) on this machine
+    — same shape as test_multihost's fixture, local to this module."""
+    import os
+    import subprocess
+    import sys
+
+    ray_tpu.init(num_cpus=2, num_tpus=0, resources={"hostA": 2})
+    from ray_tpu import api
+
+    head_port = api._global_node.port
+    agent = subprocess.Popen(
+        [sys.executable, "-m", "ray_tpu.core.node_agent",
+         "--head-host", "127.0.0.1", "--head-port", str(head_port),
+         "--num-cpus", "2", "--resources", '{"hostB": 2}',
+         "--object-store-memory", str(128 << 20)],
+        env=dict(os.environ),
+    )
+    deadline = time.monotonic() + 60
+    while time.monotonic() < deadline:
+        if ray_tpu.cluster_resources().get("hostB"):
+            break
+        if agent.poll() is not None:
+            raise RuntimeError("node agent exited during startup")
+        time.sleep(0.2)
+    else:
+        raise TimeoutError("node agent never joined")
+    yield agent
+    agent.terminate()
+    agent.wait(timeout=30)
+    ray_tpu.shutdown()
+
+
+def test_compiled_pipeline_across_two_node_agents(two_agent_cluster):
+    """Cross-process pipeline parallelism: stage actors pinned to two
+    different node agents (separate arenas/sessions), wired by shm
+    channels (same physical host — the channels' scope; cross-host
+    pipelines ride in-graph ICI collectives instead, see
+    parallel/pipeline.py)."""
+    s1 = Adder.options(resources={"hostA": 1}).remote(1)
+    s2 = Adder.options(resources={"hostB": 1}).remote(10)
+    ray_tpu.get([s1.fwd.remote(0), s2.fwd.remote(0)], timeout=120)
+    with InputNode() as inp:
+        node = s2.fwd.bind(s1.fwd.bind(inp))
+    cd = node.experimental_compile()
+    try:
+        for i in range(20):
+            assert cd.execute(i, timeout=120) == i + 11
+    finally:
+        cd.teardown()
