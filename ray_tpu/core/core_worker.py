@@ -405,7 +405,8 @@ class CoreWorker:
             "ping": self.h_ping,
         }
 
-    async def h_task_accepted(self, conn, payload):
+    def h_task_accepted(self, conn, payload):
+        # Sync notification handler (rpc fast path: no Task per frame).
         pending = self.pending_tasks.get(
             TaskID.from_hex(payload["task_id"]))
         if pending is not None:
@@ -1240,7 +1241,8 @@ class CoreWorker:
                 astate.inflight -= 1
                 self._on_actor_call_failure(astate, spec, error)
 
-    async def h_task_done(self, conn, payload):
+    def h_task_done(self, conn, payload):
+        # Sync notification handler (rpc fast path: no Task per frame).
         entry = self._outstanding_pushes.pop(payload["task_id"], None)
         if entry is None:
             return  # already failed via connection close, or cancelled

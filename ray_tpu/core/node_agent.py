@@ -118,10 +118,14 @@ class NodeAgent:
         existing = env.get("PYTHONPATH", "")
         parts = [pkg_root] + (existing.split(os.pathsep) if existing
                               else [])
-        from ray_tpu.core.scheduler import filter_worker_pythonpath
+        from ray_tpu.core.scheduler import (
+            apply_worker_bytecode_cache,
+            filter_worker_pythonpath,
+        )
 
         env["PYTHONPATH"] = os.pathsep.join(
             filter_worker_pythonpath(parts))
+        apply_worker_bytecode_cache(env)
         log_path = os.path.join(self.session_dir, "logs",
                                 f"worker-{worker_id[:12]}.log")
         os.makedirs(os.path.dirname(log_path), exist_ok=True)

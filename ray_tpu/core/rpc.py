@@ -93,7 +93,24 @@ class Connection:
                             fut.set_exception(RpcError(msg["e"]))
                         else:
                             fut.set_result(msg.get("d"))
-                elif t in ("req", "ntf"):
+                elif t == "ntf":
+                    handler = self.handlers.get(msg.get("m"))
+                    if handler is not None and not \
+                            asyncio.iscoroutinefunction(handler):
+                        # Sync fast path: notification handlers that
+                        # never await run inline — one asyncio Task per
+                        # tiny-task completion is the dominant loop
+                        # overhead at high task rates.
+                        try:
+                            handler(self, msg.get("d"))
+                        except Exception:
+                            logger.exception("notify handler %s failed",
+                                             msg.get("m"))
+                    else:
+                        asyncio.get_running_loop().create_task(
+                            self._dispatch(t, msg)
+                        )
+                elif t == "req":
                     asyncio.get_running_loop().create_task(
                         self._dispatch(t, msg)
                     )
@@ -122,19 +139,25 @@ class Connection:
         if t == "req":
             await self._send({"t": "res", "i": msg["i"], "d": result, "e": error})
 
-    async def _send(self, msg: dict):
+    def _enqueue_frame(self, msg: dict) -> bool:
+        """Append one frame to the coalescing buffer and schedule the
+        flush. Returns True when the transport is above the high-water
+        mark (caller decides how to backpressure). No awaits — the
+        frame append is atomic."""
         if self._closed:
             raise ConnectionLost(self.name, sent=False)
         data = msgpack.packb(msg, use_bin_type=True)
-        # Both appends happen before any await: the frame is atomic.
         self._outbuf.append(len(data).to_bytes(4, "little"))
         self._outbuf.append(data)
         if not self._flush_scheduled:
             self._flush_scheduled = True
             asyncio.get_running_loop().call_soon(self._flush)
         transport = self.writer.transport
-        if (transport is not None and
-                transport.get_write_buffer_size() > self.WRITE_HIGH_WATER):
+        return (transport is not None and
+                transport.get_write_buffer_size() > self.WRITE_HIGH_WATER)
+
+    async def _send(self, msg: dict):
+        if self._enqueue_frame(msg):
             self._flush()
             await self.writer.drain()
 
@@ -165,6 +188,23 @@ class Connection:
 
     async def notify(self, method: str, payload: Any = None):
         await self._send({"t": "ntf", "i": 0, "m": method, "d": payload})
+
+    def notify_nowait(self, method: str, payload: Any = None):
+        """Fire-and-forget notification without coroutine machinery —
+        the hot completion path sends one of these per finished task.
+        Backpressure degrades to an eager flush instead of awaiting
+        drain (small frames; the transport buffers)."""
+        if self._enqueue_frame({"t": "ntf", "i": 0, "m": method,
+                                "d": payload}):
+            self._flush()
+
+    def write_buffer_empty(self) -> bool:
+        """True when every flushed byte reached the kernel (the
+        transport's user-space buffer is drained)."""
+        if self._outbuf:
+            return False
+        transport = self.writer.transport
+        return transport is None or transport.get_write_buffer_size() == 0
 
     async def _teardown(self):
         if self._closed:

@@ -22,6 +22,7 @@ import logging
 import os
 import subprocess
 import sys
+import tempfile
 import time
 from dataclasses import dataclass, field
 from typing import Callable, Dict, List, Optional
@@ -82,6 +83,25 @@ class Node:
         self.resources = NodeResources(resources)
         self.labels = labels or {}
         self.state = "ALIVE"
+
+
+def apply_worker_bytecode_cache(env: dict) -> None:
+    """Give spawned workers a writable bytecode cache. Spawn cost is
+    dominated by module compilation when the environment disables
+    bytecode caching (PYTHONDONTWRITEBYTECODE is common in containers):
+    ~10s of compile() per worker for the jax import chain. The cache is
+    keyed by uid and created 0700 — a world-shared /tmp path would let
+    one user plant .pyc files that another user's workers execute."""
+    env.pop("PYTHONDONTWRITEBYTECODE", None)
+    cache = env.get("PYTHONPYCACHEPREFIX")
+    if not cache:
+        cache = os.path.join(tempfile.gettempdir(),
+                             f"ray_tpu_pycache-{os.getuid()}")
+        env["PYTHONPYCACHEPREFIX"] = cache
+    try:
+        os.makedirs(cache, mode=0o700, exist_ok=True)
+    except OSError:
+        env.pop("PYTHONPYCACHEPREFIX", None)
 
 
 def filter_worker_pythonpath(parts: List[str]) -> List[str]:
@@ -160,6 +180,7 @@ class WorkerPool:
                 ordered.append(p)
         env["PYTHONPATH"] = os.pathsep.join(
             filter_worker_pythonpath(ordered))
+        apply_worker_bytecode_cache(env)
         log_path = os.path.join(self.session_dir, "logs",
                                 f"worker-{worker_id.hex()[:12]}.log")
         os.makedirs(os.path.dirname(log_path), exist_ok=True)

@@ -41,6 +41,10 @@ from ray_tpu.core.task_spec import TaskSpec, TaskType
 
 logger = logging.getLogger(__name__)
 
+# Live cProfile instances keyed by dump path (RAY_TPU_WORKER_PROFILE);
+# dumped in main() before os._exit (atexit never runs there).
+_PROFILERS: dict = {}
+
 
 from ray_tpu.exceptions import ActorExitSignal  # noqa: E402 — see exceptions.py
 
@@ -72,6 +76,13 @@ class Executor:
         self._pending_events: list = []
         self._events_lock = threading.Lock()
         self._events_wake = False
+        # Result-delivery barrier: the executor thread must not start
+        # the NEXT task until the previous task's reply bytes reached
+        # the kernel — user code may os._exit() at any point, and a
+        # process death must never destroy an already-computed sibling
+        # result (at-most-once would silently burn the retry budget).
+        self._delivered = threading.Event()
+        self._delivered.set()
 
     def reconfigure(self, max_concurrency: int, is_async: bool):
         """Restart consumers with new settings (safe only while no task is
@@ -85,6 +96,7 @@ class Executor:
             self._sync_queue = None
             self._sync_thread = None
         self._started = False
+        self._delivered.set()  # never leave a new executor barriered
         self.ensure_started(max_concurrency, is_async)
 
     def ensure_started(self, max_concurrency: int = 1, is_async: bool = False):
@@ -111,6 +123,13 @@ class Executor:
     # ---- sync fast path ----
 
     def _sync_loop(self):
+        prof_path = os.environ.get("RAY_TPU_WORKER_PROFILE")
+        if prof_path:
+            import cProfile
+
+            prof = cProfile.Profile()
+            prof.enable()
+            _PROFILERS[f"{prof_path}.{os.getpid()}.sync"] = prof
         q = self._sync_queue
         while True:
             item = q.get()
@@ -121,6 +140,19 @@ class Executor:
             # the loop (usually while the task still runs), so a worker
             # death mid-task is distinguishable from died-in-queue.
             self._post_event(("ack", spec, None, None))
+            # Delivery barrier (see __init__): the PREVIOUS task's reply
+            # must hit the socket before this task's user code runs (it
+            # may os._exit). Placed after dequeue+ack so an empty queue
+            # absorbs the handoff for free — the loop drains while we
+            # block in q.get(). Normal tasks only: their retry budget is
+            # what a lost sibling result silently burns. Actor methods
+            # are not re-executed on actor death (stateful; the caller
+            # gets ActorDiedError either way), so they keep the fully
+            # pipelined path.
+            self._delivered.wait(timeout=10.0)
+            if (getattr(fut, "_rtpu_delivery_tracked", False)
+                    and spec.task_type == TaskType.NORMAL_TASK):
+                self._delivered.clear()
             try:
                 result = self._execute_sync(spec)
             except BaseException as e:  # incl. ActorExitSignal
@@ -144,8 +176,12 @@ class Executor:
             if kind == "ack":
                 conn = self._stream_conns.get(spec.task_id.hex())
                 if conn is not None:
-                    asyncio.ensure_future(self._notify_quiet(
-                        conn, spec.task_id.hex()))
+                    try:
+                        conn.notify_nowait(
+                            "task_accepted",
+                            {"task_id": spec.task_id.hex()})
+                    except Exception:
+                        pass
             elif kind == "result":
                 self._record_terminal(spec, payload)
                 if not fut.done():
@@ -218,6 +254,24 @@ class Executor:
         self.cw.record_task_event(
             spec, "FAILED" if reply.get("is_error") else "FINISHED")
 
+    def submit_nowait(self, spec: TaskSpec, conn=None) -> "asyncio.Future":
+        """Queue for execution and return the completion future — the
+        hot push path attaches a done-callback instead of paying an
+        awaiting coroutine per task. _stream_conns cleanup rides the
+        future's callback chain."""
+        fut = asyncio.get_running_loop().create_future()
+        fut._rtpu_delivery_tracked = True  # see _sync_loop barrier
+        self.cw.record_task_event(spec, "PENDING_EXECUTION")
+        key = spec.task_id.hex()
+        self._stream_conns[key] = conn
+        fut.add_done_callback(
+            lambda _f: self._stream_conns.pop(key, None))
+        if self._sync_queue is not None:
+            self._sync_queue.put((spec, fut))
+        else:
+            self._queue.put_nowait((spec, fut))
+        return fut
+
     async def submit(self, spec: TaskSpec, conn=None) -> dict:
         fut = asyncio.get_running_loop().create_future()
         self.cw.record_task_event(spec, "PENDING_EXECUTION")
@@ -251,6 +305,13 @@ class Executor:
         return args, kwargs
 
     def _load_callable(self, spec: TaskSpec):
+        # Sync cache hit first: the loop-thread round-trip below costs
+        # two thread hops per call, which at tiny-task rates was the
+        # single biggest executor cost (it paid even for functions
+        # fetched thousands of calls ago).
+        fn = self.cw._function_cache.get(spec.function_key)
+        if fn is not None:
+            return fn
         return self.cw.loop_thread.run(
             self.cw.fetch_function(spec.function_key)
         )
@@ -608,23 +669,50 @@ async def _amain():
                     "undecodable task spec in push_tasks batch")
         executor.ensure_started()
 
-        async def one(spec):
+        def finish(spec, fut):
             try:
-                reply = await executor.submit(spec, conn)
-            except ActorExitSignal:
-                asyncio.get_running_loop().create_task(
-                    _graceful_actor_exit())
-                reply = {"returns": [], "is_error": False}
-            except BaseException as e:  # noqa: B036 — must reach owner
-                reply = executor._package_error(spec, e)
+                e = fut.exception()
+            except asyncio.CancelledError:
+                # A real error reply: empty returns would leave the
+                # owner's return ObjectIDs unresolvable (get() hangs).
+                reply = executor._package_error(
+                    spec, exc.TaskCancelledError(
+                        f"task {spec.name} cancelled"))
+            else:
+                if e is None:
+                    reply = fut.result()
+                elif isinstance(e, ActorExitSignal):
+                    asyncio.get_running_loop().create_task(
+                        _graceful_actor_exit())
+                    reply = {"returns": [], "is_error": False}
+                else:
+                    reply = executor._package_error(spec, e)
             try:
-                await conn.notify("task_done", {
+                conn.notify_nowait("task_done", {
                     "task_id": spec.task_id.hex(), "reply": reply})
+                # Hand the bytes to the kernel NOW: the executor thread
+                # is barriered on delivery before it runs the next task
+                # (which may os._exit and take the outbuf with it).
+                conn._flush()
             except Exception:
                 pass  # owner gone; its failure handling owns the task
+            _release_delivery_barrier(conn)
+
+        def _release_delivery_barrier(conn):
+            """Release the executor only once the reply's bytes left
+            user space — under backpressure the transport buffers, and
+            an os._exit would still destroy a buffered reply."""
+            if conn.closed or conn.write_buffer_empty():
+                executor._delivered.set()
+                return
+            asyncio.get_running_loop().call_later(
+                0.005, _release_delivery_barrier, conn)
+
+        import functools
 
         for spec in specs:
-            asyncio.get_running_loop().create_task(one(spec))
+            fut = executor.submit_nowait(spec, conn)
+            fut.add_done_callback(functools.partial(finish, spec))
         return {"ok": True}
 
     async def h_create_actor(conn, payload):
@@ -721,10 +809,56 @@ def main():
         faulthandler.register(_signal.SIGUSR1, all_threads=True)
     except (AttributeError, ValueError):
         pass
+    # On-demand worker profiling (reference: profile_manager.py's
+    # py-spy hooks): RAY_TPU_WORKER_PROFILE=<path> dumps cProfile
+    # stats for the event loop (and .sync for the executor thread).
+    prof_path = os.environ.get("RAY_TPU_WORKER_PROFILE")
+    if prof_path:
+        import cProfile
+
+        _prof = cProfile.Profile()
+        _prof.enable()
+        _PROFILERS[f"{prof_path}.{os.getpid()}.loop"] = _prof
+    sample_path = os.environ.get("RAY_TPU_WORKER_SAMPLE")
+    if sample_path:
+        # Wall-clock sampler surviving SIGKILL: collapsed stacks of all
+        # threads, rewritten every 2s (py-spy-style, stdlib-only).
+        def _sampler():
+            import collections
+            import time as _t
+
+            counts: dict = collections.Counter()
+            last_dump = _t.monotonic()
+            while True:
+                _t.sleep(0.002)
+                for tid, frame in sys._current_frames().items():
+                    stack = []
+                    f = frame
+                    while f is not None and len(stack) < 30:
+                        stack.append(
+                            f"{f.f_code.co_filename.rsplit('/', 1)[-1]}"
+                            f":{f.f_code.co_name}")
+                        f = f.f_back
+                    counts[";".join(reversed(stack))] += 1
+                if _t.monotonic() - last_dump > 2:
+                    last_dump = _t.monotonic()
+                    with open(f"{sample_path}.{os.getpid()}.stacks",
+                              "w") as fh:
+                        for stack, n in counts.most_common(40):
+                            fh.write(f"{n} {stack}\n")
+
+        threading.Thread(target=_sampler, daemon=True,
+                         name="sampler").start()
     try:
         code = asyncio.run(_amain())
     except KeyboardInterrupt:
         code = 0
+    for path, prof in _PROFILERS.items():
+        try:
+            prof.disable()
+            prof.dump_stats(path)
+        except Exception:
+            pass
     # Skip interpreter teardown races from executor threads.
     os._exit(code or 0)
 
