@@ -89,6 +89,7 @@ def init(address: Optional[str] = None,
                 address = _read_cluster_address()
             worker = _connect_remote_driver(address, config, namespace)
             _global_worker = worker
+            _start_log_streaming(worker, config)
             return get_runtime_context()
 
         node_resources = detect_node_resources(num_cpus, num_tpus, resources)
@@ -97,7 +98,28 @@ def init(address: Optional[str] = None,
         _global_node = node
         _global_worker = worker
         _write_cluster_address(f"127.0.0.1:{node.port}")
+        _start_log_streaming(worker, config)
         return get_runtime_context()
+
+
+def _start_log_streaming(worker: CoreWorker, config: Config):
+    """Echo worker stdout/stderr at the driver (reference:
+    log_monitor.py -> worker prefix lines on the driver's console).
+    Every host's tailer publishes on ``worker_logs``; disable with
+    config log_to_driver=False or RAY_TPU_LOG_TO_DRIVER=0."""
+    if not config.log_to_driver:
+        return
+
+    def on_logs(data):
+        node = (data.get("node") or "?")[:8]
+        for worker_hex, lines in data.get("entries", []):
+            for line in lines:
+                print(f"(worker={worker_hex} node={node}) {line}")
+
+    try:
+        worker.subscribe("worker_logs", on_logs)
+    except Exception:
+        logger.debug("log streaming unavailable", exc_info=True)
 
 
 def _read_cluster_address() -> str:

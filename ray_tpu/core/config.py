@@ -50,6 +50,10 @@ class Config:
     # falls back to the python per-segment store if the build fails.
     use_native_object_store: bool = True
 
+    # Echo worker stdout/stderr on the driver console (reference:
+    # log_monitor.py streaming; RAY_TPU_LOG_TO_DRIVER=0 disables).
+    log_to_driver: bool = True
+
     # --- memory monitor (reference: memory_monitor.h:52) ---
     # Kill a worker when host used/limit memory crosses this fraction.
     memory_monitor_enabled: bool = True

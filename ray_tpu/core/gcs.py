@@ -322,6 +322,9 @@ class HeadService:
         return {"ok": True}
 
     async def _periodic_pump(self):
+        from ray_tpu.core.log_monitor import LogTailer
+
+        tailer = LogTailer(os.path.join(self.session_dir, "logs"))
         while not self._shutdown:
             try:
                 reaped = self.pool.reap_exited_starting()
@@ -332,6 +335,12 @@ class HeadService:
                 self._pump()
                 if self.config.memory_monitor_enabled:
                     self._memory_monitor().maybe_kill()
+                # Head-local workers' logs stream like any node's
+                # (node agents tail their own hosts).
+                entries = tailer.poll()
+                if entries:
+                    self._publish("worker_logs",
+                                  {"node": "head", "entries": entries})
             except Exception:
                 logger.exception("scheduler pump failed")
             if os.environ.get("RAY_TPU_DEBUG_PUMP"):

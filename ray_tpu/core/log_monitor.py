@@ -1,0 +1,68 @@
+"""Worker-log tailer: streams worker stdout/stderr to the driver.
+
+Reference: python/ray/_private/log_monitor.py:103 — a per-node monitor
+tails the session's worker log files and publishes new lines; drivers
+subscribe and echo them with a worker prefix, so ``print()`` inside a
+task shows up at the driver no matter which host ran it.
+
+Here the tailer is embedded in each process that owns worker logs (the
+head for its local pool, every node agent for its host) and publishes
+over the head's pubsub on the ``worker_logs`` channel.
+"""
+
+from __future__ import annotations
+
+import os
+from typing import Dict, List, Tuple
+
+#: Per-poll cap per file — a worker spamming output cannot wedge the
+#: control plane (the reference's monitor has the same guard).
+MAX_BYTES_PER_POLL = 64 << 10
+
+
+class LogTailer:
+    """Tracks read offsets over a directory of ``worker-*.log`` files
+    and returns new complete lines per poll."""
+
+    def __init__(self, logs_dir: str):
+        self.logs_dir = logs_dir
+        self._offsets: Dict[str, int] = {}
+        self._partial: Dict[str, bytes] = {}
+
+    def poll(self) -> List[Tuple[str, List[str]]]:
+        """-> [(worker_id_hex_prefix, new_lines)] since the last poll."""
+        out: List[Tuple[str, List[str]]] = []
+        try:
+            names = os.listdir(self.logs_dir)
+        except OSError:
+            return out
+        for name in names:
+            if not (name.startswith("worker-") and name.endswith(".log")):
+                continue
+            path = os.path.join(self.logs_dir, name)
+            worker = name[len("worker-"):-len(".log")]
+            try:
+                size = os.path.getsize(path)
+            except OSError:
+                continue
+            offset = self._offsets.get(name, 0)
+            if size < offset:
+                offset = 0  # truncated/rotated: start over
+            if size == offset:
+                continue
+            try:
+                with open(path, "rb") as f:
+                    f.seek(offset)
+                    data = f.read(MAX_BYTES_PER_POLL)
+            except OSError:
+                continue
+            self._offsets[name] = offset + len(data)
+            data = self._partial.pop(name, b"") + data
+            *lines, tail = data.split(b"\n")
+            if tail:
+                self._partial[name] = tail
+            if lines:
+                out.append((worker, [
+                    ln.decode("utf-8", errors="replace") for ln in lines
+                ]))
+        return out

@@ -189,7 +189,9 @@ class NodeAgent:
 
     async def _reap_loop(self):
         from ray_tpu.core import memory_monitor as mm
+        from ray_tpu.core.log_monitor import LogTailer
 
+        tailer = LogTailer(os.path.join(self.session_dir, "logs"))
         config = get_config()
         monitor = None
         if config.memory_monitor_enabled:
@@ -217,6 +219,18 @@ class NodeAgent:
                             {"worker_id": worker_id})
                     except Exception:
                         pass
+            # Stream new worker output to subscribed drivers
+            # (reference: log_monitor.py publishing to GCS pubsub).
+            entries = tailer.poll()
+            if entries:
+                try:
+                    await self.head_conn.call("publish", {
+                        "channel": "worker_logs",
+                        "data": {"node": self.node_id_hex or "",
+                                 "entries": entries},
+                    })
+                except Exception:
+                    pass
             if monitor is not None:
                 try:
                     killed = monitor.maybe_kill()

@@ -572,6 +572,136 @@ def _node_store_put(object_id: ObjectID, size: int, fill, pack_bytes,
     return size
 
 
+#: node_store_reserve sentinel: the object is already present locally.
+ALREADY_PRESENT = object()
+
+
+class NodeStoreWriter:
+    """Pre-allocated destination for a streaming ingest (cross-node
+    pull): chunks land in place at their offsets, so a multi-GiB pull
+    holds one chunk of Python memory, not the whole object (reference:
+    object_manager.cc writes chunks straight into the plasma create
+    buffer)."""
+
+    def __init__(self, kind: str, object_id: ObjectID, size: int,
+                 arena=None, idx=None, view=None, seg=None, path=None,
+                 final_path=None):
+        self._kind = kind  # "arena" | "shm" | "spill"
+        self._object_id = object_id
+        self._size = size
+        self._arena = arena
+        self._idx = idx
+        self._view = view
+        self._seg = seg
+        self._path = path          # spill: TEMP path during ingest
+        self._final_path = final_path
+        self._file = open(path, "r+b") if kind == "spill" else None
+        # shm segments have no create/seal state machine: readers gate
+        # on HEADER_MAGIC, so the magic bytes are withheld until seal().
+        self._magic: Optional[bytes] = None
+
+    def write_at(self, offset: int, data) -> None:
+        if self._kind == "spill":
+            os.pwrite(self._file.fileno(), bytes(data), offset)
+            return
+        mv = memoryview(data).cast("B")
+        if self._kind == "shm" and offset == 0 and mv.nbytes >= 4:
+            self._magic = bytes(mv[:4])
+            mv = mv[4:]
+            offset = 4
+            if not mv.nbytes:
+                return
+        buf = self._view if self._kind == "arena" else self._seg.buf
+        buf[offset:offset + mv.nbytes] = mv
+
+    def seal(self) -> None:
+        if self._kind == "arena":
+            del self._view
+            self._arena.seal_reserved(self._idx,
+                                      self._object_id.binary(),
+                                      pin_primary=False)
+        elif self._kind == "shm":
+            if self._magic is not None:
+                self._seg.buf[0:4] = self._magic  # publish LAST
+            self._seg.close()
+        else:
+            self._file.close()
+            os.replace(self._path, self._final_path)
+
+    def abort(self) -> None:
+        """Discard a partial ingest (holder died / chunk missing)."""
+        try:
+            if self._kind == "arena":
+                del self._view
+                # Delete FIRST (store.cc handles kCreated: marks the
+                # entry zombie), THEN seal — which returns TS_ESTATE and
+                # frees. Seal-then-delete would expose the garbage as a
+                # briefly-readable sealed object.
+                self._arena.delete(self._object_id.binary())
+                self._arena.seal_reserved(self._idx,
+                                          self._object_id.binary(),
+                                          pin_primary=False)
+            elif self._kind == "shm":
+                # Magic never published: readers always saw not-ready.
+                self._seg.close()
+                _unlink_segment(self._object_id.hex())
+            else:
+                self._file.close()
+                os.remove(self._path)
+        except Exception:
+            pass
+
+
+def node_store_reserve(object_id: ObjectID, size: int):
+    """Allocate a local destination of ``size`` bytes for a streaming
+    ingest. Returns a NodeStoreWriter, or ALREADY_PRESENT when a local
+    copy exists (concurrent pull landed first)."""
+    from ray_tpu.core import native_store
+
+    arena = native_store.get_attached_arena()
+    if arena is not None:
+        try:
+            reserved = arena.create_reserve(object_id.binary(), size)
+        except ObjectStoreFullError:
+            reserved = None  # overflow: spill destination below
+        if reserved is not None:
+            idx, view = reserved
+            return NodeStoreWriter("arena", object_id, size,
+                                   arena=arena, idx=idx, view=view)
+        if arena.contains(object_id.binary()):
+            return ALREADY_PRESENT
+    else:
+        try:
+            seg = shared_memory.SharedMemory(
+                name=segment_name(object_id), create=True,
+                size=max(size, 1))
+            return NodeStoreWriter("shm", object_id, size, seg=seg)
+        except FileExistsError:
+            # The segment may belong to a STILL-RUNNING concurrent
+            # ingest in another process (puller dedup is per-process).
+            # Only a published magic marks it complete; otherwise join
+            # the ingest — both writers write identical bytes of the
+            # same sealed object, and whichever seal()s first publishes.
+            try:
+                seg = shared_memory.SharedMemory(
+                    name=segment_name(object_id))
+            except OSError:
+                return ALREADY_PRESENT  # vanished: freed after seal
+            if bytes(seg.buf[:4]) == ShmStore.HEADER_MAGIC:
+                seg.close()
+                return ALREADY_PRESENT
+            return NodeStoreWriter("shm", object_id, size, seg=seg)
+        except OSError:
+            pass  # /dev/shm full: spill destination
+    final_path = _spill_path(object_id)
+    os.makedirs(os.path.dirname(final_path), exist_ok=True)
+    tmp = final_path + f".ingest{os.getpid()}"
+    with open(tmp, "wb") as f:
+        f.truncate(size)
+    return NodeStoreWriter("spill", object_id, size, path=tmp,
+                           final_path=final_path)
+
+
 def node_store_open(object_id: ObjectID) -> Optional[SerializedObject]:
     """Worker-side zero-copy read from the node store (arena or
     per-segment shm, falling back to the disk spill area)."""

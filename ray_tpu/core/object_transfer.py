@@ -25,7 +25,7 @@ import asyncio
 import logging
 from typing import Awaitable, Callable, Dict, List, Optional, Tuple
 
-from ray_tpu.core import object_store
+from ray_tpu.core import object_store, rpc
 from ray_tpu.core.ids import ObjectID
 
 logger = logging.getLogger(__name__)
@@ -55,8 +55,10 @@ def serve_handlers() -> dict:
             return {"found": False}
         off = int(payload["offset"])
         ln = int(payload["length"])
-        return {"found": True, "data": bytes(data[off:off + ln]),
-                "total": len(data)}
+        # Raw-attachment reply: the chunk is a zero-copy slice of the
+        # sealed payload all the way into the transport.
+        return rpc.WithAttachment(
+            {"found": True, "total": len(data)}, data[off:off + ln])
 
     return {
         "fetch_object_meta": h_fetch_object_meta,
@@ -125,9 +127,15 @@ class ObjectPuller:
         if not meta.get("found"):
             return False
         total = meta["size"]
-        chunks: List[bytes] = []
-        offset = 0
-        while offset < total:
+        # Reserve the destination up front and stream chunks INTO it
+        # with a windowed in-flight budget (push_manager.h:30): memory
+        # stays constant for a multi-GiB object, and chunk requests
+        # overlap instead of serializing on one round-trip each.
+        writer = object_store.node_store_reserve(object_id, total)
+        if writer is object_store.ALREADY_PRESENT:
+            return True  # a concurrent pull landed first
+
+        async def fetch(offset: int) -> None:
             ln = min(CHUNK_BYTES, total - offset)
             async with _sem_guard(self._budget):
                 reply = await conn.call("fetch_object_chunk", {
@@ -135,15 +143,36 @@ class ObjectPuller:
                     "offset": offset, "length": ln,
                 })
             if not reply.get("found"):
-                return False  # holder evicted it mid-pull
-            chunk = reply["data"]
-            chunks.append(chunk)
-            offset += len(chunk)
-            if len(chunk) < ln:
-                return False  # truncated: holder's copy shrank?
-        data = b"".join(chunks)
-        object_store.node_store_write_packed(object_id, data, primary=False)
-        return True
+                raise _PullAborted("holder evicted the object mid-pull")
+            chunk = reply.get("__attachment__", b"")
+            if len(chunk) != ln:
+                raise _PullAborted("truncated chunk")
+            writer.write_at(offset, chunk)
+
+        sealed = False
+        try:
+            results = await asyncio.gather(
+                *(fetch(off) for off in range(0, total, CHUNK_BYTES)),
+                return_exceptions=True)
+            failure = next(
+                (r for r in results if isinstance(r, Exception)), None)
+            if failure is not None:
+                if isinstance(failure, _PullAborted):
+                    return False
+                raise failure  # connection-level: try next holder
+            writer.seal()
+            sealed = True
+            return True
+        finally:
+            if not sealed:
+                # Covers failures AND cancellation (gather re-raises
+                # CancelledError past return_exceptions): a reserved
+                # arena slot left unsealed would leak capacity forever.
+                writer.abort()
+
+
+class _PullAborted(Exception):
+    """The holder's copy disappeared or shrank mid-pull."""
 
 
 class _sem_guard:

@@ -49,6 +49,26 @@ class ConnectionLost(RpcError):
         self.sent = sent
 
 
+# StreamReader buffer: the data plane ships MiB chunk frames; the
+# default 64KB limit turns each into ~16 small reads + wakeups.
+READ_LIMIT = 8 << 20
+
+
+class WithAttachment:
+    """Handler return wrapper: ``payload`` rides the msgpack frame,
+    ``attachment`` (bytes/memoryview) rides after it as a RAW sidecar —
+    the data plane's bulk bytes skip the msgpack pack/unpack copies and
+    the coalescing join (reference: the object manager's dedicated data
+    plane vs the gRPC control plane). The receiver finds the bytes under
+    ``payload["__attachment__"]``."""
+
+    __slots__ = ("payload", "attachment")
+
+    def __init__(self, payload, attachment):
+        self.payload = payload
+        self.attachment = attachment
+
+
 class Connection:
     """One bidirectional peer connection."""
 
@@ -85,6 +105,19 @@ class Connection:
                     raise RpcError(f"frame too large: {length}")
                 body = await self.reader.readexactly(length)
                 msg = msgpack.unpackb(body, raw=False)
+                if msg.pop("b", False):
+                    # Raw sidecar attachment follows the frame.
+                    blen = int.from_bytes(
+                        await self.reader.readexactly(8), "little")
+                    if blen > MAX_FRAME:
+                        raise RpcError(
+                            f"attachment too large: {blen}")
+                    blob = await self.reader.readexactly(blen)
+                    d = msg.get("d")
+                    if not isinstance(d, dict):
+                        d = {} if d is None else {"value": d}
+                        msg["d"] = d
+                    d["__attachment__"] = blob
                 t = msg["t"]
                 if t == "res":
                     fut = self._pending.pop(msg["i"], None)
@@ -137,18 +170,29 @@ class Connection:
                 logger.exception("handler %s failed", method)
                 error = f"{type(e).__name__}: {e}"
         if t == "req":
-            await self._send({"t": "res", "i": msg["i"], "d": result, "e": error})
+            attachment = None
+            if isinstance(result, WithAttachment):
+                attachment = result.attachment
+                result = result.payload
+            await self._send({"t": "res", "i": msg["i"], "d": result,
+                              "e": error}, attachment)
 
-    def _enqueue_frame(self, msg: dict) -> bool:
-        """Append one frame to the coalescing buffer and schedule the
-        flush. Returns True when the transport is above the high-water
-        mark (caller decides how to backpressure). No awaits — the
-        frame append is atomic."""
+    def _enqueue_frame(self, msg: dict, attachment=None) -> bool:
+        """Append one frame (plus optional raw attachment) to the
+        coalescing buffer and schedule the flush. Returns True when the
+        transport is above the high-water mark (caller decides how to
+        backpressure). No awaits — the frame append is atomic."""
         if self._closed:
             raise ConnectionLost(self.name, sent=False)
+        if attachment is not None:
+            msg["b"] = True
         data = msgpack.packb(msg, use_bin_type=True)
         self._outbuf.append(len(data).to_bytes(4, "little"))
         self._outbuf.append(data)
+        if attachment is not None:
+            mv = memoryview(attachment).cast("B")
+            self._outbuf.append(mv.nbytes.to_bytes(8, "little"))
+            self._outbuf.append(mv)  # flushed without joining (below)
         if not self._flush_scheduled:
             self._flush_scheduled = True
             asyncio.get_running_loop().call_soon(self._flush)
@@ -156,8 +200,8 @@ class Connection:
         return (transport is not None and
                 transport.get_write_buffer_size() > self.WRITE_HIGH_WATER)
 
-    async def _send(self, msg: dict):
-        if self._enqueue_frame(msg):
+    async def _send(self, msg: dict, attachment=None):
+        if self._enqueue_frame(msg, attachment):
             self._flush()
             await self.writer.drain()
 
@@ -165,10 +209,22 @@ class Connection:
         self._flush_scheduled = False
         if self._closed or not self._outbuf:
             return
-        data = b"".join(self._outbuf)
-        self._outbuf.clear()
+        pieces, self._outbuf = self._outbuf, []
+        # Coalesce small control frames into one write, but hand bulk
+        # attachment buffers to the transport directly — joining a MiB
+        # chunk would re-copy the entire data plane.
+        small: list = []
         try:
-            self.writer.write(data)
+            for piece in pieces:
+                if len(piece) >= (64 << 10):
+                    if small:
+                        self.writer.write(b"".join(small))
+                        small = []
+                    self.writer.write(piece)
+                else:
+                    small.append(piece)
+            if small:
+                self.writer.write(b"".join(small))
         except Exception:
             pass  # the read loop notices the broken pipe and tears down
 
@@ -249,7 +305,11 @@ class Server:
         self.on_connect: Optional[Callable[[Connection], None]] = None
 
     async def start(self, host: str = "127.0.0.1", port: int = 0) -> int:
-        self._server = await asyncio.start_server(self._on_client, host, port)
+        # Large read buffer: the data plane ships MiB chunk frames, and
+        # the default 64KB StreamReader limit turns each into ~16 small
+        # reads + wakeups.
+        self._server = await asyncio.start_server(
+            self._on_client, host, port, limit=READ_LIMIT)
         return self._server.sockets[0].getsockname()[1]
 
     async def _on_client(self, reader, writer):
@@ -273,7 +333,7 @@ class Server:
 async def connect(host: str, port: int, handlers: Optional[Dict[str, Handler]] = None,
                   name: str = "client", timeout: float = 10.0) -> Connection:
     reader, writer = await asyncio.wait_for(
-        asyncio.open_connection(host, port), timeout
+        asyncio.open_connection(host, port, limit=READ_LIMIT), timeout
     )
     conn = Connection(reader, writer, handlers or {}, name=name)
     conn.start()

@@ -322,16 +322,39 @@ class Executor:
 
         Reference: _private/runtime_env plugins. Supported here:
         env_vars (os.environ overlay), working_dir (chdir + sys.path),
-        py_modules (sys.path). pip/conda/container need package installs
-        and are gated out in this runtime.
+        py_modules (sys.path), pip (venv-per-hash with a refcounted
+        cache — runtime_env_pip.py). conda/container are gated out.
         """
         if not runtime_env:
             return lambda: None
         unsupported = set(runtime_env) - {"env_vars", "working_dir",
-                                          "py_modules"}
+                                          "py_modules", "pip"}
         if unsupported:
             raise exc.RayTpuError(
                 f"unsupported runtime_env keys: {sorted(unsupported)}")
+        pip_ctx = None
+        pip_pkgs = runtime_env.get("pip")
+        if pip_pkgs:
+            from ray_tpu.core.runtime_env_pip import PipEnvContext
+
+            try:
+                pip_ctx = PipEnvContext(list(pip_pkgs))
+                pip_ctx.__enter__()
+            except Exception as e:
+                raise exc.RuntimeEnvSetupError(
+                    f"pip runtime env {pip_pkgs} failed: {e}")
+        try:
+            return Executor._apply_rest_of_runtime_env(runtime_env,
+                                                       pip_ctx)
+        except BaseException:
+            # A failing env_vars/working_dir must not leak the pip
+            # env's sys.path entry and cache refcount.
+            if pip_ctx is not None:
+                pip_ctx.__exit__(None, None, None)
+            raise
+
+    @staticmethod
+    def _apply_rest_of_runtime_env(runtime_env: dict, pip_ctx):
         saved_env = {}
         added_paths = []
         saved_cwd = None
@@ -366,6 +389,8 @@ class Executor:
                     sys.path.remove(p)
                 except ValueError:
                     pass
+            if pip_ctx is not None:
+                pip_ctx.__exit__(None, None, None)
 
         return undo
 

@@ -142,3 +142,23 @@ def test_two_host_trainer_gang(two_host_cluster):
     )
     result = trainer.fit()
     assert result.metrics["world"] == 2
+
+
+def test_remote_worker_logs_stream_to_driver(two_host_cluster, capfd):
+    """print() in a task on the OTHER host shows up on the driver's
+    console with a worker prefix (reference: log_monitor.py:103)."""
+    @ray_tpu.remote(resources={"hostB": 1})
+    def shout():
+        print("MULTIHOST-LOG-MARKER hello")
+        return 1
+
+    assert ray_tpu.get(shout.remote(), timeout=120) == 1
+    deadline = time.monotonic() + 15
+    seen = ""
+    while time.monotonic() < deadline:
+        seen += capfd.readouterr().out
+        if "MULTIHOST-LOG-MARKER" in seen:
+            break
+        time.sleep(0.3)
+    assert "MULTIHOST-LOG-MARKER" in seen
+    assert "worker=" in seen

@@ -1,0 +1,125 @@
+"""Pip runtime environments (reference strategy: runtime_env pip plugin
+tests — conflicting dependency sets run concurrently on one node, env
+cache is refcounted and GCed). Offline: wheels are hand-rolled zips
+installed via --no-index --find-links."""
+
+import base64
+import hashlib
+import os
+import zipfile
+
+import pytest
+
+from ray_tpu.core import runtime_env_pip as rep
+
+
+def _make_wheel(dirpath: str, name: str, version: str) -> str:
+    """Hand-roll a valid py3-none-any wheel with one module exposing
+    __version__ (no network, no build backend)."""
+    wheel = os.path.join(dirpath, f"{name}-{version}-py3-none-any.whl")
+    code = f'__version__ = "{version}"\n'
+    dist = f"{name}-{version}.dist-info"
+    metadata = (f"Metadata-Version: 2.1\nName: {name}\n"
+                f"Version: {version}\n")
+    wheel_meta = ("Wheel-Version: 1.0\nGenerator: ray-tpu-test\n"
+                  "Root-Is-Purelib: true\nTag: py3-none-any\n")
+
+    def record_line(path, data):
+        digest = base64.urlsafe_b64encode(
+            hashlib.sha256(data.encode()).digest()).rstrip(b"=").decode()
+        return f"{path},sha256={digest},{len(data)}"
+
+    record = "\n".join([
+        record_line(f"{name}.py", code),
+        record_line(f"{dist}/METADATA", metadata),
+        record_line(f"{dist}/WHEEL", wheel_meta),
+        f"{dist}/RECORD,,",
+    ]) + "\n"
+    with zipfile.ZipFile(wheel, "w") as z:
+        z.writestr(f"{name}.py", code)
+        z.writestr(f"{dist}/METADATA", metadata)
+        z.writestr(f"{dist}/WHEEL", wheel_meta)
+        z.writestr(f"{dist}/RECORD", record)
+    return wheel
+
+
+@pytest.fixture()
+def wheel_house(tmp_path, monkeypatch):
+    house = tmp_path / "wheels"
+    house.mkdir()
+    _make_wheel(str(house), "rtpu_testdep", "1.0.0")
+    _make_wheel(str(house), "rtpu_testdep", "2.0.0")
+    monkeypatch.setenv("RAY_TPU_PIP_FIND_LINKS", str(house))
+    monkeypatch.setenv("RAY_TPU_SESSION_DIR", str(tmp_path / "sess"))
+    return str(house)
+
+
+def test_ensure_env_and_cache(wheel_house, tmp_path):
+    sp1 = rep.ensure_env(["rtpu_testdep==1.0.0"])
+    assert os.path.isdir(sp1)
+    assert os.path.exists(os.path.join(sp1, "rtpu_testdep.py"))
+    # Idempotent: second call reuses the ready env.
+    assert rep.ensure_env(["rtpu_testdep==1.0.0"]) == sp1
+    # Different deps, different env.
+    sp2 = rep.ensure_env(["rtpu_testdep==2.0.0"])
+    assert sp2 != sp1
+
+
+def test_pip_context_isolates_and_unloads(wheel_house):
+    import sys
+
+    with rep.PipEnvContext(["rtpu_testdep==1.0.0"]):
+        import rtpu_testdep
+
+        assert rtpu_testdep.__version__ == "1.0.0"
+    assert "rtpu_testdep" not in sys.modules
+    with rep.PipEnvContext(["rtpu_testdep==2.0.0"]):
+        import rtpu_testdep
+
+        assert rtpu_testdep.__version__ == "2.0.0"
+    assert "rtpu_testdep" not in sys.modules
+
+
+def test_gc_unused_respects_refcounts(wheel_house):
+    rep.ensure_env(["rtpu_testdep==1.0.0"])
+    rep.ensure_env(["rtpu_testdep==2.0.0"])
+    with rep.PipEnvContext(["rtpu_testdep==1.0.0"]):
+        deleted = rep.gc_unused(max_envs=0)
+        # The active env survives; the idle one is collectable.
+        live = rep.env_dir(["rtpu_testdep==1.0.0"])
+        assert live not in deleted
+        assert os.path.isdir(live)
+
+
+def test_conflicting_pip_envs_concurrently(wheel_house):
+    """Two tasks with CONFLICTING pip deps run concurrently on one
+    node: the env hash is part of the scheduling key, so they land on
+    different workers, each importing its own version."""
+    import ray_tpu
+
+    ray_tpu.init(num_cpus=4, num_tpus=0)
+    try:
+
+        @ray_tpu.remote(runtime_env={"pip": ["rtpu_testdep==1.0.0"]})
+        def v1():
+            import time
+
+            import rtpu_testdep
+
+            time.sleep(0.5)  # force temporal overlap with v2
+            return rtpu_testdep.__version__
+
+        @ray_tpu.remote(runtime_env={"pip": ["rtpu_testdep==2.0.0"]})
+        def v2():
+            import time
+
+            import rtpu_testdep
+
+            time.sleep(0.5)
+            return rtpu_testdep.__version__
+
+        out = ray_tpu.get([v1.remote(), v2.remote(),
+                           v1.remote(), v2.remote()], timeout=240)
+        assert out == ["1.0.0", "2.0.0", "1.0.0", "2.0.0"]
+    finally:
+        ray_tpu.shutdown()
