@@ -1,0 +1,9 @@
+#!/bin/bash
+# ThreadSanitizer gate for the shared-memory arena (reference: the C++
+# core's --config=tsan builds). Fails on any data race or stress error.
+set -euo pipefail
+cd "$(dirname "$0")"
+mkdir -p build
+g++ -O1 -g -fsanitize=thread -fPIC -std=c++17 -pthread \
+    store.cc store_stress.cc -o build/store_stress_tsan -lrt
+TSAN_OPTIONS="halt_on_error=1 exitcode=66" ./build/store_stress_tsan

@@ -414,6 +414,18 @@ class RemoteFunction:
         cw = _require_worker()
         opts = self._options
         function_key = cw.export_function(self._fn)
+        # Distributed tracing: the active span's context rides a hidden
+        # kwarg (reference: tracing_helper's _ray_trace_ctx) so the
+        # worker's execution span parents to this submission. Args, not
+        # runtime_env — the env is part of the scheduling key and a
+        # per-trace env would defeat worker reuse.
+        from ray_tpu.util import tracing as _tracing
+
+        if _tracing.is_enabled():
+            carrier = _tracing.inject_context()
+            if carrier:
+                kwargs = dict(kwargs)
+                kwargs["_rtpu_trace_ctx"] = carrier
         task_args = cw.serialize_args(args, kwargs)
         n = opts["num_returns"]
         if n == "streaming":

@@ -405,11 +405,28 @@ class Executor:
                 raise exc.TaskCancelledError(f"task {spec.name} cancelled")
             undo_env = self._apply_runtime_env(spec.runtime_env)
             args, kwargs = self._resolve_args(spec)
+            trace_ctx = (kwargs.pop("_rtpu_trace_ctx", None)
+                         if isinstance(kwargs, dict) else None)
+            if trace_ctx is not None:
+                # The carrier's presence proves the driver enabled
+                # tracing — don't depend on env-flag inheritance (warm
+                # workers / agent-spawned workers predate the driver).
+                from ray_tpu.util import tracing as _tracing
+
+                _tracing.setup_tracing("ray_tpu.worker")
             if spec.task_type == TaskType.NORMAL_TASK:
                 fn = self._load_callable(spec)
                 if spec.num_returns == TaskSpec.STREAMING:
+                    if trace_ctx is not None:
+                        with _tracing.task_span(spec.name, trace_ctx):
+                            return self._execute_streaming(
+                                spec, fn, args, kwargs)
                     return self._execute_streaming(spec, fn, args, kwargs)
-                value = fn(*args, **kwargs)
+                if trace_ctx is not None:
+                    with _tracing.task_span(spec.name, trace_ctx):
+                        value = fn(*args, **kwargs)
+                else:
+                    value = fn(*args, **kwargs)
             elif spec.task_type == TaskType.ACTOR_CREATION_TASK:
                 cls = self._load_callable(spec)
                 self.actor_instance = cls(*args, **kwargs)

@@ -121,11 +121,15 @@ def _push_shuffle_map(block: Block, reducers, shuffle_id: str,
     n = acc.num_rows()
     rng = np.random.default_rng(seed)
     assign = rng.integers(0, n_out, size=n)
-    acks = []
+    # ONE push per (map, reducer) carrying every owned partition: at
+    # n_out=64 the per-partition accept calls (n_in x n_out RPCs) cost
+    # more than the shuffle itself.
+    by_reducer: dict = {}
     for j in range(n_out):
         part = acc.take_indices(np.nonzero(assign == j)[0])
-        acks.append(reducers[j % len(reducers)].accept.remote(
-            shuffle_id, map_idx, j, part))
+        by_reducer.setdefault(j % len(reducers), {})[j] = part
+    acks = [reducers[r].accept_many.remote(shuffle_id, map_idx, parts)
+            for r, parts in by_reducer.items()]
     # Delivery barrier: the map only reports done once every reducer has
     # its fragments, so finish() can never race a straggler fragment.
     ray_tpu.get(acks, timeout=600)
@@ -166,6 +170,15 @@ class _ShuffleReducer:
         if len(frags) >= 16:
             self.parts[(shuffle_id, j)] = [concat_blocks(frags)]
         return len(frags)
+
+    def accept_many(self, shuffle_id: str, map_key,
+                    parts: dict) -> int:
+        """Batched accept: every partition this reducer owns from one
+        map task in a single call (same idempotence per partition)."""
+        total = 0
+        for j, part in parts.items():
+            total += self.accept(shuffle_id, map_key, j, part)
+        return total
 
     def finish(self, shuffle_id: str, j: int, seed, last: bool = False):
         """Emit partition j. `last` marks this reducer's final owned

@@ -44,9 +44,10 @@ class VectorEnv:
              ) -> Tuple[np.ndarray, np.ndarray, np.ndarray, np.ndarray]:
         """-> (obs, rewards, terminateds, truncateds). Auto-resets done
         sub-envs (the returned obs for done envs is the fresh reset).
-        After each step, ``self.final_obs`` holds the pre-reset
-        observation batch — rows are meaningful where done — so runners
-        can bootstrap V(s_final) for time-limit truncations."""
+        When any sub-env TRUNCATED this step, ``self.final_obs`` holds
+        the pre-reset observation batch (rows meaningful where done) so
+        runners can bootstrap V(s_final); otherwise it is None — envs
+        should not pay a second render on the common path."""
         raise NotImplementedError
 
     final_obs: Optional[np.ndarray] = None
@@ -107,7 +108,8 @@ class CartPoleVecEnv(VectorEnv):
         truncated = self.steps >= self.MAX_STEPS
         reward = np.ones(self.num_envs, np.float32)
         done = terminated | truncated
-        self.final_obs = self.state.copy()
+        self.final_obs = (self.state.copy() if truncated.any()
+                          else None)
         if done.any():
             n = int(done.sum())
             self.state[done] = self._sample_state(n)
@@ -145,7 +147,7 @@ class GridWorldVecEnv(VectorEnv):
         truncated = self.steps >= 3 * self.length
         reward = np.where(terminated, 1.0, -0.01).astype(np.float32)
         done = terminated | truncated
-        self.final_obs = self._obs()
+        self.final_obs = self._obs() if truncated.any() else None
         if done.any():
             self.pos[done] = 0
             self.steps[done] = 0
@@ -192,7 +194,10 @@ class PixelGridWorldVecEnv(VectorEnv):
         truncated = self.steps >= 8 * self.size
         reward = np.where(terminated, 1.0, -0.01).astype(np.float32)
         done = terminated | truncated
-        self.final_obs = self._obs()
+        # final_obs (pre-reset observation, for time-limit bootstraps)
+        # is rendered only when a truncation actually happened — the
+        # common-path step renders ONCE, not twice.
+        self.final_obs = self._obs() if truncated.any() else None
         if done.any():
             self.pos[done] = 0
             self.steps[done] = 0

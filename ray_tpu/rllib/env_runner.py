@@ -45,8 +45,12 @@ class EnvRunner:
         import jax
 
         T, B = self.rollout_length, self.env.num_envs
-        obs_buf = np.empty((T, B) + tuple(self.env.observation_space.shape),
-                           np.float32)
+        # Keep the env's dtype: casting uint8 pixels to float32 here
+        # quadruples rollout memory traffic; the module's encoder
+        # normalizes once on device (rl_module.py: /255).
+        obs_buf = np.empty(
+            (T, B) + tuple(self.env.observation_space.shape),
+            self.env.observation_space.dtype)
         act_buf = np.empty((T, B), np.int32)
         logp_buf = np.empty((T, B), np.float32)
         vf_buf = np.empty((T, B), np.float32)

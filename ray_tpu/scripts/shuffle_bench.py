@@ -32,13 +32,22 @@ def main():
     p = argparse.ArgumentParser()
     p.add_argument("--rows", type=int, default=200_000)
     p.add_argument("--blocks", type=int, default=16)
+    p.add_argument("--nodes", type=int, default=1,
+                   help="virtual nodes (fake multi-node cluster); the "
+                        "pull shuffle's n_in x n_out fan-out only bites "
+                        "with real scheduling spread")
     p.add_argument("--json", default=None)
     args = p.parse_args()
 
     import ray_tpu
 
     if not ray_tpu.is_initialized():
-        ray_tpu.init(num_cpus=4, num_tpus=0)
+        ray_tpu.init(num_cpus=2, num_tpus=0)
+    if args.nodes > 1:
+        from ray_tpu import api
+
+        for _ in range(args.nodes - 1):
+            api._global_node.add_node({"CPU": 2.0})
 
     # Warmup both paths (worker spawn + import; reducer-pool startup).
     run_one("pull", 1000, 2)
@@ -48,6 +57,7 @@ def main():
     result = {
         "rows": args.rows,
         "blocks": args.blocks,
+        "nodes": args.nodes,
         "pull_seconds": round(pull_s, 3),
         "push_seconds": round(push_s, 3),
         "push_speedup": round(pull_s / push_s, 3),

@@ -1,0 +1,100 @@
+"""gRPC ingress for Serve deployments.
+
+Reference: python/ray/serve/_private/proxy.py:542 (gRPCProxy — a second
+ingress sharing the HTTP proxy's routing/assignment machinery). The
+reference requires user-supplied protobuf servicers; here the ingress
+is schema-light: a **generic unary service** at
+
+    /ray_tpu.serve.UserDefinedService/<app_or_route>
+
+whose request/response payloads are pickled Python values — any client
+with grpcio calls deployments without compiling protos:
+
+    import grpc, pickle
+    ch = grpc.insecure_channel(addr)
+    call = ch.unary_unary("/ray_tpu.serve.UserDefinedService/myapp")
+    result = pickle.loads(call(pickle.dumps(((arg,), {}))))
+
+Routing reuses the Router (power-of-two-choices replica assignment,
+multiplex-aware) exactly as the HTTP proxy does; the gRPC method name
+selects the deployment by route prefix ("/<name>").
+"""
+
+from __future__ import annotations
+
+import logging
+import pickle
+from concurrent import futures
+from typing import Optional
+
+logger = logging.getLogger(__name__)
+
+SERVICE = "ray_tpu.serve.UserDefinedService"
+
+
+class GrpcProxy:
+    """Runs inside the proxy actor next to the HTTP ingress."""
+
+    def __init__(self, get_router, host: str = "127.0.0.1",
+                 port: int = 0):
+        import grpc
+
+        self._get_router = get_router
+
+        proxy = self
+
+        class Handler(grpc.GenericRpcHandler):
+            def service(self, handler_call_details):
+                path = handler_call_details.method
+                prefix = f"/{SERVICE}/"
+                if not path.startswith(prefix):
+                    return None
+                target = path[len(prefix):]
+                return grpc.unary_unary_rpc_method_handler(
+                    lambda req, ctx: proxy._call(target, req, ctx))
+
+        self._server = grpc.server(
+            futures.ThreadPoolExecutor(max_workers=8))
+        self._server.add_generic_rpc_handlers((Handler(),))
+        self.port = self._server.add_insecure_port(f"{host}:{port}")
+        self._server.start()
+        logger.info("serve gRPC ingress on %s:%d", host, self.port)
+
+    def _call(self, target: str, request: bytes, context):
+        import grpc
+
+        try:
+            args, kwargs = pickle.loads(request) if request else ((), {})
+        except Exception:
+            context.abort(grpc.StatusCode.INVALID_ARGUMENT,
+                          "request must be pickle.dumps((args, kwargs))")
+            return b""
+        router = self._get_router()
+        key = router.route_for_prefix(f"/{target}")
+        if key is None:
+            router._refresh(force=True)
+            key = router.route_for_prefix(f"/{target}")
+        if key is None:
+            context.abort(grpc.StatusCode.NOT_FOUND,
+                          f"no deployment routed at /{target}")
+            return b""
+        model_id = ""
+        for k, v in (context.invocation_metadata() or ()):
+            if k == "serve_multiplexed_model_id":
+                model_id = v
+        call_kwargs = dict(kwargs)
+        if model_id:
+            call_kwargs["__serve_multiplexed_model_id"] = model_id
+        import ray_tpu
+
+        try:
+            ref = router.assign(key, "__call__", tuple(args), call_kwargs)
+            result = ray_tpu.get(ref, timeout=300)
+        except Exception as e:
+            logger.exception("grpc proxy call failed")
+            context.abort(grpc.StatusCode.INTERNAL, str(e))
+            return b""
+        return pickle.dumps(result)
+
+    def stop(self, grace: Optional[float] = 1.0):
+        self._server.stop(grace)

@@ -56,6 +56,29 @@ class ProxyActor:
         self._runner = None
         self._router = None
         self._started = asyncio.get_event_loop().create_task(self._start())
+        # gRPC ingress next to HTTP (reference: proxy.py:542 gRPCProxy);
+        # it runs its own thread pool, so the actor's event loop never
+        # blocks on it.
+        from ray_tpu.serve.grpc_proxy import GrpcProxy
+
+        try:
+            # Loopback unless explicitly opened: the gRPC ingress
+            # unpickles request payloads (trusted-client protocol), so
+            # it must not silently ride the HTTP host onto 0.0.0.0.
+            import os as _os
+
+            grpc_host = _os.environ.get("RAY_TPU_SERVE_GRPC_HOST",
+                                        "127.0.0.1")
+            self._grpc = GrpcProxy(self._get_router, host=grpc_host,
+                                   port=0)
+            self.grpc_port = self._grpc.port
+        except Exception:
+            logger.exception("gRPC ingress unavailable")
+            self._grpc = None
+            self.grpc_port = None
+
+    async def get_grpc_port(self):
+        return self.grpc_port
 
     def _get_router(self):
         if self._router is None:
@@ -131,6 +154,9 @@ class ProxyActor:
         return _to_response(result)
 
     async def shutdown(self):
+        if self._grpc is not None:
+            self._grpc.stop()
+            self._grpc = None
         if self._runner is not None:
             await self._runner.cleanup()
 

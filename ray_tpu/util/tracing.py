@@ -1,0 +1,240 @@
+"""Distributed tracing: spans across task boundaries.
+
+Reference: python/ray/util/tracing/tracing_helper.py:293 — ray wraps
+remote calls in client spans and smuggles the trace context to the
+worker (``_ray_trace_ctx``), where execution runs in a consumer span.
+Here the context rides a hidden task kwarg as a W3C ``traceparent``
+carrier — no task-protocol change, no scheduling-key impact — and the
+worker's span parents correctly across processes and hosts.
+
+Backends, picked automatically:
+- **OpenTelemetry SDK** when installed (spans flow to the configured
+  exporter — OTLP via OTEL_EXPORTER_OTLP_ENDPOINT, console via
+  RAY_TPU_TRACE_CONSOLE, or one passed to ``setup_tracing``).
+- **Built-in mini tracer** otherwise (this image ships only
+  opentelemetry-api): real trace/span ids, W3C traceparent propagation,
+  spans appended to ``RAY_TPU_TRACE_FILE`` as JSON lines and readable
+  via ``get_recorded_spans()``.
+
+Usage:
+    from ray_tpu.util import tracing
+    tracing.setup_tracing(service_name="my-app")
+    ... ray_tpu.get(f.remote()) ...   # submit/execute spans auto-emitted
+"""
+
+from __future__ import annotations
+
+import json
+import logging
+import os
+import secrets
+import threading
+import time
+from contextlib import contextmanager
+from typing import Dict, List, Optional
+
+logger = logging.getLogger(__name__)
+
+_enabled = False
+_backend = None  # "otel" | "mini"
+_otel_tracer = None
+
+
+# ---------------------------------------------------------------------------
+# mini tracer (stdlib-only)
+# ---------------------------------------------------------------------------
+
+class _MiniSpan:
+    __slots__ = ("name", "trace_id", "span_id", "parent_id", "start",
+                 "end", "attributes")
+
+    def __init__(self, name: str, trace_id: str, span_id: str,
+                 parent_id: Optional[str]):
+        self.name = name
+        self.trace_id = trace_id
+        self.span_id = span_id
+        self.parent_id = parent_id
+        self.start = time.time()
+        self.end: Optional[float] = None
+        self.attributes: Dict[str, str] = {}
+
+    def to_dict(self) -> dict:
+        return {"name": self.name, "trace_id": self.trace_id,
+                "span_id": self.span_id, "parent_id": self.parent_id,
+                "start": self.start, "end": self.end,
+                "attributes": self.attributes}
+
+
+_local = threading.local()
+_recorded: List[_MiniSpan] = []
+_record_lock = threading.Lock()
+
+
+def _current_mini() -> Optional[_MiniSpan]:
+    return getattr(_local, "span", None)
+
+
+def get_recorded_spans() -> List[dict]:
+    """Mini-tracer backend: every finished span in this process."""
+    with _record_lock:
+        return [s.to_dict() for s in _recorded]
+
+
+def _record(span: _MiniSpan):
+    span.end = time.time()
+    with _record_lock:
+        _recorded.append(span)
+        if len(_recorded) > 10_000:
+            del _recorded[:5_000]
+    path = os.environ.get("RAY_TPU_TRACE_FILE")
+    if path:
+        try:
+            with open(path, "a") as f:
+                f.write(json.dumps(span.to_dict()) + "\n")
+        except OSError:
+            pass
+
+
+@contextmanager
+def _mini_span(name: str, trace_id: Optional[str],
+               parent_id: Optional[str]):
+    parent = _current_mini()
+    if trace_id is None:
+        trace_id = parent.trace_id if parent else secrets.token_hex(16)
+    if parent_id is None and parent is not None:
+        parent_id = parent.span_id
+    span = _MiniSpan(name, trace_id, secrets.token_hex(8), parent_id)
+    prev, _local.span = getattr(_local, "span", None), span
+    try:
+        yield span
+    finally:
+        _local.span = prev
+        _record(span)
+
+
+# ---------------------------------------------------------------------------
+# public API
+# ---------------------------------------------------------------------------
+
+def setup_tracing(service_name: str = "ray_tpu",
+                  exporter=None) -> bool:
+    """Idempotent per process. Returns True when tracing is active."""
+    global _enabled, _backend, _otel_tracer
+    if _enabled:
+        return True
+    try:
+        from opentelemetry import trace
+        from opentelemetry.sdk.resources import Resource
+        from opentelemetry.sdk.trace import TracerProvider
+        from opentelemetry.sdk.trace.export import (
+            BatchSpanProcessor,
+            ConsoleSpanExporter,
+            SimpleSpanProcessor,
+        )
+
+        provider = TracerProvider(
+            resource=Resource.create({"service.name": service_name}))
+        if exporter is not None:
+            provider.add_span_processor(SimpleSpanProcessor(exporter))
+        elif os.environ.get("OTEL_EXPORTER_OTLP_ENDPOINT"):
+            from opentelemetry.exporter.otlp.proto.grpc.trace_exporter \
+                import OTLPSpanExporter
+
+            provider.add_span_processor(
+                BatchSpanProcessor(OTLPSpanExporter()))
+        elif os.environ.get("RAY_TPU_TRACE_CONSOLE"):
+            provider.add_span_processor(
+                SimpleSpanProcessor(ConsoleSpanExporter()))
+        trace.set_tracer_provider(provider)
+        _otel_tracer = trace.get_tracer("ray_tpu")
+        _backend = "otel"
+    except Exception:
+        _backend = "mini"  # api-only install (or no otel at all)
+    _enabled = True
+    os.environ["RAY_TPU_TRACING_ENABLED"] = "1"
+    logger.info("tracing enabled (backend=%s)", _backend)
+    return True
+
+
+def is_enabled() -> bool:
+    return _enabled
+
+
+def backend() -> Optional[str]:
+    return _backend
+
+
+def maybe_setup_worker_tracing():
+    """Called on the worker execution path: enable when the driver
+    enabled tracing (the flag rides the spawn env)."""
+    if os.environ.get("RAY_TPU_TRACING_ENABLED") == "1" and not _enabled:
+        setup_tracing(service_name="ray_tpu.worker")
+
+
+def inject_context() -> Optional[Dict[str, str]]:
+    """The CURRENT span context as a W3C carrier dict (or None)."""
+    if not _enabled:
+        return None
+    if _backend == "otel":
+        try:
+            from opentelemetry import propagate
+
+            carrier: Dict[str, str] = {}
+            propagate.inject(carrier)
+            return carrier or None
+        except Exception:
+            return None
+    span = _current_mini()
+    if span is None:
+        return None
+    return {"traceparent":
+            f"00-{span.trace_id}-{span.span_id}-01"}
+
+
+def _parse_traceparent(carrier: Optional[Dict[str, str]]):
+    if not carrier:
+        return None, None
+    try:
+        _, trace_id, span_id, _ = carrier["traceparent"].split("-")
+        return trace_id, span_id
+    except (KeyError, ValueError):
+        return None, None
+
+
+@contextmanager
+def submit_span(name: str):
+    """Producer-side span around a remote submission."""
+    if not _enabled:
+        yield None
+        return
+    if _backend == "otel":
+        with _otel_tracer.start_as_current_span(f"submit {name}") as s:
+            yield s
+        return
+    with _mini_span(f"submit {name}", None, None) as s:
+        yield s
+
+
+@contextmanager
+def task_span(name: str, carrier: Optional[Dict[str, str]]):
+    """Consumer-side span around task execution, parented to the
+    submitter's span through the propagated carrier."""
+    if not _enabled:
+        yield None
+        return
+    if _backend == "otel":
+        ctx = None
+        if carrier:
+            try:
+                from opentelemetry import propagate
+
+                ctx = propagate.extract(carrier)
+            except Exception:
+                ctx = None
+        with _otel_tracer.start_as_current_span(f"execute {name}",
+                                                context=ctx) as s:
+            yield s
+        return
+    trace_id, parent_id = _parse_traceparent(carrier)
+    with _mini_span(f"execute {name}", trace_id, parent_id) as s:
+        yield s

@@ -23,10 +23,27 @@ jax.config.update("jax_platforms", "cpu")
 
 # Persistent compilation cache: the dominant suite cost is re-jitting the
 # same tiny models in every test process; cache compiled executables
-# across tests AND across suite runs.
+# across tests AND across suite runs. Keyed by a machine fingerprint:
+# XLA:CPU AOT results are ISA-specific, and a cache written on another
+# host class loads with "could lead to SIGILL" warnings and then
+# crashes/wedges workers mid-test.
+import hashlib as _hashlib
+import platform as _platform
+
+_fingerprint = _platform.machine()
+try:
+    with open("/proc/cpuinfo") as _f:
+        # Only the ISA flags LINE: later fields (cpu MHz, bogomips)
+        # vary between boots/reads and would defeat the cache.
+        _fingerprint += _f.read().split("flags", 1)[1].split("\n", 1)[0]
+except (OSError, IndexError):
+    pass
+_machine_tag = _hashlib.sha256(
+    _fingerprint.encode()).hexdigest()[:10]
 _cache_dir = os.environ.setdefault(
     "JAX_COMPILATION_CACHE_DIR",
-    os.path.join(os.path.dirname(__file__), ".jit_cache"))
+    os.path.join(os.path.dirname(__file__),
+                 f".jit_cache_{_machine_tag}"))
 # Env (not jax.config) so spawned worker processes inherit the cache.
 os.environ.setdefault("JAX_PERSISTENT_CACHE_MIN_COMPILE_TIME_SECS", "0")
 os.environ.setdefault("JAX_PERSISTENT_CACHE_MIN_ENTRY_SIZE_BYTES", "-1")

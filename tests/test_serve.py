@@ -253,3 +253,36 @@ def test_status_and_redeploy(serve_cluster):
     assert h.remote(None).result() == 2
     serve.delete("redeploy")
     assert "redeploy#V" not in serve.status()
+
+
+def test_grpc_ingress(serve_cluster):
+    """The gRPC ingress routes to the same deployments as HTTP
+    (reference: proxy.py:542 gRPCProxy)."""
+    import pickle
+
+    import grpc
+
+    from ray_tpu import serve
+    from ray_tpu.serve.api import PROXY_NAME
+
+    @serve.deployment
+    class GEcho:
+        def __call__(self, payload):
+            return {"got": payload}
+
+    serve.run(GEcho.bind(), name="gapp", route_prefix="/gapp")
+    proxy = ray_tpu.get_actor(PROXY_NAME)
+    port = ray_tpu.get(proxy.get_grpc_port.remote(), timeout=60)
+    assert port
+    ch = grpc.insecure_channel(f"127.0.0.1:{port}")
+    call = ch.unary_unary("/ray_tpu.serve.UserDefinedService/gapp")
+    out = pickle.loads(call(pickle.dumps((("ping",), {})), timeout=60))
+    assert out == {"got": "ping"}
+    # Unknown route -> NOT_FOUND, not a hang.
+    bad = ch.unary_unary("/ray_tpu.serve.UserDefinedService/nope")
+    try:
+        bad(pickle.dumps(((), {})), timeout=30)
+        assert False, "expected NOT_FOUND"
+    except grpc.RpcError as e:
+        assert e.code() == grpc.StatusCode.NOT_FOUND
+    ch.close()

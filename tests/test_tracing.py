@@ -1,0 +1,45 @@
+"""Distributed tracing (reference strategy: test_tracing.py — spans for
+submit + execute, worker span parented to the driver's). This image
+ships opentelemetry-api only, so the built-in mini backend is what runs;
+the assertions go through the backend-neutral public API."""
+
+import ray_tpu
+from ray_tpu.util import tracing
+
+
+def test_span_parenting_roundtrip():
+    assert tracing.setup_tracing("test-svc")
+    with tracing.submit_span("mytask") as parent:
+        carrier = tracing.inject_context()
+    assert carrier and "traceparent" in carrier
+    with tracing.task_span("mytask", carrier):
+        pass
+    if tracing.backend() == "mini":
+        spans = {s["name"]: s for s in tracing.get_recorded_spans()}
+        sub, ex = spans["submit mytask"], spans["execute mytask"]
+        assert ex["trace_id"] == sub["trace_id"]
+        assert ex["parent_id"] == sub["span_id"]
+
+
+def test_trace_ctx_rides_task_kwargs(ray_start):
+    """The hidden _rtpu_trace_ctx kwarg is stripped before user code
+    runs; the worker records an execute-span in the same trace."""
+    tracing.setup_tracing("test-e2e")
+
+    @ray_tpu.remote
+    def echo_kwargs(**kw):
+        from ray_tpu.util import tracing as wtracing
+
+        # Inside the task, the ACTIVE span is the worker's execute
+        # span; its carrier exposes the trace id it was parented to.
+        return sorted(kw), wtracing.inject_context()
+
+    with tracing.submit_span("outer") as outer:
+        outer_carrier = tracing.inject_context()
+        keys, task_carrier = ray_tpu.get(
+            echo_kwargs.remote(a=1, b=2), timeout=120)
+    assert keys == ["a", "b"]
+    assert task_carrier and "traceparent" in task_carrier
+    # Same trace across the process boundary.
+    assert (task_carrier["traceparent"].split("-")[1]
+            == outer_carrier["traceparent"].split("-")[1])

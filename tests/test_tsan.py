@@ -1,0 +1,20 @@
+"""ThreadSanitizer gate for the native arena (reference: bazel
+--config=tsan on the C++ core). Compile+run costs ~1 min, so it only
+runs when RAY_TPU_TSAN=1 (CI race-hunt lane); the script is also
+directly runnable: bash cpp/tpustore/tsan_check.sh."""
+
+import os
+import subprocess
+
+import pytest
+
+
+@pytest.mark.skipif(os.environ.get("RAY_TPU_TSAN") != "1",
+                    reason="set RAY_TPU_TSAN=1 to run the TSan stress")
+def test_native_store_under_tsan():
+    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    out = subprocess.run(
+        ["bash", os.path.join(repo, "cpp", "tpustore", "tsan_check.sh")],
+        capture_output=True, text=True, timeout=600)
+    assert out.returncode == 0, out.stdout[-2000:] + out.stderr[-2000:]
+    assert "OK" in out.stdout
