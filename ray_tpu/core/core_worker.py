@@ -97,6 +97,14 @@ class ReferenceCounter:
         # object hex -> {"local": n, "borrows": n, "owned": bool, "shm": bool}
         self._refs: Dict[str, dict] = {}
         self._disabled = False
+        # GC-deferred removals: ObjectRef.__del__ runs from the garbage
+        # collector, which can fire at ANY allocation site — including
+        # inside our own critical sections (observed: register_owned
+        # held _lock, an allocation triggered GC, a dead ref's __del__
+        # re-entered remove_local_ref → self-deadlock). Finalizers
+        # therefore only append here (deque.append is atomic and safe
+        # in GC context); every other entry point drains first.
+        self._deferred: deque = deque()
 
     def disable(self):
         self._disabled = True
@@ -106,9 +114,21 @@ class ReferenceCounter:
             hex_id, {"local": 0, "borrows": 0, "owned": False, "shm": False}
         )
 
+    def _drain_deferred(self):
+        if self._disabled:
+            self._deferred.clear()  # teardown: stores are going away
+            return
+        while True:
+            try:
+                hex_id, object_id, owner = self._deferred.popleft()
+            except IndexError:
+                return
+            self._remove_local_ref_now(hex_id, object_id, owner)
+
     def register_owned(self, object_id: ObjectID, in_shm: bool):
         if self._disabled:
             return
+        self._drain_deferred()
         with self._lock:
             entry = self._entry(object_id.hex())
             entry["owned"] = True
@@ -117,29 +137,37 @@ class ReferenceCounter:
     def add_local_ref(self, ref: ObjectRef):
         if self._disabled:
             return
+        self._drain_deferred()
         with self._lock:
             self._entry(ref.hex())["local"] += 1
 
     def remove_local_ref(self, ref: ObjectRef):
+        """Called from ObjectRef.__del__ — GC context. MUST NOT take
+        _lock (see __init__); the removal is queued and applied at the
+        next refcounter entry point."""
         if self._disabled:
             return
+        self._deferred.append((ref.hex(), ref.id, ref.owner_address))
+
+    def _remove_local_ref_now(self, hex_id: str, object_id: ObjectID,
+                              owner) -> None:
         to_free = None
         notify_owner = None
         with self._lock:
-            entry = self._refs.get(ref.hex())
+            entry = self._refs.get(hex_id)
             if entry is None:
                 return
             entry["local"] -= 1
             if entry["local"] <= 0 and entry["borrows"] <= 0:
                 if entry["owned"]:
-                    to_free = (ref.id, entry["shm"])
-                elif ref.owner_address is not None:
-                    notify_owner = ref.owner_address
-                self._refs.pop(ref.hex(), None)
+                    to_free = (object_id, entry["shm"])
+                elif owner is not None:
+                    notify_owner = owner
+                self._refs.pop(hex_id, None)
         if to_free is not None:
             self.cw._free_owned_object(to_free[0], to_free[1])
         elif notify_owner is not None:
-            self.cw._notify_owner_ref_removed(ref.id, notify_owner)
+            self.cw._notify_owner_ref_removed(object_id, notify_owner)
 
     def on_ref_serialized(self, ref: ObjectRef):
         """The serializer registers the borrow (+1 on the owner); the
@@ -147,6 +175,7 @@ class ReferenceCounter:
         (remove_ref). This keeps increments and decrements one-to-one."""
         if self._disabled:
             return
+        self._drain_deferred()
         notify_owner = None
         with self._lock:
             entry = self._refs.get(ref.hex())
@@ -163,10 +192,12 @@ class ReferenceCounter:
         pass
 
     def on_borrow_added(self, object_id: ObjectID):
+        self._drain_deferred()
         with self._lock:
             self._entry(object_id.hex())["borrows"] += 1
 
     def on_borrow_removed(self, object_id: ObjectID):
+        self._drain_deferred()
         to_free = None
         with self._lock:
             entry = self._refs.get(object_id.hex())
@@ -470,6 +501,19 @@ class CoreWorker:
         self.port = await self.server.start(self.host, 0)
         self.address = Address(self.advertise_host, self.port,
                                self.worker_id.hex())
+
+        async def ref_gc_loop():
+            # Guaranteed drain for GC-deferred ref removals: without it,
+            # a process that stops touching the reference counter would
+            # postpone frees/remove_ref notifications indefinitely.
+            while not self._shutdown:
+                await asyncio.sleep(1.0)
+                try:
+                    self.reference_counter._drain_deferred()
+                except Exception:
+                    logger.exception("deferred ref drain failed")
+
+        asyncio.get_running_loop().create_task(ref_gc_loop())
         return self.port
 
     def current_task_id(self) -> TaskID:
