@@ -28,3 +28,12 @@ __all__ = [
     "GcpTpuPodSliceProvider",
     "TPUPodSliceProvider",
 ]
+
+from ray_tpu.autoscaler.v2 import (  # noqa: E402
+    ClusterSpec,
+    Instance,
+    InstanceManager,
+    NodeTypeSpec,
+)
+
+__all__ += ["ClusterSpec", "Instance", "InstanceManager", "NodeTypeSpec"]

@@ -1,0 +1,236 @@
+"""Autoscaler v2: declarative instance management.
+
+Reference: python/ray/autoscaler/v2/ (instance_manager/,
+src/ray/protobuf/autoscaler.proto) — the v2 redesign replaces v1's
+imperative launch/kill loop with a DECLARATIVE model: a desired cluster
+shape plus per-instance state machines, reconciled every tick, with
+explicit instance lifecycles that survive restarts and are inspectable.
+
+Shape here:
+- ``ClusterSpec``: desired node-type counts (min/max per type, like the
+  v2 proto's ``ClusterResourceConstraint`` + node-type configs).
+- ``Instance``: one provider node moving through the v2 lifecycle
+  (QUEUED → REQUESTED → ALLOCATED → RUNNING → TERMINATING → TERMINATED).
+- ``InstanceManager``: owns instance records, reconciles desired vs
+  actual against a NodeProvider, and exposes the state table (the
+  ``get_cluster_status`` analog).
+
+The v1 ``StandardAutoscaler`` remains the demand-driven policy; v2 can
+wrap it (demand feeds ``ClusterSpec.target``) or run purely declarative
+(operator-pinned counts), which is the TPU-slice story: slices are gang
+units you declare, not autoscale one worker at a time.
+"""
+
+from __future__ import annotations
+
+import itertools
+import logging
+import time
+from dataclasses import dataclass, field
+from typing import Dict, List, Optional
+
+from ray_tpu.autoscaler.providers import NodeProvider
+
+logger = logging.getLogger(__name__)
+
+# v2 instance lifecycle (reference: autoscaler.proto Instance.Status).
+QUEUED = "QUEUED"
+REQUESTED = "REQUESTED"
+RUNNING = "RUNNING"
+TERMINATING = "TERMINATING"
+TERMINATED = "TERMINATED"
+FAILED = "ALLOCATION_FAILED"
+
+
+@dataclass
+class NodeTypeSpec:
+    name: str
+    min_nodes: int = 0
+    max_nodes: int = 100
+    resources: Dict[str, float] = field(default_factory=dict)
+
+
+@dataclass
+class ClusterSpec:
+    """Desired shape: per-type target counts, bounded by min/max."""
+
+    node_types: Dict[str, NodeTypeSpec] = field(default_factory=dict)
+    target: Dict[str, int] = field(default_factory=dict)
+
+    def desired(self, node_type: str) -> int:
+        spec = self.node_types[node_type]
+        want = self.target.get(node_type, spec.min_nodes)
+        return max(spec.min_nodes, min(spec.max_nodes, want))
+
+
+@dataclass
+class Instance:
+    instance_id: str
+    node_type: str
+    status: str = QUEUED
+    provider_node_id: Optional[str] = None
+    created_at: float = field(default_factory=time.time)
+    updated_at: float = field(default_factory=time.time)
+    error: str = ""
+    seq: int = 0  # monotonic creation order (created_at can tie)
+
+    def transition(self, status: str, error: str = ""):
+        self.status = status
+        self.error = error
+        self.updated_at = time.time()
+
+
+class InstanceManager:
+    """Declarative reconciler (reference: v2 instance_manager.py +
+    reconciler.py): each tick closes the gap between the spec's desired
+    counts and live provider nodes via explicit instance records."""
+
+    #: terminal records older than this are pruned (the reference v2
+    #: manager similarly GCs terminal instances).
+    TERMINAL_RETENTION_S = 600.0
+
+    def __init__(self, spec: ClusterSpec, provider: NodeProvider,
+                 max_concurrent_launches: int = 4):
+        self.spec = spec
+        self.provider = provider
+        self.max_concurrent_launches = max_concurrent_launches
+        self.instances: Dict[str, Instance] = {}
+        self._counter = itertools.count()
+
+    def _new_instance(self, node_type: str, **kw) -> Instance:
+        seq = next(self._counter)
+        inst = Instance(f"inst-{seq}", node_type, seq=seq, **kw)
+        self.instances[inst.instance_id] = inst
+        return inst
+
+    # -- introspection (get_cluster_status analog) ---------------------
+
+    def cluster_status(self) -> dict:
+        by_status: Dict[str, int] = {}
+        for inst in self.instances.values():
+            by_status[inst.status] = by_status.get(inst.status, 0) + 1
+        return {
+            "instances": [vars(i).copy()
+                          for i in self.instances.values()],
+            "by_status": by_status,
+            "desired": {t: self.spec.desired(t)
+                        for t in self.spec.node_types},
+        }
+
+    # -- declarative input ---------------------------------------------
+
+    def scale(self, node_type: str, count: int):
+        """Declare the desired count (clamped to min/max at reconcile)."""
+        if node_type not in self.spec.node_types:
+            raise ValueError(f"unknown node type {node_type!r}")
+        self.spec.target[node_type] = count
+
+    # -- reconciliation ------------------------------------------------
+
+    def reconcile(self) -> dict:
+        """One tick: sync records with the provider, then launch or
+        terminate toward the desired counts. Returns the action summary."""
+        self._sync_with_provider()
+        launched: Dict[str, int] = {}
+        terminated: List[str] = []
+        # Reconcile every type we have a spec OR live instances for —
+        # adopted nodes of types dropped from the spec must converge to
+        # zero, not linger unmanaged.
+        all_types = set(self.spec.node_types) | {
+            i.node_type for i in self.instances.values()
+            if i.status in (QUEUED, REQUESTED, RUNNING)}
+        for node_type in all_types:
+            live = [i for i in self.instances.values()
+                    if i.node_type == node_type
+                    and i.status in (QUEUED, REQUESTED, RUNNING)]
+            desired = (self.spec.desired(node_type)
+                       if node_type in self.spec.node_types else 0)
+            gap = desired - len(live)
+            if gap > 0:
+                for _ in range(gap):
+                    self._new_instance(node_type)
+            elif gap < 0:
+                need = -gap
+                # Cancel queued launches FIRST (free), then terminate
+                # running nodes newest-first (least sunk state; seq
+                # breaks created_at ties deterministically).
+                for inst in [i for i in live if i.status == QUEUED][:need]:
+                    inst.transition(TERMINATED, error="cancelled")
+                    terminated.append(inst.instance_id)
+                    need -= 1
+                victims = sorted(
+                    (i for i in live if i.status == RUNNING),
+                    key=lambda i: (-i.created_at, -i.seq))[:need]
+                for inst in victims:
+                    inst.transition(TERMINATING)
+        # Drive QUEUED → launch, capping ATTEMPTS per tick (a failing
+        # provider must not absorb an unbounded number of create calls).
+        attempts = 0
+        for inst in list(self.instances.values()):
+            if inst.status != QUEUED:
+                continue
+            if attempts >= self.max_concurrent_launches:
+                break
+            attempts += 1
+            inst.transition(REQUESTED)
+            resources = (self.spec.node_types[inst.node_type].resources
+                         if inst.node_type in self.spec.node_types
+                         else {})
+            try:
+                node_id = self.provider.create_node(
+                    inst.node_type, resources, {})
+                inst.provider_node_id = node_id
+                inst.transition(RUNNING)
+                launched[inst.node_type] = (
+                    launched.get(inst.node_type, 0) + 1)
+            except Exception as e:
+                inst.transition(FAILED, error=str(e))
+                logger.warning("launch of %s failed: %s",
+                               inst.node_type, e)
+        # Drive TERMINATING → TERMINATED.
+        live_pids = {n["provider_node_id"]
+                     for n in self.provider.non_terminated_nodes()}
+        for inst in self.instances.values():
+            if inst.status != TERMINATING:
+                continue
+            if (inst.provider_node_id is None
+                    or inst.provider_node_id not in live_pids):
+                # Already gone (preempted / raced): converge instead of
+                # retrying a terminate that can never succeed.
+                inst.transition(TERMINATED)
+                terminated.append(inst.instance_id)
+                continue
+            try:
+                self.provider.terminate_node(inst.provider_node_id)
+                inst.transition(TERMINATED)
+                terminated.append(inst.instance_id)
+            except Exception as e:
+                logger.warning("terminate of %s failed: %s",
+                               inst.instance_id, e)
+        self._prune_terminal()
+        return {"launched": launched, "terminated": terminated}
+
+    def _prune_terminal(self):
+        cutoff = time.time() - self.TERMINAL_RETENTION_S
+        for iid in [i.instance_id for i in self.instances.values()
+                    if i.status in (TERMINATED, FAILED)
+                    and i.updated_at < cutoff]:
+            self.instances.pop(iid, None)
+
+    def _sync_with_provider(self):
+        """Adopt provider nodes with no record (restart recovery) and
+        mark records whose nodes vanished (crashed/preempted) so the
+        next pass relaunches toward the desired count."""
+        live_ids = {n["provider_node_id"]: n
+                    for n in self.provider.non_terminated_nodes()}
+        known = {i.provider_node_id for i in self.instances.values()
+                 if i.provider_node_id}
+        for pid, node in live_ids.items():
+            if pid not in known:
+                self._new_instance(node["node_type"], status=RUNNING,
+                                   provider_node_id=pid)
+        for inst in self.instances.values():
+            if (inst.status == RUNNING
+                    and inst.provider_node_id not in live_ids):
+                inst.transition(TERMINATED,
+                                error="node vanished (preempted?)")

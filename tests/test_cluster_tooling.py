@@ -279,3 +279,73 @@ def test_gcp_tpu_provider_drives_gcloud():
     # Accelerator names derive from the single TOPOLOGIES table.
     for topo in GcpTpuPodSliceProvider.TOPOLOGIES:
         assert GcpTpuPodSliceProvider.accelerator_type(topo)
+
+
+def test_autoscaler_v2_declarative_reconcile():
+    """v2 instance manager (reference: autoscaler/v2 instance_manager +
+    reconciler): declarative counts, explicit lifecycles, provider
+    adoption and vanish detection."""
+    from ray_tpu.autoscaler.v2 import (
+        ClusterSpec,
+        InstanceManager,
+        NodeTypeSpec,
+        RUNNING,
+        TERMINATED,
+    )
+
+    class FakeProvider:
+        def __init__(self):
+            self.nodes = {}
+            self.counter = 0
+
+        def create_node(self, node_type, resources, labels):
+            self.counter += 1
+            pid = f"n{self.counter}"
+            self.nodes[pid] = {"provider_node_id": pid,
+                               "node_type": node_type}
+            return pid
+
+        def terminate_node(self, pid):
+            self.nodes.pop(pid, None)
+
+        def non_terminated_nodes(self):
+            return list(self.nodes.values())
+
+    provider = FakeProvider()
+    spec = ClusterSpec(node_types={
+        "v5e-16": NodeTypeSpec("v5e-16", min_nodes=1, max_nodes=4,
+                               resources={"TPU": 16.0}),
+    })
+    im = InstanceManager(spec, provider)
+
+    # min_nodes drives the first launch with no explicit target.
+    out = im.reconcile()
+    assert out["launched"] == {"v5e-16": 1}
+    assert len(provider.nodes) == 1
+
+    # Declarative scale-up, clamped by max.
+    im.scale("v5e-16", 3)
+    im.reconcile()
+    assert len(provider.nodes) == 3
+    im.scale("v5e-16", 99)
+    im.reconcile()
+    assert len(provider.nodes) == 4  # max_nodes
+
+    # Scale-down terminates newest-first down to the target.
+    im.scale("v5e-16", 1)
+    im.reconcile()
+    assert len(provider.nodes) == 1
+    status = im.cluster_status()
+    assert status["by_status"][RUNNING] == 1
+    assert status["by_status"][TERMINATED] >= 3
+
+    # A vanished node (preemption) is relaunched toward the target.
+    provider.nodes.clear()
+    im.reconcile()   # detects vanish, queues + launches replacement
+    assert len(provider.nodes) == 1
+
+    # Adoption: a provider node created outside the manager is tracked.
+    provider.create_node("v5e-16", {}, {})
+    im._sync_with_provider()
+    running = [i for i in im.instances.values() if i.status == RUNNING]
+    assert len(running) == 2
