@@ -1241,20 +1241,29 @@ class CoreWorker:
             live.append(spec)
         if not live:
             return
-        lw.busy += len(live)
-        conn = lw.conn
+        # Serialize before anything is marked outstanding: a bad spec
+        # (dumps_control raising) must fail only ITS task, not be
+        # mistaken for a dead connection and fail the whole worker.
+        blobs: List[bytes] = []
+        sendable: List[TaskSpec] = []
         for spec in live:
+            try:
+                blobs.append(serialization.dumps_control(spec))
+                sendable.append(spec)
+            except Exception as e:  # noqa: BLE001
+                self._fail_spec_locally(spec, e)
+        if not sendable:
+            return
+        lw.busy += len(sendable)
+        conn = lw.conn
+        for spec in sendable:
             self._outstanding_pushes[spec.task_id.hex()] = (
                 "task", spec, lw, key, state, conn)
 
         async def push():
             try:
-                await conn.notify(
-                    "push_tasks",
-                    {"specs": [serialization.dumps_control(s)
-                               for s in live]},
-                )
-            except Exception as e:
+                await conn.notify("push_tasks", {"specs": blobs})
+            except (rpc.ConnectionLost, rpc.RpcError, OSError) as e:
                 self._fail_worker_conn(conn, e)
 
         asyncio.ensure_future(push())
@@ -1291,6 +1300,16 @@ class CoreWorker:
         if entry is None:
             return  # already failed via connection close, or cancelled
         reply = payload["reply"]
+        if "spec_decode_error" in reply:
+            # The worker couldn't even decode the spec — it has no
+            # return ids to package an error into, but we (the owner)
+            # still hold the spec; resolve its returns here.
+            self._store_task_error(
+                entry[1], exc.RayTpuError(
+                    f"worker failed to decode task spec for "
+                    f"{entry[1].name}: {reply['spec_decode_error']}"))
+            reply = {"returns": [], "is_error": True,
+                     "_resolved_locally": True}
         if entry[0] == "task":
             _, spec, lw, key, state, _ = entry
             lw.busy -= 1
@@ -1454,6 +1473,33 @@ class CoreWorker:
                         except Exception:
                             pass
                 gen._finish(total=reply["stream_count"], error=err)
+
+    def _fail_spec_locally(self, spec: TaskSpec, error: Exception):
+        """Resolve a task's returns with an error that happened before
+        the spec ever left this process (e.g. dumps_control raised) —
+        the shape mirrors the worker's _package_error reply so gets
+        raise instead of hanging."""
+        obj = serialization.serialize_error(
+            exc.RayTpuError(
+                f"task spec for {spec.name} could not be serialized: "
+                f"{type(error).__name__}: {error}"),
+            task_name=spec.name)
+        bufs = [bytes(memoryview(b)) for b in obj.buffers]
+        if spec.num_returns == TaskSpec.STREAMING:
+            reply = {
+                "returns": [], "is_error": True, "stream_count": 0,
+                "error_payload": {"metadata": obj.metadata,
+                                  "inband": obj.inband, "buffers": bufs},
+            }
+        else:
+            reply = {
+                "returns": [
+                    {"object_id": oid.binary(), "metadata": obj.metadata,
+                     "inband": obj.inband, "buffers": bufs}
+                    for oid in spec.return_object_ids()],
+                "is_error": True,
+            }
+        self._on_task_reply(spec, reply)
 
     def _on_task_worker_failure(self, spec: TaskSpec, error: Exception):
         pending = self.pending_tasks.get(spec.task_id)
@@ -1773,20 +1819,29 @@ class CoreWorker:
                 self._on_actor_call_failure(
                     state, spec, rpc.ConnectionLost("actor connection"))
             return
-        state.inflight += len(specs)
-        conn = state.conn
+        # Serialize up front: one bad spec fails only itself — treating
+        # a local dumps_control error as a dead connection would fail
+        # every outstanding call on this (healthy) actor.
+        blobs: List[bytes] = []
+        sendable: List[TaskSpec] = []
         for spec in specs:
+            try:
+                blobs.append(serialization.dumps_control(spec))
+                sendable.append(spec)
+            except Exception as e:  # noqa: BLE001
+                self._fail_spec_locally(spec, e)
+        if not sendable:
+            return
+        state.inflight += len(sendable)
+        conn = state.conn
+        for spec in sendable:
             self._outstanding_pushes[spec.task_id.hex()] = (
                 "actor", spec, state, conn)
 
         async def push():
             try:
-                await conn.notify(
-                    "push_tasks",
-                    {"specs": [serialization.dumps_control(s)
-                               for s in specs]},
-                )
-            except Exception as e:
+                await conn.notify("push_tasks", {"specs": blobs})
+            except (rpc.ConnectionLost, rpc.RpcError, OSError) as e:
                 self._fail_worker_conn(conn, e)
 
         asyncio.ensure_future(push())

@@ -250,7 +250,12 @@ class NodeAgent:
 
     def _oom_kill(self, victim, reason: str):
         self._last_oom_reason = reason
-        proc = self._procs.pop(victim.worker_id_hex, None)
+        # The proc stays in _procs: the reap loop must observe the exit
+        # and send worker_exited_early so the head's agent-exit
+        # bookkeeping (spawn backoff, grant cleanup) fires for OOM
+        # victims too — popping here would leave only the RPC
+        # connection-close signal.
+        proc = self._procs.get(victim.worker_id_hex)
         if proc is not None and proc.poll() is None:
             try:
                 proc.kill()

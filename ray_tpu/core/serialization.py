@@ -16,7 +16,7 @@ from __future__ import annotations
 import pickle
 import traceback
 from dataclasses import dataclass, field
-from typing import Any, List, Sequence
+from typing import Any, List, Optional, Sequence
 
 import cloudpickle
 
@@ -160,6 +160,23 @@ def dumps_control(obj: Any) -> bytes:
         if fast is not None:
             return fast
     return _CTRL_PICKLE + cloudpickle.dumps(obj, protocol=5)
+
+
+def spec_task_id_from_blob(data: bytes) -> Optional[str]:
+    """Best-effort task-id (hex) extraction from a control blob whose
+    full decode failed — lets the worker still send a task_done error
+    for a spec it cannot run (protocol-bug path, worker_main
+    h_push_tasks)."""
+    if data[:1] != _CTRL_SPEC:
+        return None
+    try:
+        import msgpack
+
+        row = msgpack.unpackb(data[1:], raw=False, use_list=True)
+        tid = row[0]
+        return tid.hex() if isinstance(tid, bytes) else None
+    except Exception:  # noqa: BLE001
+        return None
 
 
 def loads_control(data: bytes) -> Any:

@@ -706,9 +706,31 @@ async def _amain():
         for blob in payload["specs"]:
             try:
                 specs.append(serialization.loads_control(blob))
-            except Exception:
+            except Exception as decode_err:  # noqa: BLE001
                 logging.getLogger(__name__).exception(
                     "undecodable task spec in push_tasks batch")
+                # push_tasks is a notification — without a task_done the
+                # owner waits on this task forever. Name the task from
+                # the raw blob if at all possible; failing that, close
+                # the connection so the owner's _fail_worker_conn path
+                # fails everything outstanding instead of hanging.
+                tid_hex = serialization.spec_task_id_from_blob(blob)
+                if tid_hex is not None:
+                    try:
+                        conn.notify_nowait("task_done", {
+                            "task_id": tid_hex,
+                            "reply": {"spec_decode_error":
+                                      f"{type(decode_err).__name__}: "
+                                      f"{decode_err}"}})
+                    except Exception:
+                        pass
+                else:
+                    # Abandon the whole batch: once the conn closes the
+                    # owner fails-and-retries everything outstanding, so
+                    # running the decodable remainder here would execute
+                    # those tasks twice.
+                    asyncio.get_running_loop().create_task(conn.close())
+                    return
         executor.ensure_started()
 
         def finish(spec, fut):

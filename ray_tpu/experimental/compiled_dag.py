@@ -27,14 +27,56 @@ in parallel/pipeline.py, not this substrate).
 from __future__ import annotations
 
 import itertools
+import logging
 import os
 import pickle
+import socket
 import time
 from typing import Any, Dict, List, Optional
 
 from ray_tpu.experimental.channel import ChannelClosed, ShmChannel
 
+logger = logging.getLogger(__name__)
+
 _dag_counter = itertools.count()
+
+
+class _NodeError:
+    """Sentinel carrying an exception raised by a node's method through
+    the channels (reference: compiled_dag_node.py wraps per-execution
+    errors and keeps the DAG alive). Downstream loops forward it
+    without invoking their method; ``execute()`` re-raises it."""
+
+    __slots__ = ("exc", "method")
+
+    def __init__(self, exc: BaseException, method: str):
+        self.exc = exc
+        self.method = method
+
+
+def _local_hosts() -> set:
+    """Addresses that resolve to this machine (shm channel scope)."""
+    hosts = {"127.0.0.1", "localhost", "0.0.0.0", "::1", ""}
+    try:
+        name = socket.gethostname()
+        hosts.add(name)
+        hosts.update(info[4][0]
+                     for info in socket.getaddrinfo(name, None))
+    except OSError:
+        pass
+    # The outward-facing interface IP — /etc/hosts often maps the
+    # hostname to 127.0.1.1 only, while node agents advertise the NIC
+    # address (same trick as train/worker_group.py node_ip()).
+    try:
+        s = socket.socket(socket.AF_INET, socket.SOCK_DGRAM)
+        try:
+            s.connect(("8.8.8.8", 80))
+            hosts.add(s.getsockname()[0])
+        finally:
+            s.close()
+    except OSError:
+        pass
+    return hosts
 
 
 # ---------------------------------------------------------------------------
@@ -75,8 +117,24 @@ def run_channel_loop(instance, config_blob: bytes) -> dict:
                 kwargs = {k: resolve(v)
                           for k, v in node["kwargs"].items()}
                 t1 = time.perf_counter() if debug else 0.0
-                method = getattr(instance, node["method"])
-                value = method(*args, **kwargs)
+                # An upstream error flows through untouched; otherwise a
+                # method exception becomes a _NodeError written to the
+                # outputs so execute() re-raises it while the loop (and
+                # the DAG) stays alive for the next tick.
+                value = next(
+                    (v for v in itertools.chain(args, kwargs.values())
+                     if isinstance(v, _NodeError)), None)
+                if value is None:
+                    try:
+                        method = getattr(instance, node["method"])
+                        value = method(*args, **kwargs)
+                    except Exception as exc:  # noqa: BLE001
+                        try:
+                            pickle.dumps(exc)
+                        except Exception:
+                            exc = RuntimeError(
+                                f"{type(exc).__name__}: {exc}")
+                        value = _NodeError(exc, node["method"])
                 for name in node["outputs"]:
                     out_chans[name].write(value)
             if debug:
@@ -132,6 +190,13 @@ class CompiledDag:
                     f"nodes only, got {n!r}")
         if len(inputs) > 1:
             raise ValueError("compiled DAGs take a single InputNode")
+        # Fail cross-host placement here, with a real error — otherwise
+        # the remote loop's ShmChannel.attach times out 30s in and
+        # execute() just hangs (advisor r4). Runs before any shm
+        # segment is allocated so a raise leaks nothing.
+        self._validate_same_host(
+            {n.actor_handle._actor_id: n.actor_handle for n in methods}
+            .values())
 
         # consumer edges: node -> list of channel names it reads, in arg
         # order; producer -> channels it writes.
@@ -208,6 +273,7 @@ class CompiledDag:
                 cfg)
         from ray_tpu.api import ActorMethod
 
+        self.loop_errors: List[BaseException] = []
         self._loop_refs = []
         for handle, nodes in per_actor.values():
             blob = pickle.dumps({"nodes": nodes})
@@ -215,6 +281,50 @@ class CompiledDag:
             # names by design.
             ref = ActorMethod(handle, "__rtpu_channel_loop__").remote(blob)
             self._loop_refs.append(ref)
+
+    def _validate_same_host(self, handles, timeout: float = 2.0):
+        """Every channel endpoint must share the driver's physical host
+        (posix shm). Resolve each actor's placement via the actor table
+        and raise a clear error for cross-host edges; the TPU-native
+        cross-host substrate is the in-graph ICI pipeline
+        (parallel/pipeline.py), not shm channels.
+
+        Best-effort with a small budget: an actor still PENDING past it
+        is skipped (the attach timeout remains the backstop) — compile
+        must not block 30s on the common no-warmup
+        ``A.remote(); compile()`` pattern."""
+        from ray_tpu import api as _api
+
+        cw = _api._require_worker()
+        local = _local_hosts()
+        deadline = time.monotonic() + timeout
+        for handle in handles:
+            aid = handle._actor_id.hex()
+            delay = 0.02
+            while True:
+                reply = cw.loop_thread.run(cw.head.call(
+                    "get_actor_info", {"actor_id": aid}))
+                if reply.get("found"):
+                    if reply.get("state") == "DEAD":
+                        raise ValueError(
+                            f"cannot compile DAG: actor {aid} is dead")
+                    addr = reply.get("address")
+                    if addr:
+                        if addr[0] not in local:
+                            raise ValueError(
+                                f"compiled DAGs require every actor on "
+                                f"the driver's host (channels are posix "
+                                f"shm); actor {aid} lives on {addr[0]}. "
+                                f"Use the in-graph ICI pipeline "
+                                f"(parallel/pipeline.py) for cross-host "
+                                f"stages.")
+                        break
+                if time.monotonic() > deadline:
+                    # Placement unresolved (actor still pending past the
+                    # budget) — let attach enforce the invariant.
+                    break
+                time.sleep(delay)
+                delay = min(delay * 2, 0.2)
 
     def execute(self, *args, timeout: Optional[float] = 60.0) -> Any:
         """One synchronous DAG tick: feed the input, return the root
@@ -226,7 +336,10 @@ class CompiledDag:
             raise ValueError("DAG has an InputNode; execute(value)")
         for ch in self._input_channels:
             ch.write(args[0] if args else None, timeout=timeout)
-        return self._output_channel.read(timeout=timeout)
+        result = self._output_channel.read(timeout=timeout)
+        if isinstance(result, _NodeError):
+            raise result.exc
+        return result
 
     def teardown(self, timeout: float = 30.0):
         """Close the input edges; loops drain, cascade-close, and their
@@ -240,8 +353,14 @@ class CompiledDag:
 
         try:
             ray_tpu.get(self._loop_refs, timeout=timeout)
-        except Exception:
-            pass  # teardown is best-effort; actors may already be dead
+        except Exception as exc:  # noqa: BLE001
+            # Teardown still proceeds (actors may legitimately be dead
+            # already), but the failure is recorded and logged — a
+            # swallowed loop error here is how a broken DAG used to
+            # masquerade as a channel timeout (advisor r4).
+            self.loop_errors.append(exc)
+            logger.warning(
+                "compiled DAG loop task failed during teardown: %r", exc)
         for ch in self._channels.values():
             ch.destroy()
 

@@ -180,3 +180,54 @@ def test_compiled_requires_input_edge(ray_start):
     node = a.fwd.bind(3)  # constant-only graph: nothing drives ticks
     with pytest.raises(ValueError):
         node.experimental_compile()
+
+
+@ray_tpu.remote
+class Flaky:
+    """Raises on demand — exercises in-loop error propagation."""
+
+    def step(self, x):
+        if isinstance(x, int) and x < 0:
+            raise ValueError(f"bad input {x}")
+        return x * 2
+
+    def tail(self, x):
+        return x + 1
+
+
+def test_compiled_method_error_propagates_and_dag_survives(ray_start):
+    """Advisor r4 (medium): a user-method exception must surface from
+    execute() as the original error — not a ChannelClosed/Timeout — and
+    the DAG must stay alive for subsequent ticks (reference:
+    compiled_dag_node.py wraps per-execution errors)."""
+    a = Flaky.remote()
+    ray_tpu.get(a.step.remote(0), timeout=60)
+    with InputNode() as inp:
+        node = a.step.bind(inp)
+    cd = node.experimental_compile()
+    try:
+        assert cd.execute(3, timeout=60) == 6
+        with pytest.raises(ValueError, match="bad input -1"):
+            cd.execute(-1, timeout=60)
+        # The pinned loop survived the error.
+        assert cd.execute(4, timeout=60) == 8
+    finally:
+        cd.teardown()
+
+
+def test_compiled_error_forwards_through_downstream(ray_start):
+    """An upstream error skips downstream methods and reaches the
+    driver intact."""
+    a = Flaky.remote()
+    b = Flaky.remote()
+    ray_tpu.get([a.step.remote(0), b.step.remote(0)], timeout=60)
+    with InputNode() as inp:
+        node = b.tail.bind(a.step.bind(inp))
+    cd = node.experimental_compile()
+    try:
+        assert cd.execute(2, timeout=60) == 5
+        with pytest.raises(ValueError, match="bad input -7"):
+            cd.execute(-7, timeout=60)
+        assert cd.execute(1, timeout=60) == 3
+    finally:
+        cd.teardown()
