@@ -86,8 +86,12 @@ class Config:
     max_lineage_entries: int = 10_000
     # Tasks pushed to one leased worker before its replies drain — hides
     # the push/reply RTT behind execution (reference:
-    # max_tasks_in_flight_per_worker, direct_task_transport.h).
-    max_tasks_in_flight_per_worker: int = 10
+    # max_tasks_in_flight_per_worker, direct_task_transport.h). Deeper
+    # than the reference's 10: push frames amortize per-frame syscalls
+    # and the pump distributes the queue EVENLY across leased workers,
+    # so the cap is a ceiling, not the typical depth (imbalance stays
+    # bounded by the even split).
+    max_tasks_in_flight_per_worker: int = 64
     # Byte budget for retained creating-task specs used to reconstruct
     # lost shm objects (reference: task_manager.h:202 max_lineage_bytes).
     max_lineage_bytes: int = 64 * 1024 * 1024

@@ -376,6 +376,10 @@ class CoreWorker:
         self._exported_functions: Dict[int, str] = {}
         self._actor_sub_started = False
         self._shutdown = False
+        # Bumped whenever an ordered owner-bound notification is queued
+        # (see _notify_owner_add_borrow); read by the worker executor's
+        # sync-reply fast path.
+        self.owner_notify_epoch = 0
         self.server: Optional[rpc.Server] = None
         self._finished_task_ids: set = set()
         self._pubsub_callbacks: Dict[str, List[Callable]] = {}
@@ -598,9 +602,77 @@ class CoreWorker:
 
     async def _get_all_async(self, refs: List[ObjectRef],
                              timeout: Optional[float]) -> List[Any]:
-        return await asyncio.gather(
-            *(self.get_async(ref, timeout) for ref in refs)
-        )
+        """Batched get with a single awaitable for every owned-local
+        pending ref: per-ref ``gather`` + ``wait_for`` costs an asyncio
+        Task and a timer handle per object — at tiny-object rates that
+        machinery dominates the driver's ingest path. Remote-owner
+        fetches (cross-process borrows) keep the per-ref coroutine
+        path; they already pay an RPC each."""
+        objs: List[Optional[SerializedObject]] = [
+            self.memory_store.get_if_exists(ref.id) for ref in refs]
+        pending_local: List[int] = []
+        remote: List[int] = []
+        for i, (ref, obj) in enumerate(zip(refs, objs)):
+            if obj is not None:
+                continue
+            # Ownership is by ADDRESS first: a ref whose owner is
+            # another process must be fetched from it even when the
+            # task-id heuristic matches one of ours (see
+            # _resolve_object).
+            owner = ref.owner_address
+            owner_is_self = (owner is None
+                             or owner.key() == self.address.key())
+            if owner_is_self and self._owns(ref.id):
+                pending_local.append(i)
+            else:
+                remote.append(i)
+        if pending_local:
+            fut = self.loop.create_future()
+            state = {"n": len(pending_local)}
+
+            def _mk(i):
+                def cb(obj):
+                    def fire():
+                        objs[i] = obj
+                        state["n"] -= 1
+                        if state["n"] == 0 and not fut.done():
+                            fut.set_result(None)
+                    # Most waiters resolve from the loop thread (reply
+                    # ingestion); skip the self-pipe syscall there.
+                    if threading.get_ident() == self._loop_thread_ident:
+                        fire()
+                    else:
+                        self.loop.call_soon_threadsafe(fire)
+                return cb
+
+            for i in pending_local:
+                self.memory_store.add_waiter(refs[i].id, _mk(i))
+            try:
+                await asyncio.wait_for(fut, timeout)
+            except asyncio.TimeoutError:
+                missing = next(i for i in pending_local
+                               if objs[i] is None)
+                raise exc.GetTimeoutError(
+                    f"get() timed out waiting for "
+                    f"{refs[missing].id.hex()}")
+        if remote:
+            fetched = await asyncio.gather(
+                *(self._fetch_from_owner(refs[i], timeout)
+                  for i in remote))
+            for i, obj in zip(remote, fetched):
+                objs[i] = obj
+        plasma = [i for i, obj in enumerate(objs)
+                  if obj.metadata == IN_PLASMA]
+        if plasma:
+            opened = await asyncio.gather(
+                *(self._open_shm(refs[i].id, timeout) for i in plasma))
+            for i, obj in zip(plasma, opened):
+                objs[i] = obj
+        return [
+            serialization.deserialize(obj.metadata, obj.inband,
+                                      obj.buffers)
+            for obj in objs
+        ]
 
     async def get_async(self, ref: ObjectRef, timeout: Optional[float] = None):
         obj = await self._resolve_object(ref, timeout)
@@ -905,6 +977,12 @@ class CoreWorker:
     def _notify_owner_add_borrow(self, object_id: ObjectID, owner: Address):
         if self._shutdown:
             return
+        # Epoch for the executor's sync-reply fast path: an add_borrow
+        # queued during a task's execution must not be overtaken by a
+        # raw-socket task_done (the owner could free the object before
+        # learning of the borrow) — the executor compares this counter
+        # around execution and falls back to the ordered loop path.
+        self.owner_notify_epoch += 1
 
         async def go():
             try:
@@ -1138,8 +1216,17 @@ class CoreWorker:
         with self._submit_lock:
             specs, self._submit_buf = self._submit_buf, []
             self._submit_wake_pending = False
+        # Queue everything first, pump once per scheduling key — a
+        # 100-task burst otherwise pays 100 pump scans for one batch.
+        touched: Dict[int, tuple] = {}
         for spec in specs:
-            self._submit_on_loop(spec)
+            key = spec.scheduling_key()
+            state = self.scheduling_keys.setdefault(
+                key, SchedulingKeyState())
+            state.queue.append(spec)
+            touched[id(state)] = (key, state)
+        for key, state in touched.values():
+            self._pump_scheduling_key(key, state)
 
     def _submit_on_loop(self, spec: TaskSpec):
         key = spec.scheduling_key()
@@ -1154,13 +1241,23 @@ class CoreWorker:
         # ONE batched RPC per worker — at tiny-task rates the msgpack
         # envelope + loop wakeups per frame are the throughput ceiling.
         cap = max(1, self.config.max_tasks_in_flight_per_worker)
-        for lw in list(state.workers.values()):
+        avail = [lw for lw in state.workers.values()
+                 if lw.conn is not None and not lw.conn.closed
+                 and lw.busy < cap]
+        # Even split across available workers: a burst becomes one big
+        # frame per worker (frame-cost amortization) without piling the
+        # whole queue onto the first worker (load-imbalance bound).
+        remaining = len(avail)
+        for lw in avail:
             if not state.queue:
                 break
-            if lw.conn is None or lw.conn.closed or lw.busy >= cap:
+            share = -(-len(state.queue) // remaining)  # ceil
+            remaining -= 1
+            n = min(cap - lw.busy, share)
+            if n <= 0:
                 continue
             batch: List[TaskSpec] = []
-            while state.queue and lw.busy + len(batch) < cap:
+            while state.queue and len(batch) < n:
                 batch.append(state.queue.popleft())
             if batch:
                 self._push_tasks_to_worker(key, state, lw, batch)
@@ -1508,10 +1605,11 @@ class CoreWorker:
         # Free-retry decision. Two signals:
         # - error.sent is False: the push was never written to the socket,
         #   so the task PROVABLY never ran — always safe to requeue.
-        # - ack missing (pending.accepted False): the worker almost
-        #   certainly died before user code started, but a lost-ack window
-        #   exists where execution began; honor strict at-most-once for
-        #   max_retries=0 tasks by not using it there.
+        # - ack missing (pending.accepted False): the worker died before
+        #   user code started OR within the executor's deferred-ack
+        #   window (ACK_DELAY, worker_main) — either way execution
+        #   lasted <~20ms; honor strict at-most-once for max_retries=0
+        #   tasks by not using it there.
         provably_unsent = getattr(error, "sent", True) is False
         likely_unstarted = (not pending.accepted
                             and spec.max_retries != 0)

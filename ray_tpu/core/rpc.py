@@ -84,17 +84,27 @@ class Connection:
         self._req_counter = itertools.count(1)
         self._pending: Dict[int, asyncio.Future] = {}
         self._closed = False
+        self._loop: Optional[asyncio.AbstractEventLoop] = None
         self._read_task: Optional[asyncio.Task] = None
         self.on_close: Optional[Callable[["Connection"], None]] = None
         # Write coalescing: frames queued within one loop tick flush as a
         # single writer.write (one syscall for a burst of small RPCs).
         self._outbuf: list = []
         self._flush_scheduled = False
+        # Cross-thread write fence: the executor's synchronous reply
+        # fast path (try_notify_sync) and the loop's _flush must not
+        # interleave bytes of different frames on the socket.
+        self._write_mutex = threading.Lock()
+        # Lazily dup'ed real socket for try_notify_sync (asyncio only
+        # exposes a send-less TransportSocket wrapper).
+        self._sock = None
+        self._sock_tried = False
         # Arbitrary per-connection state (e.g. registered worker id).
         self.state: Dict[str, Any] = {}
 
     def start(self):
-        self._read_task = asyncio.get_running_loop().create_task(self._read_loop())
+        self._loop = asyncio.get_running_loop()
+        self._read_task = self._loop.create_task(self._read_loop())
 
     async def _read_loop(self):
         try:
@@ -214,19 +224,20 @@ class Connection:
         # attachment buffers to the transport directly — joining a MiB
         # chunk would re-copy the entire data plane.
         small: list = []
-        try:
-            for piece in pieces:
-                if len(piece) >= (64 << 10):
-                    if small:
-                        self.writer.write(b"".join(small))
-                        small = []
-                    self.writer.write(piece)
-                else:
-                    small.append(piece)
-            if small:
-                self.writer.write(b"".join(small))
-        except Exception:
-            pass  # the read loop notices the broken pipe and tears down
+        with self._write_mutex:  # fence vs try_notify_sync mid-frame
+            try:
+                for piece in pieces:
+                    if len(piece) >= (64 << 10):
+                        if small:
+                            self.writer.write(b"".join(small))
+                            small = []
+                        self.writer.write(piece)
+                    else:
+                        small.append(piece)
+                if small:
+                    self.writer.write(b"".join(small))
+            except Exception:
+                pass  # read loop notices the broken pipe and tears down
 
     async def call(self, method: str, payload: Any = None,
                    timeout: Optional[float] = None) -> Any:
@@ -254,6 +265,85 @@ class Connection:
                                 "d": payload}):
             self._flush()
 
+    def try_notify_sync(self, method: str, payload: Any = None) -> bool:
+        """Synchronous fire-and-forget from a NON-loop thread — the
+        task executor's reply fast path. On success the frame's bytes
+        are in the kernel when this returns, which (a) satisfies the
+        delivery barrier without an executor⇄loop ping-pong and (b) on
+        a one-core host removes two context switches from every task
+        reply. Returns False — caller falls back to the loop path —
+        whenever frame ordering or atomicity can't be guaranteed: no
+        raw socket, connection closed/closing, frames waiting in the
+        coalescing buffer, bytes pending in the transport, or the loop
+        currently mid-flush."""
+        if self._closed:
+            return False
+        sock = self._sock
+        if sock is None:
+            if self._sock_tried:
+                return False
+            self._sock_tried = True
+            try:
+                tr = self.writer.get_extra_info("socket")
+                fd = tr.fileno() if tr is not None else -1
+                if fd < 0:
+                    return False
+                import os as _os
+                import socket as _socket
+
+                # dup shares the file description (already O_NONBLOCK
+                # via asyncio) but gives us a send()-capable object.
+                self._sock = sock = _socket.socket(fileno=_os.dup(fd))
+            except OSError:
+                return False
+        data = msgpack.packb({"t": "ntf", "i": 0, "m": method,
+                              "d": payload}, use_bin_type=True)
+        mutex = self._write_mutex
+        if not mutex.acquire(blocking=False):
+            return False
+        try:
+            if self._closed or self._outbuf:
+                return False
+            transport = self.writer.transport
+            if transport is None or transport.get_write_buffer_size() > 0:
+                return False
+            view = memoryview(
+                len(data).to_bytes(4, "little") + data)
+            sent_any = False
+            try:
+                while view.nbytes:
+                    try:
+                        n = sock.send(view)
+                    except (BlockingIOError, InterruptedError):
+                        if not sent_any:
+                            return False  # clean refusal; loop path takes it
+                        # Mid-frame: the frame MUST complete or the
+                        # stream corrupts. Wait for writability (tiny
+                        # frames on a draining peer make this
+                        # ~unreachable).
+                        import select as _select
+
+                        if not _select.select([], [sock], [], 2.0)[1]:
+                            # Wedged socket with a half-written frame:
+                            # the connection is unusable — abort it from
+                            # the loop and report "sent" (it is dying
+                            # either way; the peer's close handling owns
+                            # cleanup).
+                            if self._loop is not None:
+                                self._loop.call_soon_threadsafe(
+                                    transport.abort)
+                            return True
+                        continue
+                    sent_any = True
+                    view = view[n:]
+            except (OSError, ValueError):
+                # Broken pipe / socket closed under us (teardown race):
+                # the read loop notices and owns the cleanup.
+                return sent_any
+            return True
+        finally:
+            mutex.release()
+
     def write_buffer_empty(self) -> bool:
         """True when every flushed byte reached the kernel (the
         transport's user-space buffer is drained)."""
@@ -270,6 +360,12 @@ class Connection:
         # not drop it (the pre-coalescing code wrote synchronously).
         self._flush()
         self._closed = True
+        if self._sock is not None:
+            try:
+                self._sock.close()  # dup'ed fd only; transport unaffected
+            except OSError:
+                pass
+            self._sock = None
         for fut in self._pending.values():
             if not fut.done():
                 fut.set_exception(ConnectionLost(self.name))

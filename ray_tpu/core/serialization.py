@@ -70,7 +70,26 @@ def serialize(value: Any) -> SerializedObject:
         return False  # out-of-band
 
     value = _map_jax_arrays(value)
-    inband = cloudpickle.dumps(value, protocol=5, buffer_callback=buffer_callback)
+    # The C pickler is ~7x cheaper than cloudpickle for plain data (the
+    # overwhelmingly common case for args/returns); cloudpickle is only
+    # needed for closures/lambdas/locally-defined classes, which plain
+    # pickle refuses — so try fast, fall back (reference: msgpack
+    # envelope + pickle5, cloudpickle only for functions,
+    # _private/serialization.py).
+    try:
+        inband = pickle.dumps(value, protocol=5,
+                              buffer_callback=buffer_callback)
+        if b"__main__" in inband:
+            # The C pickler serialized a __main__-defined class/function
+            # BY REFERENCE — unpicklable in a worker whose __main__ is
+            # worker_main. Cloudpickle serializes those by value. (A
+            # literal "__main__" string in user data merely costs the
+            # slower path.)
+            raise pickle.PicklingError("__main__ reference")
+    except (pickle.PicklingError, TypeError, AttributeError):
+        del buffers[:]
+        inband = cloudpickle.dumps(value, protocol=5,
+                                   buffer_callback=buffer_callback)
     return SerializedObject(
         metadata=NORMAL,
         inband=inband,

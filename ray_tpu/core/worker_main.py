@@ -27,6 +27,7 @@ import logging
 import os
 import sys
 import threading
+import time
 import traceback
 from typing import Optional
 
@@ -83,6 +84,18 @@ class Executor:
         # result (at-most-once would silently burn the retry budget).
         self._delivered = threading.Event()
         self._delivered.set()
+        # Deferred execution ack (normal tasks): the ack's only consumer
+        # is the owner's free-retry decision on worker death, so a task
+        # whose reply arrives never needed one — acking every tiny task
+        # costs a syscall (and a cross-process wakeup) per task on the
+        # critical path. Instead the loop acks only tasks still running
+        # after ACK_DELAY; a death inside that window looks unstarted
+        # and gets a free retry (bounded by the owner's free_retries
+        # budget).
+        self.ACK_DELAY = 0.02
+        self._ack_slot = None  # [task_hex, conn, started, acked]
+        self._ack_timer_running = False
+        self._ack_idle_checks = 0
 
     def reconfigure(self, max_concurrency: int, is_async: bool):
         """Restart consumers with new settings (safe only while no task is
@@ -136,29 +149,77 @@ class Executor:
             if item is None or q is not self._sync_queue:
                 return
             spec, fut = item
-            # Ack execution start through the batched channel: flushed by
-            # the loop (usually while the task still runs), so a worker
-            # death mid-task is distinguishable from died-in-queue.
-            self._post_event(("ack", spec, None, None))
+            conn = self._stream_conns.get(spec.task_id.hex())
+            is_normal = spec.task_type == TaskType.NORMAL_TASK
+            tracked = (getattr(fut, "_rtpu_delivery_tracked", False)
+                       and is_normal)
             # Delivery barrier (see __init__): the PREVIOUS task's reply
             # must hit the socket before this task's user code runs (it
-            # may os._exit). Placed after dequeue+ack so an empty queue
-            # absorbs the handoff for free — the loop drains while we
-            # block in q.get(). Normal tasks only: their retry budget is
-            # what a lost sibling result silently burns. Actor methods
-            # are not re-executed on actor death (stateful; the caller
-            # gets ActorDiedError either way), so they keep the fully
-            # pipelined path.
+            # may os._exit). An empty queue absorbs the handoff for free
+            # — the loop drains while we block in q.get(). Replies that
+            # went out through try_notify_sync never arm it.
             self._delivered.wait(timeout=10.0)
-            if (getattr(fut, "_rtpu_delivery_tracked", False)
-                    and spec.task_type == TaskType.NORMAL_TASK):
-                self._delivered.clear()
+            epoch = self.cw.owner_notify_epoch
+            # Arm the deferred ack (see __init__): the loop's ack timer
+            # acks this task only if it is still running at ACK_DELAY.
+            if is_normal and conn is not None:
+                self._ack_slot = [spec.task_id.hex(), conn,
+                                  time.monotonic(), False]
             try:
                 result = self._execute_sync(spec)
             except BaseException as e:  # incl. ActorExitSignal
+                self._ack_slot = None
+                if tracked:
+                    self._delivered.clear()
                 self._post_event(("done", spec, fut, e))
             else:
+                self._ack_slot = None
+                # Reply fast path: put the bytes in the kernel from THIS
+                # thread. Skipped when ordering could be violated —
+                # streaming tasks (items ride the loop) or an add_borrow
+                # queued during execution (epoch moved).
+                sent = (
+                    conn is not None
+                    and spec.num_returns != TaskSpec.STREAMING
+                    and self.cw.owner_notify_epoch == epoch
+                    and conn.try_notify_sync("task_done", {
+                        "task_id": spec.task_id.hex(), "reply": result})
+                )
+                if sent:
+                    fut._rtpu_reply_sent = True
+                elif tracked:
+                    self._delivered.clear()
                 self._post_event(("result", spec, fut, result))
+
+    def ensure_ack_timer(self):
+        """(loop thread) Start the deferred-ack scanner if idle. Runs
+        every ACK_DELAY while tasks flow, stops itself after a few idle
+        checks — ~50 wakeups/s while busy vs one syscall per task."""
+        if self._ack_timer_running:
+            return
+        self._ack_timer_running = True
+        self._ack_idle_checks = 0
+        self._loop.call_later(self.ACK_DELAY, self._ack_check)
+
+    def _ack_check(self):
+        slot = self._ack_slot
+        now = time.monotonic()
+        if slot is not None and not slot[3] \
+                and now - slot[2] >= self.ACK_DELAY:
+            slot[3] = True
+            try:
+                slot[1].notify_nowait("task_accepted",
+                                      {"task_id": slot[0]})
+            except Exception:
+                pass
+        if slot is None:
+            self._ack_idle_checks += 1
+            if self._ack_idle_checks >= 3:
+                self._ack_timer_running = False
+                return
+        else:
+            self._ack_idle_checks = 0
+        self._loop.call_later(self.ACK_DELAY, self._ack_check)
 
     def _post_event(self, event):
         with self._events_lock:
@@ -173,16 +234,7 @@ class Executor:
             events, self._pending_events = self._pending_events, []
             self._events_wake = False
         for kind, spec, fut, payload in events:
-            if kind == "ack":
-                conn = self._stream_conns.get(spec.task_id.hex())
-                if conn is not None:
-                    try:
-                        conn.notify_nowait(
-                            "task_accepted",
-                            {"task_id": spec.task_id.hex()})
-                    except Exception:
-                        pass
-            elif kind == "result":
+            if kind == "result":
                 self._record_terminal(spec, payload)
                 if not fut.done():
                     fut.set_result(payload)
@@ -204,7 +256,10 @@ class Executor:
         """Tell the owner execution is starting. Sent at dequeue time,
         not push receipt: with pipelined pushes, tasks still sitting in
         this queue when the worker dies provably never ran, and the
-        missing ack lets the owner retry them for free."""
+        missing ack lets the owner retry them for free. Normal tasks
+        only — the free-retry decision is the ack's sole consumer."""
+        if spec.task_type != TaskType.NORMAL_TASK:
+            return
         conn = self._stream_conns.get(spec.task_id.hex())
         if conn is not None:
             await self._notify_quiet(conn, spec.task_id.hex())
@@ -734,6 +789,8 @@ async def _amain():
         executor.ensure_started()
 
         def finish(spec, fut):
+            if getattr(fut, "_rtpu_reply_sent", False):
+                return  # reply already in the kernel (executor fast path)
             try:
                 e = fut.exception()
             except asyncio.CancelledError:
@@ -777,6 +834,7 @@ async def _amain():
         for spec in specs:
             fut = executor.submit_nowait(spec, conn)
             fut.add_done_callback(functools.partial(finish, spec))
+        executor.ensure_ack_timer()
         return {"ok": True}
 
     async def h_create_actor(conn, payload):

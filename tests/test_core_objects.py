@@ -121,3 +121,27 @@ def test_shm_store_pin_blocks_eviction():
     b = ObjectID.from_random()
     store.mark_sealed(b, 6_000)  # cannot evict a; over-capacity tolerated
     assert store.contains(a)
+
+
+def test_main_module_class_arg_roundtrips(ray_start):
+    """A class living at driver __main__ must serialize BY VALUE: the C
+    pickler serializes it by reference ('__main__.Cfg'), which a worker
+    (whose __main__ is worker_main) cannot resolve. serialize() detects
+    the __main__ reference and reroutes to cloudpickle (r5 advisor)."""
+    import __main__ as main_mod
+
+    class Cfg:
+        def __init__(self):
+            self.v = 41
+
+    Cfg.__module__ = "__main__"
+    Cfg.__qualname__ = "Cfg"
+    main_mod.Cfg = Cfg  # simulate a script-level definition
+    try:
+        @ray_tpu.remote
+        def probe(c):
+            return c.v + 1
+
+        assert ray_tpu.get(probe.remote(Cfg()), timeout=120) == 42
+    finally:
+        del main_mod.Cfg
