@@ -66,17 +66,30 @@ class StandardAutoscaler:
         return cw.loop_thread.run(cw.head.call("get_load", {}))
 
     # -- planning ------------------------------------------------------
-    def plan(self, load: dict) -> tuple:
-        """Pure planning: (to_launch: {type: n}, to_terminate: [ids])."""
+    def plan(self, load: dict,
+             extra_capacity: Optional[List[Dict[str, float]]] = None,
+             pending_by_type: Optional[Dict[str, int]] = None
+             ) -> tuple:
+        """Pure planning: (to_launch: {type: n}, to_terminate: [ids]).
+
+        ``extra_capacity``: hypothetical availability for nodes that are
+        coming but not yet ALIVE (async launches in flight, booting
+        provider nodes) — the Monitor passes these so a booting node
+        isn't re-launched every tick. ``pending_by_type``: in-flight
+        launches that are not provider nodes yet, counted toward the
+        min_workers floor and max_workers caps for the same reason."""
         provider_nodes = self.provider.non_terminated_nodes()
         counts: Dict[str, int] = {}
         for n in provider_nodes:
             counts[n["node_type"]] = counts.get(n["node_type"], 0) + 1
+        for tname, n in (pending_by_type or {}).items():
+            counts[tname] = counts.get(tname, 0) + n
 
         # Unmet demand: pending shapes that no ALIVE node's availability
         # covers (simulate packing onto current availability first).
         avail = [dict(n["available"]) for n in load["nodes"]
                  if n["state"] == "ALIVE"]
+        avail.extend(dict(c) for c in (extra_capacity or []))
         unmet: List[Dict[str, float]] = []
         for demand in load["pending"]:
             placed = False

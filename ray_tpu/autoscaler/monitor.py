@@ -1,0 +1,228 @@
+"""Autoscaler monitor: the head-side loop that actually runs scaling.
+
+Reference: python/ray/autoscaler/_private/monitor.py:126 — the Monitor
+head-node process whose loop (:360) reads load from the GCS (:241) and
+drives StandardAutoscaler.update(). Here the same loop drives the v1
+demand policy (``StandardAutoscaler.plan`` — bin-packing pending demand
+onto node types, idle termination) through the v2 ``InstanceManager``
+(declarative records, ASYNC provider calls), so one slow cloud create
+never stalls a tick.
+
+Runs either embedded in the head process (``HeadNode`` starts it when
+``RAY_TPU_AUTOSCALER=1``, config from ``RAY_TPU_AUTOSCALER_CONFIG``) or
+in-driver for tests/tools (``Monitor(...).start()`` with a
+FakeNodeProvider).
+"""
+
+from __future__ import annotations
+
+import json
+import logging
+import threading
+import time
+from typing import Callable, Dict, List, Optional
+
+from ray_tpu.autoscaler.autoscaler import AutoscalerConfig, NodeType, StandardAutoscaler
+from ray_tpu.autoscaler.providers import NodeProvider
+from ray_tpu.autoscaler.v2 import (
+    QUEUED,
+    REQUESTED,
+    RUNNING,
+    TERMINATING,
+    ClusterSpec,
+    InstanceManager,
+    NodeTypeSpec,
+)
+
+logger = logging.getLogger(__name__)
+
+
+class Monitor:
+    """v1 policy × v2 lifecycle, on a timer."""
+
+    #: How long a created node without head-node linkage is presumed to
+    #: still be booting (counts as coming capacity). TPU slices take
+    #: minutes to provision.
+    BOOT_GRACE_S = 300.0
+
+    def __init__(self, config: AutoscalerConfig, provider: NodeProvider,
+                 load_fn: Callable[[], dict], interval_s: float = 5.0,
+                 max_concurrent_launches: int = 4,
+                 launch_mode: str = "async"):
+        self.config = config
+        self.provider = provider
+        self.load_fn = load_fn
+        self.interval_s = interval_s
+        self.policy = StandardAutoscaler(config, provider)
+        spec = ClusterSpec(node_types={
+            nt.name: NodeTypeSpec(nt.name, nt.min_workers, nt.max_workers,
+                                  dict(nt.resources))
+            for nt in config.node_types
+        })
+        self.im = InstanceManager(
+            spec, provider,
+            max_concurrent_launches=max_concurrent_launches,
+            launch_mode=launch_mode)
+        self.last_summary: dict = {}
+        self.last_error: str = ""
+        self.ticks = 0
+        self._stop = threading.Event()
+        self._thread: Optional[threading.Thread] = None
+
+    # -- one tick ------------------------------------------------------
+
+    def tick(self) -> dict:
+        load = self.load_fn()
+        # Capacity that is COMING but not yet schedulable (async create
+        # in flight, or created node booting toward its head
+        # registration) must count against demand, or every tick while a
+        # node boots would launch another (the v1 monitor tracks this as
+        # pending launches + non-ALIVE provider nodes). Counted from
+        # INSTANCE records only — a mid-create node that the provider
+        # already lists has exactly one record (REQUESTED), so it can't
+        # be double-counted against both views.
+        alive_node_ids = {n["node_id"] for n in load["nodes"]
+                          if n["state"] == "ALIVE"}
+        provider_by_pid = {n["provider_node_id"]: n
+                           for n in self.provider.non_terminated_nodes()}
+        pending_caps: List[Dict[str, float]] = []
+        pending_by_type: Dict[str, int] = {}
+        types = {nt.name: nt for nt in self.config.node_types}
+        booting = 0
+        now = time.time()
+        for inst in list(self.im.instances.values()):
+            if inst.node_type not in types:
+                continue
+            if inst.status in (QUEUED, REQUESTED):
+                coming = True
+            elif inst.status == RUNNING:
+                # Created but possibly still booting toward its head
+                # registration. Providers that expose the head node_id
+                # (FakeNodeProvider) answer exactly; otherwise fall back
+                # to a boot-grace window on instance age.
+                pnode = provider_by_pid.get(inst.provider_node_id, {})
+                nid = pnode.get("node_id")
+                nid_hex = nid.hex() if hasattr(nid, "hex") \
+                    and nid is not None else nid
+                if nid_hex is not None:
+                    coming = nid_hex not in alive_node_ids
+                else:
+                    coming = now - inst.created_at < self.BOOT_GRACE_S
+                if coming:
+                    booting += 1
+            else:
+                continue
+            if coming:
+                pending_caps.append(dict(types[inst.node_type].resources))
+                # Floor/cap counting: only instances that are NOT yet
+                # provider nodes — RUNNING-booting ones already appear
+                # in plan()'s provider counts.
+                if inst.status in (QUEUED, REQUESTED):
+                    pending_by_type[inst.node_type] = \
+                        pending_by_type.get(inst.node_type, 0) + 1
+        to_launch, to_terminate = self.policy.plan(
+            load, extra_capacity=pending_caps,
+            pending_by_type=pending_by_type)
+        # Specific idle victims first (the policy picked THEM, not
+        # newest-first), then declare per-type targets and reconcile.
+        for pid in to_terminate:
+            self.im.terminate_node(pid)
+        current: Dict[str, int] = {}
+        for inst in self.im.instances.values():
+            if inst.status in (QUEUED, REQUESTED, RUNNING):
+                current[inst.node_type] = current.get(inst.node_type,
+                                                      0) + 1
+        for tname in types:
+            self.im.scale(tname, current.get(tname, 0)
+                          + to_launch.get(tname, 0))
+        summary = self.im.reconcile()
+        self.ticks += 1
+        self.last_summary = {
+            "tick": self.ticks,
+            "ts": time.time(),
+            "pending_demand": len(load["pending"]),
+            "booting": booting,
+            "planned_launches": to_launch,
+            "planned_terminations": list(to_terminate),
+            **summary,
+        }
+        return self.last_summary
+
+    # -- loop ----------------------------------------------------------
+
+    def start(self):
+        if self._thread is not None:
+            return
+        self._thread = threading.Thread(
+            target=self._run, name="rtpu-autoscaler", daemon=True)
+        self._thread.start()
+
+    def _run(self):
+        while not self._stop.wait(self.interval_s):
+            try:
+                self.tick()
+                self.last_error = ""
+            except Exception as e:  # noqa: BLE001 — the loop must survive
+                self.last_error = f"{type(e).__name__}: {e}"
+                logger.exception("autoscaler tick failed")
+
+    def stop(self):
+        self._stop.set()
+        if self._thread is not None:
+            self._thread.join(timeout=5)
+            self._thread = None
+
+    def status(self) -> dict:
+        """CLI / dashboard surface (``ray status`` analog)."""
+        return {
+            "running": self._thread is not None and
+            self._thread.is_alive(),
+            "interval_s": self.interval_s,
+            "last_summary": self.last_summary,
+            "last_error": self.last_error,
+            "cluster": self.im.cluster_status(),
+        }
+
+
+def monitor_from_config_file(path: str, provider: NodeProvider,
+                             load_fn, **kw) -> Monitor:
+    """Build a Monitor from a cluster-config JSON (the cluster-YAML
+    analog): {"node_types": [{"name", "resources", "min_workers",
+    "max_workers"}], "idle_timeout_s": 60}."""
+    with open(path) as f:
+        raw = json.load(f)
+    config = AutoscalerConfig(
+        node_types=[NodeType(
+            name=t["name"], resources=t["resources"],
+            min_workers=t.get("min_workers", 0),
+            max_workers=t.get("max_workers", 10),
+            labels=t.get("labels", {}),
+        ) for t in raw["node_types"]],
+        idle_timeout_s=raw.get("idle_timeout_s", 60.0),
+        upscaling_speed=raw.get("upscaling_speed", 1.0),
+    )
+    return Monitor(config, provider, load_fn,
+                   interval_s=raw.get("interval_s", 5.0), **kw)
+
+
+def provider_from_config(raw: dict, head_address: str,
+                         head_node=None) -> NodeProvider:
+    """Instantiate the provider named in the cluster config."""
+    ptype = raw.get("provider", {}).get("type", "fake")
+    if ptype == "fake":
+        from ray_tpu.autoscaler.providers import FakeNodeProvider
+
+        return FakeNodeProvider(head=head_node)
+    if ptype == "gcp_tpu":
+        from ray_tpu.autoscaler.providers import GcpTpuPodSliceProvider
+
+        p = raw["provider"]
+        return GcpTpuPodSliceProvider(
+            project=p["project"], zone=p["zone"],
+            head_address=p.get("head_address", head_address),
+            runtime_version=p.get("runtime_version",
+                                  "tpu-ubuntu2204-base"),
+            name_prefix=p.get("name_prefix", "ray-tpu"),
+            setup_commands=p.get("setup_commands"),
+        )
+    raise ValueError(f"unknown provider type {ptype!r}")

@@ -36,14 +36,17 @@ class FakeNodeProvider(NodeProvider):
     """Creates logical nodes on the in-process head — the analog of
     RAY_FAKE_CLUSTER=1 (fake_multi_node). Used for autoscaler tests."""
 
-    def __init__(self):
-        from ray_tpu import api as _api
+    def __init__(self, head=None):
+        if head is None:
+            from ray_tpu import api as _api
 
-        if _api._global_node is None:
-            raise RuntimeError(
-                "FakeNodeProvider needs an in-process head "
-                "(ray_tpu.init() without address=)")
-        self._head = _api._global_node
+            if _api._global_node is None:
+                raise RuntimeError(
+                    "FakeNodeProvider needs an in-process head "
+                    "(ray_tpu.init() without address=) or an explicit "
+                    "head= (a HeadNode, e.g. from head_main)")
+            head = _api._global_node
+        self._head = head
         self._nodes: Dict[str, dict] = {}
 
     def create_node(self, node_type: str, resources: Dict[str, float],

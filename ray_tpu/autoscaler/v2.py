@@ -90,12 +90,30 @@ class InstanceManager:
     TERMINAL_RETENTION_S = 600.0
 
     def __init__(self, spec: ClusterSpec, provider: NodeProvider,
-                 max_concurrent_launches: int = 4):
+                 max_concurrent_launches: int = 4,
+                 launch_mode: str = "sync"):
+        """``launch_mode="async"`` runs provider create/terminate calls
+        on a background thread pool so one slow cloud call (gcloud create
+        can take minutes) never stalls the reconcile tick — the mode the
+        Monitor uses (reference: v1 launches from NodeLauncher threads).
+        ``"sync"`` keeps the deterministic inline behavior for
+        single-shot/declarative use."""
+        if launch_mode not in ("sync", "async"):
+            raise ValueError(f"launch_mode {launch_mode!r}")
         self.spec = spec
         self.provider = provider
         self.max_concurrent_launches = max_concurrent_launches
         self.instances: Dict[str, Instance] = {}
         self._counter = itertools.count()
+        self._pool = None
+        self._launches: Dict[str, object] = {}  # instance_id -> Future
+        self._terminations: Dict[str, object] = {}  # instance_id -> Future
+        if launch_mode == "async":
+            from concurrent.futures import ThreadPoolExecutor
+
+            self._pool = ThreadPoolExecutor(
+                max_workers=max(1, max_concurrent_launches),
+                thread_name_prefix="rtpu-launch")
 
     def _new_instance(self, node_type: str, **kw) -> Instance:
         seq = next(self._counter)
@@ -106,12 +124,14 @@ class InstanceManager:
     # -- introspection (get_cluster_status analog) ---------------------
 
     def cluster_status(self) -> dict:
+        # Snapshot first: status is read from other threads (the head's
+        # h_autoscaler_status) while the Monitor thread reconciles.
+        instances = list(self.instances.values())
         by_status: Dict[str, int] = {}
-        for inst in self.instances.values():
+        for inst in instances:
             by_status[inst.status] = by_status.get(inst.status, 0) + 1
         return {
-            "instances": [vars(i).copy()
-                          for i in self.instances.values()],
+            "instances": [vars(i).copy() for i in instances],
             "by_status": by_status,
             "desired": {t: self.spec.desired(t)
                         for t in self.spec.node_types},
@@ -125,11 +145,26 @@ class InstanceManager:
             raise ValueError(f"unknown node type {node_type!r}")
         self.spec.target[node_type] = count
 
+    def terminate_node(self, provider_node_id: str) -> bool:
+        """Mark the instance backing a specific provider node for
+        termination (the Monitor's idle-node path picks victims by id —
+        reference: StandardAutoscaler terminating specific idle nodes,
+        not newest-first)."""
+        for inst in self.instances.values():
+            if (inst.provider_node_id == provider_node_id
+                    and inst.status in (RUNNING, REQUESTED)):
+                inst.transition(TERMINATING)
+                return True
+        return False
+
     # -- reconciliation ------------------------------------------------
 
     def reconcile(self) -> dict:
         """One tick: sync records with the provider, then launch or
-        terminate toward the desired counts. Returns the action summary."""
+        terminate toward the desired counts. Returns the action summary.
+        In async mode the tick never blocks on the cloud: creates run on
+        the pool and are harvested on later ticks."""
+        launched_async = self._harvest_launches()
         self._sync_with_provider()
         launched: Dict[str, int] = {}
         terminated: List[str] = []
@@ -158,6 +193,14 @@ class InstanceManager:
                     inst.transition(TERMINATED, error="cancelled")
                     terminated.append(inst.instance_id)
                     need -= 1
+                # In-flight creates next: flipping them off REQUESTED
+                # makes the harvest release the node on arrival.
+                for inst in [i for i in live
+                             if i.status == REQUESTED][:max(0, need)]:
+                    inst.transition(TERMINATED,
+                                    error="cancelled mid-launch")
+                    terminated.append(inst.instance_id)
+                    need -= 1
                 victims = sorted(
                     (i for i in live if i.status == RUNNING),
                     key=lambda i: (-i.created_at, -i.seq))[:need]
@@ -165,7 +208,7 @@ class InstanceManager:
                     inst.transition(TERMINATING)
         # Drive QUEUED → launch, capping ATTEMPTS per tick (a failing
         # provider must not absorb an unbounded number of create calls).
-        attempts = 0
+        attempts = len(self._launches)
         for inst in list(self.instances.values()):
             if inst.status != QUEUED:
                 continue
@@ -176,6 +219,11 @@ class InstanceManager:
             resources = (self.spec.node_types[inst.node_type].resources
                          if inst.node_type in self.spec.node_types
                          else {})
+            if self._pool is not None:
+                self._launches[inst.instance_id] = self._pool.submit(
+                    self.provider.create_node, inst.node_type,
+                    resources, {})
+                continue
             try:
                 node_id = self.provider.create_node(
                     inst.node_type, resources, {})
@@ -187,6 +235,8 @@ class InstanceManager:
                 inst.transition(FAILED, error=str(e))
                 logger.warning("launch of %s failed: %s",
                                inst.node_type, e)
+        for node_type, n in launched_async.items():
+            launched[node_type] = launched.get(node_type, 0) + n
         # Drive TERMINATING → TERMINATED.
         live_pids = {n["provider_node_id"]
                      for n in self.provider.non_terminated_nodes()}
@@ -200,6 +250,12 @@ class InstanceManager:
                 inst.transition(TERMINATED)
                 terminated.append(inst.instance_id)
                 continue
+            if self._pool is not None:
+                if inst.instance_id not in self._terminations:
+                    self._terminations[inst.instance_id] = \
+                        self._pool.submit(self.provider.terminate_node,
+                                          inst.provider_node_id)
+                continue
             try:
                 self.provider.terminate_node(inst.provider_node_id)
                 inst.transition(TERMINATED)
@@ -207,8 +263,60 @@ class InstanceManager:
             except Exception as e:
                 logger.warning("terminate of %s failed: %s",
                                inst.instance_id, e)
+        terminated.extend(self._harvest_terminations())
         self._prune_terminal()
         return {"launched": launched, "terminated": terminated}
+
+    def _harvest_launches(self) -> Dict[str, int]:
+        """Collect finished async creates (REQUESTED → RUNNING/FAILED).
+        A launch whose instance was cancelled mid-flight gets its node
+        released again — never leak a billing slice."""
+        done: Dict[str, int] = {}
+        for iid, fut in list(self._launches.items()):
+            if not fut.done():
+                continue
+            del self._launches[iid]
+            inst = self.instances.get(iid)
+            try:
+                node_id = fut.result()
+            except Exception as e:  # noqa: BLE001
+                if inst is not None and inst.status == REQUESTED:
+                    inst.transition(FAILED, error=str(e))
+                logger.warning("async launch failed: %s", e)
+                continue
+            if inst is None or inst.status != REQUESTED:
+                # Scaled down while the create was in flight: record the
+                # orphan as TERMINATING so the normal termination driver
+                # owns (and retries) its release — a bare fire-and-forget
+                # terminate could leak a billing slice on one transient
+                # cloud error.
+                self._new_instance(
+                    inst.node_type if inst is not None else "adopted",
+                    status=TERMINATING, provider_node_id=node_id,
+                    error="cancelled mid-launch; releasing")
+                continue
+            inst.provider_node_id = node_id
+            inst.transition(RUNNING)
+            done[inst.node_type] = done.get(inst.node_type, 0) + 1
+        return done
+
+    def _harvest_terminations(self) -> List[str]:
+        out: List[str] = []
+        for iid, fut in list(self._terminations.items()):
+            if not fut.done():
+                continue
+            del self._terminations[iid]
+            inst = self.instances.get(iid)
+            if inst is None:
+                continue
+            try:
+                fut.result()
+                inst.transition(TERMINATED)
+                out.append(iid)
+            except Exception as e:  # noqa: BLE001
+                logger.warning("terminate of %s failed: %s", iid, e)
+                # stays TERMINATING; retried next tick
+        return out
 
     def _prune_terminal(self):
         cutoff = time.time() - self.TERMINAL_RETENTION_S
@@ -225,10 +333,14 @@ class InstanceManager:
                     for n in self.provider.non_terminated_nodes()}
         known = {i.provider_node_id for i in self.instances.values()
                  if i.provider_node_id}
-        for pid, node in live_ids.items():
-            if pid not in known:
-                self._new_instance(node["node_type"], status=RUNNING,
-                                   provider_node_id=pid)
+        # Adoption is deferred while async creates are outstanding: a
+        # node the provider already lists but whose create-future hasn't
+        # been harvested would otherwise be double-recorded.
+        if not self._launches:
+            for pid, node in live_ids.items():
+                if pid not in known:
+                    self._new_instance(node["node_type"], status=RUNNING,
+                                       provider_node_id=pid)
         for inst in self.instances.values():
             if (inst.status == RUNNING
                     and inst.provider_node_id not in live_ids):

@@ -475,6 +475,7 @@ class HeadService:
             "worker_death_reason": self.h_worker_death_reason,
             "report_oom_kill": self.h_report_oom_kill,
             "ping": self.h_ping,
+            "autoscaler_status": self.h_autoscaler_status,
             # Serve the head-host node store for cross-node pulls.
             **object_transfer.serve_handlers(),
         }
@@ -1334,6 +1335,15 @@ class HeadService:
 
     async def h_ping(self, conn, payload):
         return {"ok": True, "time": time.time()}
+
+    async def h_autoscaler_status(self, conn, payload):
+        """Monitor introspection for CLI/dashboard (``ray status``
+        analog). ``self.autoscaler`` is set by whoever runs a Monitor
+        in this process (HeadNode with RAY_TPU_AUTOSCALER=1)."""
+        monitor = getattr(self, "autoscaler", None)
+        if monitor is None:
+            return {"enabled": False}
+        return {"enabled": True, **monitor.status()}
 
     # ------------------------------------------------------------------
 

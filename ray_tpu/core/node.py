@@ -122,6 +122,45 @@ class HeadNode:
 
         self.port = self.loop_thread.run(boot())
         self.default_node_id = self.add_node(resources)
+        # Opt-in autoscaler monitor (reference: the Monitor head-node
+        # process, autoscaler/_private/monitor.py:126): RAY_TPU_AUTOSCALER=1
+        # + RAY_TPU_AUTOSCALER_CONFIG=<cluster config JSON>.
+        self.monitor = None
+        if os.environ.get("RAY_TPU_AUTOSCALER") == "1":
+            cfg_path = os.environ.get("RAY_TPU_AUTOSCALER_CONFIG")
+            if not cfg_path:
+                logger.warning("RAY_TPU_AUTOSCALER=1 but no "
+                               "RAY_TPU_AUTOSCALER_CONFIG; not starting")
+            else:
+                try:
+                    self._start_monitor(cfg_path)
+                except Exception:
+                    logger.exception("autoscaler monitor failed to start")
+
+    def _start_monitor(self, cfg_path: str):
+        import json as _json
+
+        from ray_tpu.autoscaler.monitor import (
+            monitor_from_config_file,
+            provider_from_config,
+        )
+
+        with open(cfg_path) as f:
+            raw = _json.load(f)
+        provider = provider_from_config(
+            raw, head_address=f"{self.host}:{self.port}", head_node=self)
+
+        def load_fn():
+            return self.loop_thread.run(
+                self.service.h_get_load(None, {}))
+
+        self.monitor = monitor_from_config_file(
+            cfg_path, provider, load_fn)
+        self.service.autoscaler = self.monitor
+        self.monitor.start()
+        logger.info("autoscaler monitor running (interval %.1fs, %d "
+                    "node types)", self.monitor.interval_s,
+                    len(self.monitor.config.node_types))
 
     def add_node(self, resources: Dict[str, float],
                  labels: Optional[Dict[str, str]] = None) -> NodeID:
@@ -144,6 +183,12 @@ class HeadNode:
             self.node_ids.remove(node_id)
 
     def shutdown(self):
+        if getattr(self, "monitor", None) is not None:
+            try:
+                self.monitor.stop()
+            except Exception:
+                pass
+            self.monitor = None
         try:
             self.loop_thread.run(self.service.shutdown(), timeout=10)
         except Exception:

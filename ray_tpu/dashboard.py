@@ -37,6 +37,7 @@ class DashboardActor:
         app.router.add_get("/api/workers", self._workers)
         app.router.add_get("/api/jobs", self._jobs)
         app.router.add_get("/api/objects", self._objects)
+        app.router.add_get("/api/autoscaler", self._autoscaler)
         app.router.add_get("/metrics", self._metrics)
         app.router.add_get("/healthz", self._healthz)
         self._runner = web.AppRunner(app)
@@ -68,6 +69,14 @@ class DashboardActor:
                 "cluster_resources": ray_tpu.cluster_resources(),
                 "available_resources": ray_tpu.available_resources(),
             }
+
+        return await self._json(produce)
+
+    async def _autoscaler(self, request):
+        def produce():
+            from ray_tpu.util.state import _call
+
+            return _call("autoscaler_status")
 
         return await self._json(produce)
 

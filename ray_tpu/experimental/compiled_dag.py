@@ -54,9 +54,14 @@ class _NodeError:
         self.method = method
 
 
-def _local_hosts() -> set:
-    """Addresses that resolve to this machine (shm channel scope)."""
+def _local_hosts() -> tuple:
+    """(addresses that resolve to this machine, confident) — shm channel
+    scope. ``confident`` is False when the NIC address couldn't be
+    determined (no default route): a non-loopback advertised address
+    then CAN'T be disproven local, so the caller must not reject on it
+    (the attach timeout stays the backstop)."""
     hosts = {"127.0.0.1", "localhost", "0.0.0.0", "::1", ""}
+    confident = False
     try:
         name = socket.gethostname()
         hosts.add(name)
@@ -72,11 +77,12 @@ def _local_hosts() -> set:
         try:
             s.connect(("8.8.8.8", 80))
             hosts.add(s.getsockname()[0])
+            confident = True
         finally:
             s.close()
     except OSError:
         pass
-    return hosts
+    return hosts, confident
 
 
 # ---------------------------------------------------------------------------
@@ -296,7 +302,7 @@ class CompiledDag:
         from ray_tpu import api as _api
 
         cw = _api._require_worker()
-        local = _local_hosts()
+        local, confident = _local_hosts()
         deadline = time.monotonic() + timeout
         for handle in handles:
             aid = handle._actor_id.hex()
@@ -310,7 +316,7 @@ class CompiledDag:
                             f"cannot compile DAG: actor {aid} is dead")
                     addr = reply.get("address")
                     if addr:
-                        if addr[0] not in local:
+                        if addr[0] not in local and confident:
                             raise ValueError(
                                 f"compiled DAGs require every actor on "
                                 f"the driver's host (channels are posix "

@@ -91,6 +91,17 @@ def cmd_status(args):
     for w in workers:
         by_state[w["state"]] = by_state.get(w["state"], 0) + 1
     print(f"== workers: {by_state} ==")
+    asc = ust._call("autoscaler_status")
+    if asc.get("enabled"):
+        summary = asc.get("last_summary", {})
+        cluster = asc.get("cluster", {})
+        print("== autoscaler ==")
+        print(f"  running: {asc.get('running')}  "
+              f"tick: {summary.get('tick', 0)}  "
+              f"pending demand: {summary.get('pending_demand', 0)}")
+        print(f"  instances: {cluster.get('by_status', {})}")
+        if asc.get("last_error"):
+            print(f"  last error: {asc['last_error']}")
     ray_tpu.shutdown()
 
 
