@@ -28,7 +28,9 @@ from ray_tpu.api import (
     kv_del,
     kv_exists,
     kv_get,
+    kv_keys,
     kv_put,
+    list_named_actors,
     method,
     nodes,
     placement_group,
@@ -63,7 +65,9 @@ __all__ = [
     "kv_del",
     "kv_exists",
     "kv_get",
+    "kv_keys",
     "kv_put",
+    "list_named_actors",
     "method",
     "nodes",
     "placement_group",

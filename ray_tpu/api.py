@@ -783,3 +783,21 @@ def kv_exists(key: bytes, *, namespace: str = "") -> bool:
         "ns": namespace, "key": key,
     }))
     return bool(reply.get("exists"))
+
+
+def list_named_actors(all_namespaces: bool = False,
+                      namespace: str = "") -> list:
+    """[{namespace, name}] of live named actors (reference:
+    ray.util.list_named_actors)."""
+    cw = _require_worker()
+    return cw.loop_thread.run(cw.head.call("list_named_actors", {
+        "all_namespaces": all_namespaces, "namespace": namespace,
+    }))
+
+
+def kv_keys(prefix: bytes = b"", *, namespace: str = "") -> list:
+    cw = _require_worker()
+    reply = cw.loop_thread.run(cw.head.call("kv_keys", {
+        "ns": namespace, "prefix": prefix,
+    }))
+    return list(reply.get("keys", []))

@@ -21,10 +21,15 @@ CONTROLLER_NAME = "SERVE_CONTROLLER"
 
 
 class DeploymentState:
-    def __init__(self, app_name: str, spec: dict):
+    def __init__(self, app_name: str, spec: dict, generation: int = 0):
         self.app_name = app_name
         self.spec = spec
         self.name = spec["name"]
+        # Per-deploy generation: replica names embed it, so a replica
+        # from a deleted/replaced app generation can never be adopted by
+        # the next one (recovery reuses the checkpointed generation so
+        # adoption of surviving replicas still works).
+        self.generation = generation
         self.target_replicas = spec["config"].initial_replicas()
         self.replicas: Dict[str, Any] = {}  # replica_id -> actor handle
         self.replica_started: Dict[str, float] = {}
@@ -45,30 +50,183 @@ class ServeController:
     """Async actor; deploy/delete mutate target state, a reconcile loop
     converges the actual state."""
 
+    #: GCS KV namespace for controller state (reference:
+    #: serve/_private/application_state.py checkpoints app specs to the
+    #: GCS KV so a restarted controller recovers every deployed app).
+    KV_NS = "_serve"
+    KV_APP_PREFIX = b"serve:app:"
+
     def __init__(self):
         self.apps: Dict[str, List[str]] = {}  # app -> deployment keys
         self.deployments: Dict[str, DeploymentState] = {}
         self.routing_version = 0
         self._shutdown = False
+        # Serializes check-then-act replica creation: creation awaits
+        # off-loop (get_if_exists name lookup), so two interleaved
+        # _reconcile_once runs would otherwise both see the same gap
+        # and over-create.
+        self._reconcile_lock = asyncio.Lock()
+        self._recovered = asyncio.get_event_loop().create_task(
+            self._recover())
         self._loop_task = asyncio.get_event_loop().create_task(
             self._reconcile_loop())
         self.http_port: Optional[int] = None
 
+    # -- persistence / recovery ----------------------------------------
+    # KV calls are blocking control RPCs; from this async actor they
+    # must run off-loop (same rule as _kill_async).
+
+    async def _kv(self, fn, *args, **kw):
+        loop = asyncio.get_event_loop()
+        return await loop.run_in_executor(None, lambda: fn(*args, **kw))
+
+    async def _next_generation(self, app_name: str) -> int:
+        """Monotonic per-app deploy counter, persisted OUTSIDE the app
+        checkpoint (delete must not reset it — a post-delete redeploy
+        reusing names would adopt replicas that are mid graceful-stop)."""
+        import ray_tpu
+
+        key = b"serve:gen:" + app_name.encode()
+        try:
+            raw = await self._kv(ray_tpu.kv_get, key,
+                                 namespace=self.KV_NS)
+            gen = int(raw or 0) + 1
+            await self._kv(ray_tpu.kv_put, key, str(gen).encode(),
+                           namespace=self.KV_NS)
+            return gen
+        except Exception:
+            logger.exception("generation bump failed; using clock")
+            return int(time.time())
+
+    async def _persist_app(self, app_name: str, specs: List[dict],
+                           generation: int):
+        import cloudpickle
+
+        import ray_tpu
+
+        try:
+            await self._kv(ray_tpu.kv_put,
+                           self.KV_APP_PREFIX + app_name.encode(),
+                           cloudpickle.dumps({"specs": specs,
+                                              "gen": generation}),
+                           namespace=self.KV_NS)
+        except Exception:
+            logger.exception("failed to checkpoint app %s", app_name)
+
+    async def _unpersist_app(self, app_name: str):
+        import ray_tpu
+
+        try:
+            await self._kv(ray_tpu.kv_del,
+                           self.KV_APP_PREFIX + app_name.encode(),
+                           namespace=self.KV_NS)
+        except Exception:
+            logger.exception("failed to drop checkpoint of %s", app_name)
+
+    async def _recover(self):
+        """Controller restart (including head restart recreating this
+        detached actor __init__-fresh): redeploy every checkpointed app.
+        Replica creation uses get_if_exists, so replicas that survived a
+        controller-only restart are adopted rather than duplicated."""
+        import cloudpickle
+
+        import ray_tpu
+
+        try:
+            keys = await self._kv(ray_tpu.kv_keys, self.KV_APP_PREFIX,
+                                  namespace=self.KV_NS)
+        except Exception:
+            logger.exception("serve recovery: KV unavailable")
+            return
+        failed_apps = set()
+        for key in keys:
+            app_name = key[len(self.KV_APP_PREFIX):].decode()
+            try:
+                blob = await self._kv(ray_tpu.kv_get, key,
+                                      namespace=self.KV_NS)
+                if blob is None:
+                    continue
+                ckpt = cloudpickle.loads(blob)
+                await self.deploy_application(
+                    app_name, ckpt["specs"], _persist=False,
+                    _generation=ckpt.get("gen", 0))
+                logger.info("serve recovery: redeployed app %r "
+                            "(%d deployments)", app_name,
+                            len(ckpt["specs"]))
+            except Exception:
+                failed_apps.add(app_name)
+                logger.exception("serve recovery of app %r failed",
+                                 app_name)
+        if keys:
+            await self._reap_orphan_replicas(failed_apps)
+
+    async def _reap_orphan_replicas(self, failed_apps: set):
+        """Pre-crash replicas of earlier generations were recreated as
+        detached actors by GCS recovery but belong to no deployment —
+        kill them, or they linger serving nothing forever. Replicas of
+        apps whose RECOVERY failed are left alone: they may still be
+        serving, and killing them would turn a transient recovery error
+        into an outage."""
+        import ray_tpu
+
+        try:
+            named = await self._kv(ray_tpu.list_named_actors, True)
+        except Exception:
+            return
+        known = set()
+        for ds in self.deployments.values():
+            known.update(ds.replicas)
+        for row in named:
+            name = row["name"]
+            if not name.startswith("SERVE_REPLICA::") or name in known:
+                continue
+            # name layout: SERVE_REPLICA::<app>#<deployment>#g<gen>#<n>
+            app = name[len("SERVE_REPLICA::"):].split("#", 1)[0]
+            if app in failed_apps:
+                continue
+            try:
+                actor = await self._kv(
+                    ray_tpu.get_actor, name,
+                    namespace=row.get("namespace", ""))
+            except Exception:
+                continue
+            logger.info("serve recovery: reaping orphan replica %s",
+                        name)
+            await _kill_async(actor)
+
     # -- deploy API -----------------------------------------------------
     async def deploy_application(self, app_name: str,
-                                 specs: List[dict]) -> None:
+                                 specs: List[dict],
+                                 _persist: bool = True,
+                                 _generation: Optional[int] = None
+                                 ) -> None:
+        if _persist:
+            # External deploys wait for recovery: a stale checkpoint
+            # being replayed must not stomp a newer deploy.
+            try:
+                await self._recovered
+            except Exception:
+                pass
+        if _generation is None:
+            _generation = await self._next_generation(app_name)
+        # Validate/build BEFORE checkpointing — a deploy that raises must
+        # not poison the KV with specs every future recovery replays.
+        new_states = [DeploymentState(app_name, spec, _generation)
+                      for spec in specs]
+        for ds in new_states:
+            ds.spec["replica_config"].actor_options()  # validates
+        if _persist:
+            await self._persist_app(app_name, specs, _generation)
         old_keys = set(self.apps.get(app_name, []))
         new_keys = set()
-        for spec in specs:
-            ds = DeploymentState(app_name, spec)
+        for ds in new_states:
             key = ds.key()
             new_keys.add(key)
             existing = self.deployments.get(key)
             if existing is not None:
                 # Redeploy: replace spec; replicas are replaced by the
-                # reconcile loop (version bump -> restart all).
+                # reconcile loop (fresh generation -> fresh names).
                 await self._stop_all_replicas(existing)
-                ds._counter = existing._counter
             self.deployments[key] = ds
         for stale in old_keys - new_keys:
             st = self.deployments.pop(stale, None)
@@ -78,6 +236,7 @@ class ServeController:
         await self._reconcile_once()
 
     async def delete_application(self, app_name: str) -> None:
+        await self._unpersist_app(app_name)
         for key in self.apps.pop(app_name, []):
             st = self.deployments.pop(key, None)
             if st:
@@ -135,25 +294,43 @@ class ServeController:
             await asyncio.sleep(0.5)
 
     async def _reconcile_once(self):
+        async with self._reconcile_lock:
+            await self._reconcile_locked()
+
+    async def _reconcile_locked(self):
         import ray_tpu
 
         changed = False
         for key, ds in list(self.deployments.items()):
             while len(ds.replicas) < ds.target_replicas:
-                rid = f"{key}#{ds._counter}"
+                rid = f"{key}#g{ds.generation}#{ds._counter}"
                 ds._counter += 1
                 from ray_tpu.serve.replica import Replica
 
                 opts = dict(ds.spec["replica_config"].actor_options())
                 opts["name"] = f"SERVE_REPLICA::{rid}"
                 opts["lifetime"] = "detached"
-                actor = ray_tpu.remote(Replica).options(**opts).remote(
-                    ds.spec["serialized_callable"],
-                    ds.spec.get("init_args", ()),
-                    ds.spec.get("init_kwargs", {}),
-                    ds.spec["config"].user_config,
-                    ds.name, rid,
-                )
+                # Adoption on controller restart: a replica that
+                # survived (controller-only failure) is re-attached by
+                # name instead of name-colliding (reference: the
+                # controller recovering running replicas from
+                # checkpoints). get_if_exists does a BLOCKING name
+                # lookup, so creation runs off-loop (same rule as
+                # _kill_async).
+                opts["get_if_exists"] = True
+                spec = ds.spec
+
+                def create(opts=opts, spec=spec, rid=rid):
+                    return ray_tpu.remote(Replica).options(**opts).remote(
+                        spec["serialized_callable"],
+                        spec.get("init_args", ()),
+                        spec.get("init_kwargs", {}),
+                        spec["config"].user_config,
+                        spec["name"], rid,
+                    )
+
+                actor = await asyncio.get_event_loop().run_in_executor(
+                    None, create)
                 name = f"SERVE_REPLICA::{rid}"
                 ds.replicas[name] = actor
                 ds.replica_started[name] = time.time()
@@ -293,6 +470,8 @@ class ServeController:
 
     async def shutdown(self) -> None:
         self._shutdown = True
+        for app_name in list(self.apps):
+            await self._unpersist_app(app_name)
         for key, ds in list(self.deployments.items()):
             await self._stop_all_replicas(ds)
         self.deployments.clear()

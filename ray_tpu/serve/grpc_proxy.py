@@ -33,13 +33,37 @@ SERVICE = "ray_tpu.serve.UserDefinedService"
 
 
 class GrpcProxy:
-    """Runs inside the proxy actor next to the HTTP ingress."""
+    """Runs inside the proxy actor next to the HTTP ingress.
+
+    Security posture (r4 advisor): payloads are PICKLED, so the ingress
+    must never be reachable by untrusted peers. Enforced here, not just
+    documented:
+
+    - binding anything but loopback requires a shared-secret token
+      (``token=`` or ``RAY_TPU_SERVE_GRPC_TOKEN``) — a bare wide bind
+      raises at startup;
+    - when a token is set, every call must carry metadata
+      ``("serve-token", <token>)`` (or ``authorization: Bearer <token>``)
+      and unauthenticated calls are rejected with UNAUTHENTICATED
+      before the request bytes are unpickled.
+    """
 
     def __init__(self, get_router, host: str = "127.0.0.1",
-                 port: int = 0):
+                 port: int = 0, token: Optional[str] = None):
+        import os
+
         import grpc
 
         self._get_router = get_router
+        self._token = token if token is not None else \
+            os.environ.get("RAY_TPU_SERVE_GRPC_TOKEN") or None
+        if host not in ("127.0.0.1", "localhost", "::1") \
+                and not self._token:
+            raise ValueError(
+                f"refusing to bind the pickle-payload gRPC ingress to "
+                f"non-loopback {host!r} without a shared secret — set "
+                f"RAY_TPU_SERVE_GRPC_TOKEN (clients then send "
+                f"('serve-token', <token>) metadata)")
 
         proxy = self
 
@@ -60,9 +84,29 @@ class GrpcProxy:
         self._server.start()
         logger.info("serve gRPC ingress on %s:%d", host, self.port)
 
+    def _authorized(self, context) -> bool:
+        if self._token is None:
+            return True
+        import hmac
+
+        for k, v in (context.invocation_metadata() or ()):
+            if k == "serve-token" and hmac.compare_digest(
+                    str(v), self._token):
+                return True
+            if k == "authorization" and hmac.compare_digest(
+                    str(v), f"Bearer {self._token}"):
+                return True
+        return False
+
     def _call(self, target: str, request: bytes, context):
         import grpc
 
+        if not self._authorized(context):
+            # Rejected BEFORE unpickling: the payload format is the
+            # attack surface.
+            context.abort(grpc.StatusCode.UNAUTHENTICATED,
+                          "missing or wrong serve-token metadata")
+            return b""
         try:
             args, kwargs = pickle.loads(request) if request else ((), {})
         except Exception:

@@ -155,3 +155,61 @@ def test_head_restart_recovers_state(tmp_path):
             pass
         head.kill()
         head.wait(timeout=30)
+
+
+def test_serve_survives_head_restart(tmp_path):
+    """VERDICT r4 #3: controller fault tolerance. kill -9 the head,
+    restart on the same port + session dir — the recreated controller
+    recovers checkpointed app specs from GCS KV and the app serves
+    again WITHOUT redeploy (reference:
+    serve/_private/application_state.py checkpoint/recover)."""
+    import urllib.request
+
+    port = _free_port()
+    http_port = _free_port()
+    session_dir = str(tmp_path / "session")
+    os.makedirs(session_dir, exist_ok=True)
+    head = _start_head(port, session_dir)
+    try:
+        ray_tpu.init(address=f"127.0.0.1:{port}")
+        from ray_tpu import serve
+
+        @serve.deployment(num_cpus=0.1)
+        class Hello:
+            def __call__(self, request):
+                return "hello-ft"
+
+        serve.run(Hello.bind(), name="ft_app", route_prefix="/hello",
+                  http_port=http_port)
+
+        def fetch(timeout=20):
+            with urllib.request.urlopen(
+                    f"http://127.0.0.1:{http_port}/hello",
+                    timeout=timeout) as r:
+                return r.read().decode().strip('"')
+
+        assert fetch() == "hello-ft"
+        ray_tpu.shutdown()
+
+        head.send_signal(signal.SIGKILL)
+        head.wait(timeout=30)
+        head = _start_head(port, session_dir)
+
+        # No redeploy: the recreated controller + proxy must converge on
+        # their own from the KV checkpoint.
+        deadline = time.monotonic() + 300
+        last_err = None
+        while time.monotonic() < deadline:
+            try:
+                if fetch(timeout=5) == "hello-ft":
+                    break
+            except Exception as e:
+                last_err = e
+                time.sleep(1.0)
+        else:
+            print(_dump_session(session_dir))
+            raise AssertionError(
+                f"app never came back after head restart: {last_err}")
+    finally:
+        head.send_signal(signal.SIGKILL)
+        head.wait(timeout=30)

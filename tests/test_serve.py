@@ -286,3 +286,75 @@ def test_grpc_ingress(serve_cluster):
     except grpc.RpcError as e:
         assert e.code() == grpc.StatusCode.NOT_FOUND
     ch.close()
+
+
+def test_grpc_ingress_auth(serve_cluster):
+    """Hardening (VERDICT r4 #10): non-loopback binds require a shared
+    secret; with a token set, unauthenticated calls are rejected with
+    UNAUTHENTICATED before the pickle payload is touched."""
+    import pickle
+
+    import grpc
+    import pytest as _pytest
+
+    from ray_tpu.serve.grpc_proxy import GrpcProxy
+
+    # A wide bind without a token must refuse to start.
+    with _pytest.raises(ValueError, match="non-loopback"):
+        GrpcProxy(lambda: None, host="0.0.0.0", port=0)
+
+    # Token-protected loopback ingress end to end.
+    from ray_tpu import serve
+    from ray_tpu.serve.router import Router
+
+    @serve.deployment
+    class SEcho:
+        def __call__(self, payload):
+            return {"ok": payload}
+
+    serve.run(SEcho.bind(), name="sapp", route_prefix="/sapp",
+              proxy=False)
+    from ray_tpu.serve.api import CONTROLLER_NAME
+
+    router = Router(ray_tpu.get_actor(CONTROLLER_NAME))
+    gp = GrpcProxy(lambda: router, host="127.0.0.1", port=0,
+                   token="sekrit")
+    try:
+        ch = grpc.insecure_channel(f"127.0.0.1:{gp.port}")
+        call = ch.unary_unary("/ray_tpu.serve.UserDefinedService/sapp")
+        payload = pickle.dumps((("x",), {}))
+        with _pytest.raises(grpc.RpcError) as ei:
+            call(payload, timeout=30)
+        assert ei.value.code() == grpc.StatusCode.UNAUTHENTICATED
+        with _pytest.raises(grpc.RpcError) as ei:
+            call(payload, timeout=30,
+                 metadata=(("serve-token", "wrong"),))
+        assert ei.value.code() == grpc.StatusCode.UNAUTHENTICATED
+        out = pickle.loads(call(
+            payload, timeout=60, metadata=(("serve-token", "sekrit"),)))
+        assert out == {"ok": "x"}
+        ch.close()
+    finally:
+        gp.stop()
+
+
+def test_delete_then_immediate_redeploy(serve_cluster):
+    """Generation-stamped replica names: a redeploy right after delete
+    must not adopt a replica that is mid graceful-shutdown (r5
+    advisor)."""
+    @serve.deployment(num_cpus=0.1)
+    class V:
+        def __call__(self, x):
+            return f"v2:{x}"
+
+    @serve.deployment(num_cpus=0.1, name="V")
+    class V1:
+        def __call__(self, x):
+            return f"v1:{x}"
+
+    h = serve.run(V1.bind(), name="gen_app", proxy=False)
+    assert h.remote(1).result() == "v1:1"
+    serve.delete("gen_app")
+    h2 = serve.run(V.bind(), name="gen_app", proxy=False)
+    assert h2.remote(2).result() == "v2:2"
+    serve.delete("gen_app")
