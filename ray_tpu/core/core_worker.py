@@ -822,7 +822,7 @@ class CoreWorker:
                     continue
                 if not reply.get("found") or not reply.get("locations"):
                     break  # no copies exist anywhere: reconstruct
-                if await self._puller.pull(
+                if await self._delegate_or_pull(
                         object_id,
                         [tuple(a) for a in reply["locations"]]):
                     obj = object_store.node_store_open(object_id)
@@ -877,6 +877,32 @@ class CoreWorker:
             obj = await self._pull_remote(object_id)
         return obj
 
+    async def _delegate_or_pull(self, object_id: ObjectID,
+                                locations: list) -> bool:
+        """Prefer pulling through the local node agent (reference: the
+        raylet's pull manager owns pulls; workers read the result from
+        shm): it coalesces concurrent workers' pulls and its long-lived
+        mapping recycles warm extents. Direct pull is the fallback
+        (head-host workers have no agent)."""
+        import os as _os
+
+        agent_port = _os.environ.get("RAY_TPU_AGENT_PORT")
+        if agent_port:
+            address = (_os.environ.get("RAY_TPU_AGENT_HOST",
+                                       "127.0.0.1"), int(agent_port))
+            try:
+                conn = await self.get_connection(address)
+                reply = await conn.call("pull_object", {
+                    "object_id": object_id.hex(),
+                    "locations": [list(a) for a in locations],
+                })
+                if reply.get("ok"):
+                    return True
+            except Exception:
+                logger.info("agent pull delegation failed; pulling "
+                            "directly", exc_info=True)
+        return await self._puller.pull(object_id, locations)
+
     async def _pull_remote(self, object_id: ObjectID
                            ) -> Optional[SerializedObject]:
         try:
@@ -887,7 +913,7 @@ class CoreWorker:
         if not reply.get("found") or not reply.get("locations"):
             return None
         locations = [tuple(a) for a in reply["locations"]]
-        if not await self._puller.pull(object_id, locations):
+        if not await self._delegate_or_pull(object_id, locations):
             return None
         obj = object_store.node_store_open(object_id)
         if obj is not None and self.node_id_hex:

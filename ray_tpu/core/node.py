@@ -66,6 +66,11 @@ class HeadNode:
         # Driver-side spill path must match workers' (they inherit it
         # through the spawn env).
         os.environ["RAY_TPU_SESSION_DIR"] = self.session_dir
+        # Data-plane listeners bind per the control plane's exposure.
+        os.environ.setdefault(
+            "RAY_TPU_BIND_HOST",
+            "0.0.0.0" if host not in ("127.0.0.1", "localhost")
+            else "127.0.0.1")
         if not resources.get("TPU"):
             # No chips on this node: keep accelerator site hooks (e.g. a
             # tunneled-TPU PJRT plugin registered via sitecustomize) out

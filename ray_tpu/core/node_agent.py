@@ -60,6 +60,8 @@ class NodeAgent:
         self.head_conn: Optional[rpc.Connection] = None
         self._procs: Dict[str, subprocess.Popen] = {}
         self._exit = asyncio.Event()
+        self._peer_conns: Dict[tuple, rpc.Connection] = {}
+        self._puller = object_transfer.ObjectPuller(self._get_peer_conn)
 
         capacity = object_store_memory or object_store.default_capacity(
             get_config().object_store_memory_proportion)
@@ -90,9 +92,42 @@ class NodeAgent:
             "kill_worker": self.h_kill_worker,
             "free_objects": self.h_free_objects,
             "ping": self.h_ping,
+            "pull_object": self.h_pull_object,
             "shutdown_node": self.h_shutdown_node,
             **object_transfer.serve_handlers(),
         }
+
+    async def h_pull_object(self, conn, payload):
+        """Workers delegate cross-node pulls here (reference: the
+        raylet's pull manager does the pulling, workers read shm):
+        concurrent worker requests for one object coalesce on the
+        agent's single puller, and the long-lived agent's arena extents
+        get recycled, so steady-state ingests land on warm pages."""
+        from ray_tpu.core.ids import ObjectID as _OID
+
+        object_id = _OID.from_hex(payload["object_id"])
+        locations = [tuple(a) for a in payload.get("locations", [])]
+        try:
+            ok = await self._puller.pull(object_id, locations)
+        except Exception as e:  # noqa: BLE001
+            logger.info("agent pull of %s failed: %s",
+                        payload["object_id"][:12], e)
+            ok = False
+        return {"ok": bool(ok)}
+
+    async def _get_peer_conn(self, address):
+        conn = self._peer_conns.get(address)
+        if conn is not None and not conn.closed:
+            return conn
+        new = await rpc.connect(address[0], address[1], {})
+        # Re-check after the await: a concurrent pull may have connected
+        # first — keep one connection per peer, close the loser.
+        cur = self._peer_conns.get(address)
+        if cur is not None and not cur.closed:
+            await new.close()
+            return cur
+        self._peer_conns[address] = new
+        return new
 
     async def h_ping(self, conn, payload):
         return {"ok": True, "node_id": self.node_id_hex}
@@ -106,6 +141,9 @@ class NodeAgent:
         env["RAY_TPU_NODE_ID"] = self.node_id_hex or ""
         env["RAY_TPU_SESSION_DIR"] = self.session_dir
         env["RAY_TPU_ADVERTISE_HOST"] = self.host
+        # Workers delegate cross-node pulls to this agent (h_pull_object).
+        env["RAY_TPU_AGENT_HOST"] = "127.0.0.1"
+        env["RAY_TPU_AGENT_PORT"] = str(self.port)
         env["RAY_TPU_BIND_HOST"] = "0.0.0.0" if self.host not in (
             "127.0.0.1", "localhost") else "127.0.0.1"
         if self.arena_name:
@@ -168,6 +206,9 @@ class NodeAgent:
         self.server = rpc.Server(self.handlers(), name="node-agent")
         bind = "0.0.0.0" if self.host not in ("127.0.0.1",
                                               "localhost") else "127.0.0.1"
+        # The data-plane listener (and spawned workers') bind policy
+        # follows the control plane's.
+        os.environ.setdefault("RAY_TPU_BIND_HOST", bind)
         self.port = await self.server.start(bind, 0)
         self.head_conn = await rpc.connect(
             self.head_host, self.head_port, self.handlers(),

@@ -572,6 +572,40 @@ def _node_store_put(object_id: ObjectID, size: int, fill, pack_bytes,
     return size
 
 
+MADV_POPULATE_READ = 22
+MADV_POPULATE_WRITE = 23
+
+
+def populate_range(view: memoryview,
+                   advice: int = MADV_POPULATE_READ) -> None:
+    """Batch-fault a mapped range into this process's page table.
+    Measured on this infrastructure: POPULATE_READ of an existing
+    range is ~30ms/GiB (worth it before a bulk copy from a
+    freshly-attached mapping); POPULATE_WRITE is pathologically SLOW
+    (~60µs/page ≈ 16s/GiB, far worse than lazy write faults at
+    ~2µs/page) — do NOT use it on ingest destinations. Arena views are
+    writable, so from_buffer always yields the address. Best-effort:
+    kernels without the flag keep lazy faulting."""
+    try:
+        import ctypes
+
+        addr = ctypes.addressof(ctypes.c_char.from_buffer(view))
+        page = 4096
+        base = addr & ~(page - 1)
+        length = (addr - base) + view.nbytes
+        libc = ctypes.CDLL(None, use_errno=True)
+        libc.madvise.restype = ctypes.c_int
+        libc.madvise.argtypes = [ctypes.c_void_p, ctypes.c_size_t,
+                                 ctypes.c_int]
+        libc.madvise(ctypes.c_void_p(base), ctypes.c_size_t(length),
+                     advice)
+    except Exception:
+        pass
+
+
+_populate_range = populate_range  # alias (direct_view call site)
+
+
 #: node_store_reserve sentinel: the object is already present locally.
 ALREADY_PRESENT = object()
 
@@ -599,6 +633,16 @@ class NodeStoreWriter:
         # shm segments have no create/seal state machine: readers gate
         # on HEADER_MAGIC, so the magic bytes are withheld until seal().
         self._magic: Optional[bytes] = None
+
+    def direct_view(self) -> Optional[memoryview]:
+        """Writable full-size view for zero-copy ingest (the data-plane
+        puller recv_into()s socket bytes straight into the slot). Arena
+        slots only: the shm-segment kind gates readers on a magic
+        prefix that a direct write would publish too early, and spill
+        has no memory view."""
+        if self._kind == "arena":
+            return self._view
+        return None
 
     def write_at(self, offset: int, data) -> None:
         if self._kind == "spill":
@@ -717,6 +761,20 @@ def node_store_open(object_id: ObjectID) -> Optional[SerializedObject]:
     if obj is not None:
         return obj
     return _spill_open(object_id)
+
+
+def node_store_arena_name(object_id: ObjectID) -> Optional[str]:
+    """Name of this process's attached arena IF it holds the object —
+    advertised in fetch_object_meta so a same-host puller can attach
+    the arena and memcpy instead of round-tripping through loopback
+    TCP (reference: plasma's same-node objects are shared, never
+    socket-copied)."""
+    from ray_tpu.core import native_store
+
+    arena = native_store.get_attached_arena()
+    if arena is not None and arena.contains(object_id.binary()):
+        return arena.name
+    return None
 
 
 def node_store_read_packed(object_id: ObjectID):

@@ -23,6 +23,10 @@ from __future__ import annotations
 
 import asyncio
 import logging
+import socket
+import struct
+import threading
+import time
 from typing import Awaitable, Callable, Dict, List, Optional, Tuple
 
 from ray_tpu.core import object_store, rpc
@@ -36,6 +40,215 @@ CHUNK_BYTES = 1 << 20
 # Admission control: total bytes in flight across all pulls.
 MAX_INFLIGHT_BYTES = 64 << 20
 
+# ---------------------------------------------------------------------------
+# Bulk data plane (reference: the object manager's dedicated transfer
+# connections vs the gRPC control plane — object_manager.h:117). Framing a
+# GiB through the asyncio control transport costs ~4 user-space copies per
+# byte (slice → transport buffer → StreamReader → chunk bytes → store);
+# this plane is plain blocking sockets on their own threads: the holder
+# sendall()s zero-copy views of the sealed payload and the puller
+# recv_into()s straight into the reserved arena slot — one user→kernel and
+# one kernel→user copy per byte, GIL released throughout.
+# ---------------------------------------------------------------------------
+
+_DATA_REQ = struct.Struct("<I Q Q")  # id length, offset, length
+_DATA_MISSING = 0xFFFFFFFFFFFFFFFF
+_RECV_CAP = 4 << 20  # per-recv_into cap; also the socket buffer size
+
+
+class DataPlaneServer:
+    """Per-holder listener answering range reads of sealed objects.
+    Binds per the process's bind policy (RAY_TPU_BIND_HOST, set by the
+    node agent / head for loopback-only deployments) — the protocol is
+    unauthenticated, so it must not silently widen the configured
+    exposure."""
+
+    def __init__(self, host: Optional[str] = None, port: int = 0):
+        if host is None:
+            import os
+
+            host = os.environ.get("RAY_TPU_BIND_HOST", "0.0.0.0")
+        self._sock = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
+        self._sock.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
+        self._sock.bind((host, port))
+        self._sock.listen(64)
+        self.port = self._sock.getsockname()[1]
+        self._closed = False
+        threading.Thread(target=self._accept_loop,
+                         name="rtpu-dataplane", daemon=True).start()
+
+    def _accept_loop(self):
+        while not self._closed:
+            try:
+                conn, _addr = self._sock.accept()
+            except OSError:
+                return
+            conn.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
+            try:
+                conn.setsockopt(socket.SOL_SOCKET, socket.SO_SNDBUF,
+                                _RECV_CAP)
+            except OSError:
+                pass
+            threading.Thread(target=self._serve_conn, args=(conn,),
+                             daemon=True).start()
+
+    def _serve_conn(self, conn: socket.socket):
+        try:
+            while True:
+                head = _recv_exactly(conn, _DATA_REQ.size)
+                if head is None:
+                    return
+                idlen, offset, length = _DATA_REQ.unpack(head)
+                if idlen > 64:
+                    return  # protocol violation
+                raw_id = _recv_exactly(conn, idlen)
+                if raw_id is None:
+                    return
+                try:
+                    object_id = ObjectID(bytes(raw_id))
+                    view = object_store.node_store_read_packed(object_id)
+                except Exception:
+                    view = None
+                if view is None or offset > len(view):
+                    conn.sendall((_DATA_MISSING).to_bytes(8, "little"))
+                    continue
+                payload = memoryview(view)[offset:offset + length]
+                conn.sendall(len(payload).to_bytes(8, "little"))
+                if payload.nbytes:
+                    conn.sendall(payload)
+        except OSError:
+            pass
+        finally:
+            try:
+                conn.close()
+            except OSError:
+                pass
+
+    def close(self):
+        self._closed = True
+        try:
+            self._sock.close()
+        except OSError:
+            pass
+
+
+_data_server: Optional[DataPlaneServer] = None
+
+# Same-host holder arenas this process has attached (name -> arena).
+# Guarded by _peer_arenas_lock (copies run on executor threads). Growth
+# is bounded by the number of distinct holder daemon INSTANCES this
+# process ever pulled from on its own host; mappings persist for the
+# process lifetime (cheap: address space, shared pages).
+_peer_arenas: Dict[str, object] = {}
+_peer_arenas_lock = threading.Lock()
+_local_hosts_cache: Optional[set] = None
+
+
+def _is_local_host(host: str) -> bool:
+    global _local_hosts_cache
+    if _local_hosts_cache is None:
+        hosts = {"127.0.0.1", "localhost", "::1", "0.0.0.0", ""}
+        try:
+            name = socket.gethostname()
+            hosts.add(name)
+            hosts.update(info[4][0]
+                         for info in socket.getaddrinfo(name, None))
+        except OSError:
+            pass
+        try:
+            s = socket.socket(socket.AF_INET, socket.SOCK_DGRAM)
+            try:
+                s.connect(("8.8.8.8", 80))
+                hosts.add(s.getsockname()[0])
+            finally:
+                s.close()
+        except OSError:
+            pass
+        _local_hosts_cache = hosts
+    return host in _local_hosts_cache
+
+
+def _copy_from_peer_arena(arena_name: str, object_id: ObjectID,
+                          dest: memoryview, total: int) -> bool:
+    """(worker thread) Same-host fast path: attach the holder's shm
+    arena and memcpy the sealed payload straight into our reserved
+    slot — no sockets at all (reference: plasma same-node sharing).
+    The lookup takes a read pin, so a concurrent delete on the holder
+    defers the free past the copy."""
+    from ray_tpu.core import native_store
+
+    with _peer_arenas_lock:
+        arena = _peer_arenas.get(arena_name)
+    if arena is None:
+        arena = native_store.NativeArena.attach(arena_name)
+        if arena is None:
+            return False
+        with _peer_arenas_lock:
+            arena = _peer_arenas.setdefault(arena_name, arena)
+    view = arena.lookup(object_id.binary())
+    if view is None or len(view) < total:
+        return False
+    src = view[:total]
+    # Batch-fault the freshly-attached source range: lazy read faults
+    # per 4KiB would dominate the copy on virtualized hosts.
+    object_store.populate_range(src, object_store.MADV_POPULATE_READ)
+    dest[:total] = src
+    return True
+
+
+def ensure_data_server() -> int:
+    """Start (once) this process's data-plane listener; returns port."""
+    global _data_server
+    if _data_server is None:
+        _data_server = DataPlaneServer()
+    return _data_server.port
+
+
+def _recv_exactly(conn: socket.socket, n: int) -> Optional[bytearray]:
+    buf = bytearray(n)
+    mv = memoryview(buf)
+    got = 0
+    while got < n:
+        r = conn.recv_into(mv[got:], n - got)
+        if r == 0:
+            return None
+        got += r
+    return buf
+
+
+def _pull_range_direct(address: Tuple[str, int], object_id: ObjectID,
+                       dest: memoryview, offset: int, length: int,
+                       state: Optional[dict] = None):
+    """(worker thread) Stream [offset, offset+length) of the packed
+    payload straight into ``dest`` (a slice of the reserved store
+    slot). Raises on any shortfall. ``state["stop"]`` (set when the
+    awaiting pull is cancelled) aborts between recvs."""
+    with socket.create_connection(address, timeout=120) as conn:
+        conn.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
+        try:
+            conn.setsockopt(socket.SOL_SOCKET, socket.SO_RCVBUF,
+                            _RECV_CAP)
+        except OSError:
+            pass
+        raw = object_id.binary()
+        conn.sendall(_DATA_REQ.pack(len(raw), offset, length) + raw)
+        head = _recv_exactly(conn, 8)
+        if head is None:
+            raise _PullAborted("data plane connection closed")
+        avail = int.from_bytes(head, "little")
+        if avail == _DATA_MISSING or avail != length:
+            raise _PullAborted(
+                f"holder served {avail} of {length} requested bytes")
+        got = 0
+        while got < length:
+            if state is not None and state.get("stop"):
+                raise _PullAborted("pull cancelled")
+            r = conn.recv_into(dest[got:],
+                               min(length - got, _RECV_CAP))
+            if r == 0:
+                raise _PullAborted("data plane EOF mid-payload")
+            got += r
+
 
 def serve_handlers() -> dict:
     """RPC handlers a node-store holder (head / node agent) registers so
@@ -46,7 +259,9 @@ def serve_handlers() -> dict:
         data = object_store.node_store_read_packed(object_id)
         if data is None:
             return {"found": False}
-        return {"found": True, "size": len(data)}
+        return {"found": True, "size": len(data),
+                "data_port": ensure_data_server(),
+                "arena": object_store.node_store_arena_name(object_id)}
 
     async def h_fetch_object_chunk(conn, payload):
         object_id = ObjectID.from_hex(payload["object_id"])
@@ -134,7 +349,148 @@ class ObjectPuller:
         writer = object_store.node_store_reserve(object_id, total)
         if writer is object_store.ALREADY_PRESENT:
             return True  # a concurrent pull landed first
+        # Fast paths: same-host arena memcpy, then the bulk data plane
+        # (two kernel copies total; no rpc framing). Chunked rpc over
+        # the control connection is the last resort (no direct view —
+        # shm-segment/spill destinations — or the data port
+        # unreachable, e.g. firewalled to the configured ports only).
+        direct = writer.direct_view()
+        t_path = time.perf_counter()
+        if direct is not None and total > 0:
+            holder_arena = meta.get("arena")
+            if holder_arena and _is_local_host(address[0]):
+                outcome = await self._run_settled(
+                    writer,
+                    lambda state: _copy_from_peer_arena(
+                        holder_arena, object_id, direct, total))
+                logger.debug("pull path=peer-arena %s %.0fMiB in %.2fs",
+                             outcome, total / (1 << 20),
+                             time.perf_counter() - t_path)
+                if outcome is True:
+                    return True
+                # Holder's copy vanished from its arena mid-flight (or
+                # the attach failed): reserve anew, try the sockets.
+                writer = object_store.node_store_reserve(object_id,
+                                                         total)
+                if writer is object_store.ALREADY_PRESENT:
+                    return True
+                direct = writer.direct_view()
+            data_port = meta.get("data_port")
+            if direct is not None and data_port:
+                try:
+                    await self._pull_direct(
+                        object_id, (address[0], data_port), writer,
+                        direct, total)
+                    logger.debug("pull path=data-plane %.0fMiB in %.2fs",
+                                 total / (1 << 20),
+                                 time.perf_counter() - t_path)
+                    return True
+                except _PullAborted:
+                    return False
+                except asyncio.CancelledError:
+                    raise
+                except Exception as e:  # data port unreachable etc.
+                    logger.info("data-plane pull from %s failed (%s); "
+                                "falling back to chunked rpc",
+                                address[0], e)
+                writer = object_store.node_store_reserve(object_id,
+                                                         total)
+                if writer is object_store.ALREADY_PRESENT:
+                    return True
 
+        return await self._pull_chunked(object_id, conn, writer, total)
+
+    @staticmethod
+    async def _run_settled(writer, fn):
+        """Run ``fn(state)`` on the executor with the WRITER's fate
+        owned by a done-callback: seal on success, abort on failure —
+        and crucially only AFTER the thread stopped touching the
+        reserved slot. A cancellation of this coroutine must neither
+        leak the reservation nor free the slot while an orphaned
+        thread still writes into it (the memory would be reused by the
+        next allocation and silently corrupted). Returns True/False,
+        or raises the thread's exception."""
+        loop = asyncio.get_running_loop()
+        state = {"stop": False}
+        job = loop.run_in_executor(None, fn, state)
+        done = loop.create_future()
+
+        def settle(fut):
+            try:
+                ok = fut.result()
+            except BaseException as e:  # noqa: BLE001
+                writer.abort()
+                outcome = e
+            else:
+                if ok:
+                    # Complete even if the awaiter was cancelled: the
+                    # copy finished, the object is whole — sealing is
+                    # free.
+                    writer.seal()
+                else:
+                    writer.abort()
+                outcome = bool(ok)
+            if not done.done():
+                done.set_result(outcome)
+
+        job.add_done_callback(settle)
+        try:
+            outcome = await asyncio.shield(done)
+        except asyncio.CancelledError:
+            state["stop"] = True  # threads drain; settle() cleans up
+            raise
+        if isinstance(outcome, BaseException):
+            raise outcome
+        return outcome
+
+    #: Objects above this split across parallel data-plane streams
+    #: (parallel TCP + NIC queues on real DCN; the GIL is released in
+    #: the socket syscalls so stripes genuinely overlap).
+    STRIPE_THRESHOLD = 64 << 20
+    STRIPES = 2
+
+    async def _pull_direct(self, object_id: ObjectID,
+                           address: Tuple[str, int], writer,
+                           dest: memoryview, total: int) -> None:
+        """Stream over the data plane into the reserved slot. Writer
+        fate (seal/abort) is settled only once every stripe thread has
+        stopped writing — see _run_settled for why cancellation must
+        not abort a slot that threads still touch."""
+        loop = asyncio.get_running_loop()
+        state = {"stop": False}
+        stripes = self.STRIPES if total >= self.STRIPE_THRESHOLD else 1
+        bounds = [total * i // stripes for i in range(stripes + 1)]
+        jobs = []
+        for lo, hi in zip(bounds, bounds[1:]):
+            if hi > lo:
+                jobs.append(loop.run_in_executor(
+                    None, _pull_range_direct, address, object_id,
+                    dest[lo:hi], lo, hi - lo, state))
+        agg = asyncio.gather(*jobs, return_exceptions=True)
+        done = loop.create_future()
+
+        def settle(fut):
+            results = fut.result()  # list (gather had return_exceptions)
+            failure = next((r for r in results
+                            if isinstance(r, BaseException)), None)
+            if failure is None:
+                writer.seal()
+            else:
+                writer.abort()
+            if not done.done():
+                done.set_result(failure)
+
+        agg.add_done_callback(settle)
+        try:
+            failure = await asyncio.shield(done)
+        except asyncio.CancelledError:
+            state["stop"] = True
+            raise
+        if failure is not None:
+            raise failure
+
+    async def _pull_chunked(self, object_id: ObjectID, conn,
+                            writer, total: int) -> bool:
         async def fetch(offset: int) -> None:
             ln = min(CHUNK_BYTES, total - offset)
             async with _sem_guard(self._budget):

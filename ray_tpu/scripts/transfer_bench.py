@@ -63,6 +63,21 @@ def main(size_gib: float = 1.0, out: str | None = None):
                             return int(line.split()[1])
                 return 0
 
+            def first_touch_floor(self, gib):
+                """Infra floor: the rate at which THIS host supplies
+                brand-new pages (plain anonymous memory, no framework
+                code at all). On lazy-memory microVMs this is the hard
+                ceiling for any COLD ingest — page supply, not the
+                transfer plane, is the bottleneck; steady-state pulls
+                recycle pages and don't pay it."""
+                n = int(gib * (1 << 30))
+                buf = bytearray(8)
+                t0 = time.perf_counter()
+                buf = bytearray(n)  # zero-filled: touches every page
+                dt = time.perf_counter() - t0
+                del buf
+                return n / (1 << 30) / dt
+
             def pull_once(self, refs):
                 r = refs[0]
                 rss0 = self._anon_rss_kib()
@@ -95,6 +110,8 @@ def main(size_gib: float = 1.0, out: str | None = None):
                 return True
 
         puller = Puller.remote()
+        floor = ray_tpu.get(
+            puller.first_touch_floor.remote(size_gib), timeout=900)
         cold = ray_tpu.get(puller.pull_once.remote([ref]), timeout=900)
         assert cold["checksum_head"] == float(data[0])
         ray_tpu.get(puller.drop_local.remote([ref]), timeout=60)
@@ -104,6 +121,7 @@ def main(size_gib: float = 1.0, out: str | None = None):
                 steady["gib"] / steady["seconds"], 2),
             "loopback_pull_cold_gibps": round(
                 cold["gib"] / cold["seconds"], 2),
+            "first_touch_floor_gibps": round(floor, 2),
             "object_gib": round(steady["gib"], 2),
             "puller_anon_rss_delta_mib": round(
                 steady["anon_rss_delta_mib"], 1),
