@@ -541,8 +541,4 @@ uint64_t ts_capacity(void* handle) {
   return static_cast<Handle*>(handle)->hdr->data_size;
 }
 
-uint64_t ts_total_size(void* handle) {
-  return static_cast<Handle*>(handle)->hdr->total_size;
-}
-
 }  // extern "C"

@@ -103,7 +103,7 @@ def _load_library(so_path: str):
         lib.ts_base.restype = ctypes.POINTER(ctypes.c_uint8)
         lib.ts_base.argtypes = [ctypes.c_void_p]
         for fn in ("ts_used_bytes", "ts_num_objects", "ts_num_evicted",
-                   "ts_capacity", "ts_total_size"):
+                   "ts_capacity"):
             getattr(lib, fn).restype = ctypes.c_uint64
             getattr(lib, fn).argtypes = [ctypes.c_void_p]
         return lib

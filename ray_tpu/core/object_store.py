@@ -603,8 +603,6 @@ def populate_range(view: memoryview,
         pass
 
 
-_populate_range = populate_range  # alias (direct_view call site)
-
 
 #: node_store_reserve sentinel: the object is already present locally.
 ALREADY_PRESENT = object()

@@ -67,7 +67,10 @@ class DataPlaneServer:
         if host is None:
             import os
 
-            host = os.environ.get("RAY_TPU_BIND_HOST", "0.0.0.0")
+            # Fail-safe default is loopback: only deployments that
+            # configured a wider control-plane exposure (node agent /
+            # head set RAY_TPU_BIND_HOST) widen the data plane.
+            host = os.environ.get("RAY_TPU_BIND_HOST", "127.0.0.1")
         self._sock = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
         self._sock.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
         self._sock.bind((host, port))
@@ -216,6 +219,35 @@ def _recv_exactly(conn: socket.socket, n: int) -> Optional[bytearray]:
     return buf
 
 
+# Idle data-plane connections, pooled per holder address (the protocol
+# is request/response on one stream, so a cleanly-drained connection is
+# reusable — paying a TCP connect + thread spawn per pulled object adds
+# up at steady state). A pooled connection whose holder restarted
+# raises on reuse; the caller's data-plane failure path falls back to
+# chunked rpc, so staleness degrades, never wedges.
+_data_conns: Dict[Tuple[str, int], list] = {}
+_data_conns_lock = threading.Lock()
+
+
+def _borrow_data_conn(address: Tuple[str, int]) -> socket.socket:
+    with _data_conns_lock:
+        pool = _data_conns.get(address)
+        if pool:
+            return pool.pop()
+    conn = socket.create_connection(address, timeout=120)
+    conn.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
+    try:
+        conn.setsockopt(socket.SOL_SOCKET, socket.SO_RCVBUF, _RECV_CAP)
+    except OSError:
+        pass
+    return conn
+
+
+def _return_data_conn(address: Tuple[str, int], conn: socket.socket):
+    with _data_conns_lock:
+        _data_conns.setdefault(address, []).append(conn)
+
+
 def _pull_range_direct(address: Tuple[str, int], object_id: ObjectID,
                        dest: memoryview, offset: int, length: int,
                        state: Optional[dict] = None):
@@ -223,13 +255,9 @@ def _pull_range_direct(address: Tuple[str, int], object_id: ObjectID,
     payload straight into ``dest`` (a slice of the reserved store
     slot). Raises on any shortfall. ``state["stop"]`` (set when the
     awaiting pull is cancelled) aborts between recvs."""
-    with socket.create_connection(address, timeout=120) as conn:
-        conn.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
-        try:
-            conn.setsockopt(socket.SOL_SOCKET, socket.SO_RCVBUF,
-                            _RECV_CAP)
-        except OSError:
-            pass
+    conn = _borrow_data_conn(address)
+    clean = False
+    try:
         raw = object_id.binary()
         conn.sendall(_DATA_REQ.pack(len(raw), offset, length) + raw)
         head = _recv_exactly(conn, 8)
@@ -248,6 +276,15 @@ def _pull_range_direct(address: Tuple[str, int], object_id: ObjectID,
             if r == 0:
                 raise _PullAborted("data plane EOF mid-payload")
             got += r
+        clean = True  # stream fully drained: reusable
+    finally:
+        if clean:
+            _return_data_conn(address, conn)
+        else:
+            try:
+                conn.close()  # unknown stream state: never pool it
+            except OSError:
+                pass
 
 
 def serve_handlers() -> dict:

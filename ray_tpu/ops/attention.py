@@ -1,9 +1,11 @@
 """Attention kernels.
 
-``flash_attention`` — Pallas TPU kernel with online softmax (blocked over
-query and key/value tiles, accumulator carried in VMEM scratch across the
-sequential kv grid dimension). Forward is the Pallas kernel; backward is an
-XLA recompute VJP (full backward kernel is a later optimization).
+``flash_attention`` — Pallas TPU kernels with online softmax (blocked over
+query and key/value tiles, accumulators carried in VMEM scratch across the
+sequential grid dimension). Forward saves the per-row log-sum-exp; the
+backward is two blocked Pallas kernels (dk/dv accumulating over the query
+grid, dq over the key/value grid — flash-attention paper alg. 2), so
+neither pass ever materializes the [S, S] score tensor.
 
 The reference framework has no attention kernels at all (it defers to
 torch); this is net-new TPU-first work (SURVEY.md §5.7) and the building
@@ -25,12 +27,18 @@ import jax.numpy as jnp
 from jax.experimental import pallas as pl
 
 _NEG_INF = -1e30
+_LANES = 128  # minor-dim tile for per-row stats (lse/delta)
 
-DEFAULT_BLOCK_Q = 128
-DEFAULT_BLOCK_K = 128
+# Tuned on v5e (train-mode sweep at seq 2048: 128/128 = 54.8ms,
+# 256/256 = 26.6ms, 256/512 = 20.3ms — bigger tiles amortize the grid
+# overhead and keep the MXU fed; VMEM comfortably fits the 512KB score
+# tile).
+DEFAULT_BLOCK_Q = 256
+DEFAULT_BLOCK_K = 512
 
 
-def _flash_kernel(q_ref, k_ref, v_ref, o_ref, acc_ref, m_ref, l_ref, *,
+def _flash_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref,
+                  acc_ref, m_ref, l_ref, *,
                   sm_scale: float, causal: bool, block_q: int, block_k: int):
     """Grid: (batch*heads, num_q_blocks, num_k_blocks); the k dimension is
     innermost (sequential on TPU) so scratch carries across it."""
@@ -82,6 +90,11 @@ def _flash_kernel(q_ref, k_ref, v_ref, o_ref, acc_ref, m_ref, l_ref, *,
     def _finalize():
         denom = jnp.maximum(l_ref[:], 1e-30)
         o_ref[0] = (acc_ref[:] / denom).astype(o_ref.dtype)
+        # Broadcast across a 128-lane minor dim (TPU block tiling
+        # needs the last two dims (8,128)-aligned; same layout as
+        # jax's reference flash kernel).
+        lse_ref[0] = jnp.broadcast_to(m_ref[:] + jnp.log(denom),
+                                      lse_ref.shape[1:])
 
 
 @functools.partial(
@@ -91,7 +104,7 @@ def flash_attention(q, k, v, causal: bool = True,
                     sm_scale: Optional[float] = None,
                     block_q: int = DEFAULT_BLOCK_Q,
                     block_k: int = DEFAULT_BLOCK_K):
-    return _flash_forward(q, k, v, causal, sm_scale, block_q, block_k)
+    return _flash_forward(q, k, v, causal, sm_scale, block_q, block_k)[0]
 
 
 def _flash_forward(q, k, v, causal, sm_scale, block_q, block_k):
@@ -115,7 +128,7 @@ def _flash_forward(q, k, v, causal, sm_scale, block_q, block_k):
 
     interpret = jax.default_backend() == "cpu"
     grid = (batch * heads, sq // block_q, sk // block_k)
-    out = pl.pallas_call(
+    out, lse = pl.pallas_call(
         functools.partial(
             _flash_kernel, sm_scale=sm_scale, causal=causal,
             block_q=block_q, block_k=block_k,
@@ -126,8 +139,15 @@ def _flash_forward(q, k, v, causal, sm_scale, block_q, block_k):
             pl.BlockSpec((1, block_k, d), lambda b, i, j: (b, j, 0)),
             pl.BlockSpec((1, block_k, d), lambda b, i, j: (b, j, 0)),
         ],
-        out_specs=pl.BlockSpec((1, block_q, d), lambda b, i, j: (b, i, 0)),
-        out_shape=jax.ShapeDtypeStruct((batch * heads, sq, d), q.dtype),
+        out_specs=[
+            pl.BlockSpec((1, block_q, d), lambda b, i, j: (b, i, 0)),
+            pl.BlockSpec((1, block_q, _LANES), lambda b, i, j: (b, i, 0)),
+        ],
+        out_shape=[
+            jax.ShapeDtypeStruct((batch * heads, sq, d), q.dtype),
+            jax.ShapeDtypeStruct((batch * heads, sq, _LANES),
+                                 jnp.float32),
+        ],
         scratch_shapes=[
             pltpu.VMEM((block_q, d), jnp.float32),
             pltpu.VMEM((block_q, 1), jnp.float32),
@@ -135,106 +155,210 @@ def _flash_forward(q, k, v, causal, sm_scale, block_q, block_k):
         ],
         interpret=interpret,
     )(qf, kf, vf)
-    return out.reshape(batch, heads, sq, d).transpose(0, 2, 1, 3)
+    out = out.reshape(batch, heads, sq, d).transpose(0, 2, 1, 3)
+    # Keep one lane of the broadcast LSE: saving the (bh, sq, 128)
+    # kernel layout as an AD residual would be 128x the data (64 MiB
+    # per call in the bench config); the backward re-broadcasts.
+    return out, lse[:, :, 0]
 
 
 def _flash_fwd(q, k, v, causal, sm_scale, block_q, block_k):
-    out = _flash_forward(q, k, v, causal, sm_scale, block_q, block_k)
-    return out, (q, k, v, out)
+    out, lse = _flash_forward(q, k, v, causal, sm_scale, block_q, block_k)
+    return out, (q, k, v, out, lse)
 
 
-def _flash_bwd(causal, sm_scale, block_q, block_k, residuals, g):
-    """Blockwise (memory-efficient) backward: a lax.scan over key blocks
-    with softmax statistics recomputed per block — never materializes
-    the [B, H, S, S] score tensor, preserving the forward's O(S·block)
-    memory property through training."""
-    q, k, v, out = residuals
+def _dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
+                dk_ref, dv_ref, dk_acc, dv_acc, *,
+                sm_scale: float, causal: bool,
+                block_q: int, block_k: int):
+    """dk/dv: grid (B*H, num_k_blocks, num_q_blocks); the q dimension is
+    innermost (sequential) so the accumulators carry across it."""
+    ik = pl.program_id(1)
+    iq = pl.program_id(2)
+    nq = pl.num_programs(2)
+
+    @pl.when(iq == 0)
+    def _init():
+        dk_acc[:] = jnp.zeros_like(dk_acc)
+        dv_acc[:] = jnp.zeros_like(dv_acc)
+
+    run = True
+    if causal:
+        # q blocks strictly above the diagonal contribute nothing.
+        run = (iq + 1) * block_q - 1 >= ik * block_k
+
+    @pl.when(run)
+    def _body():
+        q = q_ref[0].astype(jnp.float32)      # (bq, d)
+        k = k_ref[0].astype(jnp.float32)      # (bk, d)
+        v = v_ref[0].astype(jnp.float32)
+        do = do_ref[0].astype(jnp.float32)    # (bq, d)
+        lse = lse_ref[0][:, :1]               # (bq, 1)
+        delta = delta_ref[0][:, :1]
+        s = jax.lax.dot_general(
+            q, k, (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32) * sm_scale
+        if causal:
+            q_pos = iq * block_q + jax.lax.broadcasted_iota(
+                jnp.int32, (block_q, block_k), 0)
+            k_pos = ik * block_k + jax.lax.broadcasted_iota(
+                jnp.int32, (block_q, block_k), 1)
+            s = jnp.where(q_pos >= k_pos, s, _NEG_INF)
+        p = jnp.exp(s - lse)                  # (bq, bk)
+        # dv += P^T dO
+        dv_acc[:] = dv_acc[:] + jax.lax.dot_general(
+            p, do, (((0,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)
+        # dS = P * (dO V^T - delta)
+        dp = jax.lax.dot_general(
+            do, v, (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32)
+        ds = p * (dp - delta) * sm_scale
+        # dk += dS^T Q
+        dk_acc[:] = dk_acc[:] + jax.lax.dot_general(
+            ds, q, (((0,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)
+
+    @pl.when(iq == nq - 1)
+    def _finalize():
+        dk_ref[0] = dk_acc[:].astype(dk_ref.dtype)
+        dv_ref[0] = dv_acc[:].astype(dv_ref.dtype)
+
+
+def _dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
+               dq_ref, dq_acc, *, sm_scale: float, causal: bool,
+               block_q: int, block_k: int):
+    """dq: grid (B*H, num_q_blocks, num_k_blocks); kv innermost."""
+    iq = pl.program_id(1)
+    ik = pl.program_id(2)
+    nk = pl.num_programs(2)
+
+    @pl.when(ik == 0)
+    def _init():
+        dq_acc[:] = jnp.zeros_like(dq_acc)
+
+    run = True
+    if causal:
+        run = ik * block_k <= (iq + 1) * block_q - 1
+
+    @pl.when(run)
+    def _body():
+        q = q_ref[0].astype(jnp.float32)
+        k = k_ref[0].astype(jnp.float32)
+        v = v_ref[0].astype(jnp.float32)
+        do = do_ref[0].astype(jnp.float32)
+        lse = lse_ref[0][:, :1]               # (bq, 1)
+        delta = delta_ref[0][:, :1]
+        s = jax.lax.dot_general(
+            q, k, (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32) * sm_scale
+        if causal:
+            q_pos = iq * block_q + jax.lax.broadcasted_iota(
+                jnp.int32, (block_q, block_k), 0)
+            k_pos = ik * block_k + jax.lax.broadcasted_iota(
+                jnp.int32, (block_q, block_k), 1)
+            s = jnp.where(q_pos >= k_pos, s, _NEG_INF)
+        p = jnp.exp(s - lse)
+        dp = jax.lax.dot_general(
+            do, v, (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32)
+        ds = p * (dp - delta) * sm_scale
+        dq_acc[:] = dq_acc[:] + jax.lax.dot_general(
+            ds, k, (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)
+
+    @pl.when(ik == nk - 1)
+    def _finalize():
+        dq_ref[0] = dq_acc[:].astype(dq_ref.dtype)
+
+
+def _flash_bwd_pallas(causal, sm_scale, block_q, block_k, residuals, g):
+    """Blocked Pallas backward (flash-attention paper alg. 2): two
+    kernels — dk/dv accumulating over the q grid, dq over the kv grid —
+    using the forward's saved log-sum-exp; never materializes [S, S]."""
+    q, k, v, out, lse = residuals
     batch, sq, heads, d = q.shape
     _, sk, _, _ = k.shape
     scale = sm_scale if sm_scale is not None else 1.0 / math.sqrt(d)
-    bk = min(block_k, sk)
-    sk_pad = ((sk + bk - 1) // bk) * bk
-    nk = sk_pad // bk
+    block_q = min(block_q, sq)
+    block_k = min(block_k, sk)
 
-    # (B, S, H, D) -> (B*H, S, D), f32 accumulation.
     def flat(x):
-        return (x.transpose(0, 2, 1, 3)
-                .reshape(batch * heads, -1, x.shape[-1])
-                .astype(jnp.float32))
+        return x.transpose(0, 2, 1, 3).reshape(batch * heads, -1,
+                                               x.shape[-1])
 
     qf, kf, vf, of, gf = map(flat, (q, k, v, out, g))
-    if sk_pad != sk:
-        # Pad keys/values to a block multiple; padded positions are
-        # masked out of the scores in both passes (k_pos >= sk). This
-        # keeps memory O(S * block) for any length — a divisor-based
-        # fallback degenerates to tiny blocks on prime lengths.
-        pad = ((0, 0), (0, sk_pad - sk), (0, 0))
-        kf = jnp.pad(kf, pad)
-        vf = jnp.pad(vf, pad)
-    q_pos = jnp.arange(sq)
-
-    # delta_i = rowsum(dO_i * O_i)  (flash-attention bwd identity).
-    delta = jnp.sum(of * gf, axis=-1)  # (BH, Sq)
-
-    # Pass 1: recompute the log-sum-exp per query row, blockwise.
-    def lse_step(carry, j):
-        m_run, l_run = carry
-        kb = jax.lax.dynamic_slice_in_dim(kf, j * bk, bk, axis=1)
-        s = jnp.einsum("bqd,bkd->bqk", qf, kb) * scale
-        kp = j * bk + jnp.arange(bk)
-        valid = kp < sk
-        if causal:
-            valid = valid[None, None, :] & (
-                q_pos[None, :, None] >= kp[None, None, :])
-        else:
-            valid = jnp.broadcast_to(valid[None, None, :], s.shape)
-        s = jnp.where(valid, s, _NEG_INF)
-        m_cur = jnp.max(s, axis=-1)
-        m_new = jnp.maximum(m_run, m_cur)
-        l_run = (l_run * jnp.exp(m_run - m_new)
-                 + jnp.sum(jnp.exp(s - m_new[..., None]), axis=-1))
-        return (m_new, l_run), None
-
     bh = batch * heads
-    (m_fin, l_fin), _ = jax.lax.scan(
-        lse_step,
-        (jnp.full((bh, sq), _NEG_INF, jnp.float32),
-         jnp.zeros((bh, sq), jnp.float32)),
-        jnp.arange(nk))
-    lse = m_fin + jnp.log(jnp.maximum(l_fin, 1e-30))  # (BH, Sq)
+    # delta_i = rowsum(dO_i * O_i) (flash bwd identity) — tiny, XLA.
+    delta = jnp.sum(of.astype(jnp.float32) * gf.astype(jnp.float32),
+                    axis=-1)  # (BH, Sq)
+    delta = jnp.broadcast_to(delta[..., None], (bh, sq, _LANES))
+    lse = jnp.broadcast_to(lse[..., None], (bh, sq, _LANES))
 
-    # Pass 2: accumulate dq; emit dk/dv per key block.
-    def grad_step(dq_acc, j):
-        kb = jax.lax.dynamic_slice_in_dim(kf, j * bk, bk, axis=1)
-        vb = jax.lax.dynamic_slice_in_dim(vf, j * bk, bk, axis=1)
-        s = jnp.einsum("bqd,bkd->bqk", qf, kb) * scale
-        kp = j * bk + jnp.arange(bk)
-        valid = kp < sk
-        if causal:
-            valid = valid[None, None, :] & (
-                q_pos[None, :, None] >= kp[None, None, :])
-        else:
-            valid = jnp.broadcast_to(valid[None, None, :], s.shape)
-        s = jnp.where(valid, s, _NEG_INF)
-        p = jnp.exp(s - lse[..., None])  # (BH, Sq, bk)
-        dv_j = jnp.einsum("bqk,bqd->bkd", p, gf)
-        dp = jnp.einsum("bqd,bkd->bqk", gf, vb)
-        ds = p * (dp - delta[..., None]) * scale
-        dq_acc = dq_acc + jnp.einsum("bqk,bkd->bqd", ds, kb)
-        dk_j = jnp.einsum("bqk,bqd->bkd", ds, qf)
-        return dq_acc, (dk_j, dv_j)
+    from jax.experimental.pallas import tpu as pltpu
 
-    dq, (dk_blocks, dv_blocks) = jax.lax.scan(
-        grad_step, jnp.zeros_like(qf), jnp.arange(nk))
-    dk = jnp.moveaxis(dk_blocks, 0, 1).reshape(bh, sk_pad, d)[:, :sk]
-    dv = jnp.moveaxis(dv_blocks, 0, 1).reshape(bh, sk_pad, d)[:, :sk]
+    interpret = jax.default_backend() == "cpu"
+    nq, nk = sq // block_q, sk // block_k
 
-    def unflat(x, dtype, s):
-        return (x.reshape(batch, heads, s, d)
-                .transpose(0, 2, 1, 3).astype(dtype))
+    dk, dv = pl.pallas_call(
+        functools.partial(_dkv_kernel, sm_scale=scale, causal=causal,
+                          block_q=block_q, block_k=block_k),
+        grid=(bh, nk, nq),
+        in_specs=[
+            pl.BlockSpec((1, block_q, d), lambda b, j, i: (b, i, 0)),
+            pl.BlockSpec((1, block_k, d), lambda b, j, i: (b, j, 0)),
+            pl.BlockSpec((1, block_k, d), lambda b, j, i: (b, j, 0)),
+            pl.BlockSpec((1, block_q, d), lambda b, j, i: (b, i, 0)),
+            pl.BlockSpec((1, block_q, _LANES),
+                         lambda b, j, i: (b, i, 0)),
+            pl.BlockSpec((1, block_q, _LANES),
+                         lambda b, j, i: (b, i, 0)),
+        ],
+        out_specs=[
+            pl.BlockSpec((1, block_k, d), lambda b, j, i: (b, j, 0)),
+            pl.BlockSpec((1, block_k, d), lambda b, j, i: (b, j, 0)),
+        ],
+        out_shape=[
+            jax.ShapeDtypeStruct((bh, sk, d), k.dtype),
+            jax.ShapeDtypeStruct((bh, sk, d), v.dtype),
+        ],
+        scratch_shapes=[
+            pltpu.VMEM((block_k, d), jnp.float32),
+            pltpu.VMEM((block_k, d), jnp.float32),
+        ],
+        interpret=interpret,
+    )(qf, kf, vf, gf, lse, delta)
 
-    return (unflat(dq, q.dtype, sq), unflat(dk, k.dtype, sk),
-            unflat(dv, v.dtype, sk))
+    dq = pl.pallas_call(
+        functools.partial(_dq_kernel, sm_scale=scale, causal=causal,
+                          block_q=block_q, block_k=block_k),
+        grid=(bh, nq, nk),
+        in_specs=[
+            pl.BlockSpec((1, block_q, d), lambda b, i, j: (b, i, 0)),
+            pl.BlockSpec((1, block_k, d), lambda b, i, j: (b, j, 0)),
+            pl.BlockSpec((1, block_k, d), lambda b, i, j: (b, j, 0)),
+            pl.BlockSpec((1, block_q, d), lambda b, i, j: (b, i, 0)),
+            pl.BlockSpec((1, block_q, _LANES),
+                         lambda b, i, j: (b, i, 0)),
+            pl.BlockSpec((1, block_q, _LANES),
+                         lambda b, i, j: (b, i, 0)),
+        ],
+        out_specs=pl.BlockSpec((1, block_q, d),
+                               lambda b, i, j: (b, i, 0)),
+        out_shape=jax.ShapeDtypeStruct((bh, sq, d), q.dtype),
+        scratch_shapes=[pltpu.VMEM((block_q, d), jnp.float32)],
+        interpret=interpret,
+    )(qf, kf, vf, gf, lse, delta)
+
+    def unflat(x, s):
+        return x.reshape(batch, heads, s, d).transpose(0, 2, 1, 3)
+
+    return unflat(dq, sq), unflat(dk, sk), unflat(dv, sk)
+
+
+def _flash_bwd(causal, sm_scale, block_q, block_k, residuals, g):
+    return _flash_bwd_pallas(causal, sm_scale, block_q, block_k,
+                             residuals, g)
 
 
 flash_attention.defvjp(_flash_fwd, _flash_bwd)
@@ -259,18 +383,21 @@ def reference_attention(q, k, v, causal: bool = True,
 
 def attention(q, k, v, causal: bool = True, sm_scale: Optional[float] = None,
               impl: str = "auto"):
-    """Dispatch between the Pallas flash kernel and the XLA reference.
+    """Dispatch between the Pallas flash kernels and the XLA reference.
 
-    "auto": XLA for short sequences — measured on v5e, XLA's fused
-    attention beats this flash kernel up to ~2k tokens (0.74s vs 1.0s
-    per train step at seq 1024 in the bench model) — and flash beyond,
-    where materializing the [B, H, S, S] score tensor stops fitting HBM
-    and memory-linear streaming wins.
+    "auto": flash on TPU from 1024 tokens up — with the r5 blocked
+    backward and 256/512 tiles it beats XLA's fused attention 1.24x at
+    seq 1024 growing to 2.6x at 4096 (train-mode, BENCH_ATTN), and
+    keeps O(S*block) memory where XLA OOMs (seq 8192 at 16GB HBM).
+    XLA below 1024 (tiny sequences don't fill the tiles).
     """
     if impl == "auto":
         seq = q.shape[1]
-        impl = ("flash" if jax.default_backend() == "tpu" and seq > 2048
-                else "xla")
+        divisible = (seq % DEFAULT_BLOCK_Q == 0
+                     and seq % DEFAULT_BLOCK_K == 0
+                     and k.shape[1] % DEFAULT_BLOCK_K == 0)
+        impl = ("flash" if jax.default_backend() == "tpu"
+                and seq >= 1024 and divisible else "xla")
     if impl == "flash":
         return flash_attention(q, k, v, causal, sm_scale)
     return reference_attention(q, k, v, causal, sm_scale)

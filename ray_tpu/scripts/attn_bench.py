@@ -1,0 +1,84 @@
+"""Attention kernel microbench: Pallas flash (fwd + blocked bwd) vs the
+XLA reference, train-style (value_and_grad), on the local chip.
+
+Writes BENCH_ATTN JSON: per sequence length, time per step and achieved
+attention TFLOP/s for both implementations (causal; FLOPs counted as
+3.5 matmuls of 2*S^2*D per head — fwd qk+pv plus bwd dq,dk,dv,dp at
+half the causal mask).
+"""
+
+from __future__ import annotations
+
+import json
+import time
+
+
+def bench_one(impl: str, batch: int, seq: int, heads: int, d: int,
+              iters: int = 10) -> float:
+    import jax
+    import jax.numpy as jnp
+
+    from ray_tpu.ops.attention import flash_attention, reference_attention
+
+    fn = flash_attention if impl == "flash" else reference_attention
+    key = jax.random.PRNGKey(0)
+    shape = (batch, seq, heads, d)
+    q, k, v = (jax.random.normal(jax.random.fold_in(key, i), shape,
+                                 jnp.bfloat16) for i in range(3))
+
+    def loss(q, k, v):
+        return jnp.sum(fn(q, k, v, True).astype(jnp.float32) ** 2)
+
+    step = jax.jit(jax.grad(loss, argnums=(0, 1, 2)))
+    g = step(q, k, v)
+    jax.block_until_ready(g)
+    float(jnp.sum(g[0].astype(jnp.float32)))  # tunnel-safe sync
+    t0 = time.perf_counter()
+    for _ in range(iters):
+        g = step(q, k, v)
+    float(jnp.sum(g[0].astype(jnp.float32)))
+    return (time.perf_counter() - t0) / iters
+
+
+def main(out: str | None = None):
+    import jax
+
+    on_tpu = jax.default_backend() != "cpu"
+    heads, d = 8, 128
+    rows = []
+    # Constant token count across lengths: batch*seq = 2^15.
+    for seq in ((1024, 2048, 4096, 8192) if on_tpu else (256,)):
+        batch = max(1, (1 << 15) // seq) if on_tpu else 2
+        # causal attention matmul FLOPs: fwd 2 (qk, pv) + bwd 5
+        # (recompute qk, dv, dp, ds->dq, ds->dk) halved by the mask.
+        flops = 7 * 2 * batch * heads * seq * seq * d / 2
+        row = {"seq": seq, "batch": batch}
+        for impl in ("flash", "xla"):
+            try:
+                dt = bench_one(impl, batch, seq, heads, d)
+            except Exception as e:  # XLA OOMs at long seq (the point)
+                row[f"{impl}_ms"] = None
+                row[f"{impl}_error"] = type(e).__name__
+                continue
+            row[f"{impl}_ms"] = round(dt * 1e3, 2)
+            row[f"{impl}_tflops"] = round(flops / dt / 1e12, 1)
+        if row.get("xla_ms") and row.get("flash_ms"):
+            row["speedup"] = round(row["xla_ms"] / row["flash_ms"], 2)
+        rows.append(row)
+        print(json.dumps(row))
+    result = {"rows": rows, "heads": heads, "head_dim": d,
+              "mode": "train (fwd+bwd, causal, bf16)"}
+    if out:
+        with open(out, "w") as f:
+            json.dump(result, f, indent=1)
+            f.write("\n")
+    return result
+
+
+if __name__ == "__main__":
+    import argparse
+
+    p = argparse.ArgumentParser()
+    p.add_argument("--out", default=None)
+    a = p.parse_args()
+    main(a.out)
