@@ -204,10 +204,121 @@ class PixelGridWorldVecEnv(VectorEnv):
         return self._obs(), reward, terminated, truncated
 
 
+class AtariLikeVecEnv(VectorEnv):
+    """Atari-class observation pipeline: 84x84x4 uint8 frame stacks
+    (~28 KiB/obs — the exact volume of preprocessed Atari, ~37x the
+    16x16x3 gridworld) with vectorized pong-like dynamics. Synthetic on
+    purpose: BASELINE.md's north star is pipeline THROUGHPUT per chip
+    ("PPO Atari >= 50k env-steps/s/chip"), and the honest cost being
+    measured is rendering + frame-stack rolling + conv-tower forwards
+    over real Atari-sized bytes, not ALE emulation fidelity."""
+
+    H = W = 84
+    STACK = 4
+
+    def __init__(self, num_envs: int = 8, seed: int = 0):
+        self.num_envs = num_envs
+        self.observation_space = Space((self.H, self.W, self.STACK),
+                                       np.uint8)
+        self.action_space = Space.discrete(6)  # Atari-style action set
+        self._rng = np.random.default_rng(seed)
+        n = num_envs
+        self.ball = np.zeros((n, 2), np.float32)     # (y, x)
+        self.vel = np.zeros((n, 2), np.float32)
+        self.paddle = np.zeros(n, np.float32)        # paddle y
+        self.steps = np.zeros(n, np.int64)
+        self.obs = np.zeros((n, self.H, self.W, self.STACK), np.uint8)
+        self._reset_balls(np.ones(n, bool))
+
+    def _reset_balls(self, mask):
+        m = int(mask.sum())
+        if not m:
+            return
+        self.ball[mask, 0] = self._rng.uniform(10, self.H - 10, m)
+        self.ball[mask, 1] = self.W // 2
+        ang = self._rng.uniform(-0.6, 0.6, m)
+        sign = self._rng.choice([-1.0, 1.0], m)
+        self.vel[mask, 0] = np.sin(ang) * 2.0
+        self.vel[mask, 1] = np.cos(ang) * 2.0 * sign
+
+    def _render_frame(self):
+        """One new 84x84 frame per env, drawn with fancy indexing."""
+        n = self.num_envs
+        frame = np.zeros((n, self.H, self.W), np.uint8)
+        frame[:, 0, :] = 60   # walls
+        frame[:, -1, :] = 60
+        idx = np.arange(n)
+        by = np.clip(self.ball[:, 0].astype(np.int64), 1, self.H - 3)
+        bx = np.clip(self.ball[:, 1].astype(np.int64), 0, self.W - 3)
+        for dy in range(2):          # 2x2 ball
+            for dx in range(2):
+                frame[idx, by + dy, bx + dx] = 255
+        py = np.clip(self.paddle.astype(np.int64), 4, self.H - 12)
+        for dy in range(8):          # 2-wide, 8-tall paddle at x=2
+            frame[idx, py + dy, 2] = 200
+            frame[idx, py + dy, 3] = 200
+        return frame
+
+    def reset(self, seed=None) -> np.ndarray:
+        n = self.num_envs
+        self.steps[:] = 0
+        self.paddle[:] = self.H // 2
+        self._reset_balls(np.ones(n, bool))
+        frame = self._render_frame()
+        self.obs[:] = frame[..., None]  # fill the whole stack
+        return self.obs.copy()
+
+    def step(self, actions: np.ndarray):
+        n = self.num_envs
+        # Paddle: actions 2/4 up, 3/5 down (Atari UP/DOWN + FIRE dirs).
+        up = (actions == 2) | (actions == 4)
+        down = (actions == 3) | (actions == 5)
+        self.paddle += np.where(up, -3.0, 0.0) + np.where(down, 3.0, 0.0)
+        self.paddle = np.clip(self.paddle, 4, self.H - 12)
+        # Ball physics: bounce off top/bottom and the right wall.
+        self.ball += self.vel
+        hit_tb = (self.ball[:, 0] <= 1) | (self.ball[:, 0] >= self.H - 3)
+        self.vel[hit_tb, 0] *= -1
+        hit_r = self.ball[:, 1] >= self.W - 3
+        self.vel[hit_r, 1] *= -1
+        # Left edge: point scored or lost depending on paddle overlap.
+        at_left = self.ball[:, 1] <= 4
+        aligned = (np.abs(self.ball[:, 0] - (self.paddle + 4)) <= 5)
+        returned = at_left & aligned
+        missed = at_left & ~aligned
+        self.vel[returned, 1] *= -1
+        reward = (returned.astype(np.float32)
+                  - missed.astype(np.float32))
+        self.steps += 1
+        terminated = missed
+        truncated = self.steps >= 1000
+        done = terminated | truncated
+        # Roll the frame stack and render the new frame IN PLACE (the
+        # memmove + render over real Atari-sized buffers is the honest
+        # per-step pipeline cost).
+        self.obs[..., :-1] = self.obs[..., 1:]
+        self.obs[..., -1] = self._render_frame()
+        self.final_obs = self.obs.copy() if truncated.any() else None
+        if done.any():
+            # Full auto-reset (VectorEnv contract: done rows return the
+            # FRESH episode's obs): new ball + centered paddle, and the
+            # whole 4-frame stack refilled — a rolled stack would leak
+            # the ended episode's motion cues into the new one.
+            self.steps[done] = 0
+            self.paddle[done] = self.H // 2
+            self._reset_balls(done)
+            fresh = self._render_frame()
+            self.obs[done] = fresh[done][..., None]
+        # Copy out: every env in the registry has value semantics (the
+        # internal buffer mutates in place next step).
+        return self.obs.copy(), reward, terminated, truncated
+
+
 _ENV_REGISTRY: Dict[str, Callable[..., VectorEnv]] = {
     "CartPole-v1": CartPoleVecEnv,
     "GridWorld-v0": GridWorldVecEnv,
     "PixelGridWorld-v0": PixelGridWorldVecEnv,
+    "AtariLike-v0": AtariLikeVecEnv,
 }
 
 

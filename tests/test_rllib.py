@@ -241,3 +241,30 @@ def test_algorithm_with_tune(rl_cluster, tmp_path):
     grid = tuner.fit()
     assert len(grid) == 2
     assert not grid.errors
+
+
+def test_atari_like_env_contract():
+    """r5: the Atari-class env (84x84x4 uint8 frame stacks) honors the
+    VectorEnv contract and feeds the conv-tower sampling path."""
+    import numpy as np
+
+    from ray_tpu.rllib.env import make_vec
+    from ray_tpu.rllib.env_runner import EnvRunner
+    from ray_tpu.rllib.rl_module import RLModuleSpec
+
+    env = make_vec("AtariLike-v0", num_envs=4, seed=1)
+    obs = env.reset()
+    assert obs.shape == (4, 84, 84, 4) and obs.dtype == np.uint8
+    rng = np.random.default_rng(0)
+    for _ in range(30):
+        obs, rew, term, trunc = env.step(
+            rng.integers(0, 6, 4).astype(np.int32))
+    assert obs[..., -1].max() == 255  # something rendered
+    probe = make_vec("AtariLike-v0", num_envs=1)
+    spec = RLModuleSpec(observation_space=probe.observation_space,
+                        action_space=probe.action_space)
+    runner = EnvRunner("AtariLike-v0", num_envs=4, rollout_length=8,
+                       module_spec=spec, seed=0)
+    batch = runner.sample()
+    assert batch["obs"].shape == (8, 4, 84, 84, 4)
+    assert batch["obs"].dtype == np.uint8  # raw bytes in rollouts
