@@ -241,25 +241,31 @@ class AtariLikeVecEnv(VectorEnv):
         self.vel[mask, 0] = np.sin(ang) * 2.0
         self.vel[mask, 1] = np.cos(ang) * 2.0 * sign
 
-    def _render_frame(self):
-        """One new 84x84 frame per env, drawn with fancy indexing."""
-        n = self.num_envs
+    def _render_frame(self, idx=None):
+        """New 84x84 frames drawn with fancy indexing — for all envs, or
+        only the rows in ``idx`` (the done-row re-render must not pay a
+        full-batch render; same rule as PixelGridWorldVecEnv)."""
+        if idx is None:
+            idx = np.arange(self.num_envs)
+        n = len(idx)
         frame = np.zeros((n, self.H, self.W), np.uint8)
         frame[:, 0, :] = 60   # walls
         frame[:, -1, :] = 60
-        idx = np.arange(n)
-        by = np.clip(self.ball[:, 0].astype(np.int64), 1, self.H - 3)
-        bx = np.clip(self.ball[:, 1].astype(np.int64), 0, self.W - 3)
+        rows = np.arange(n)
+        by = np.clip(self.ball[idx, 0].astype(np.int64), 1, self.H - 3)
+        bx = np.clip(self.ball[idx, 1].astype(np.int64), 0, self.W - 3)
         for dy in range(2):          # 2x2 ball
             for dx in range(2):
-                frame[idx, by + dy, bx + dx] = 255
-        py = np.clip(self.paddle.astype(np.int64), 4, self.H - 12)
+                frame[rows, by + dy, bx + dx] = 255
+        py = np.clip(self.paddle[idx].astype(np.int64), 4, self.H - 12)
         for dy in range(8):          # 2-wide, 8-tall paddle at x=2
-            frame[idx, py + dy, 2] = 200
-            frame[idx, py + dy, 3] = 200
+            frame[rows, py + dy, 2] = 200
+            frame[rows, py + dy, 3] = 200
         return frame
 
     def reset(self, seed=None) -> np.ndarray:
+        if seed is not None:
+            self._rng = np.random.default_rng(seed)
         n = self.num_envs
         self.steps[:] = 0
         self.paddle[:] = self.H // 2
@@ -307,8 +313,8 @@ class AtariLikeVecEnv(VectorEnv):
             self.steps[done] = 0
             self.paddle[done] = self.H // 2
             self._reset_balls(done)
-            fresh = self._render_frame()
-            self.obs[done] = fresh[done][..., None]
+            fresh = self._render_frame(np.flatnonzero(done))
+            self.obs[done] = fresh[..., None]
         # Copy out: every env in the registry has value semantics (the
         # internal buffer mutates in place next step).
         return self.obs.copy(), reward, terminated, truncated

@@ -5,8 +5,12 @@ Measures, per BASELINE.json's "PPO >= 50k env-steps/s/chip" target:
 - env-runner sampling throughput (env stepping + batched policy
   forwards + rollout assembly),
 - PPO end-to-end env-steps/s (sampling + learner updates),
-both on state obs (CartPole-v1) and pixel obs (PixelGridWorld-v0, conv
-tower). Run: python -m ray_tpu.scripts.rllib_bench [--json PATH]
+on state obs (CartPole-v1), small pixel obs (PixelGridWorld-v0) and
+the Atari-class pipeline (AtariLike-v0: 84x84x4 uint8 frame stacks).
+``vs_target`` rides the Atari-class sampling number (r5; see PARITY.md
+for this box's measured infra bounds); the gridworld numbers remain
+for round-over-round comparability.
+Run: python -m ray_tpu.scripts.rllib_bench [--json PATH]
 """
 
 from __future__ import annotations

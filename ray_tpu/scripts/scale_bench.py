@@ -1,0 +1,173 @@
+"""Scale/stress lane (reference: release/benchmarks README — many_tasks,
+many_actors, object-store broadcast; release_logs/2.9.1/benchmarks/*).
+
+Three dimensions, recorded per round as BENCH_SCALE_r*.json:
+- many tasks: N trivial tasks across a fake multi-node cluster
+  (reference envelope: 10k launched at 575/s on 2500 CPUs),
+- many actors: M actor creations to readiness (reference: 10k actors
+  registered at 647/s on a release cluster),
+- broadcast: one 100 MB object read by a task on every node agent
+  (reference: 1 GiB to 50 nodes in 74.8 s).
+
+Sizes default to what a single shared core can express (worker spawn
+costs ~2s of CPU here; PARITY.md documents the box): the value of the
+lane is the round-over-round trend, not the absolute envelope.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import subprocess
+import sys
+import time
+
+
+def bench_many_tasks(n_tasks: int) -> dict:
+    import ray_tpu
+
+    @ray_tpu.remote(num_cpus=1)
+    def nop(i):
+        return i
+
+    # Warm the worker pool first so the number measures the task path,
+    # not process spawn (reference harness warms too).
+    ray_tpu.get([nop.remote(i) for i in range(64)], timeout=300)
+    t0 = time.perf_counter()
+    refs = [nop.remote(i) for i in range(n_tasks)]
+    out = ray_tpu.get(refs, timeout=900)
+    dt = time.perf_counter() - t0
+    assert out[-1] == n_tasks - 1
+    return {"num_tasks": n_tasks, "seconds": round(dt, 2),
+            "tasks_per_second": round(n_tasks / dt, 1)}
+
+
+def bench_many_actors(n_actors: int) -> dict:
+    import ray_tpu
+
+    @ray_tpu.remote(num_cpus=0.01)
+    class A:
+        def ping(self):
+            return 1
+
+    t0 = time.perf_counter()
+    actors = [A.remote() for _ in range(n_actors)]
+    ray_tpu.get([a.ping.remote() for a in actors], timeout=1800)
+    dt = time.perf_counter() - t0
+    rate = n_actors / dt
+    for a in actors:
+        ray_tpu.kill(a)
+    return {"num_actors": n_actors, "seconds": round(dt, 2),
+            "actors_per_second": round(rate, 2)}
+
+
+def bench_broadcast(n_agents: int, mb: int, head_port: int) -> dict:
+    import numpy as np
+
+    import ray_tpu
+
+    agents = []
+    try:
+        for i in range(n_agents):
+            agents.append(subprocess.Popen(
+                [sys.executable, "-m", "ray_tpu.core.node_agent",
+                 "--head-host", "127.0.0.1",
+                 "--head-port", str(head_port),
+                 "--num-cpus", "1",
+                 "--resources", json.dumps({f"bcast{i}": 1}),
+                 "--object-store-memory", str(512 << 20)],
+                env={**os.environ},
+                stdout=subprocess.DEVNULL, stderr=subprocess.STDOUT))
+        deadline = time.monotonic() + 120
+        while time.monotonic() < deadline:
+            res = ray_tpu.cluster_resources()
+            if all(res.get(f"bcast{i}") for i in range(n_agents)):
+                break
+            time.sleep(0.3)
+        else:
+            raise TimeoutError("broadcast agents never joined")
+
+        data = np.random.default_rng(0).random(mb * (1 << 20) // 8)
+        ref = ray_tpu.put(data)
+
+        def reader(arr):
+            return float(arr[0]) + arr.nbytes
+
+        def warm():
+            return 1
+
+        # Warm worker spawn on each agent WITHOUT touching the object,
+        # so the timed round measures exactly one cross-node pull per
+        # node (reference: fresh nodes reading one broadcast object).
+        ray_tpu.get([ray_tpu.remote(warm).options(
+            resources={f"bcast{i}": 1}).remote()
+            for i in range(n_agents)], timeout=900)
+        t0 = time.perf_counter()
+        tasks = [ray_tpu.remote(reader).options(
+            resources={f"bcast{i}": 1}).remote(ref)
+            for i in range(n_agents)]
+        out = ray_tpu.get(tasks, timeout=900)
+        dt = time.perf_counter() - t0
+        assert all(o == out[0] for o in out)
+        return {"num_nodes": n_agents, "mb": mb,
+                "broadcast_seconds": round(dt, 2)}
+    finally:
+        for p in agents:
+            p.terminate()
+        for p in agents:
+            try:
+                p.wait(timeout=30)
+            except Exception:
+                p.kill()
+
+
+def main():
+    p = argparse.ArgumentParser()
+    p.add_argument("--json", default=None)
+    p.add_argument("--tasks", type=int, default=10_000)
+    p.add_argument("--actors", type=int, default=200)
+    p.add_argument("--nodes", type=int, default=4,
+                   help="virtual scheduling nodes for the task lane")
+    p.add_argument("--broadcast-nodes", type=int, default=2,
+                   help="real node-agent processes for the broadcast "
+                        "lane (each is a full daemon; 1-core box)")
+    p.add_argument("--broadcast-mb", type=int, default=100)
+    args = p.parse_args()
+
+    os.environ.setdefault("JAX_PLATFORMS", "cpu")
+    import ray_tpu
+    from ray_tpu import api
+
+    ray_tpu.init(num_cpus=8, num_tpus=0,
+                 object_store_memory=1 << 30)
+    # Fake multi-node: extra virtual nodes so scheduling spreads
+    # (reference: cluster_utils.Cluster.add_node).
+    for _ in range(args.nodes - 1):
+        api._global_node.add_node({"CPU": 8.0})
+
+    results = {}
+    try:
+        results["many_tasks"] = bench_many_tasks(args.tasks)
+        results["many_actors"] = bench_many_actors(args.actors)
+        results["broadcast"] = bench_broadcast(
+            args.broadcast_nodes, args.broadcast_mb,
+            api._global_node.port)
+        results["reference_envelope"] = {
+            "many_tasks": "10k tasks @ 575/s (2500 CPUs)",
+            "many_actors": "10k actors @ 647/s (release cluster)",
+            "broadcast": "1 GiB to 50 nodes in 74.8 s",
+        }
+        print(json.dumps(results, indent=1))
+        if args.json:
+            with open(args.json, "w") as f:
+                json.dump(results, f, indent=1)
+                f.write("\n")
+    finally:
+        # Always tear the cluster down: leaked workers/agents poison
+        # every later run on this single-core box.
+        ray_tpu.shutdown()
+
+
+if __name__ == "__main__":
+    main()
