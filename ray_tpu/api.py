@@ -84,6 +84,15 @@ def init(address: Optional[str] = None,
         if object_store_memory:
             config.object_store_memory = object_store_memory
 
+        if address is not None and address.startswith("rtpu://"):
+            # Thin-client mode (reference: ray:// Ray Client): ONE
+            # outbound connection to a cluster-side client server; the
+            # cluster never dials back (NAT'd clients work).
+            from ray_tpu import client as _client
+
+            _global_worker = _client.connect(address[len("rtpu://"):],
+                                             namespace=namespace)
+            return get_runtime_context()
         if address is not None:
             if address == "auto":
                 address = _read_cluster_address()

@@ -1,0 +1,300 @@
+"""Thin-client driver — the Ray Client equivalent.
+
+Reference: python/ray/util/client/__init__.py:217 (RayAPIStub) and
+util/client/server/proxier.py — ``ray.init("ray://host:port")`` lets a
+laptop/notebook drive a remote cluster through ONE outbound connection;
+the cluster never dials the client back, so NAT'd/firewalled clients
+work (a plain remote driver, by contrast, hosts an RPC server that
+workers must reach to deliver results).
+
+Usage:
+    ray_tpu.init(address="rtpu://host:port")   # port = client server
+
+The cluster side runs ``python -m ray_tpu.client.server`` (usually next
+to the head; ``head_main --client-server-port`` starts one), which hosts
+a REAL driver session and executes the api calls on the clients'
+behalf. API calls are forwarded verbatim: tasks/actors (function and
+class bytes shipped once, cached by digest), get/put/wait/kill/cancel,
+and every head RPC the api layer issues (KV, placement groups, named
+actors, cluster state) relays through the same connection.
+"""
+
+from __future__ import annotations
+
+import hashlib
+import logging
+import threading
+from typing import Any, Dict, List, Optional
+
+import cloudpickle
+
+from ray_tpu.core import rpc
+from ray_tpu.core.ids import ActorID, JobID, ObjectID, TaskID, WorkerID
+from ray_tpu.core.object_ref import ObjectRef
+from ray_tpu.core.task_spec import Address
+
+logger = logging.getLogger(__name__)
+
+
+class ClientError(RuntimeError):
+    pass
+
+
+class _ClientRefCounter:
+    """Client-side ref lifecycle: the proxy pins every ref it hands out;
+    when the last client-side ObjectRef for an id dies, a release rides
+    to the proxy (batched) so the cluster can free the object."""
+
+    def __init__(self, worker: "ClientWorker"):
+        self._worker = worker
+        self._lock = threading.Lock()
+        self._counts: Dict[str, int] = {}
+        self._to_release: List[str] = []
+
+    def add_local_ref(self, ref: ObjectRef):
+        with self._lock:
+            h = ref.hex()
+            self._counts[h] = self._counts.get(h, 0) + 1
+
+    def remove_local_ref(self, ref: ObjectRef):
+        flush = None
+        with self._lock:
+            h = ref.hex()
+            n = self._counts.get(h, 0) - 1
+            if n > 0:
+                self._counts[h] = n
+                return
+            self._counts.pop(h, None)
+            self._to_release.append(h)
+            if len(self._to_release) >= 64:
+                flush, self._to_release = self._to_release, []
+        if flush:
+            self._worker._release(flush)
+
+    def flush_releases(self):
+        with self._lock:
+            flush, self._to_release = self._to_release, []
+        if flush:
+            self._worker._release(flush)
+
+    def on_ref_serialized(self, ref: ObjectRef):
+        pass  # the proxy owns and pins; no borrow protocol client-side
+
+    def disable(self):
+        with self._lock:
+            self._counts.clear()
+            self._to_release.clear()
+
+
+class _ProxyHead:
+    """Duck-typed HeadClient: api-layer head RPCs relay through the
+    client connection (the proxy forwards to the real head)."""
+
+    def __init__(self, worker: "ClientWorker"):
+        self._worker = worker
+
+    async def call(self, method: str, payload: Any = None,
+                   timeout: Optional[float] = None):
+        reply = await self._worker._conn.call(
+            "c_head", {"m": method, "p": payload}, timeout=timeout)
+        if reply.get("err") is not None:
+            raise cloudpickle.loads(reply["err"])
+        return reply["r"]
+
+
+class ClientWorker:
+    """Implements the CoreWorker surface the api layer consumes, by
+    forwarding every operation to the cluster-side client server."""
+
+    def __init__(self, host: str, port: int, namespace: str = ""):
+        self.loop_thread = rpc.EventLoopThread(name="rtpu-client")
+        self.namespace = namespace
+        self.worker_id = WorkerID.from_random()
+        self.node_id_hex: Optional[str] = None
+        self.no_node_store = True
+        self._exported: Dict[str, str] = {}  # digest -> proxy key
+        self._closed = False
+
+        async def boot():
+            conn = await rpc.connect(host, port, {}, name="rtpu-client")
+            self._conn = conn
+            return await conn.call("c_handshake", {
+                "namespace": namespace,
+                "worker_id": self.worker_id.hex(),
+            })
+
+        try:
+            reply = self.loop_thread.run(boot(), timeout=30)
+        except BaseException:
+            self.loop_thread.stop()
+            raise
+        self.job_id = JobID.from_hex(reply["job_id"])
+        self._root_task_id = TaskID.for_normal_task(self.job_id)
+        self._proxy_address = tuple(reply["address"])
+        self.reference_counter = _ClientRefCounter(self)
+        self.head = _ProxyHead(self)
+        self._attached_loop_thread = self.loop_thread
+
+    # -- plumbing ------------------------------------------------------
+
+    def _call(self, method: str, payload: dict,
+              timeout: Optional[float] = None):
+        reply = self.loop_thread.run(
+            self._conn.call(method, payload, timeout=timeout))
+        if reply.get("err") is not None:
+            raise cloudpickle.loads(reply["err"])
+        return reply
+
+    def _release(self, hex_ids: List[str]):
+        if self._closed:
+            return
+        try:
+            self.loop_thread.submit(
+                self._conn.notify("c_release", {"ids": hex_ids}))
+        except Exception:
+            pass  # connection gone; the proxy reaps on disconnect
+
+    def _mk_ref(self, hex_id: str) -> ObjectRef:
+        owner = Address(self._proxy_address[0], self._proxy_address[1],
+                        self._proxy_address[2])
+        return ObjectRef(ObjectID.from_hex(hex_id), owner)
+
+    # -- function/actor export ----------------------------------------
+
+    def export_function(self, fn) -> str:
+        blob = cloudpickle.dumps(fn, protocol=5)
+        digest = hashlib.sha1(blob).hexdigest()
+        key = self._exported.get(digest)
+        if key is None:
+            key = self._call("c_export", {"blob": blob})["key"]
+            self._exported[digest] = key
+        return key
+
+    # -- task/actor submission ----------------------------------------
+
+    def serialize_args(self, args: tuple, kwargs: dict) -> bytes:
+        # ObjectRefs/ActorHandles pickle by id + proxy owner address and
+        # rebuild as REAL refs inside the proxy's driver session.
+        return cloudpickle.dumps((args, kwargs), protocol=5)
+
+    def submit_task(self, function_key: str, args_blob: bytes, *,
+                    name: str, num_returns: int,
+                    resources: Dict[str, float], max_retries: int,
+                    retry_exceptions: bool, scheduling_strategy,
+                    runtime_env=None) -> List[ObjectRef]:
+        reply = self._call("c_task", {
+            "key": function_key, "args": args_blob,
+            "opts": cloudpickle.dumps({
+                "name": name, "num_returns": num_returns,
+                "resources": resources, "max_retries": max_retries,
+                "retry_exceptions": retry_exceptions,
+                "scheduling_strategy": scheduling_strategy,
+                "runtime_env": runtime_env,
+            }),
+        })
+        return [self._mk_ref(h) for h in reply["refs"]]
+
+    def create_actor(self, class_key: str, args_blob: bytes, *,
+                     name: str, actor_name: str, namespace: str,
+                     resources: Dict[str, float], max_restarts: int,
+                     max_task_retries: int, max_concurrency: int,
+                     is_async: bool, scheduling_strategy,
+                     runtime_env=None, detached: bool = False) -> ActorID:
+        reply = self._call("c_actor", {
+            "key": class_key, "args": args_blob,
+            "opts": cloudpickle.dumps({
+                "name": name, "actor_name": actor_name,
+                "namespace": namespace or self.namespace,
+                "resources": resources, "max_restarts": max_restarts,
+                "max_task_retries": max_task_retries,
+                "max_concurrency": max_concurrency,
+                "is_async": is_async,
+                "scheduling_strategy": scheduling_strategy,
+                "runtime_env": runtime_env, "detached": detached,
+            }),
+        })
+        return ActorID.from_hex(reply["actor_id"])
+
+    def submit_actor_task(self, actor_id: ActorID, method_name: str,
+                          args_blob: bytes, *, num_returns: int,
+                          name: str = "") -> List[ObjectRef]:
+        reply = self._call("c_actor_call", {
+            "actor_id": actor_id.hex(), "method": method_name,
+            "args": args_blob, "num_returns": num_returns,
+            "name": name,
+        })
+        return [self._mk_ref(h) for h in reply["refs"]]
+
+    # -- data plane ----------------------------------------------------
+
+    def put(self, value: Any) -> ObjectRef:
+        reply = self._call(
+            "c_put", {"blob": cloudpickle.dumps(value, protocol=5)})
+        return self._mk_ref(reply["ref"])
+
+    def get(self, refs: List[ObjectRef],
+            timeout: Optional[float] = None) -> List[Any]:
+        reply = self._call(
+            "c_get", {"ids": [r.hex() for r in refs],
+                      "timeout": timeout},
+            timeout=None if timeout is None else timeout + 30)
+        return cloudpickle.loads(reply["values"])
+
+    def wait(self, refs: List[ObjectRef], num_returns: int,
+             timeout: Optional[float], fetch_local: bool):
+        reply = self._call("c_wait", {
+            "ids": [r.hex() for r in refs], "num_returns": num_returns,
+            "timeout": timeout, "fetch_local": fetch_local,
+        }, timeout=None if timeout is None else timeout + 30)
+        ready_set = set(reply["ready"])
+        ready = [r for r in refs if r.hex() in ready_set]
+        not_ready = [r for r in refs if r.hex() not in ready_set]
+        return ready, not_ready
+
+    # -- control -------------------------------------------------------
+
+    def kill_actor(self, actor_id: ActorID, no_restart: bool = True):
+        self._call("c_kill", {"actor_id": actor_id.hex(),
+                              "no_restart": no_restart})
+
+    def cancel_task(self, ref: ObjectRef, force: bool = False):
+        self._call("c_cancel", {"id": ref.hex(), "force": force})
+
+    def current_task_id(self) -> TaskID:
+        return self._root_task_id
+
+    def _on_actor_state_threadsafe(self, data: dict):
+        """No-op: the api layer pushes named-actor table rows here for
+        the real CoreWorker's call-routing cache; the thin client
+        routes every call through the proxy instead."""
+
+    def export_actor_class(self, cls) -> str:
+        return self.export_function(cls)
+
+    async def stop(self):
+        # Ship every pending release BEFORE closing (the proxy also
+        # reaps on disconnect; this is the graceful path).
+        with self.reference_counter._lock:
+            pending, self.reference_counter._to_release = (
+                self.reference_counter._to_release, [])
+        if pending:
+            try:
+                await self._conn.notify("c_release", {"ids": pending})
+            except Exception:
+                pass
+        self._closed = True
+        self.reference_counter.disable()
+        try:
+            await self._conn.close()
+        except Exception:
+            pass
+
+
+def connect(address: str, namespace: str = "") -> ClientWorker:
+    """``address`` is "host:port" of a ray_tpu.client.server."""
+    from ray_tpu.core import object_ref as object_ref_mod
+
+    host, port_s = address.rsplit(":", 1)
+    worker = ClientWorker(host, int(port_s), namespace=namespace)
+    object_ref_mod.set_core_worker(worker)
+    return worker

@@ -1,0 +1,352 @@
+"""Cluster-side client server (reference: util/client/server/proxier.py
+— the process that terminates ``ray://`` connections and executes api
+calls on the clients' behalf).
+
+Hosts ONE real driver session (``ray_tpu.init(address=head)``) and a
+dedicated RPC server for thin clients. Blocking driver calls run on an
+executor pool so one slow ``get`` never stalls other clients' requests.
+
+Design note vs the reference: Ray's proxier forks a fresh driver per
+client for job isolation; here all clients share the server's driver
+session (single job id) — a deliberate simplification recorded in
+PARITY.md. The NAT property (client only dials out) is identical.
+
+Run next to the head:
+    python -m ray_tpu.core.head_main --client-server-port 10001
+or standalone:
+    python -m ray_tpu.client.server --head 127.0.0.1:6379 --port 10001
+"""
+
+from __future__ import annotations
+
+import asyncio
+import itertools
+import logging
+from typing import Dict, Optional
+
+import cloudpickle
+
+from ray_tpu.core import rpc
+
+logger = logging.getLogger(__name__)
+
+
+class ClientServer:
+    def __init__(self, host: str = "0.0.0.0", port: int = 10001):
+        self._host = host
+        self._port = port
+        self._fns: Dict[str, str] = {}      # digest -> exported key
+        self._refs: Dict[str, object] = {}  # hex -> pinned ObjectRef
+        self._counter = itertools.count()
+        self.loop_thread = rpc.EventLoopThread(name="rtpu-client-srv")
+        self.server: Optional[rpc.Server] = None
+        self.port: Optional[int] = None
+
+    # -- lifecycle -----------------------------------------------------
+
+    def start(self) -> int:
+        async def boot():
+            self.server = rpc.Server(self._handlers(),
+                                     name="client-server")
+            self.server.on_connect = self._on_connect
+            return await self.server.start(self._host, self._port)
+
+        self.port = self.loop_thread.run(boot())
+        logger.info("client server listening on %s:%d",
+                    self._host, self.port)
+        return self.port
+
+    def stop(self):
+        try:
+            self.loop_thread.run(self.server.stop(), timeout=5)
+        except Exception:
+            pass
+        self.loop_thread.stop()
+
+    def _on_connect(self, conn):
+        """Chain a disconnect reaper: refs pinned for a vanished client
+        must not pin the shared driver session's objects forever."""
+        prev = conn.on_close
+
+        def closed(c):
+            if prev is not None:
+                prev(c)
+            mine = c.state.pop("client_refs", set())
+            if not mine:
+                return
+            still_held = set()
+            for other in list(self.server.connections):
+                still_held |= other.state.get("client_refs", set())
+            for h in mine - still_held:
+                self._refs.pop(h, None)
+
+        conn.on_close = closed
+
+    # -- helpers -------------------------------------------------------
+
+    def _handlers(self) -> dict:
+        return {
+            "c_handshake": self.h_handshake,
+            "c_export": self.h_export,
+            "c_task": self.h_task,
+            "c_actor": self.h_actor,
+            "c_actor_call": self.h_actor_call,
+            "c_put": self.h_put,
+            "c_get": self.h_get,
+            "c_wait": self.h_wait,
+            "c_kill": self.h_kill,
+            "c_cancel": self.h_cancel,
+            "c_release": self.h_release,
+            "c_head": self.h_head,
+        }
+
+    @staticmethod
+    async def _blocking(fn, *args):
+        loop = asyncio.get_running_loop()
+        return await loop.run_in_executor(None, fn, *args)
+
+    @staticmethod
+    def _guard(fn):
+        """Run ``fn`` and pack the result; exceptions travel to the
+        client serialized (it re-raises the original)."""
+        try:
+            return fn()
+        except Exception as e:  # noqa: BLE001 — relayed, not swallowed
+            try:
+                blob = cloudpickle.dumps(e)
+            except Exception:
+                blob = cloudpickle.dumps(
+                    RuntimeError(f"{type(e).__name__}: {e}"))
+            return {"err": blob}
+
+    def _pin(self, refs, conn) -> list:
+        out = []
+        mine = conn.state.setdefault("client_refs", set())
+        for ref in refs:
+            h = ref.hex()
+            self._refs[h] = ref
+            mine.add(h)
+            out.append(h)
+        return out
+
+    def _resolve(self, hex_id: str):
+        from ray_tpu.core.ids import ObjectID
+        from ray_tpu.core.object_ref import ObjectRef
+
+        ref = self._refs.get(hex_id)
+        if ref is not None:
+            return ref
+        # A ref the client rebuilt from a value payload: this driver
+        # owns it (proxy-minted ids), so a bare rebuild resolves.
+        return ObjectRef(ObjectID.from_hex(hex_id))
+
+    # -- handlers ------------------------------------------------------
+
+    async def h_handshake(self, conn, payload):
+        from ray_tpu import api
+
+        cw = api._require_worker()
+        return {"job_id": cw.job_id.hex(),
+                "address": [cw.address.host, cw.address.port,
+                            cw.address.worker_id_hex]}
+
+    async def h_export(self, conn, payload):
+        import hashlib
+
+        blob = payload["blob"]
+        digest = hashlib.sha1(blob).hexdigest()
+        key = self._fns.get(digest)
+        if key is None:
+            def run():
+                from ray_tpu import api
+
+                fn = cloudpickle.loads(blob)
+                return api._require_worker().export_function(fn)
+
+            key = await self._blocking(run)
+            self._fns[digest] = key
+        return {"key": key}
+
+    async def h_task(self, conn, payload):
+        def run():
+            return self._guard(lambda: self._do_task(payload, conn))
+
+        return await self._blocking(run)
+
+    def _do_task(self, payload, conn):
+        from ray_tpu import api
+
+        cw = api._require_worker()
+        args, kwargs = cloudpickle.loads(payload["args"])
+        opts = cloudpickle.loads(payload["opts"])
+        if opts["num_returns"] == -1:
+            raise NotImplementedError(
+                "streaming tasks (num_returns='streaming') are not "
+                "supported through the thin client yet; use a remote "
+                "driver (address='host:port') for streaming generators")
+        task_args = cw.serialize_args(args, kwargs)
+        refs = cw.submit_task(
+            payload["key"], task_args,
+            name=opts["name"], num_returns=opts["num_returns"],
+            resources=opts["resources"],
+            max_retries=opts["max_retries"],
+            retry_exceptions=opts["retry_exceptions"],
+            scheduling_strategy=opts["scheduling_strategy"],
+            runtime_env=opts["runtime_env"],
+        )
+        return {"refs": self._pin(refs, conn)}
+
+    async def h_actor(self, conn, payload):
+        def run():
+            return self._guard(lambda: self._do_actor(payload))
+
+        return await self._blocking(run)
+
+    def _do_actor(self, payload):
+        from ray_tpu import api
+
+        cw = api._require_worker()
+        args, kwargs = cloudpickle.loads(payload["args"])
+        opts = cloudpickle.loads(payload["opts"])
+        task_args = cw.serialize_args(args, kwargs)
+        actor_id = cw.create_actor(
+            payload["key"], task_args,
+            name=opts["name"], actor_name=opts["actor_name"],
+            namespace=opts["namespace"], resources=opts["resources"],
+            max_restarts=opts["max_restarts"],
+            max_task_retries=opts["max_task_retries"],
+            max_concurrency=opts["max_concurrency"],
+            is_async=opts["is_async"],
+            scheduling_strategy=opts["scheduling_strategy"],
+            runtime_env=opts["runtime_env"],
+            detached=opts["detached"],
+        )
+        return {"actor_id": actor_id.hex()}
+
+    async def h_actor_call(self, conn, payload):
+        def run():
+            return self._guard(
+                lambda: self._do_actor_call(payload, conn))
+
+        return await self._blocking(run)
+
+    def _do_actor_call(self, payload, conn):
+        from ray_tpu import api
+        from ray_tpu.core.ids import ActorID
+
+        cw = api._require_worker()
+        args, kwargs = cloudpickle.loads(payload["args"])
+        task_args = cw.serialize_args(args, kwargs)
+        refs = cw.submit_actor_task(
+            ActorID.from_hex(payload["actor_id"]), payload["method"],
+            task_args, num_returns=payload["num_returns"],
+            name=payload.get("name", ""),
+        )
+        return {"refs": self._pin(refs, conn)}
+
+    async def h_put(self, conn, payload):
+        def run():
+            def inner():
+                from ray_tpu import api
+
+                value = cloudpickle.loads(payload["blob"])
+                ref = api._require_worker().put(value)
+                return {"ref": self._pin([ref], conn)[0]}
+            return self._guard(inner)
+
+        return await self._blocking(run)
+
+    async def h_get(self, conn, payload):
+        def run():
+            def inner():
+                from ray_tpu import api
+
+                refs = [self._resolve(h) for h in payload["ids"]]
+                values = api._require_worker().get(
+                    refs, payload.get("timeout"))
+                return {"values": cloudpickle.dumps(values,
+                                                    protocol=5)}
+            return self._guard(inner)
+
+        return await self._blocking(run)
+
+    async def h_wait(self, conn, payload):
+        def run():
+            def inner():
+                from ray_tpu import api
+
+                refs = [self._resolve(h) for h in payload["ids"]]
+                ready, _ = api._require_worker().wait(
+                    refs, payload["num_returns"], payload["timeout"],
+                    payload["fetch_local"])
+                return {"ready": [r.hex() for r in ready]}
+            return self._guard(inner)
+
+        return await self._blocking(run)
+
+    async def h_kill(self, conn, payload):
+        def run():
+            def inner():
+                from ray_tpu import api
+                from ray_tpu.core.ids import ActorID
+
+                api._require_worker().kill_actor(
+                    ActorID.from_hex(payload["actor_id"]),
+                    payload["no_restart"])
+                return {"ok": True}
+            return self._guard(inner)
+
+        return await self._blocking(run)
+
+    async def h_cancel(self, conn, payload):
+        def run():
+            def inner():
+                from ray_tpu import api
+
+                api._require_worker().cancel_task(
+                    self._resolve(payload["id"]), payload["force"])
+                return {"ok": True}
+            return self._guard(inner)
+
+        return await self._blocking(run)
+
+    async def h_release(self, conn, payload):
+        for hex_id in payload.get("ids", []):
+            self._refs.pop(hex_id, None)
+
+    async def h_head(self, conn, payload):
+        def run():
+            def inner():
+                from ray_tpu import api
+
+                cw = api._require_worker()
+                return {"r": cw.loop_thread.run(cw.head.call(
+                    payload["m"], payload["p"]))}
+            return self._guard(inner)
+
+        return await self._blocking(run)
+
+
+def main():
+    import argparse
+
+    logging.basicConfig(level=logging.INFO)
+    p = argparse.ArgumentParser(description=__doc__)
+    p.add_argument("--head", required=True, help="head host:port")
+    p.add_argument("--host", default="0.0.0.0")
+    p.add_argument("--port", type=int, default=10001)
+    args = p.parse_args()
+
+    import ray_tpu
+
+    ray_tpu.init(address=args.head)
+    srv = ClientServer(args.host, args.port)
+    port = srv.start()
+    print(f"ray_tpu client server on {args.host}:{port}", flush=True)
+    import threading
+
+    threading.Event().wait()
+
+
+if __name__ == "__main__":
+    main()

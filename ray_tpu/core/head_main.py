@@ -41,6 +41,9 @@ def main():
     p.add_argument("--session-dir", default=None,
                    help="pin to reuse durable state across restarts")
     p.add_argument("--object-store-memory", type=int, default=None)
+    p.add_argument("--client-server-port", type=int, default=None,
+                   help="also serve thin clients (rtpu:// — the Ray "
+                        "Client analog) on this port")
     args = p.parse_args()
 
     from ray_tpu.core.config import get_config
@@ -61,6 +64,18 @@ def main():
     print(f"ray_tpu head listening on {args.host}:{node.port} "
           f"(session {node.session_dir})", flush=True)
 
+    client_srv = None
+    if args.client_server_port is not None:
+        # Thin-client endpoint (rtpu://): a driver session in THIS
+        # process backs it (reference: the proxier runs beside the GCS).
+        import ray_tpu
+        from ray_tpu.client.server import ClientServer
+
+        ray_tpu.init(address=f"127.0.0.1:{node.port}")
+        client_srv = ClientServer(args.host, args.client_server_port)
+        print(f"ray_tpu client server on {args.host}:"
+              f"{client_srv.start()}", flush=True)
+
     stop = asyncio.Event()
 
     async def wait_forever():
@@ -71,6 +86,8 @@ def main():
     except KeyboardInterrupt:
         pass
     finally:
+        if client_srv is not None:
+            client_srv.stop()
         node.shutdown()
 
 

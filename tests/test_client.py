@@ -1,0 +1,133 @@
+"""Thin-client mode (reference strategy: util/client tests — a driver
+behind a single outbound connection runs tasks/actors/data ops)."""
+
+import os
+import signal
+import subprocess
+import sys
+import time
+
+import pytest
+
+import ray_tpu
+
+
+@pytest.fixture(scope="module")
+def client_cluster():
+    import socket
+
+    def free_port():
+        s = socket.socket()
+        s.bind(("127.0.0.1", 0))
+        port = s.getsockname()[1]
+        s.close()
+        return port
+
+    head_port, client_port = free_port(), free_port()
+    repo_root = os.path.dirname(os.path.dirname(
+        os.path.abspath(__file__)))
+    env = {**os.environ, "PYTHONPATH": repo_root,
+           "JAX_PLATFORMS": "cpu"}
+    proc = subprocess.Popen(
+        [sys.executable, "-m", "ray_tpu.core.head_main",
+         "--port", str(head_port), "--num-cpus", "4",
+         "--client-server-port", str(client_port)],
+        env=env, stdout=subprocess.PIPE, stderr=subprocess.STDOUT,
+        text=True)
+    deadline = time.monotonic() + 90
+    seen = ""
+    while time.monotonic() < deadline:
+        line = proc.stdout.readline()
+        seen += line
+        if "client server on" in line:
+            break
+        if proc.poll() is not None:
+            raise RuntimeError(f"head died: {seen}")
+    else:
+        proc.kill()
+        raise TimeoutError(f"client server never started: {seen}")
+    yield client_port
+    proc.send_signal(signal.SIGTERM)
+    try:
+        proc.wait(timeout=30)
+    except subprocess.TimeoutExpired:
+        proc.kill()
+
+
+@pytest.fixture()
+def client(client_cluster):
+    ray_tpu.init(address=f"rtpu://127.0.0.1:{client_cluster}")
+    yield
+    ray_tpu.shutdown()
+
+
+def test_client_tasks_and_data(client):
+    @ray_tpu.remote
+    def add(a, b):
+        return a + b
+
+    assert ray_tpu.get(add.remote(1, 2), timeout=120) == 3
+    # refs as args cross the proxy
+    r1 = add.remote(10, 20)
+    assert ray_tpu.get(add.remote(r1, 5), timeout=120) == 35
+    # put/get roundtrip
+    ref = ray_tpu.put({"k": [1, 2, 3]})
+    assert ray_tpu.get(ref, timeout=60) == {"k": [1, 2, 3]}
+    # wait
+    refs = [add.remote(i, i) for i in range(4)]
+    ready, not_ready = ray_tpu.wait(refs, num_returns=4, timeout=120)
+    assert len(ready) == 4 and not_ready == []
+    # errors propagate with the original type
+
+    @ray_tpu.remote
+    def boom():
+        raise ValueError("client boom")
+
+    from ray_tpu import exceptions as exc
+
+    with pytest.raises(exc.TaskError, match="client boom"):
+        ray_tpu.get(boom.remote(), timeout=120)
+
+
+def test_client_actors(client):
+    @ray_tpu.remote
+    class Counter:
+        def __init__(self, start):
+            self.v = start
+
+        def inc(self, by=1):
+            self.v += by
+            return self.v
+
+    c = Counter.remote(10)
+    assert ray_tpu.get(c.inc.remote(), timeout=120) == 11
+    assert ray_tpu.get(c.inc.remote(5), timeout=60) == 16
+    ray_tpu.kill(c)
+
+
+def test_client_head_relay(client):
+    # Head RPCs (kv, cluster state) relay through the proxy.
+    ray_tpu.kv_put(b"client-key", b"client-val")
+    assert ray_tpu.kv_get(b"client-key") == b"client-val"
+    res = ray_tpu.cluster_resources()
+    assert res.get("CPU") == 4.0
+
+
+def test_client_named_actor_and_errors(client):
+    @ray_tpu.remote
+    class Named:
+        def who(self):
+            return "named-one"
+
+    Named.options(name="client_named", lifetime="detached").remote()
+    h = ray_tpu.get_actor("client_named")
+    assert ray_tpu.get(h.who.remote(), timeout=120) == "named-one"
+    ray_tpu.kill(h)
+    # Streaming is a clean error through the client, not a hang.
+
+    @ray_tpu.remote(num_returns="streaming")
+    def gen():
+        yield 1
+
+    with pytest.raises(Exception, match="not supported"):
+        gen.remote()
