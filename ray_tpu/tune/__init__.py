@@ -7,6 +7,8 @@ schedulers, experiment checkpointing).
 from ray_tpu.tune.search import (
     BasicVariantGenerator,
     ConcurrencyLimiter,
+    SearchGenerator,
+    Searcher,
     choice,
     grid_search,
     loguniform,
@@ -18,6 +20,7 @@ from ray_tpu.tune.search import (
 from ray_tpu.tune.schedulers import (
     AsyncHyperBandScheduler,
     FIFOScheduler,
+    HyperBandScheduler,
     MedianStoppingRule,
     PopulationBasedTraining,
 )
@@ -38,9 +41,12 @@ __all__ = [
     "BasicVariantGenerator",
     "ConcurrencyLimiter",
     "FIFOScheduler",
+    "HyperBandScheduler",
     "MedianStoppingRule",
     "PopulationBasedTraining",
     "ResultGrid",
+    "SearchGenerator",
+    "Searcher",
     "Trainable",
     "TuneConfig",
     "Tuner",

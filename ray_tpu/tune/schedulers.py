@@ -14,6 +14,8 @@ from typing import Any, Dict, List, Optional
 
 CONTINUE = "CONTINUE"
 STOP = "STOP"
+PAUSE = "PAUSE"
+RESUME = "RESUME"
 
 
 class TrialScheduler:
@@ -25,8 +27,22 @@ class TrialScheduler:
     def score(self, result: dict) -> float:
         return self._sign * float(result[self.metric])
 
+    def on_trial_add(self, trial):
+        """Called when the controller creates a trial (before it runs)."""
+
     def on_result(self, trial, result: dict) -> str:
         return CONTINUE
+
+    def paused_actions(self, paused_trials) -> Dict[str, str]:
+        """Decide the fate of paused trials: trial_id -> RESUME | STOP.
+
+        Called by the controller each loop iteration while any trial is
+        paused. Trials absent from the returned dict stay paused.
+        """
+        return {}
+
+    def on_search_exhausted(self):
+        """The search algorithm will produce no further trials."""
 
     def on_trial_complete(self, trial, result: Optional[dict]):
         pass
@@ -80,6 +96,133 @@ class AsyncHyperBandScheduler(TrialScheduler):
             if s < top[-1]:
                 return STOP
         return CONTINUE
+
+
+class _Bracket:
+    """One HyperBand bracket: n trials, initial budget r, halved by eta
+    at each rung until the milestone reaches max_t."""
+
+    def __init__(self, s: int, s_max: int, max_t: int, eta: int):
+        self.s = s
+        self.eta = eta
+        self.max_t = max_t
+        self.capacity = int(math.ceil((s_max + 1) * eta ** s / (s + 1)))
+        self.r0 = max(1, int(round(max_t * eta ** -s)))
+        self.rung = 0
+        self.milestone = min(max_t, self.r0)
+        self.added = 0                # total trials ever assigned
+        self.live: set = set()        # trial_ids not yet cut/finished
+        self.pending_scores: Dict[str, float] = {}  # paused at milestone
+
+    def full(self) -> bool:
+        return self.added >= self.capacity
+
+    def add(self, trial_id: str):
+        self.added += 1
+        self.live.add(trial_id)
+
+    def remove(self, trial_id: str):
+        self.live.discard(trial_id)
+        self.pending_scores.pop(trial_id, None)
+
+    def record_pause(self, trial_id: str, score: float):
+        self.pending_scores[trial_id] = score
+
+    def ready_to_halve(self, no_more_trials: bool) -> bool:
+        # A bracket only halves once its cohort is complete — either
+        # filled to capacity or the search can add no more — so that
+        # incrementally-arriving trials (Searcher-driven) are compared
+        # against their full rung cohort, not promoted in cohorts of one.
+        if not (self.full() or no_more_trials):
+            return False
+        return (bool(self.live)
+                and set(self.pending_scores) >= self.live)
+
+    def halve(self) -> Dict[str, str]:
+        """All live trials paused at the milestone: keep the top
+        len/eta, stop the rest, advance the rung."""
+        ranked = sorted(self.live, key=lambda t: self.pending_scores[t],
+                        reverse=True)
+        keep = max(1, len(ranked) // self.eta)
+        survivors, losers = ranked[:keep], ranked[keep:]
+        actions = {t: RESUME for t in survivors}
+        actions.update({t: STOP for t in losers})
+        for t in losers:
+            self.remove(t)
+        self.pending_scores.clear()
+        self.rung += 1
+        self.milestone = min(self.max_t,
+                             self.r0 * self.eta ** self.rung)
+        return actions
+
+
+class HyperBandScheduler(TrialScheduler):
+    """Synchronous HyperBand (Li et al., JMLR 2018).
+
+    Reference: python/ray/tune/schedulers/hyperband.py:HyperBandScheduler.
+    Trials fill brackets s = s_max .. 0 in order (a "band"); each bracket
+    runs its cohort to a rung milestone, pauses every trial there, keeps
+    the top 1/eta by the metric and stops the rest, then resumes the
+    survivors toward the next milestone (r0 * eta^k, capped at max_t).
+    Unlike ASHA the halving is synchronous — a bracket waits for all of
+    its live trials before promoting, which is exactly the reference
+    semantics and requires the controller's pause/resume support.
+    Pausing checkpoints the trial; class trainables resume in place.
+    """
+
+    def __init__(self, *, time_attr: str = "training_iteration",
+                 max_t: int = 81, reduction_factor: int = 3):
+        self.time_attr = time_attr
+        self.max_t = max_t
+        self.eta = reduction_factor
+        # Integer log (math.log(243, 3) == 4.999... would truncate).
+        s_max, t = 0, reduction_factor
+        while t <= max_t:
+            s_max += 1
+            t *= reduction_factor
+        self.s_max = s_max
+        self._brackets: List[_Bracket] = []
+        self._by_trial: Dict[str, _Bracket] = {}
+        self._next_s = self.s_max
+        self._no_more_trials = False
+
+    def on_trial_add(self, trial):
+        if not self._brackets or self._brackets[-1].full():
+            self._brackets.append(
+                _Bracket(self._next_s, self.s_max, self.max_t, self.eta))
+            self._next_s = (self._next_s - 1 if self._next_s > 0
+                            else self.s_max)
+        bracket = self._brackets[-1]
+        bracket.add(trial.trial_id)
+        self._by_trial[trial.trial_id] = bracket
+
+    def on_result(self, trial, result: dict) -> str:
+        bracket = self._by_trial.get(trial.trial_id)
+        if bracket is None:
+            return CONTINUE
+        t = result.get(self.time_attr, 0)
+        if t < bracket.milestone:
+            return CONTINUE
+        if bracket.milestone >= self.max_t:
+            bracket.remove(trial.trial_id)
+            return STOP
+        bracket.record_pause(trial.trial_id, self.score(result))
+        return PAUSE
+
+    def on_search_exhausted(self):
+        self._no_more_trials = True
+
+    def paused_actions(self, paused_trials) -> Dict[str, str]:
+        actions: Dict[str, str] = {}
+        for bracket in self._brackets:
+            if bracket.ready_to_halve(self._no_more_trials):
+                actions.update(bracket.halve())
+        return actions
+
+    def on_trial_complete(self, trial, result):
+        bracket = self._by_trial.pop(trial.trial_id, None)
+        if bracket is not None:
+            bracket.remove(trial.trial_id)
 
 
 class MedianStoppingRule(TrialScheduler):

@@ -129,13 +129,32 @@ def _deep_copy_static(space):
 
 
 class SearchAlgorithm:
-    """Base: yields trial configs (reference: search/search_algorithm.py)."""
+    """Base: yields trial configs (reference: search/search_algorithm.py).
+
+    ``next_configs`` is polled every controller loop iteration; return a
+    batch of new configs, or None/[] when nothing new is available right
+    now. The controller reports back trial ids (in emission order) via
+    ``on_trials_created``, then intermediate results and completions.
+    """
 
     def set_metric(self, metric: Optional[str], mode: str):
         self.metric, self.mode = metric, mode
 
     def next_configs(self) -> Optional[List[dict]]:
         raise NotImplementedError
+
+    def is_finished(self) -> bool:
+        """True once the search will produce no further configs. Used by
+        synchronous schedulers (HyperBand) to close underfilled brackets;
+        False (the conservative default) just defers to the controller's
+        stall guard."""
+        return False
+
+    def on_trials_created(self, trial_ids: List[str]):
+        pass
+
+    def on_trial_result(self, trial_id: str, result: dict):
+        pass
 
     def on_trial_complete(self, trial_id: str, result: Optional[dict],
                           error: bool = False):
@@ -179,12 +198,119 @@ class BasicVariantGenerator(SearchAlgorithm):
                 configs.append(cfg)
         return configs
 
+    def is_finished(self) -> bool:
+        return self._emitted
+
+
+class Searcher:
+    """Adapter base for external optimizers (reference:
+    python/ray/tune/search/searcher.py:Searcher).
+
+    Subclass this to plug any sequential optimizer (Bayesian, TPE,
+    annealing, a vendor library) into Tune: implement ``suggest`` to
+    propose a config for a new trial id and ``on_trial_complete`` to
+    feed the observed metric back. Wrap with ``SearchGenerator`` (or
+    pass directly to TuneConfig.search_alg, which wraps automatically).
+    """
+
+    FINISHED = "FINISHED"
+
+    def __init__(self, metric: Optional[str] = None, mode: str = "max"):
+        self.metric = metric
+        self.mode = mode
+
+    def set_search_properties(self, metric: Optional[str], mode: str,
+                              config: Optional[dict] = None) -> bool:
+        if metric:
+            self.metric = metric
+        if mode:
+            self.mode = mode
+        return True
+
+    def suggest(self, trial_id: str) -> Optional[Any]:
+        """Return a config dict, None (nothing available right now), or
+        Searcher.FINISHED (the search space is exhausted)."""
+        raise NotImplementedError
+
+    def on_trial_result(self, trial_id: str, result: dict):
+        pass
+
+    def on_trial_complete(self, trial_id: str,
+                          result: Optional[dict] = None,
+                          error: bool = False):
+        pass
+
+
+class SearchGenerator(SearchAlgorithm):
+    """Drives a ``Searcher`` through the SearchAlgorithm interface
+    (reference: tune/search/search_generator.py:SearchGenerator).
+
+    Suggests up to ``num_samples`` trials, pairing each suggestion with
+    the controller-assigned trial id via ``on_trials_created`` so
+    completion feedback reaches the searcher under the id it suggested
+    for.
+    """
+
+    def __init__(self, searcher: Searcher,
+                 num_samples: Optional[int] = 1):
+        self.searcher = searcher
+        # None = "not set yet": Tuner.fit fills in TuneConfig.num_samples
+        # (used when ConcurrencyLimiter wraps a bare Searcher).
+        self.num_samples = num_samples
+        self._suggested = 0
+        self._finished = False
+        self._unpaired: List[str] = []   # searcher ids awaiting trial ids
+        self._id_map: Dict[str, str] = {}  # trial_id -> searcher id
+
+    def set_metric(self, metric, mode):
+        super().set_metric(metric, mode)
+        self.searcher.set_search_properties(metric, mode)
+
+    def next_configs(self) -> Optional[List[dict]]:
+        out = []
+        limit = self.num_samples if self.num_samples is not None else 1
+        while not self._finished and self._suggested < limit:
+            sid = f"suggest_{self._suggested:05d}"
+            cfg = self.searcher.suggest(sid)
+            if cfg is None:
+                break
+            if cfg is Searcher.FINISHED or cfg == Searcher.FINISHED:
+                self._finished = True
+                break
+            self._suggested += 1
+            self._unpaired.append(sid)
+            out.append(dict(cfg))
+        return out or None
+
+    def is_finished(self) -> bool:
+        limit = self.num_samples if self.num_samples is not None else 1
+        return self._finished or self._suggested >= limit
+
+    def on_trials_created(self, trial_ids: List[str]):
+        for tid in trial_ids:
+            if self._unpaired:
+                self._id_map[tid] = self._unpaired.pop(0)
+
+    def on_trial_result(self, trial_id, result):
+        sid = self._id_map.get(trial_id)
+        if sid is not None:
+            self.searcher.on_trial_result(sid, result)
+
+    def on_trial_complete(self, trial_id, result=None, error=False):
+        sid = self._id_map.get(trial_id)
+        if sid is not None:
+            self.searcher.on_trial_complete(sid, result, error=error)
+
 
 class ConcurrencyLimiter(SearchAlgorithm):
     """Caps concurrent trials from a wrapped searcher (reference:
-    search/concurrency_limiter.py). The controller reads max_concurrent."""
+    search/concurrency_limiter.py). The controller reads max_concurrent.
+    Accepts a SearchAlgorithm or a bare ``Searcher`` (wrapped in a
+    SearchGenerator automatically, matching the reference API)."""
 
-    def __init__(self, searcher: SearchAlgorithm, max_concurrent: int):
+    def __init__(self, searcher, max_concurrent: int):
+        if isinstance(searcher, Searcher):
+            searcher = SearchGenerator(searcher, num_samples=None)
         self.searcher = searcher
         self.max_concurrent = max_concurrent
 
@@ -193,6 +319,15 @@ class ConcurrencyLimiter(SearchAlgorithm):
 
     def next_configs(self):
         return self.searcher.next_configs()
+
+    def is_finished(self):
+        return self.searcher.is_finished()
+
+    def on_trials_created(self, trial_ids):
+        self.searcher.on_trials_created(trial_ids)
+
+    def on_trial_result(self, trial_id, result):
+        self.searcher.on_trial_result(trial_id, result)
 
     def on_trial_complete(self, trial_id, result=None, error=False):
         self.searcher.on_trial_complete(trial_id, result, error)

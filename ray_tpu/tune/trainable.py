@@ -136,11 +136,22 @@ class _TrialRunner:
         self._ckpt_seq += 1
         os.makedirs(path, exist_ok=True)
         self._cls_instance.save_checkpoint(path)
+        # Runner-level meta so a restarted actor (pause/resume, PBT
+        # exploit) keeps counting training_iteration from where the
+        # checkpoint left off instead of from zero.
+        with open(os.path.join(path, ".runner_meta"), "w") as f:
+            f.write(f"{self.iteration} {self._ckpt_seq}")
         return path
 
     def restore(self, checkpoint_path: str) -> None:
         if self._cls_instance is not None:
             self._cls_instance.load_checkpoint(checkpoint_path)
+            meta = os.path.join(checkpoint_path, ".runner_meta")
+            if os.path.exists(meta):
+                with open(meta) as f:
+                    it, seq = f.read().split()
+                self.iteration = int(it)
+                self._ckpt_seq = int(seq)
         else:
             # Applied on (re)start: exposed to the fn via
             # tune.get_checkpoint().

@@ -24,6 +24,8 @@ from ray_tpu.train.result import Result
 from ray_tpu.tune import schedulers as sched_mod
 from ray_tpu.tune.schedulers import (
     CONTINUE,
+    PAUSE,
+    RESUME,
     STOP,
     ExploitDirective,
     FIFOScheduler,
@@ -36,6 +38,7 @@ logger = logging.getLogger(__name__)
 
 PENDING = "PENDING"
 RUNNING = "RUNNING"
+PAUSED = "PAUSED"
 TERMINATED = "TERMINATED"
 ERROR = "ERROR"
 
@@ -90,12 +93,17 @@ class TuneController:
         configs = self.search_alg.next_configs()
         if not configs:
             return
+        created = []
         for cfg in configs:
             self._counter += 1
             tid = f"trial_{self._counter:05d}"
-            self.trials.append(Trial(
+            trial = Trial(
                 trial_id=tid, config=cfg,
-                trial_dir=os.path.join(self.exp_dir, tid)))
+                trial_dir=os.path.join(self.exp_dir, tid))
+            self.trials.append(trial)
+            created.append(tid)
+            self.scheduler.on_trial_add(trial)
+        self.search_alg.on_trials_created(created)
 
     def _start_trial(self, trial: Trial):
         actor_cls = ray_tpu.remote(_TrialRunner).options(**self.resources)
@@ -123,6 +131,46 @@ class TuneController:
         self.search_alg.on_trial_complete(
             trial.trial_id, trial.last_result, error=state == ERROR)
         self.scheduler.on_trial_complete(trial, trial.last_result)
+
+    def _pause_trial(self, trial: Trial):
+        """Checkpoint and release the trial's actor; the scheduler later
+        resumes (-> PENDING, restored from the checkpoint) or stops it."""
+        self._maybe_checkpoint(trial, force=True)
+        if trial.checkpoint_path is None:
+            # Function trainables only checkpoint through
+            # tune.report(checkpoint=...); without one, resume restarts
+            # the function from scratch (reference semantics — its
+            # HyperBand/PBT docs require checkpointable trainables).
+            logger.warning(
+                "pausing trial %s without a checkpoint; it will restart "
+                "from iteration 0 on resume. Report checkpoints from the "
+                "trainable (or use a class Trainable) with "
+                "HyperBand/PBT.", trial.trial_id)
+        trial.state = PAUSED
+        trial.future = None
+        if trial.actor is not None:
+            try:
+                ray_tpu.get(trial.actor.stop.remote(), timeout=5)
+            except Exception:
+                pass
+            try:
+                ray_tpu.kill(trial.actor)
+            except Exception:
+                pass
+            trial.actor = None
+
+    def _apply_paused_actions(self):
+        paused = [t for t in self.trials if t.state == PAUSED]
+        if not paused:
+            return
+        actions = self.scheduler.paused_actions(paused)
+        for t in paused:
+            act = actions.get(t.trial_id)
+            if act == RESUME:
+                t.state = PENDING
+            elif act == STOP:
+                self._stop_trial(t, TERMINATED)
+                self._save_state()
 
     def _maybe_checkpoint(self, trial: Trial, force: bool = False):
         """Class trainables: periodic checkpoint via actor.save()."""
@@ -181,8 +229,13 @@ class TuneController:
     # -- main loop ------------------------------------------------------
     def run(self) -> List[Trial]:
         self._new_trials()
+        search_exhausted = False
         while True:
             self._new_trials()
+            if not search_exhausted and self.search_alg.is_finished():
+                search_exhausted = True
+                self.scheduler.on_search_exhausted()
+            self._apply_paused_actions()
             pending = [t for t in self.trials if t.state == PENDING]
             running = [t for t in self.trials if t.state == RUNNING]
             for t in pending:
@@ -192,10 +245,26 @@ class TuneController:
                     self._start_trial(t)
                     running.append(t)
                 except Exception as e:
-                    t.state = ERROR
-                    t.error = str(e)
+                    # _stop_trial notifies the scheduler and searcher —
+                    # a silently ERROR'd trial would wedge a HyperBand
+                    # bracket (never halves) and starve a sequential
+                    # searcher waiting for its completion.
+                    self._stop_trial(t, ERROR, error=str(e))
             running = [t for t in self.trials if t.state == RUNNING]
+            pending = [t for t in self.trials if t.state == PENDING]
             if not running and not pending:
+                paused = [t for t in self.trials if t.state == PAUSED]
+                if paused:
+                    # Scheduler offered no action for any paused trial and
+                    # nothing else can make progress (e.g. a bracket member
+                    # died outside the scheduler's view): resume them all
+                    # rather than hang.
+                    logger.warning(
+                        "resuming %d paused trial(s) with no scheduler "
+                        "action to avoid a stall", len(paused))
+                    for t in paused:
+                        t.state = PENDING
+                    continue
                 break
             futures = [t.future for t in running if t.future is not None]
             if not futures:
@@ -226,6 +295,7 @@ class TuneController:
             trial.checkpoint_path = ckpt
         trial.last_result = result
         trial.history.append(dict(result))
+        self.search_alg.on_trial_result(trial.trial_id, result)
         self._maybe_checkpoint(trial)
         if self._stop_criteria_met(trial, result):
             self._maybe_checkpoint(trial, force=bool(self.checkpoint_freq))
@@ -241,6 +311,9 @@ class TuneController:
                     if self.metric else CONTINUE)
         if isinstance(decision, ExploitDirective):
             self._exploit(trial, decision)
+        elif decision == PAUSE:
+            self._pause_trial(trial)
+            self._save_state()
         elif decision == STOP:
             self._maybe_checkpoint(trial, force=bool(self.checkpoint_freq))
             self._stop_trial(trial, TERMINATED)

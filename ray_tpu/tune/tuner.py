@@ -20,6 +20,8 @@ from ray_tpu.train.result import Result
 from ray_tpu.tune.search import (
     BasicVariantGenerator,
     SearchAlgorithm,
+    SearchGenerator,
+    Searcher,
 )
 from ray_tpu.tune.schedulers import TrialScheduler
 from ray_tpu.tune.tune_controller import TuneController, Trial
@@ -109,6 +111,16 @@ class Tuner:
         tc = self.tune_config
         search_alg = tc.search_alg or BasicVariantGenerator(
             self.param_space, num_samples=tc.num_samples, seed=tc.seed)
+        if isinstance(search_alg, Searcher):
+            search_alg = SearchGenerator(search_alg,
+                                         num_samples=tc.num_samples)
+        else:
+            # A ConcurrencyLimiter wrapping a bare Searcher defers the
+            # sample budget to TuneConfig.num_samples.
+            inner = getattr(search_alg, "searcher", None)
+            if (isinstance(inner, SearchGenerator)
+                    and inner.num_samples is None):
+                inner.num_samples = tc.num_samples
         failure = self.run_config.failure_config
         controller = TuneController(
             self.trainable,

@@ -300,3 +300,228 @@ def test_trial_failure_retry(tune_cluster, tmp_path):
     grid = tuner.fit()
     assert not grid.errors
     assert grid.get_best_result().metrics["ok"] == 1.0
+
+
+# -- HyperBand (synchronous brackets) ---------------------------------------
+
+
+def _fake_trial(tid):
+    return type("T", (), {"trial_id": tid})()
+
+
+def test_hyperband_bracket_shapes():
+    from ray_tpu.tune.schedulers import HyperBandScheduler
+
+    sched = HyperBandScheduler(max_t=9, reduction_factor=3)
+    sched.set_metric("score", "max")
+    # s_max = 2: bracket sizes 9 (r=1), 5 (r=3), 3 (r=9).
+    trials = [_fake_trial(f"t{i}") for i in range(17)]
+    for t in trials:
+        sched.on_trial_add(t)
+    caps = [b.capacity for b in sched._brackets]
+    assert caps == [9, 5, 3]
+    assert [b.r0 for b in sched._brackets] == [1, 3, 9]
+
+
+def test_hyperband_pause_halve_resume():
+    from ray_tpu.tune.schedulers import (
+        PAUSE, RESUME, HyperBandScheduler)
+
+    sched = HyperBandScheduler(max_t=9, reduction_factor=3)
+    sched.set_metric("score", "max")
+    trials = [_fake_trial(f"t{i}") for i in range(9)]
+    for t in trials:
+        sched.on_trial_add(t)
+    # All 9 trials reach milestone 1 -> all pause.
+    for i, t in enumerate(trials):
+        assert sched.on_result(
+            t, {"training_iteration": 1, "score": float(i)}) == PAUSE
+    actions = sched.paused_actions(trials)
+    # Top 3 by score resume, 6 stop.
+    resumed = {tid for tid, a in actions.items() if a == RESUME}
+    stopped = {tid for tid, a in actions.items() if a == STOP}
+    assert resumed == {"t6", "t7", "t8"}
+    assert len(stopped) == 6
+    for tid in stopped:
+        sched.on_trial_complete(_fake_trial(tid), None)
+    # Next milestone is 3; survivors continue below it.
+    t8 = trials[8]
+    assert sched.on_result(
+        t8, {"training_iteration": 2, "score": 9.0}) == CONTINUE
+    assert sched.on_result(
+        t8, {"training_iteration": 3, "score": 9.0}) == PAUSE
+    for t in (trials[6], trials[7]):
+        sched.on_result(t, {"training_iteration": 3, "score": 1.0})
+    actions = sched.paused_actions(trials[6:])
+    assert actions["t8"] == RESUME
+    # Final rung: milestone == max_t -> STOP when reached.
+    assert sched.on_result(
+        t8, {"training_iteration": 9, "score": 9.0}) == STOP
+
+
+def test_hyperband_underfilled_bracket_halves():
+    from ray_tpu.tune.schedulers import PAUSE, RESUME, HyperBandScheduler
+
+    sched = HyperBandScheduler(max_t=9, reduction_factor=3)
+    sched.set_metric("score", "max")
+    trials = [_fake_trial(f"t{i}") for i in range(4)]  # bracket cap is 9
+    for t in trials:
+        sched.on_trial_add(t)
+    for i, t in enumerate(trials):
+        assert sched.on_result(
+            t, {"training_iteration": 1, "score": float(i)}) == PAUSE
+    # The bracket is underfilled, so it waits for more trials ...
+    assert sched.paused_actions(trials) == {}
+    # ... until the search is exhausted, then halves with what it has.
+    sched.on_search_exhausted()
+    actions = sched.paused_actions(trials)
+    assert actions["t3"] == RESUME
+    assert sum(1 for a in actions.values() if a == STOP) == 3
+
+
+class _CkptTrainable(tune.Trainable):
+    def setup(self, config):
+        self.x = config["x"]
+        self.total = 0.0
+
+    def step(self):
+        self.total += self.x
+        return {"score": self.total}
+
+    def save_checkpoint(self, checkpoint_dir):
+        with open(os.path.join(checkpoint_dir, "state"), "w") as f:
+            f.write(str(self.total))
+
+    def load_checkpoint(self, checkpoint_dir):
+        with open(os.path.join(checkpoint_dir, "state")) as f:
+            self.total = float(f.read())
+
+
+def test_tuner_with_hyperband(tune_cluster, tmp_path):
+    tuner = tune.Tuner(
+        _CkptTrainable,
+        param_space={"x": tune.grid_search([1, 2, 3, 4, 5, 6])},
+        tune_config=tune.TuneConfig(
+            metric="score", mode="max",
+            scheduler=tune.HyperBandScheduler(max_t=9,
+                                              reduction_factor=3),
+            max_concurrent_trials=3,
+        ),
+        run_config=RunConfig(name="hb", storage_path=str(tmp_path)),
+    )
+    grid = tuner.fit()
+    assert not grid.errors
+    best = grid.get_best_result()
+    # x=6 dominates at every rung, so it must survive to max_t.
+    assert best.metrics["score"] == pytest.approx(54.0)
+    # Early-stopped trials did fewer than max_t iterations.
+    iters = sorted(r.metrics.get("training_iteration", 0) for r in grid)
+    assert iters[0] < 9
+    assert iters[-1] == 9
+
+
+# -- Searcher adapter --------------------------------------------------------
+
+
+class _GreedySearcher(tune.Searcher):
+    """Suggests x from a pool, then exploits the best observed so far."""
+
+    def __init__(self):
+        super().__init__(metric="score", mode="max")
+        self.pool = [1.0, 5.0, 2.0]
+        self.observed = {}
+        self.suggested = {}
+        self.completed = []
+
+    def suggest(self, trial_id):
+        if len(self.suggested) > len(self.completed):
+            return None  # sequential: one outstanding suggestion
+        if self.pool:
+            x = self.pool.pop(0)
+        elif self.observed:
+            # Refine around the best seen so far.
+            best_sid = max(self.observed, key=self.observed.get)
+            x = self.suggested[best_sid] + 1.0
+        else:
+            return None
+        self.suggested[trial_id] = x
+        return {"x": x}
+
+    def on_trial_complete(self, trial_id, result=None, error=False):
+        self.completed.append(trial_id)
+        if result and "score" in result:
+            self.observed[trial_id] = result["score"]
+
+
+def test_searcher_adapter_drives_trials(tune_cluster, tmp_path):
+    searcher = _GreedySearcher()
+    tuner = tune.Tuner(
+        _objective,
+        tune_config=tune.TuneConfig(
+            metric="score", mode="max", num_samples=5,
+            search_alg=searcher, max_concurrent_trials=1,
+        ),
+        run_config=RunConfig(name="searcher", storage_path=str(tmp_path)),
+    )
+    grid = tuner.fit()
+    assert not grid.errors
+    assert len(grid) == 5
+    # Feedback reached the searcher under its own suggestion ids.
+    assert len(searcher.completed) == 5
+    assert all(t.startswith("suggest_") for t in searcher.completed)
+    # The exploitation step built on the best observed trial (x=5 ->
+    # refinements 6, 7; scores are 5*x).
+    xs = sorted(searcher.suggested.values())
+    assert xs == [1.0, 2.0, 5.0, 6.0, 7.0]
+    assert grid.get_best_result().metrics["score"] == pytest.approx(35.0)
+
+
+def test_search_generator_exhausts_with_finished():
+    from ray_tpu.tune.search import SearchGenerator
+
+    class Two(tune.Searcher):
+        def __init__(self):
+            super().__init__()
+            self.n = 0
+
+        def suggest(self, trial_id):
+            if self.n >= 2:
+                return tune.Searcher.FINISHED
+            self.n += 1
+            return {"x": self.n}
+
+    gen = SearchGenerator(Two(), num_samples=10)
+    cfgs = gen.next_configs()
+    assert cfgs == [{"x": 1}, {"x": 2}]
+    assert gen.next_configs() is None
+
+
+def test_concurrency_limiter_wraps_bare_searcher(tune_cluster, tmp_path):
+    from ray_tpu.tune.search import SearchGenerator
+
+    class Fixed(tune.Searcher):
+        def __init__(self):
+            super().__init__()
+            self.done = []
+
+        def suggest(self, trial_id):
+            return {"x": 2.0}
+
+        def on_trial_complete(self, trial_id, result=None, error=False):
+            self.done.append(trial_id)
+
+    searcher = Fixed()
+    limiter = tune.ConcurrencyLimiter(searcher, max_concurrent=2)
+    assert isinstance(limiter.searcher, SearchGenerator)
+    tuner = tune.Tuner(
+        _objective,
+        tune_config=tune.TuneConfig(
+            metric="score", mode="max", num_samples=3,
+            search_alg=limiter,
+        ),
+        run_config=RunConfig(name="limiter", storage_path=str(tmp_path)),
+    )
+    grid = tuner.fit()
+    assert not grid.errors
+    assert len(grid) == 3  # TuneConfig.num_samples reached the generator
+    assert len(searcher.done) == 3
