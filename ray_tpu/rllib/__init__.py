@@ -8,6 +8,16 @@ recurrences, GSPMD data parallelism on the learner.
 
 from ray_tpu.rllib.actor_manager import FaultTolerantActorManager
 from ray_tpu.rllib.algorithm import Algorithm, AlgorithmConfig
+from ray_tpu.rllib.connectors import (
+    CastObs,
+    ClipActions,
+    ConnectorPipelineV2,
+    ConnectorV2,
+    FlattenObs,
+    FrameStackObs,
+    NormalizeObs,
+    UnsquashActions,
+)
 from ray_tpu.rllib.env import (
     CartPoleVecEnv,
     GridWorldVecEnv,
@@ -19,6 +29,19 @@ from ray_tpu.rllib.env import (
 from ray_tpu.rllib.env_runner import EnvRunner
 from ray_tpu.rllib.impala import IMPALA, IMPALAConfig, impala_loss
 from ray_tpu.rllib.learner import JaxLearner
+from ray_tpu.rllib.offline import (
+    BC,
+    BCConfig,
+    DirectMethod,
+    DoublyRobust,
+    ImportanceSampling,
+    JsonReader,
+    JsonWriter,
+    OffPolicyEstimator,
+    WeightedImportanceSampling,
+    bc_loss,
+    collect_episodes,
+)
 from ray_tpu.rllib.math import compute_gae, vtrace
 from ray_tpu.rllib.ppo import PPO, PPOConfig, ppo_loss
 from ray_tpu.rllib.rl_module import ActorCriticMLP, RLModule, RLModuleSpec
@@ -27,8 +50,27 @@ __all__ = [
     "ActorCriticMLP",
     "Algorithm",
     "AlgorithmConfig",
+    "BC",
+    "BCConfig",
     "CartPoleVecEnv",
+    "CastObs",
+    "ClipActions",
+    "ConnectorPipelineV2",
+    "ConnectorV2",
+    "DirectMethod",
+    "DoublyRobust",
     "EnvRunner",
+    "FlattenObs",
+    "FrameStackObs",
+    "ImportanceSampling",
+    "JsonReader",
+    "JsonWriter",
+    "NormalizeObs",
+    "OffPolicyEstimator",
+    "UnsquashActions",
+    "WeightedImportanceSampling",
+    "bc_loss",
+    "collect_episodes",
     "FaultTolerantActorManager",
     "GridWorldVecEnv",
     "IMPALA",

@@ -40,6 +40,12 @@ class AlgorithmConfig:
         self.seed = 0
         self.num_cpus_per_env_runner = 1.0
         self.num_tpus_per_learner = 0.0
+        # Connector factories/instances (rllib/connectors equivalent):
+        # env-to-module transforms obs before the policy forward (and
+        # the module is built against the transformed space);
+        # module-to-env transforms actions before env.step.
+        self.env_to_module_connectors: list = []
+        self.module_to_env_connectors: list = []
         self.extra: Dict[str, Any] = {}
 
     def environment(self, env=None, *, num_envs_per_env_runner=None
@@ -75,6 +81,16 @@ class AlgorithmConfig:
         if model is not None:
             self.model = model
         self.extra.update(kwargs)
+        return self
+
+    def connectors(self, *, env_to_module=None, module_to_env=None
+                   ) -> "AlgorithmConfig":
+        """Pass lists of ConnectorV2 instances or zero-arg factories
+        (factories preferred: every env runner builds fresh state)."""
+        if env_to_module is not None:
+            self.env_to_module_connectors = list(env_to_module)
+        if module_to_env is not None:
+            self.module_to_env_connectors = list(module_to_env)
         return self
 
     def resources(self, *, num_tpus_per_learner=None) -> "AlgorithmConfig":
@@ -141,10 +157,17 @@ class Algorithm(Trainable):
         from ray_tpu.rllib.learner import JaxLearner
         from ray_tpu.rllib.rl_module import RLModuleSpec
 
+        from ray_tpu.rllib.connectors import build_pipeline
+
         cfg = self.config
         probe = make_vec(cfg.env, 1, seed=cfg.seed)
+        obs_space = probe.observation_space
+        probe_pipeline = build_pipeline(cfg.env_to_module_connectors)
+        if probe_pipeline is not None:
+            # The module consumes post-pipeline observations.
+            obs_space = probe_pipeline.transform_space(obs_space)
         self.module_spec = RLModuleSpec(
-            probe.observation_space, probe.action_space,
+            obs_space, probe.action_space,
             model_config=dict(cfg.model))
         self.learner = JaxLearner(
             self.module_spec, loss_fn, lr=cfg.lr,
@@ -156,11 +179,15 @@ class Algorithm(Trainable):
             self.module_spec, cfg.num_cpus_per_env_runner, cfg.seed,
             cfg.gamma)
 
+        e2m = list(cfg.env_to_module_connectors)
+        m2e = list(cfg.module_to_env_connectors)
+
         def make_runner(i: int):
             return (ray_tpu.remote(EnvRunner)
                     .options(num_cpus=ncpu)
                     .remote(env_spec, n_envs, T, module_spec,
-                            seed=seed + 1000 * (i + 1), gamma=gamma))
+                            seed=seed + 1000 * (i + 1), gamma=gamma,
+                            env_to_module=e2m, module_to_env=m2e))
 
         self.workers = FaultTolerantActorManager(
             make_runner, cfg.num_env_runners)
@@ -206,18 +233,34 @@ class Algorithm(Trainable):
         self.load_checkpoint(checkpoint_dir)
 
     def get_state(self) -> dict:
-        return {
+        state = {
             "learner": self.learner.get_state(),
             "iteration": self.iteration,
             "timesteps_total": self._timesteps_total,
             "episodes_total": self._episodes_total,
         }
+        if self.config.env_to_module_connectors:
+            # Stateful connectors (normalization filters) live in the
+            # runners; checkpoint the first healthy runner's state
+            # (reference keeps per-worker filters and syncs through the
+            # local worker similarly).
+            for _, s in self.workers.foreach(
+                    lambda a: a.get_connector_state.remote()):
+                if s is not None:
+                    state["connectors"] = s
+                    break
+        return state
 
     def set_state(self, state: dict) -> None:
         self.learner.set_state(state["learner"])
         self.iteration = state["iteration"]
         self._timesteps_total = state["timesteps_total"]
         self._episodes_total = state["episodes_total"]
+        conn = state.get("connectors")
+        if conn is not None:
+            conn_ref = ray_tpu.put(conn)
+            self.workers.foreach(
+                lambda a: a.set_connector_state.remote(conn_ref))
         self._broadcast_weights()
 
     def _broadcast_weights(self):

@@ -1,0 +1,282 @@
+"""Connector pipelines: declarative obs/action transforms between env
+and module.
+
+Reference: rllib/connectors/connector_v2.py (ConnectorV2 pieces with an
+env-to-module and a module-to-env direction) and
+connector_pipeline_v2.py (ordered pipeline with insert/prepend/append
+surgery). The TPU-shaped difference: connectors here operate on whole
+vectorized [B, ...] batches (numpy in the rollout loop, never per-env
+Python), and each connector declares how it transforms the observation
+space so the RLModule is built against the *post-pipeline* space.
+
+Data flows as a dict: env-to-module pipelines see at least
+``{"obs": [B, ...], "dones": [B] | None}`` and must return the same keys;
+module-to-env pipelines see ``{"actions": [B, ...]}``.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict, List, Optional
+
+import numpy as np
+
+from ray_tpu.rllib.env import Space
+
+
+class ConnectorV2:
+    """One transform stage. Stateless by default; stateful connectors
+    (frame stacking, running normalization) keep per-env state keyed by
+    batch row and reset it where ``dones`` is set."""
+
+    def __call__(self, data: Dict[str, Any]) -> Dict[str, Any]:
+        raise NotImplementedError
+
+    def preview(self, data: Dict[str, Any]) -> Dict[str, Any]:
+        """Apply the transform WITHOUT mutating connector state. Used
+        for out-of-band observations (e.g. bootstrapping V(s_final) on a
+        truncated episode) that must not advance frame stacks or
+        normalization statistics. Stateless connectors just call
+        through."""
+        return self(data)
+
+    def transform_space(self, space: Space) -> Space:
+        """Observation space after this connector (identity default)."""
+        return space
+
+    def get_state(self) -> dict:
+        return {}
+
+    def set_state(self, state: dict) -> None:
+        pass
+
+    @property
+    def name(self) -> str:
+        return type(self).__name__
+
+
+class ConnectorPipelineV2(ConnectorV2):
+    """Ordered connector list with the reference's surgery API
+    (reference: connector_pipeline_v2.py — prepend/append/
+    insert_before/insert_after/remove by class or name)."""
+
+    def __init__(self, connectors: Optional[List[ConnectorV2]] = None):
+        self.connectors: List[ConnectorV2] = list(connectors or [])
+
+    def __call__(self, data: Dict[str, Any]) -> Dict[str, Any]:
+        for c in self.connectors:
+            data = c(data)
+        return data
+
+    def preview(self, data: Dict[str, Any]) -> Dict[str, Any]:
+        for c in self.connectors:
+            data = c.preview(data)
+        return data
+
+    def transform_space(self, space: Space) -> Space:
+        for c in self.connectors:
+            space = c.transform_space(space)
+        return space
+
+    # -- surgery --------------------------------------------------------
+    def _index_of(self, key) -> int:
+        for i, c in enumerate(self.connectors):
+            if (c is key or c.name == key
+                    or (isinstance(key, type) and isinstance(c, key))):
+                return i
+        raise ValueError(f"no connector matching {key!r}")
+
+    def append(self, connector: ConnectorV2) -> "ConnectorPipelineV2":
+        self.connectors.append(connector)
+        return self
+
+    def prepend(self, connector: ConnectorV2) -> "ConnectorPipelineV2":
+        self.connectors.insert(0, connector)
+        return self
+
+    def insert_before(self, key, connector) -> "ConnectorPipelineV2":
+        self.connectors.insert(self._index_of(key), connector)
+        return self
+
+    def insert_after(self, key, connector) -> "ConnectorPipelineV2":
+        self.connectors.insert(self._index_of(key) + 1, connector)
+        return self
+
+    def remove(self, key) -> "ConnectorPipelineV2":
+        del self.connectors[self._index_of(key)]
+        return self
+
+    def get_state(self) -> dict:
+        return {i: c.get_state() for i, c in enumerate(self.connectors)}
+
+    def set_state(self, state: dict) -> None:
+        for i, c in enumerate(self.connectors):
+            if i in state:
+                c.set_state(state[i])
+
+
+# -- env-to-module connectors -----------------------------------------------
+
+
+class FlattenObs(ConnectorV2):
+    """[B, ...] -> [B, prod(...)] (reference: the flatten-observations
+    env-to-module connector)."""
+
+    def __call__(self, data):
+        obs = data["obs"]
+        data["obs"] = obs.reshape(obs.shape[0], -1)
+        return data
+
+    def transform_space(self, space: Space) -> Space:
+        return Space((int(np.prod(space.shape)),), space.dtype)
+
+
+class CastObs(ConnectorV2):
+    def __init__(self, dtype=np.float32):
+        self.dtype = np.dtype(dtype)
+
+    def __call__(self, data):
+        data["obs"] = data["obs"].astype(self.dtype, copy=False)
+        return data
+
+    def transform_space(self, space: Space) -> Space:
+        return Space(space.shape, self.dtype, space.n)
+
+
+class NormalizeObs(ConnectorV2):
+    """Running mean/std normalization (reference: the mean-std filter
+    connector). Welford-style batch updates; the statistics are part of
+    connector state so checkpoints carry them."""
+
+    def __init__(self, epsilon: float = 1e-8, clip: float = 10.0,
+                 update: bool = True):
+        self.epsilon = epsilon
+        self.clip = clip
+        self.update = update
+        self._count = 0.0
+        self._mean: Optional[np.ndarray] = None
+        self._m2: Optional[np.ndarray] = None
+
+    def __call__(self, data):
+        obs = np.asarray(data["obs"], np.float32)
+        if self._mean is None:
+            self._mean = np.zeros(obs.shape[1:], np.float64)
+            self._m2 = np.ones(obs.shape[1:], np.float64)
+        if self.update:
+            b = obs.shape[0]
+            b_mean = obs.mean(axis=0)
+            b_var = obs.var(axis=0)
+            delta = b_mean - self._mean
+            tot = self._count + b
+            self._mean = self._mean + delta * b / tot
+            self._m2 = (self._m2 + b_var * b
+                        + delta ** 2 * self._count * b / tot)
+            self._count = tot
+        std = np.sqrt(self._m2 / max(self._count, 1.0) + self.epsilon)
+        out = (obs - self._mean) / std
+        data["obs"] = np.clip(out, -self.clip, self.clip).astype(np.float32)
+        return data
+
+    def preview(self, data):
+        obs = np.asarray(data["obs"], np.float32)
+        if self._mean is None:
+            data["obs"] = obs
+            return data
+        std = np.sqrt(self._m2 / max(self._count, 1.0) + self.epsilon)
+        out = (obs - self._mean) / std
+        data["obs"] = np.clip(out, -self.clip, self.clip).astype(np.float32)
+        return data
+
+    def transform_space(self, space: Space) -> Space:
+        return Space(space.shape, np.float32, space.n)
+
+    def get_state(self):
+        return {"count": self._count, "mean": self._mean, "m2": self._m2}
+
+    def set_state(self, state):
+        self._count = state["count"]
+        self._mean = state["mean"]
+        self._m2 = state["m2"]
+
+
+class FrameStackObs(ConnectorV2):
+    """Stack the last k observations along the trailing axis
+    (reference: the frame-stacking env-to-module connector). Per-env
+    stacks live in the connector; a done row re-seeds its stack with the
+    fresh reset observation so episodes never see frames from the
+    previous episode."""
+
+    def __init__(self, k: int = 4):
+        if k < 1:
+            raise ValueError("k must be >= 1")
+        self.k = k
+        self._stack: Optional[np.ndarray] = None  # [B, ..., C*k]
+
+    def __call__(self, data):
+        obs = data["obs"]
+        dones = data.get("dones")
+        if self._stack is None:
+            self._stack = np.concatenate([obs] * self.k, axis=-1)
+        else:
+            c = obs.shape[-1]
+            self._stack = np.concatenate(
+                [self._stack[..., c:], obs], axis=-1)
+            if dones is not None and dones.any():
+                # Re-seed finished rows: their obs is already the fresh
+                # reset (auto-reset envs); an episode must not see
+                # frames from its predecessor.
+                self._stack[dones] = np.concatenate(
+                    [obs[dones]] * self.k, axis=-1)
+        data["obs"] = self._stack.copy()
+        return data
+
+    def preview(self, data):
+        obs = data["obs"]
+        if self._stack is None:
+            data["obs"] = np.concatenate([obs] * self.k, axis=-1)
+        else:
+            c = obs.shape[-1]
+            data["obs"] = np.concatenate(
+                [self._stack[..., c:], obs], axis=-1)
+        return data
+
+    def transform_space(self, space: Space) -> Space:
+        shape = tuple(space.shape[:-1]) + (space.shape[-1] * self.k,)
+        return Space(shape, space.dtype, space.n)
+
+
+# -- module-to-env connectors -----------------------------------------------
+
+
+class ClipActions(ConnectorV2):
+    """Clip continuous actions to the env's bounds (reference: the
+    clip-actions module-to-env connector)."""
+
+    def __init__(self, low: float = -1.0, high: float = 1.0):
+        self.low, self.high = low, high
+
+    def __call__(self, data):
+        data["actions"] = np.clip(data["actions"], self.low, self.high)
+        return data
+
+
+class UnsquashActions(ConnectorV2):
+    """Map tanh-squashed [-1, 1] module outputs onto [low, high]
+    (reference: the unsquash-actions connector)."""
+
+    def __init__(self, low: float, high: float):
+        self.low, self.high = low, high
+
+    def __call__(self, data):
+        a = np.asarray(data["actions"], np.float32)
+        data["actions"] = self.low + (a + 1.0) * 0.5 * (self.high - self.low)
+        return data
+
+
+def build_pipeline(connectors) -> Optional[ConnectorPipelineV2]:
+    """Normalize a user-supplied connector list (instances or zero-arg
+    factories) into a fresh pipeline; None/[] -> None."""
+    if not connectors:
+        return None
+    built = [c() if (callable(c) and not isinstance(c, ConnectorV2))
+             else c for c in connectors]
+    return ConnectorPipelineV2(built)

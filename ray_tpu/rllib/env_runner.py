@@ -14,24 +14,39 @@ import numpy as np
 
 class EnvRunner:
     def __init__(self, env_spec, num_envs: int, rollout_length: int,
-                 module_spec, seed: int = 0, gamma: float = 0.99):
+                 module_spec, seed: int = 0, gamma: float = 0.99,
+                 env_to_module=None, module_to_env=None):
         import jax
 
+        from ray_tpu.rllib.connectors import build_pipeline
         from ray_tpu.rllib.env import make_vec
 
         self.env = make_vec(env_spec, num_envs, seed=seed)
         self.rollout_length = rollout_length
         self.gamma = gamma
+        # Connector pipelines (reference: env_runner's env-to-module /
+        # module-to-env ConnectorV2 pipelines). The module consumes and
+        # trains on POST-pipeline observations; module_spec is expected
+        # to already carry the transformed space (algorithm._build_common
+        # applies transform_space).
+        self.env_to_module = build_pipeline(env_to_module)
+        self.module_to_env = build_pipeline(module_to_env)
         self.module = module_spec.build()
         self.forwards = self.module.make_forwards()
         self.params = self.module.init_params(
             jax.random.PRNGKey(seed))
         self._key = jax.random.PRNGKey(seed + 1)
-        self.obs = self.env.reset(seed=seed)
+        self.obs = self._process_obs(self.env.reset(seed=seed), None)
         self._ep_returns = np.zeros(num_envs, np.float32)
         self._ep_lens = np.zeros(num_envs, np.int64)
         self._completed: list = []
         self._weights_version = 0
+
+    def _process_obs(self, obs: np.ndarray,
+                     dones: Optional[np.ndarray]) -> np.ndarray:
+        if self.env_to_module is None:
+            return obs
+        return self.env_to_module({"obs": obs, "dones": dones})["obs"]
 
     def set_weights(self, params, version: int = 0) -> None:
         self.params = params
@@ -45,12 +60,12 @@ class EnvRunner:
         import jax
 
         T, B = self.rollout_length, self.env.num_envs
-        # Keep the env's dtype: casting uint8 pixels to float32 here
+        # Keep the obs dtype: casting uint8 pixels to float32 here
         # quadruples rollout memory traffic; the module's encoder
-        # normalizes once on device (rl_module.py: /255).
-        obs_buf = np.empty(
-            (T, B) + tuple(self.env.observation_space.shape),
-            self.env.observation_space.dtype)
+        # normalizes once on device (rl_module.py: /255). Shape/dtype
+        # come from the (possibly connector-transformed) current obs.
+        obs_buf = np.empty((T, B) + tuple(self.obs.shape[1:]),
+                           self.obs.dtype)
         act_buf = np.empty((T, B), np.int32)
         logp_buf = np.empty((T, B), np.float32)
         vf_buf = np.empty((T, B), np.float32)
@@ -65,7 +80,12 @@ class EnvRunner:
             act_buf[t] = action
             logp_buf[t] = np.asarray(logp)
             vf_buf[t] = np.asarray(vf)
-            self.obs, rew, term, trunc = self.env.step(action)
+            if self.module_to_env is not None:
+                env_action = self.module_to_env(
+                    {"actions": action})["actions"]
+            else:
+                env_action = action
+            raw_obs, rew, term, trunc = self.env.step(env_action)
             done = term | trunc
             # Episode metrics use the TRUE env reward (before any
             # bootstrap augmentation below).
@@ -76,15 +96,25 @@ class EnvRunner:
             # advantage recurrence (which cuts at done) stays unbiased.
             only_trunc = trunc & ~term
             if only_trunc.any() and self.env.final_obs is not None:
+                fin_obs = self.env.final_obs
+                if self.env_to_module is not None:
+                    # preview: transform the pre-reset obs without
+                    # advancing frame stacks / filter statistics (the
+                    # pipeline state still reflects the step that
+                    # produced final_obs here, so the stack shift is
+                    # the true end-of-episode view).
+                    fin_obs = self.env_to_module.preview(
+                        {"obs": fin_obs, "dones": None})["obs"]
                 # Full-batch forward (fixed shape -> no per-count
                 # recompiles), then select the truncated rows.
-                fin = self.forwards["train"](self.params,
-                                             self.env.final_obs)
+                fin = self.forwards["train"](self.params, fin_obs)
                 rew = rew.copy()
                 rew[only_trunc] += (
                     self.gamma * np.asarray(fin["vf"])[only_trunc])
             rew_buf[t] = rew
             done_buf[t] = done
+            # Advance pipeline state only after the final_obs preview.
+            self.obs = self._process_obs(raw_obs, done)
             if done.any():
                 for i in np.nonzero(done)[0]:
                     self._completed.append(
@@ -119,6 +149,17 @@ class EnvRunner:
             "episode_return_min": float(np.min(returns)),
             "episode_len_mean": float(np.mean(lens)),
         }
+
+    def get_connector_state(self) -> Optional[dict]:
+        """Stateful connector state (frame stacks are transient, but
+        normalization statistics must survive checkpoints)."""
+        if self.env_to_module is None:
+            return None
+        return self.env_to_module.get_state()
+
+    def set_connector_state(self, state: Optional[dict]) -> None:
+        if state is not None and self.env_to_module is not None:
+            self.env_to_module.set_state(state)
 
     def ping(self) -> bool:
         return True

@@ -1,0 +1,703 @@
+"""Offline RL: episode IO, off-policy estimation, behavior cloning.
+
+Reference: rllib/offline/ — json_writer.py / json_reader.py (sample IO),
+off_policy_estimator.py + estimators/ (importance_sampling.py,
+weighted_importance_sampling.py, direct_method.py, doubly_robust.py),
+and the BC algorithm family (rllib/algorithms/bc). The TPU redesign:
+episodes are stored whole (not row-chunked SampleBatches) because every
+estimator here is a per-episode computation; all policy evaluations are
+batched jit-compiled forwards over the concatenation of episodes, and
+the Direct Method's Q-model is a jax FQE trained with expected-SARSA
+targets under the target policy — not a torch FQE model.
+
+Episode dict format (the unit of IO):
+  obs:        [T+1, ...]   observations incl. the final one
+  actions:    [T]          int32
+  rewards:    [T]          float32
+  logp:       [T]          float32 behavior-policy log-probs
+  terminated: bool         True terminal (False = time-limit truncation)
+"""
+
+from __future__ import annotations
+
+import base64
+import glob
+import json
+import os
+from typing import Any, Dict, Iterator, List, Optional, Sequence
+
+import numpy as np
+
+
+# -- array <-> json ---------------------------------------------------------
+
+
+def _enc(a: np.ndarray) -> dict:
+    a = np.ascontiguousarray(a)
+    return {"__npy__": base64.b64encode(a.tobytes()).decode("ascii"),
+            "dtype": str(a.dtype), "shape": list(a.shape)}
+
+
+def _dec(d: dict) -> np.ndarray:
+    buf = base64.b64decode(d["__npy__"])
+    return np.frombuffer(buf, dtype=d["dtype"]).reshape(d["shape"]).copy()
+
+
+def _encode_episode(ep: Dict[str, Any]) -> str:
+    out = {}
+    for k, v in ep.items():
+        out[k] = _enc(np.asarray(v)) if isinstance(
+            v, (np.ndarray, list)) else v
+    return json.dumps(out)
+
+
+def _decode_episode(line: str) -> Dict[str, Any]:
+    raw = json.loads(line)
+    return {k: (_dec(v) if isinstance(v, dict) and "__npy__" in v else v)
+            for k, v in raw.items()}
+
+
+# -- writer / reader --------------------------------------------------------
+
+
+class JsonWriter:
+    """Write episodes as JSONL shards in a directory (reference:
+    offline/json_writer.py). The first line of every shard is a header
+    record carrying the spaces, so readers need no env to reconstruct a
+    module."""
+
+    def __init__(self, path: str, *, max_episodes_per_file: int = 1024):
+        self.path = path
+        os.makedirs(path, exist_ok=True)
+        self.max_per_file = max_episodes_per_file
+        self._file = None
+        self._count = 0
+        self._shard = 0
+        self._header: Optional[dict] = None
+
+    def write(self, episode: Dict[str, Any]) -> None:
+        if self._header is None:
+            obs = np.asarray(episode["obs"])
+            self._header = {
+                "type": "header",
+                "obs_shape": list(obs.shape[1:]),
+                "obs_dtype": str(obs.dtype),
+                "num_actions": int(np.max(episode["actions"])) + 1,
+            }
+        else:
+            self._header["num_actions"] = max(
+                self._header["num_actions"],
+                int(np.max(episode["actions"])) + 1)
+        if self._file is None or self._count >= self.max_per_file:
+            self.close()
+            fname = os.path.join(self.path,
+                                 f"episodes-{self._shard:05d}.jsonl")
+            self._file = open(fname, "w")
+            self._file.write(json.dumps(self._header) + "\n")
+            self._shard += 1
+            self._count = 0
+        self._file.write(_encode_episode(episode) + "\n")
+        self._count += 1
+
+    def close(self) -> None:
+        if self._file is not None:
+            self._file.close()
+            self._file = None
+        if self._header is not None:
+            # Shard headers are written before later episodes can raise
+            # num_actions (an early shard whose episodes never take the
+            # highest action id would undercount); meta.json carries the
+            # final authoritative header.
+            tmp = os.path.join(self.path, ".meta.tmp")
+            with open(tmp, "w") as f:
+                json.dump(self._header, f)
+            os.replace(tmp, os.path.join(self.path, "meta.json"))
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        self.close()
+
+
+class JsonReader:
+    """Read JSONL episode shards (reference: offline/json_reader.py).
+    Accepts a directory, a glob, a file path, or a list of them."""
+
+    def __init__(self, paths):
+        if isinstance(paths, (str, os.PathLike)):
+            paths = [paths]
+        files: List[str] = []
+        for p in paths:
+            p = str(p)
+            if os.path.isdir(p):
+                files.extend(sorted(glob.glob(os.path.join(p, "*.jsonl"))))
+            elif any(ch in p for ch in "*?["):
+                files.extend(sorted(glob.glob(p)))
+            else:
+                files.append(p)
+        if not files:
+            raise FileNotFoundError(f"no episode files under {paths!r}")
+        self.files = files
+        self.header = self._read_header()
+
+    def _read_header(self) -> dict:
+        # Prefer the writer's final meta.json; shard headers can
+        # undercount num_actions (written before later episodes).
+        for f0 in self.files:
+            meta = os.path.join(os.path.dirname(f0), "meta.json")
+            if os.path.exists(meta):
+                with open(meta) as f:
+                    return json.load(f)
+        header = None
+        for path in self.files:
+            with open(path) as f:
+                first = json.loads(f.readline())
+            if first.get("type") != "header":
+                raise ValueError(f"{path} has no header line")
+            if header is None:
+                header = first
+            else:
+                header["num_actions"] = max(header["num_actions"],
+                                            first["num_actions"])
+        return header
+
+    @property
+    def obs_shape(self):
+        return tuple(self.header["obs_shape"])
+
+    @property
+    def obs_dtype(self):
+        return np.dtype(self.header["obs_dtype"])
+
+    @property
+    def num_actions(self) -> int:
+        return int(self.header["num_actions"])
+
+    def read_episodes(self) -> Iterator[Dict[str, Any]]:
+        for path in self.files:
+            with open(path) as f:
+                for line in f:
+                    if not line.strip():
+                        continue
+                    rec = json.loads(line)
+                    if rec.get("type") == "header":
+                        continue
+                    yield {k: (_dec(v) if isinstance(v, dict)
+                               and "__npy__" in v else v)
+                           for k, v in rec.items()}
+
+    def to_transitions(self) -> Dict[str, np.ndarray]:
+        """Flatten all episodes into SARSA transitions: obs, actions,
+        rewards, next_obs, dones (done only on a TRUE terminal — a
+        truncation bootstraps), logp."""
+        obs, acts, rews, nxt, dones, logps = [], [], [], [], [], []
+        for ep in self.read_episodes():
+            T = len(ep["actions"])
+            obs.append(ep["obs"][:T])
+            nxt.append(ep["obs"][1:T + 1])
+            acts.append(ep["actions"])
+            rews.append(ep["rewards"])
+            logps.append(ep["logp"])
+            d = np.zeros(T, np.bool_)
+            if ep.get("terminated", True):
+                d[-1] = True
+            dones.append(d)
+        return {
+            "obs": np.concatenate(obs),
+            "actions": np.concatenate(acts).astype(np.int32),
+            "rewards": np.concatenate(rews).astype(np.float32),
+            "next_obs": np.concatenate(nxt),
+            "dones": np.concatenate(dones),
+            "logp": np.concatenate(logps).astype(np.float32),
+        }
+
+
+def collect_episodes(env_spec, module_spec, params, *,
+                     num_episodes: int, num_envs: int = 8, seed: int = 0,
+                     max_steps: int = 1000,
+                     writer: Optional[JsonWriter] = None
+                     ) -> List[Dict[str, Any]]:
+    """Roll out the policy and return complete episodes (optionally
+    streaming them into a writer) — the data-generation half of the
+    reference's ``output`` config."""
+    import jax
+
+    from ray_tpu.rllib.env import make_vec
+
+    env = make_vec(env_spec, num_envs, seed=seed)
+    module = module_spec.build()
+    forwards = module.make_forwards()
+    key = jax.random.PRNGKey(seed)
+    obs = env.reset(seed=seed)
+    B = env.num_envs
+    traj: List[Dict[str, list]] = [
+        {"obs": [obs[i]], "actions": [], "rewards": [], "logp": []}
+        for i in range(B)]
+    episodes: List[Dict[str, Any]] = []
+    steps = 0
+    while len(episodes) < num_episodes and steps < max_steps:
+        key, sub = jax.random.split(key)
+        action, logp, _ = forwards["exploration"](params, obs, sub)
+        action = np.asarray(action)
+        logp = np.asarray(logp)
+        next_obs, rew, term, trunc = env.step(action)
+        done = term | trunc
+        final = env.final_obs
+        for i in range(B):
+            t = traj[i]
+            t["actions"].append(int(action[i]))
+            t["rewards"].append(float(rew[i]))
+            t["logp"].append(float(logp[i]))
+            if done[i]:
+                last = (final[i] if final is not None else next_obs[i])
+                ep = {
+                    "obs": np.stack(t["obs"] + [last]),
+                    "actions": np.asarray(t["actions"], np.int32),
+                    "rewards": np.asarray(t["rewards"], np.float32),
+                    "logp": np.asarray(t["logp"], np.float32),
+                    "terminated": bool(term[i]),
+                }
+                episodes.append(ep)
+                traj[i] = {"obs": [next_obs[i]], "actions": [],
+                           "rewards": [], "logp": []}
+            else:
+                t["obs"].append(next_obs[i])
+        obs = next_obs
+        steps += 1
+    episodes = episodes[:num_episodes]
+    if len(episodes) < num_episodes:
+        import logging
+
+        logging.getLogger(__name__).warning(
+            "collect_episodes: hit max_steps=%d with only %d/%d "
+            "episodes complete", max_steps, len(episodes), num_episodes)
+    # Write exactly the returned set so the on-disk dataset and the
+    # returned one agree (the last vectorized step can finish several
+    # episodes past the request).
+    if writer is not None:
+        for ep in episodes:
+            writer.write(ep)
+    return episodes
+
+
+# -- off-policy estimators --------------------------------------------------
+
+
+class OffPolicyEstimator:
+    """Estimate the value of a TARGET policy from BEHAVIOR-policy
+    episodes (reference: offline/off_policy_estimator.py). Subclasses
+    implement estimate_on_single_episode-equivalent math vectorized
+    over the whole episode set; target-policy log-probs come from one
+    batched jit forward over every step in the dataset."""
+
+    def __init__(self, module_spec, params, *, gamma: float = 0.99):
+        import jax
+        import jax.numpy as jnp
+
+        self.gamma = gamma
+        self.params = params
+        module = module_spec.build()
+        net = module.net
+
+        def _logp_probs(p, obs):
+            out = net.apply(p, obs)
+            logp = jax.nn.log_softmax(out["logits"])
+            return logp, jnp.exp(logp)
+
+        self._logp_probs = jax.jit(_logp_probs)
+
+    def _target_logps(self, episodes) -> List[np.ndarray]:
+        """Per-episode arrays of log pi_target(a_t | s_t)."""
+        obs = np.concatenate([ep["obs"][:len(ep["actions"])]
+                              for ep in episodes])
+        acts = np.concatenate([ep["actions"] for ep in episodes])
+        logp_all, _ = self._logp_probs(self.params, obs)
+        logp_all = np.asarray(logp_all)
+        flat = logp_all[np.arange(len(acts)), acts]
+        out, lo = [], 0
+        for ep in episodes:
+            T = len(ep["actions"])
+            out.append(flat[lo:lo + T])
+            lo += T
+        return out
+
+    @staticmethod
+    def _behavior_return(ep, gamma: float) -> float:
+        r = np.asarray(ep["rewards"])
+        return float((gamma ** np.arange(len(r))) @ r)
+
+    def estimate(self, episodes: Sequence[Dict[str, Any]]
+                 ) -> Dict[str, float]:
+        v_b = float(np.mean([self._behavior_return(ep, self.gamma)
+                             for ep in episodes]))
+        v_t = self._estimate_target(list(episodes))
+        return {
+            "v_behavior": v_b,
+            "v_target": v_t,
+            "v_gain": v_t / v_b if v_b else float("nan"),
+            "num_episodes": len(episodes),
+        }
+
+    def _estimate_target(self, episodes) -> float:
+        raise NotImplementedError
+
+    def _cum_weights(self, episodes) -> List[np.ndarray]:
+        """Per-episode cumulative importance weights w_t =
+        prod_{k<=t} pi_target(a_k|s_k) / pi_behavior(a_k|s_k)."""
+        tlogps = self._target_logps(episodes)
+        out = []
+        for ep, tl in zip(episodes, tlogps):
+            rho = np.exp(tl - np.asarray(ep["logp"]))
+            out.append(np.cumprod(rho))
+        return out
+
+
+class ImportanceSampling(OffPolicyEstimator):
+    """Per-decision ordinary IS (reference: estimators/
+    importance_sampling.py): V = mean_ep sum_t gamma^t w_t r_t."""
+
+    def _estimate_target(self, episodes) -> float:
+        ws = self._cum_weights(episodes)
+        vals = []
+        for ep, w in zip(episodes, ws):
+            r = np.asarray(ep["rewards"])
+            g = self.gamma ** np.arange(len(r))
+            vals.append(float(np.sum(g * w * r)))
+        return float(np.mean(vals))
+
+
+class WeightedImportanceSampling(OffPolicyEstimator):
+    """Per-decision WIS (reference: estimators/
+    weighted_importance_sampling.py): weights at step t are normalized
+    by their mean over episodes alive at t, trading a little bias for
+    much lower variance."""
+
+    def _estimate_target(self, episodes) -> float:
+        ws = self._cum_weights(episodes)
+        max_t = max(len(w) for w in ws)
+        # Mean cumulative weight per step over episodes that reach it.
+        wbar = np.zeros(max_t)
+        cnt = np.zeros(max_t)
+        for w in ws:
+            wbar[:len(w)] += w
+            cnt[:len(w)] += 1
+        wbar = wbar / np.maximum(cnt, 1)
+        vals = []
+        for ep, w in zip(episodes, ws):
+            r = np.asarray(ep["rewards"])
+            g = self.gamma ** np.arange(len(r))
+            norm = np.where(wbar[:len(w)] > 0, wbar[:len(w)], 1.0)
+            vals.append(float(np.sum(g * (w / norm) * r)))
+        return float(np.mean(vals))
+
+
+class _FQE:
+    """Fitted Q Evaluation: a small jax Q-network regressed on expected-
+    SARSA targets under the target policy (reference: estimators/
+    fqe_torch_model.py, redesigned as a jit-compiled optax loop)."""
+
+    def __init__(self, obs_shape, num_actions: int, *, gamma: float,
+                 hidden=(64, 64), lr: float = 1e-2, seed: int = 0):
+        import jax
+        import jax.numpy as jnp
+        import optax
+        from flax import linen as nn
+
+        class QNet(nn.Module):
+            n: int
+            hidden: tuple
+
+            @nn.compact
+            def __call__(self, obs):
+                x = obs.astype(jnp.float32)
+                x = x.reshape((x.shape[0], -1))
+                for h in self.hidden:
+                    x = nn.relu(nn.Dense(h)(x))
+                return nn.Dense(self.n)(x)
+
+        self.net = QNet(num_actions, tuple(hidden))
+        dummy = jnp.zeros((1,) + tuple(obs_shape), jnp.float32)
+        self.q_params = self.net.init(jax.random.PRNGKey(seed), dummy)
+        self.tx = optax.adam(lr)
+        self.opt_state = self.tx.init(self.q_params)
+        self.gamma = gamma
+        net, tx, gamma_ = self.net, self.tx, gamma
+
+        def step(qp, opt_state, batch):
+            # Expected-SARSA target under pi_target; (1-done) cuts the
+            # bootstrap at true terminals.
+            q_next = net.apply(qp, batch["next_obs"])
+            v_next = jnp.sum(batch["next_probs"] * q_next, axis=-1)
+            target = batch["rewards"] + gamma_ * v_next * (
+                1.0 - batch["dones"])
+            target = jax.lax.stop_gradient(target)
+
+            def loss_fn(p):
+                q = net.apply(p, batch["obs"])
+                qa = q[jnp.arange(q.shape[0]), batch["actions"]]
+                return jnp.mean((qa - target) ** 2)
+
+            loss, grads = jax.value_and_grad(loss_fn)(qp)
+            updates, new_opt = tx.update(grads, opt_state, qp)
+            return optax.apply_updates(qp, updates), new_opt, loss
+
+        self._step = jax.jit(step)
+        self._apply = jax.jit(lambda p, obs: net.apply(p, obs))
+
+    def train(self, transitions: Dict[str, np.ndarray],
+              next_probs: np.ndarray, *, iterations: int = 200,
+              batch_size: int = 256, seed: int = 0) -> float:
+        import jax.numpy as jnp
+
+        n = len(transitions["actions"])
+        rng = np.random.default_rng(seed)
+        loss = 0.0
+        for _ in range(iterations):
+            idx = rng.integers(0, n, size=min(batch_size, n))
+            batch = {
+                "obs": jnp.asarray(transitions["obs"][idx]),
+                "actions": jnp.asarray(transitions["actions"][idx]),
+                "rewards": jnp.asarray(transitions["rewards"][idx]),
+                "next_obs": jnp.asarray(transitions["next_obs"][idx]),
+                "dones": jnp.asarray(
+                    transitions["dones"][idx].astype(np.float32)),
+                "next_probs": jnp.asarray(next_probs[idx]),
+            }
+            self.q_params, self.opt_state, loss = self._step(
+                self.q_params, self.opt_state, batch)
+        return float(loss)
+
+    def q_values(self, obs: np.ndarray) -> np.ndarray:
+        return np.asarray(self._apply(self.q_params, obs))
+
+
+class DirectMethod(OffPolicyEstimator):
+    """DM (reference: estimators/direct_method.py): fit Q^pi by FQE,
+    then V = mean_ep E_{a ~ pi(s_0)} Q(s_0, a)."""
+
+    def __init__(self, module_spec, params, *, gamma: float = 0.99,
+                 fqe_iterations: int = 1000, seed: int = 0):
+        super().__init__(module_spec, params, gamma=gamma)
+        self.fqe_iterations = fqe_iterations
+        self.seed = seed
+        self._fqe: Optional[_FQE] = None
+
+    def _fit(self, episodes) -> _FQE:
+        trans = _episodes_to_transitions(episodes)
+        num_actions = int(np.max(trans["actions"])) + 1
+        _, next_probs = self._logp_probs(self.params, trans["next_obs"])
+        num_actions = max(num_actions, np.asarray(next_probs).shape[-1])
+        fqe = _FQE(trans["obs"].shape[1:], num_actions,
+                   gamma=self.gamma, seed=self.seed)
+        fqe.train(trans, np.asarray(next_probs),
+                  iterations=self.fqe_iterations, seed=self.seed)
+        return fqe
+
+    def _estimate_target(self, episodes) -> float:
+        self._fqe = self._fit(episodes)
+        s0 = np.stack([ep["obs"][0] for ep in episodes])
+        _, probs0 = self._logp_probs(self.params, s0)
+        q0 = self._fqe.q_values(s0)
+        return float(np.mean(np.sum(np.asarray(probs0) * q0, axis=-1)))
+
+
+class DoublyRobust(DirectMethod):
+    """DR (reference: estimators/doubly_robust.py): the Jiang & Li
+    backward recursion v_t = V(s_t) + rho_t (r_t + gamma v_{t+1} -
+    Q(s_t, a_t)) combining the FQE model with per-decision IS."""
+
+    def _estimate_target(self, episodes) -> float:
+        self._fqe = self._fit(episodes)
+        tlogps = self._target_logps(episodes)
+        vals = []
+        for ep, tl in zip(episodes, tlogps):
+            T = len(ep["actions"])
+            obs = ep["obs"][:T]
+            q = self._fqe.q_values(obs)
+            _, probs = self._logp_probs(self.params, obs)
+            v = np.sum(np.asarray(probs) * q, axis=-1)
+            qa = q[np.arange(T), ep["actions"]]
+            rho = np.exp(tl - np.asarray(ep["logp"]))
+            acc = 0.0
+            for t in range(T - 1, -1, -1):
+                acc = v[t] + rho[t] * (
+                    ep["rewards"][t] + self.gamma * acc - qa[t])
+            vals.append(float(acc))
+        return float(np.mean(vals))
+
+
+def _episodes_to_transitions(episodes) -> Dict[str, np.ndarray]:
+    obs, acts, rews, nxt, dones = [], [], [], [], []
+    for ep in episodes:
+        T = len(ep["actions"])
+        obs.append(ep["obs"][:T])
+        nxt.append(ep["obs"][1:T + 1])
+        acts.append(np.asarray(ep["actions"], np.int32))
+        rews.append(np.asarray(ep["rewards"], np.float32))
+        d = np.zeros(T, np.bool_)
+        if ep.get("terminated", True):
+            d[-1] = True
+        dones.append(d)
+    return {
+        "obs": np.concatenate(obs),
+        "actions": np.concatenate(acts),
+        "rewards": np.concatenate(rews),
+        "next_obs": np.concatenate(nxt),
+        "dones": np.concatenate(dones),
+    }
+
+
+# -- behavior cloning -------------------------------------------------------
+
+
+def bc_loss(fwd, batch):
+    """Negative log-likelihood of the dataset actions (reference:
+    rllib/algorithms/bc — BC's policy loss without its MARWIL scaffold)."""
+    import jax
+    import jax.numpy as jnp
+
+    out = fwd(batch["obs"])
+    logp = jax.nn.log_softmax(out["logits"])
+    nll = -jnp.mean(logp[jnp.arange(logp.shape[0]), batch["actions"]])
+    return nll, {"bc_loss": nll}
+
+
+class BCConfig:
+    """Offline behavior-cloning config (reference:
+    rllib/algorithms/bc/bc.py:BCConfig)."""
+
+    def __init__(self):
+        from ray_tpu.rllib.algorithm import AlgorithmConfig
+
+        # Compose rather than subclass AlgorithmConfig: BC shares the
+        # training knobs but has no env / env-runner surface.
+        self._base = AlgorithmConfig()
+        self.input_: Any = None
+        self.lr = 1e-3
+        self.train_batch_size = 256
+        self.model: Dict[str, Any] = {}
+        self.grad_clip: Optional[float] = None
+        self.seed = 0
+        self.algo_class = BC
+
+    def offline_data(self, *, input_=None) -> "BCConfig":
+        if input_ is not None:
+            self.input_ = input_
+        return self
+
+    def training(self, *, lr=None, train_batch_size=None, model=None,
+                 grad_clip=None) -> "BCConfig":
+        if lr is not None:
+            self.lr = lr
+        if train_batch_size is not None:
+            self.train_batch_size = train_batch_size
+        if model is not None:
+            self.model = model
+        if grad_clip is not None:
+            self.grad_clip = grad_clip
+        return self
+
+    def debugging(self, *, seed=None) -> "BCConfig":
+        if seed is not None:
+            self.seed = seed
+        return self
+
+    def build(self) -> "BC":
+        algo = BC()
+        algo.setup({"bc_config": self})
+        return algo
+
+
+from ray_tpu.tune.trainable import Trainable as _Trainable
+
+
+class BC(_Trainable):
+    """Behavior cloning from offline episodes (reference:
+    rllib/algorithms/bc). Supervised -log pi(a|s) on dataset
+    transitions via the standard JaxLearner; a real tune.Trainable
+    (setup from a flat param dict, checkpointable), so
+    ``tune.Tuner(BC, param_space={"input_": ..., "lr": ...})`` works
+    like the reference's Tune integration."""
+
+    def __init__(self):
+        self.iteration = 0
+
+    def setup(self, config):
+        from ray_tpu.rllib.env import Space
+        from ray_tpu.rllib.learner import JaxLearner
+        from ray_tpu.rllib.rl_module import RLModuleSpec
+
+        if isinstance(config, BCConfig):
+            cfg = config
+        elif isinstance(config, dict) and "bc_config" in config:
+            cfg = config["bc_config"]
+        else:
+            # Flat Tune-style param dict.
+            cfg = BCConfig()
+            for k, v in (config or {}).items():
+                if hasattr(cfg, k):
+                    setattr(cfg, k, v)
+        self.config = cfg
+        if cfg.input_ is None:
+            raise ValueError("BCConfig.offline_data(input_=...) required")
+        self.reader = JsonReader(cfg.input_)
+        obs_space = Space(self.reader.obs_shape, self.reader.obs_dtype)
+        act_space = Space.discrete(self.reader.num_actions)
+        self.module_spec = RLModuleSpec(obs_space, act_space,
+                                        model_config=dict(cfg.model))
+        self.learner = JaxLearner(
+            self.module_spec, bc_loss, lr=cfg.lr,
+            grad_clip=cfg.grad_clip, seed=cfg.seed)
+        trans = self.reader.to_transitions()
+        self._obs = trans["obs"]
+        self._actions = trans["actions"]
+        self._rng = np.random.default_rng(cfg.seed)
+        self.iteration = 0
+
+    def training_step(self) -> Dict[str, Any]:
+        n = len(self._actions)
+        idx = self._rng.integers(0, n, size=min(
+            self.config.train_batch_size, n))
+        metrics = self.learner.update(
+            {"obs": self._obs[idx], "actions": self._actions[idx]})
+        metrics["num_samples_trained"] = len(idx)
+        return metrics
+
+    def step(self) -> Dict[str, Any]:
+        result = self.training_step()
+        self.iteration += 1
+        result["training_iteration"] = self.iteration
+        return result
+
+    train = step
+
+    def get_policy_params(self):
+        return self.learner.get_weights()
+
+    def get_state(self) -> dict:
+        return {"learner": self.learner.get_state(),
+                "iteration": self.iteration}
+
+    def set_state(self, state: dict) -> None:
+        self.learner.set_state(state["learner"])
+        self.iteration = state["iteration"]
+
+    def save_checkpoint(self, checkpoint_dir: str) -> None:
+        import pickle
+
+        with open(os.path.join(checkpoint_dir, "bc_state.pkl"),
+                  "wb") as f:
+            pickle.dump(self.get_state(), f)
+
+    def load_checkpoint(self, checkpoint_dir: str) -> None:
+        import pickle
+
+        with open(os.path.join(checkpoint_dir, "bc_state.pkl"),
+                  "rb") as f:
+            self.set_state(pickle.load(f))
+
+    def stop(self):
+        pass
+
+    cleanup = stop

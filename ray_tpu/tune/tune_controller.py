@@ -230,6 +230,7 @@ class TuneController:
     def run(self) -> List[Trial]:
         self._new_trials()
         search_exhausted = False
+        last_forced: Optional[frozenset] = None
         while True:
             self._new_trials()
             if not search_exhausted and self.search_alg.is_finished():
@@ -258,7 +259,21 @@ class TuneController:
                     # Scheduler offered no action for any paused trial and
                     # nothing else can make progress (e.g. a bracket member
                     # died outside the scheduler's view): resume them all
-                    # rather than hang.
+                    # rather than hang. If the SAME set lands here again
+                    # (a checkpointless trial that re-pauses at the same
+                    # milestone forever), terminate it instead — a
+                    # bounded guard, not a livelock.
+                    ids = frozenset(t.trial_id for t in paused)
+                    if ids == last_forced:
+                        logger.warning(
+                            "stall guard fired twice for the same %d "
+                            "paused trial(s); terminating them",
+                            len(paused))
+                        for t in paused:
+                            self._stop_trial(t, TERMINATED)
+                        self._save_state()
+                        continue
+                    last_forced = ids
                     logger.warning(
                         "resuming %d paused trial(s) with no scheduler "
                         "action to avoid a stall", len(paused))

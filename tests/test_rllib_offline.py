@@ -1,0 +1,363 @@
+"""Tests for ray_tpu.rllib offline RL + connectors (reference strategy:
+rllib/offline/tests/, rllib/connectors/tests/)."""
+
+import numpy as np
+import pytest
+
+from ray_tpu.rllib import (
+    BCConfig,
+    CastObs,
+    ConnectorPipelineV2,
+    DirectMethod,
+    DoublyRobust,
+    FlattenObs,
+    FrameStackObs,
+    ImportanceSampling,
+    JsonReader,
+    JsonWriter,
+    NormalizeObs,
+    WeightedImportanceSampling,
+    collect_episodes,
+)
+from ray_tpu.rllib.env import Space
+from ray_tpu.rllib.rl_module import RLModuleSpec
+
+
+# -- connectors -------------------------------------------------------------
+
+
+def test_pipeline_surgery():
+    pipe = ConnectorPipelineV2([FlattenObs(), CastObs(np.float32)])
+    pipe.prepend(FrameStackObs(2))
+    pipe.insert_after("FlattenObs", NormalizeObs())
+    names = [c.name for c in pipe.connectors]
+    assert names == ["FrameStackObs", "FlattenObs", "NormalizeObs",
+                     "CastObs"]
+    pipe.remove(FrameStackObs)
+    assert [c.name for c in pipe.connectors] == [
+        "FlattenObs", "NormalizeObs", "CastObs"]
+
+
+def test_flatten_and_space_transform():
+    pipe = ConnectorPipelineV2([FrameStackObs(3), FlattenObs()])
+    space = Space.box((4, 4, 2))
+    out_space = pipe.transform_space(space)
+    assert out_space.shape == (4 * 4 * 6,)
+    obs = np.ones((5, 4, 4, 2), np.float32)
+    out = pipe({"obs": obs, "dones": None})
+    assert out["obs"].shape == (5, 96)
+
+
+def test_frame_stack_resets_on_done():
+    fs = FrameStackObs(3)
+    obs1 = np.full((2, 1), 1.0, np.float32)
+    out = fs({"obs": obs1, "dones": None})["obs"]
+    assert out.shape == (2, 3)
+    np.testing.assert_array_equal(out[0], [1, 1, 1])
+    obs2 = np.full((2, 1), 2.0, np.float32)
+    out = fs({"obs": obs2, "dones": np.array([False, False])})["obs"]
+    np.testing.assert_array_equal(out[0], [1, 1, 2])
+    # Env 1 finished: its new obs must seed a fresh stack.
+    obs3 = np.stack([np.array([3.0], np.float32),
+                     np.array([9.0], np.float32)])
+    out = fs({"obs": obs3, "dones": np.array([False, True])})["obs"]
+    np.testing.assert_array_equal(out[0], [1, 2, 3])
+    np.testing.assert_array_equal(out[1], [9, 9, 9])
+
+
+def test_frame_stack_preview_does_not_mutate():
+    fs = FrameStackObs(2)
+    fs({"obs": np.full((1, 1), 1.0, np.float32), "dones": None})
+    before = fs._stack.copy()
+    pv = fs.preview({"obs": np.full((1, 1), 5.0, np.float32),
+                     "dones": None})["obs"]
+    np.testing.assert_array_equal(pv[0], [1, 5])
+    np.testing.assert_array_equal(fs._stack, before)
+
+
+def test_normalize_obs_converges():
+    norm = NormalizeObs()
+    rng = np.random.default_rng(0)
+    for _ in range(50):
+        norm({"obs": rng.normal(5.0, 2.0, (64, 3)).astype(np.float32),
+              "dones": None})
+    out = norm({"obs": rng.normal(5.0, 2.0, (512, 3)).astype(np.float32),
+                "dones": None})["obs"]
+    assert abs(float(out.mean())) < 0.15
+    assert abs(float(out.std()) - 1.0) < 0.15
+    # preview must not advance the statistics
+    count = norm._count
+    norm.preview({"obs": np.zeros((8, 3), np.float32), "dones": None})
+    assert norm._count == count
+
+
+def test_connectors_in_env_runner():
+    from ray_tpu.rllib.env_runner import EnvRunner
+
+    spec = RLModuleSpec(Space.box((4 * 2,)), Space.discrete(2))
+    runner = EnvRunner("CartPole-v1", 4, 16, spec, seed=0,
+                       env_to_module=[lambda: FrameStackObs(2)])
+    batch = runner.sample()
+    assert batch["obs"].shape == (16, 4, 8)  # 4-dim obs stacked x2
+
+
+@pytest.fixture(scope="module")
+def rl_cluster():
+    import ray_tpu
+
+    ray_tpu.init(num_cpus=4, num_tpus=0)
+    yield
+    ray_tpu.shutdown()
+
+
+def test_connectors_through_algorithm(rl_cluster):
+    from ray_tpu.rllib import PPOConfig
+
+    algo = (
+        PPOConfig()
+        .environment("CartPole-v1", num_envs_per_env_runner=4)
+        .env_runners(num_env_runners=1, rollout_fragment_length=16)
+        .training(train_batch_size=64, minibatch_size=32, num_epochs=1)
+        .connectors(env_to_module=[lambda: FrameStackObs(2)])
+        .build()
+    )
+    try:
+        result = algo.step()
+        assert result["timesteps_total"] > 0
+    finally:
+        algo.stop()
+
+
+# -- offline IO -------------------------------------------------------------
+
+
+def _make_episodes(n=20, T=10, seed=0):
+    rng = np.random.default_rng(seed)
+    eps = []
+    for _ in range(n):
+        eps.append({
+            "obs": rng.normal(size=(T + 1, 4)).astype(np.float32),
+            "actions": rng.integers(0, 2, T).astype(np.int32),
+            "rewards": np.ones(T, np.float32),
+            "logp": np.full(T, np.log(0.5), np.float32),
+            "terminated": True,
+        })
+    return eps
+
+
+def test_json_writer_reader_roundtrip(tmp_path):
+    eps = _make_episodes(7, T=5)
+    with JsonWriter(str(tmp_path / "out"),
+                    max_episodes_per_file=3) as w:
+        for ep in eps:
+            w.write(ep)
+    reader = JsonReader(str(tmp_path / "out"))
+    assert reader.obs_shape == (4,)
+    assert reader.num_actions == 2
+    back = list(reader.read_episodes())
+    assert len(back) == 7
+    np.testing.assert_allclose(back[0]["obs"], eps[0]["obs"])
+    np.testing.assert_array_equal(back[3]["actions"], eps[3]["actions"])
+    trans = reader.to_transitions()
+    assert trans["obs"].shape == (35, 4)
+    assert trans["dones"].sum() == 7  # one terminal per episode
+
+
+def test_collect_episodes_cartpole(tmp_path):
+    import jax
+
+    spec = RLModuleSpec(Space.box((4,)), Space.discrete(2))
+    params = spec.build().init_params(jax.random.PRNGKey(0))
+    writer = JsonWriter(str(tmp_path / "cp"))
+    eps = collect_episodes("CartPole-v1", spec, params,
+                           num_episodes=5, num_envs=4, seed=0,
+                           writer=writer)
+    writer.close()
+    assert len(eps) == 5
+    for ep in eps:
+        T = len(ep["actions"])
+        assert ep["obs"].shape == (T + 1, 4)
+        assert ep["rewards"].shape == (T,)
+        assert np.all(ep["logp"] <= 0)
+    reader = JsonReader(str(tmp_path / "cp"))
+    assert len(list(reader.read_episodes())) >= 5
+
+
+# -- off-policy estimators --------------------------------------------------
+
+
+def test_is_wis_identity_policy():
+    """Target == behavior -> v_target ~= v_behavior (weights ~1)."""
+    import jax
+
+    spec = RLModuleSpec(Space.box((4,)), Space.discrete(2))
+    params = spec.build().init_params(jax.random.PRNGKey(0))
+    eps = collect_episodes("CartPole-v1", spec, params,
+                           num_episodes=10, num_envs=4, seed=1)
+    for cls in (ImportanceSampling, WeightedImportanceSampling):
+        est = cls(spec, params, gamma=1.0)
+        out = est.estimate(eps)
+        assert out["num_episodes"] == 10
+        # Same policy: the IS estimate equals the behavior return
+        # exactly (weights == 1) up to float noise.
+        assert out["v_gain"] == pytest.approx(1.0, rel=0.05), cls
+        assert out["v_target"] == pytest.approx(out["v_behavior"],
+                                                rel=0.05)
+
+
+def test_is_detects_better_policy():
+    """A target policy preferring the rewarded action must score higher
+    than a uniform behavior policy on a synthetic bandit."""
+    import jax
+    import jax.numpy as jnp
+
+    spec = RLModuleSpec(Space.box((2,)), Space.discrete(2))
+    module = spec.build()
+    params = module.init_params(jax.random.PRNGKey(0))
+    # Steer logits toward action 1 by biasing the output layer.
+    flat = params["params"]
+    last = [k for k in flat if k.startswith("Dense")][-2]  # logits head
+
+    def bias_toward_one(p):
+        b = np.zeros_like(np.asarray(p["bias"]))
+        b[1] = 4.0  # ~98% action 1
+        return {"kernel": jnp.zeros_like(p["kernel"]),
+                "bias": jnp.asarray(b)}
+
+    flat[last] = bias_toward_one(flat[last])
+    # Behavior: uniform random; reward 1 only for action 1.
+    rng = np.random.default_rng(0)
+    eps = []
+    for _ in range(40):
+        T = 6
+        acts = rng.integers(0, 2, T).astype(np.int32)
+        eps.append({
+            "obs": np.zeros((T + 1, 2), np.float32),
+            "actions": acts,
+            "rewards": acts.astype(np.float32),
+            "logp": np.full(T, np.log(0.5), np.float32),
+            "terminated": True,
+        })
+    est = WeightedImportanceSampling(spec, params, gamma=1.0)
+    out = est.estimate(eps)
+    # Behavior earns ~3 of 6; target should be near 6.
+    assert out["v_behavior"] == pytest.approx(3.0, abs=0.8)
+    assert out["v_target"] > out["v_behavior"] * 1.4
+
+
+def test_dm_and_dr_estimate():
+    """DM/DR on the synthetic bandit: the FQE model learns Q(s, a) = a
+    (immediate reward), so both should score the action-1 policy near
+    its true value."""
+    import jax
+    import jax.numpy as jnp
+
+    spec = RLModuleSpec(Space.box((2,)), Space.discrete(2))
+    module = spec.build()
+    params = module.init_params(jax.random.PRNGKey(0))
+    flat = params["params"]
+    last = [k for k in flat if k.startswith("Dense")][-2]
+    b = np.zeros_like(np.asarray(flat[last]["bias"]))
+    b[1] = 4.0
+    flat[last] = {"kernel": jnp.zeros_like(flat[last]["kernel"]),
+                  "bias": jnp.asarray(b)}
+    rng = np.random.default_rng(1)
+    eps = []
+    for _ in range(30):
+        T = 4
+        acts = rng.integers(0, 2, T).astype(np.int32)
+        eps.append({
+            "obs": np.zeros((T + 1, 2), np.float32),
+            "actions": acts,
+            "rewards": acts.astype(np.float32),
+            "logp": np.full(T, np.log(0.5), np.float32),
+            "terminated": True,
+        })
+    for cls in (DirectMethod, DoublyRobust):
+        est = cls(spec, params, gamma=1.0, fqe_iterations=1000)
+        out = est.estimate(eps)
+        # True target value ~= 3.92 (0.98 * 4 steps); behavior ~2. DR
+        # carries IS variance on 30 episodes, so the band is wide.
+        assert out["v_target"] > out["v_behavior"] * 1.3, cls
+        assert 2.5 < out["v_target"] < 4.8, (cls, out)
+
+
+# -- behavior cloning -------------------------------------------------------
+
+
+def test_bc_learns_dataset_policy(tmp_path):
+    """BC on an expert dataset (always action 1) should drive the
+    policy toward action 1."""
+    import jax
+
+    rng = np.random.default_rng(0)
+    with JsonWriter(str(tmp_path / "expert")) as w:
+        for _ in range(20):
+            T = 8
+            w.write({
+                "obs": rng.normal(size=(T + 1, 3)).astype(np.float32),
+                "actions": np.ones(T, np.int32),
+                "rewards": np.ones(T, np.float32),
+                "logp": np.zeros(T, np.float32),
+                "terminated": True,
+            })
+    algo = (
+        BCConfig()
+        .offline_data(input_=str(tmp_path / "expert"))
+        .training(lr=1e-2, train_batch_size=64)
+        .debugging(seed=0)
+        .build()
+    )
+    first = algo.step()
+    for _ in range(30):
+        last = algo.step()
+    assert last["bc_loss"] < first["bc_loss"]
+    # The trained policy should now prefer action 1 everywhere.
+    spec = algo.module_spec
+    module = spec.build()
+    forwards = module.make_forwards()
+    obs = rng.normal(size=(32, 3)).astype(np.float32)
+    acts = np.asarray(forwards["inference"](
+        algo.get_policy_params(), obs))
+    assert (acts == 1).mean() > 0.9
+    # state roundtrip
+    state = algo.get_state()
+    algo2 = (BCConfig().offline_data(input_=str(tmp_path / "expert"))
+             .build())
+    algo2.set_state(state)
+    acts2 = np.asarray(forwards["inference"](
+        algo2.get_policy_params(), obs))
+    np.testing.assert_array_equal(acts, acts2)
+
+
+def test_writer_header_num_actions_not_frozen(tmp_path):
+    """First episode lacks the highest action id: the reader must still
+    report the full cardinality (via meta.json, not shard-0's header)."""
+    rng = np.random.default_rng(0)
+
+    def ep(actions):
+        a = np.asarray(actions, np.int32)
+        T = len(a)
+        return {"obs": rng.normal(size=(T + 1, 2)).astype(np.float32),
+                "actions": a, "rewards": np.ones(T, np.float32),
+                "logp": np.zeros(T, np.float32), "terminated": True}
+
+    with JsonWriter(str(tmp_path / "d"), max_episodes_per_file=1) as w:
+        w.write(ep([0, 0, 0]))   # shard 0 header says num_actions=1
+        w.write(ep([0, 2, 1]))
+    reader = JsonReader(str(tmp_path / "d"))
+    assert reader.num_actions == 3
+
+
+def test_collect_writer_matches_return(tmp_path):
+    import jax
+
+    spec = RLModuleSpec(Space.box((4,)), Space.discrete(2))
+    params = spec.build().init_params(jax.random.PRNGKey(0))
+    w = JsonWriter(str(tmp_path / "m"))
+    eps = collect_episodes("CartPole-v1", spec, params,
+                           num_episodes=3, num_envs=8, seed=2, writer=w)
+    w.close()
+    on_disk = list(JsonReader(str(tmp_path / "m")).read_episodes())
+    assert len(eps) == 3
+    assert len(on_disk) == 3
