@@ -1,0 +1,187 @@
+"""MPI runtime-env plugin: run a task's function inside an MPI gang.
+
+Reference: python/ray/_private/runtime_env/mpi.py:41 (MPIPlugin wraps
+the worker command in ``mpirun``; rank 0 becomes the Ray worker while
+ranks > 0 run the user's ``worker_entry``). Redesigned for this
+runtime's single-process task executor: instead of rewriting the
+long-lived worker's own command line, a task whose runtime_env carries
+``mpi`` executes its function inside a freshly launched process gang —
+
+    @ray_tpu.remote
+    def dist_compute(...): ...
+    dist_compute.options(runtime_env={"mpi": {
+        "args": ["-n", "4"],
+        "worker_entry": "my_pkg.mpi_worker",   # ranks > 0 run this
+    }}).remote(...)
+
+The function + arguments ship to the gang via a pickle spool file;
+every rank first imports/calls ``worker_entry(rank, size)`` (host
+bootstrap — typically a loop that serves MPI collectives), rank 0 then
+runs the task body, and its return value (or pickled exception) comes
+back through the spool. The launcher is ``mpirun`` by default; the
+built-in ``"simulated"`` launcher spawns the gang as plain subprocesses
+with RTPU_MPI_RANK/SIZE set, which is what CI images without an MPI
+distribution (like this one) exercise — see PARITY.md.
+"""
+
+from __future__ import annotations
+
+import os
+import pickle
+import shutil
+import subprocess
+import sys
+import tempfile
+import time
+from typing import Any, Dict
+
+from ray_tpu import exceptions as exc
+
+
+def _detect_rank_size() -> tuple:
+    """Rank/size from whatever launcher started us (OpenMPI, MPICH/
+    Hydra, or the built-in simulator)."""
+    for rank_var, size_var in (
+            ("OMPI_COMM_WORLD_RANK", "OMPI_COMM_WORLD_SIZE"),
+            ("PMI_RANK", "PMI_SIZE"),
+            ("RTPU_MPI_RANK", "RTPU_MPI_SIZE")):
+        if rank_var in os.environ:
+            return int(os.environ[rank_var]), int(os.environ[size_var])
+    return 0, 1
+
+
+def _parse_np(args) -> int:
+    """Extract the gang size from mpirun-style args (-n/-np N)."""
+    args = list(args or [])
+    for flag in ("-n", "-np", "--np"):
+        if flag in args:
+            return int(args[args.index(flag) + 1])
+    return 1
+
+
+def run_under_mpi(mpi_cfg: Dict[str, Any], fn, args, kwargs) -> Any:
+    """Execute ``fn(*args, **kwargs)`` on rank 0 of an MPI gang and
+    return its result. Raises RuntimeEnvSetupError if no launcher is
+    available, or re-raises the task's own exception."""
+    import cloudpickle
+
+    launcher = mpi_cfg.get("launcher", "mpirun")
+    mpi_args = list(mpi_cfg.get("args") or [])
+    worker_entry = mpi_cfg.get("worker_entry")
+    spool = tempfile.mkdtemp(prefix="rtpu_mpi_")
+    payload = os.path.join(spool, "payload.pkl")
+    result_path = os.path.join(spool, "result.pkl")
+    try:
+        with open(payload, "wb") as f:
+            cloudpickle.dump(
+                {"fn": fn, "args": args, "kwargs": kwargs,
+                 "worker_entry": worker_entry}, f)
+        child = [sys.executable, "-m", "ray_tpu.core.runtime_env_mpi",
+                 payload, result_path]
+        # Gang ranks are fresh interpreters: make sure they can import
+        # ray_tpu regardless of the worker's own sys.path bootstrap.
+        pkg_root = os.path.dirname(os.path.dirname(
+            os.path.dirname(os.path.abspath(__file__))))
+        env = dict(os.environ)
+        env["PYTHONPATH"] = (pkg_root + os.pathsep
+                             + env.get("PYTHONPATH", "")).rstrip(
+                                 os.pathsep)
+        if launcher == "simulated":
+            procs = _launch_simulated(_parse_np(mpi_args), child, env)
+            deadline = time.monotonic() + mpi_cfg.get("timeout", 600)
+            try:
+                rcs = [p.wait(timeout=max(0.1,
+                                          deadline - time.monotonic()))
+                       for p in procs]
+            except subprocess.TimeoutExpired:
+                # Kill the whole gang — a hung rank must not orphan.
+                for p in procs:
+                    if p.poll() is None:
+                        p.kill()
+                for p in procs:
+                    p.wait(timeout=10)
+                raise exc.RayTpuError(
+                    "MPI gang timed out; all ranks killed")
+            bad = [rc for rc in rcs if rc != 0]
+        else:
+            if shutil.which(launcher) is None:
+                raise exc.RuntimeEnvSetupError(
+                    f"MPI launcher {launcher!r} not found on this host; "
+                    "install an MPI distribution or use "
+                    '{"launcher": "simulated"}')
+            rc = subprocess.run(
+                [launcher, *mpi_args, *child], env=env,
+                timeout=mpi_cfg.get("timeout", 600)).returncode
+            bad = [rc] if rc != 0 else []
+        if not os.path.exists(result_path):
+            raise exc.RayTpuError(
+                f"MPI gang produced no result (exit codes {bad or 'ok'})")
+        with open(result_path, "rb") as f:
+            out = pickle.load(f)
+        if "err" in out:
+            raise exc.RayTpuError(
+                f"MPI task failed on rank 0:\n{out['err']}")
+        if bad:
+            raise exc.RayTpuError(
+                f"MPI ranks exited nonzero: {bad}")
+        return out["ok"]
+    finally:
+        shutil.rmtree(spool, ignore_errors=True)
+
+
+def _launch_simulated(n: int, child_cmd, base_env) -> list:
+    """The built-in launcher: N plain subprocesses with rank/size env
+    (no MPI distribution required; collectives must come from the
+    user's own rendezvous, e.g. jax.distributed or sockets)."""
+    procs = []
+    for rank in range(n):
+        env = dict(base_env)
+        env["RTPU_MPI_RANK"] = str(rank)
+        env["RTPU_MPI_SIZE"] = str(n)
+        procs.append(subprocess.Popen(child_cmd, env=env))
+    return procs
+
+
+def _child_main(payload_path: str, result_path: str) -> int:
+    import importlib
+    import traceback
+
+    import cloudpickle
+
+    rank, size = _detect_rank_size()
+    with open(payload_path, "rb") as f:
+        payload = cloudpickle.load(f)
+    entry = payload.get("worker_entry")
+    entry_fn = None
+    if entry:
+        mod, _, name = entry.rpartition(".")
+        entry_fn = getattr(importlib.import_module(mod), name)
+    if rank != 0:
+        # Non-zero ranks ARE the MPI workers: worker_entry runs the
+        # user's collective-serving loop (reference: MPIPlugin's
+        # worker_entry contract).
+        if entry_fn is not None:
+            entry_fn(rank, size)
+        return 0
+    ok = False
+    try:
+        if entry_fn is not None:
+            entry_fn(rank, size)
+        value = payload["fn"](*payload["args"], **payload["kwargs"])
+        # cloudpickle: return values may be instances of driver-defined
+        # classes that stdlib pickle cannot serialize by reference —
+        # and serialization failure must surface as an error blob, not
+        # crash the child after a "successful" run.
+        blob = cloudpickle.dumps({"ok": value})
+        ok = True
+    except BaseException:
+        blob = cloudpickle.dumps({"err": traceback.format_exc()})
+    tmp = result_path + ".tmp"
+    with open(tmp, "wb") as f:
+        f.write(blob)
+    os.replace(tmp, result_path)
+    return 0 if ok else 1
+
+
+if __name__ == "__main__":
+    sys.exit(_child_main(sys.argv[1], sys.argv[2]))

@@ -383,7 +383,7 @@ class Executor:
         if not runtime_env:
             return lambda: None
         unsupported = set(runtime_env) - {"env_vars", "working_dir",
-                                          "py_modules", "pip"}
+                                          "py_modules", "pip", "mpi"}
         if unsupported:
             raise exc.RayTpuError(
                 f"unsupported runtime_env keys: {sorted(unsupported)}")
@@ -469,8 +469,21 @@ class Executor:
                 from ray_tpu.util import tracing as _tracing
 
                 _tracing.setup_tracing("ray_tpu.worker")
+            mpi_cfg = (spec.runtime_env or {}).get("mpi")
             if spec.task_type == TaskType.NORMAL_TASK:
                 fn = self._load_callable(spec)
+                if mpi_cfg:
+                    # MPI runtime env: the function body runs on rank 0
+                    # of a freshly launched gang (runtime_env_mpi.py).
+                    from ray_tpu.core.runtime_env_mpi import run_under_mpi
+
+                    if spec.num_returns == TaskSpec.STREAMING:
+                        raise exc.RayTpuError(
+                            "mpi runtime env does not support "
+                            "streaming generators")
+                    fn_inner = fn
+                    fn = (lambda *a, **kw:
+                          run_under_mpi(mpi_cfg, fn_inner, a, kw))
                 if spec.num_returns == TaskSpec.STREAMING:
                     if trace_ctx is not None:
                         with _tracing.task_span(spec.name, trace_ctx):

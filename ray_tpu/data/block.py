@@ -36,6 +36,9 @@ class BlockMetadata:
     size_bytes: int
     schema: Optional[Dict[str, str]] = None
     input_files: List[str] = field(default_factory=list)
+    # Remote execution seconds that produced this block (stamped by the
+    # executor's task bodies; consumed by data/stats.py).
+    exec_s: float = 0.0
 
 
 def _to_column(values: List[Any]) -> np.ndarray:

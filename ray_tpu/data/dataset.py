@@ -139,12 +139,24 @@ class Dataset:
         return Dataset(lp.InputData(list(self._execute())))
 
     def stats(self) -> Dict[str, Any]:
-        bundles = list(self._execute())
-        return {
+        """Execute the plan with per-operator instrumentation
+        (reference: Dataset.stats / _internal/stats.py). Returns the
+        dataset totals plus a per-stage breakdown (rows, bytes, driver
+        wall seconds, remote exec seconds per block) and a formatted
+        ``summary`` string."""
+        from ray_tpu.data.stats import DatasetStats
+
+        collector = DatasetStats()
+        bundles = list(StreamingExecutor(
+            self._op, stats=collector).execute())
+        out: Dict[str, Any] = {
             "num_blocks": len(bundles),
             "num_rows": sum(m.num_rows for _, m in bundles),
             "size_bytes": sum(m.size_bytes for _, m in bundles),
         }
+        out.update(collector.to_dict())
+        out["summary"] = collector.summary_string()
+        return out
 
     # -- consumption ----------------------------------------------------
     def iter_internal_ref_bundles(self) -> Iterator[Bundle]:

@@ -18,6 +18,7 @@ compiles once, and streams batches through the cached executable.
 from __future__ import annotations
 
 import collections
+import time
 from typing import Any, Iterator, List, Optional, Tuple
 
 import numpy as np
@@ -55,22 +56,29 @@ Bundle = Tuple[Any, BlockMetadata]
 
 
 def _run_read_task(read_task):
+    t0 = time.perf_counter()
     block = read_task()
     meta = BlockAccessor(block).metadata()
+    meta.exec_s = time.perf_counter() - t0
     return block, meta
 
 
 def _run_map_stage(transforms, block: Block):
+    t0 = time.perf_counter()
     out = apply_transforms(transforms, block)
     meta = BlockAccessor(out).metadata()
+    meta.exec_s = time.perf_counter() - t0
     return out, meta
 
 
 def _slice_concat(ranges, *blocks):
     """Assemble one output block from [(input_idx, start, end), ...]."""
+    t0 = time.perf_counter()
     parts = [BlockAccessor(blocks[i]).slice(s, e) for (i, s, e) in ranges]
     out = concat_blocks(parts)
-    return out, BlockAccessor(out).metadata()
+    meta = BlockAccessor(out).metadata()
+    meta.exec_s = time.perf_counter() - t0
+    return out, meta
 
 
 def plan_row_slice(bundles: List[Bundle], lo: int, hi: int):
@@ -101,11 +109,14 @@ def _shuffle_map(block: Block, num_out: int, seed):
 
 
 def _shuffle_reduce(seed, *parts):
+    t0 = time.perf_counter()
     out = concat_blocks(list(parts))
     acc = BlockAccessor(out)
     rng = np.random.default_rng(seed)
     out = acc.take_indices(rng.permutation(acc.num_rows()))
-    return out, BlockAccessor(out).metadata()
+    meta = BlockAccessor(out).metadata()
+    meta.exec_s = time.perf_counter() - t0
+    return out, meta
 
 
 def _push_shuffle_map(block: Block, reducers, shuffle_id: str,
@@ -243,17 +254,24 @@ def _sort_map(block: Block, boundaries, key, descending):
 
 
 def _sort_reduce(key, descending, *parts):
+    t0 = time.perf_counter()
     merged = concat_blocks(list(parts))
     out = BlockAccessor(merged).sort(key, descending)
-    return out, BlockAccessor(out).metadata()
+    meta = BlockAccessor(out).metadata()
+    meta.exec_s = time.perf_counter() - t0
+    return out, meta
 
 
 def _truncate(block: Block, n: int):
+    t0 = time.perf_counter()
     out = BlockAccessor(block).slice(0, n)
-    return out, BlockAccessor(out).metadata()
+    meta = BlockAccessor(out).metadata()
+    meta.exec_s = time.perf_counter() - t0
+    return out, meta
 
 
 def _zip_blocks(left: Block, right: Block):
+    t0 = time.perf_counter()
     left = BlockAccessor(left).to_batch()
     right = BlockAccessor(right).to_batch()
     out = dict(left)
@@ -262,7 +280,9 @@ def _zip_blocks(left: Block, right: Block):
         while name in out:
             name = name + "_1"
         out[name] = v
-    return out, BlockAccessor(out).metadata()
+    meta = BlockAccessor(out).metadata()
+    meta.exec_s = time.perf_counter() - t0
+    return out, meta
 
 
 class _MapActor:
@@ -283,8 +303,11 @@ class _MapActor:
             self.transforms.append(t)
 
     def process(self, block: Block):
+        t0 = time.perf_counter()
         out = apply_transforms(self.transforms, block)
-        return out, BlockAccessor(out).metadata()
+        meta = BlockAccessor(out).metadata()
+        meta.exec_s = time.perf_counter() - t0
+        return out, meta
 
 
 # ---------------------------------------------------------------------------
@@ -293,8 +316,10 @@ class _MapActor:
 
 
 class StreamingExecutor:
-    def __init__(self, terminal_op, *, max_in_flight: Optional[int] = None):
+    def __init__(self, terminal_op, *, max_in_flight: Optional[int] = None,
+                 stats=None):
         self.stages = fuse_plan(terminal_op)
+        self.stats = stats  # data.stats.DatasetStats or None
         if max_in_flight is None:
             try:
                 cpus = int(ray_tpu.cluster_resources().get("CPU", 4))
@@ -307,36 +332,45 @@ class StreamingExecutor:
     def execute(self) -> Iterator[Bundle]:
         it: Optional[Iterator[Bundle]] = None
         for stage in self.stages:
-            if isinstance(stage, Read):
-                it = self._read_iter(stage)
-            elif isinstance(stage, InputData):
-                it = iter(stage.bundles)
-            elif isinstance(stage, MapStage):
-                if stage.compute == "actors":
-                    it = self._actor_map_iter(stage, it)
-                else:
-                    it = self._map_iter(stage, it)
-            elif isinstance(stage, Repartition):
-                it = self._repartition(stage, list(it))
-            elif isinstance(stage, RandomShuffle):
-                it = self._shuffle(stage, list(it))
-            elif isinstance(stage, RandomizeBlockOrder):
-                bundles = list(it)
-                order = np.random.default_rng(stage.seed).permutation(
-                    len(bundles))
-                it = iter([bundles[i] for i in order])
-            elif isinstance(stage, Sort):
-                it = self._sort(stage, list(it))
-            elif isinstance(stage, Limit):
-                it = self._limit_iter(stage, it)
-            elif isinstance(stage, Union):
-                it = self._union_iter(stage, it)
-            elif isinstance(stage, Zip):
-                it = self._zip(stage, list(it))
-            else:
-                raise TypeError(f"unknown stage {stage!r}")
+            it = self._stage_iter(stage, it)
+            if self.stats is not None:
+                passthrough = isinstance(
+                    stage, (InputData, Limit, Union,
+                            RandomizeBlockOrder))
+                it = self.stats.wrap(
+                    getattr(stage, "name", type(stage).__name__), it,
+                    passthrough=passthrough)
         assert it is not None, "empty plan"
         return it
+
+    def _stage_iter(self, stage, it: Optional[Iterator[Bundle]]
+                    ) -> Iterator[Bundle]:
+        if isinstance(stage, Read):
+            return self._read_iter(stage)
+        if isinstance(stage, InputData):
+            return iter(stage.bundles)
+        if isinstance(stage, MapStage):
+            if stage.compute == "actors":
+                return self._actor_map_iter(stage, it)
+            return self._map_iter(stage, it)
+        if isinstance(stage, Repartition):
+            return self._repartition(stage, list(it))
+        if isinstance(stage, RandomShuffle):
+            return self._shuffle(stage, list(it))
+        if isinstance(stage, RandomizeBlockOrder):
+            bundles = list(it)
+            order = np.random.default_rng(stage.seed).permutation(
+                len(bundles))
+            return iter([bundles[i] for i in order])
+        if isinstance(stage, Sort):
+            return self._sort(stage, list(it))
+        if isinstance(stage, Limit):
+            return self._limit_iter(stage, it)
+        if isinstance(stage, Union):
+            return self._union_iter(stage, it)
+        if isinstance(stage, Zip):
+            return self._zip(stage, list(it))
+        raise TypeError(f"unknown stage {stage!r}")
 
     # -- streaming stages ----------------------------------------------
     def _windowed(self, submits: Iterator[Tuple[Any, Any]]

@@ -1,0 +1,147 @@
+"""Per-operator execution statistics for Data pipelines.
+
+Reference: python/ray/data/_internal/stats.py (DatasetStats /
+StatsActor: per-operator wall time, block exec times, rows/bytes,
+formatted summary). Redesigned for the pull-based streaming executor:
+each stage's output iterator is wrapped with a timer that attributes
+driver-blocking wall time to the stage itself (child-stage time is
+subtracted via a charge stack, since stages pull from each other), and
+remote task bodies stamp their execution seconds into BlockMetadata so
+per-block compute time needs no extra RPCs.
+"""
+
+from __future__ import annotations
+
+import time
+from dataclasses import dataclass
+from typing import Any, Dict, Iterator, List, Optional
+
+
+@dataclass
+class StageStats:
+    """One executed stage's aggregate metrics."""
+
+    name: str
+    num_blocks: int = 0
+    num_rows: int = 0
+    size_bytes: int = 0
+    # Wall seconds the driver spent blocked in THIS stage (child-stage
+    # pull time excluded).
+    driver_wall_s: float = 0.0
+    # Remote execution seconds, summed over this stage's blocks.
+    task_exec_s: float = 0.0
+    block_exec_min_s: float = float("inf")
+    block_exec_max_s: float = 0.0
+    # Passthrough stages (Limit/Union/RandomizeBlockOrder/InputData)
+    # forward upstream blocks whose exec_s belongs to the PRODUCING
+    # stage; counting it again would double-book remote compute.
+    passthrough: bool = False
+
+    def record(self, meta) -> None:
+        self.num_blocks += 1
+        self.num_rows += getattr(meta, "num_rows", 0) or 0
+        self.size_bytes += getattr(meta, "size_bytes", 0) or 0
+        exec_s = getattr(meta, "exec_s", 0.0) or 0.0
+        if exec_s and not self.passthrough:
+            self.task_exec_s += exec_s
+            self.block_exec_min_s = min(self.block_exec_min_s, exec_s)
+            self.block_exec_max_s = max(self.block_exec_max_s, exec_s)
+
+    def to_dict(self) -> Dict[str, Any]:
+        d = {
+            "name": self.name,
+            "num_blocks": self.num_blocks,
+            "num_rows": self.num_rows,
+            "size_bytes": self.size_bytes,
+            "driver_wall_s": round(self.driver_wall_s, 6),
+            "task_exec_s": round(self.task_exec_s, 6),
+        }
+        if self.num_blocks and self.task_exec_s:
+            d["block_exec_min_s"] = round(self.block_exec_min_s, 6)
+            d["block_exec_max_s"] = round(self.block_exec_max_s, 6)
+            d["block_exec_mean_s"] = round(
+                self.task_exec_s / self.num_blocks, 6)
+        return d
+
+
+class DatasetStats:
+    """Collects StageStats across one plan execution (reference:
+    DatasetStats). Pass to StreamingExecutor; read ``.stages`` /
+    ``.summary_string()`` after the iterator is consumed."""
+
+    def __init__(self):
+        self.stages: List[StageStats] = []
+        self.total_wall_s: float = 0.0
+        # Charge stack: wrap() frames push 0.0, children add their whole
+        # next() duration to the parent's top-of-stack entry so the
+        # parent can subtract it from its own elapsed time.
+        self._stack: List[float] = []
+        self._t_start: Optional[float] = None
+
+    def wrap(self, name: str, it: Iterator,
+             passthrough: bool = False) -> Iterator:
+        ss = StageStats(name, passthrough=passthrough)
+        self.stages.append(ss)
+
+        def timed() -> Iterator:
+            if self._t_start is None:
+                self._t_start = time.perf_counter()
+            while True:
+                t0 = time.perf_counter()
+                self._stack.append(0.0)
+                try:
+                    bundle = next(it)
+                except StopIteration:
+                    child = self._stack.pop()
+                    dt = time.perf_counter() - t0
+                    ss.driver_wall_s += dt - child
+                    if self._stack:
+                        self._stack[-1] += dt
+                    self.total_wall_s = (time.perf_counter()
+                                         - self._t_start)
+                    return
+                child = self._stack.pop()
+                dt = time.perf_counter() - t0
+                ss.driver_wall_s += dt - child
+                if self._stack:
+                    self._stack[-1] += dt
+                ss.record(bundle[1])
+                self.total_wall_s = time.perf_counter() - self._t_start
+                yield bundle
+
+        return timed()
+
+    def to_dict(self) -> Dict[str, Any]:
+        return {
+            "total_wall_s": round(self.total_wall_s, 6),
+            "stages": [s.to_dict() for s in self.stages],
+        }
+
+    def summary_string(self) -> str:
+        """Human-readable per-operator summary (reference: the
+        Dataset.stats() text block)."""
+        lines = []
+        for s in self.stages:
+            lines.append(
+                f"Operator {s.name}: {s.num_blocks} blocks, "
+                f"{s.num_rows} rows, {_fmt_bytes(s.size_bytes)}")
+            lines.append(
+                f"    driver wall: {s.driver_wall_s:.3f}s, remote exec "
+                f"total: {s.task_exec_s:.3f}s")
+            if s.num_blocks and s.task_exec_s:
+                lines.append(
+                    f"    block exec min/mean/max: "
+                    f"{s.block_exec_min_s * 1e3:.1f}/"
+                    f"{s.task_exec_s / s.num_blocks * 1e3:.1f}/"
+                    f"{s.block_exec_max_s * 1e3:.1f} ms")
+        lines.append(f"Total wall: {self.total_wall_s:.3f}s")
+        return "\n".join(lines)
+
+
+def _fmt_bytes(n: int) -> str:
+    for unit in ("B", "KiB", "MiB", "GiB"):
+        if abs(n) < 1024 or unit == "GiB":
+            return (f"{n:.1f} {unit}" if unit != "B"
+                    else f"{n} {unit}")
+        n /= 1024
+    return f"{n} B"

@@ -51,8 +51,7 @@ def _encode_episode(ep: Dict[str, Any]) -> str:
     return json.dumps(out)
 
 
-def _decode_episode(line: str) -> Dict[str, Any]:
-    raw = json.loads(line)
+def _decode_episode(raw: dict) -> Dict[str, Any]:
     return {k: (_dec(v) if isinstance(v, dict) and "__npy__" in v else v)
             for k, v in raw.items()}
 
@@ -66,28 +65,33 @@ class JsonWriter:
     record carrying the spaces, so readers need no env to reconstruct a
     module."""
 
-    def __init__(self, path: str, *, max_episodes_per_file: int = 1024):
+    def __init__(self, path: str, *, max_episodes_per_file: int = 1024,
+                 num_actions: Optional[int] = None):
         self.path = path
         os.makedirs(path, exist_ok=True)
         self.max_per_file = max_episodes_per_file
+        # Pass the true action-space cardinality when known; inference
+        # from the data undercounts when the behavior policy never takes
+        # the highest action id.
+        self._num_actions = num_actions
         self._file = None
         self._count = 0
         self._shard = 0
         self._header: Optional[dict] = None
 
     def write(self, episode: Dict[str, Any]) -> None:
+        seen = int(np.max(episode["actions"])) + 1
         if self._header is None:
             obs = np.asarray(episode["obs"])
             self._header = {
                 "type": "header",
                 "obs_shape": list(obs.shape[1:]),
                 "obs_dtype": str(obs.dtype),
-                "num_actions": int(np.max(episode["actions"])) + 1,
+                "num_actions": self._num_actions or seen,
             }
-        else:
+        if self._num_actions is None:
             self._header["num_actions"] = max(
-                self._header["num_actions"],
-                int(np.max(episode["actions"])) + 1)
+                self._header["num_actions"], seen)
         if self._file is None or self._count >= self.max_per_file:
             self.close()
             fname = os.path.join(self.path,
@@ -183,9 +187,7 @@ class JsonReader:
                     rec = json.loads(line)
                     if rec.get("type") == "header":
                         continue
-                    yield {k: (_dec(v) if isinstance(v, dict)
-                               and "__npy__" in v else v)
-                           for k, v in rec.items()}
+                    yield _decode_episode(rec)
 
     def to_transitions(self) -> Dict[str, np.ndarray]:
         """Flatten all episodes into SARSA transitions: obs, actions,
@@ -226,6 +228,8 @@ def collect_episodes(env_spec, module_spec, params, *,
     from ray_tpu.rllib.env import make_vec
 
     env = make_vec(env_spec, num_envs, seed=seed)
+    if writer is not None and writer._num_actions is None:
+        writer._num_actions = env.action_space.n
     module = module_spec.build()
     forwards = module.make_forwards()
     key = jax.random.PRNGKey(seed)
@@ -510,13 +514,20 @@ class DoublyRobust(DirectMethod):
     def _estimate_target(self, episodes) -> float:
         self._fqe = self._fit(episodes)
         tlogps = self._target_logps(episodes)
-        vals = []
+        # ONE batched forward over the concatenation of all episode
+        # steps (per-episode forwards would recompile the jit function
+        # for every distinct episode length).
+        all_obs = np.concatenate([ep["obs"][:len(ep["actions"])]
+                                  for ep in episodes])
+        q_all = self._fqe.q_values(all_obs)
+        _, probs_all = self._logp_probs(self.params, all_obs)
+        probs_all = np.asarray(probs_all)
+        vals, lo = [], 0
         for ep, tl in zip(episodes, tlogps):
             T = len(ep["actions"])
-            obs = ep["obs"][:T]
-            q = self._fqe.q_values(obs)
-            _, probs = self._logp_probs(self.params, obs)
-            v = np.sum(np.asarray(probs) * q, axis=-1)
+            q = q_all[lo:lo + T]
+            v = np.sum(probs_all[lo:lo + T] * q, axis=-1)
+            lo += T
             qa = q[np.arange(T), ep["actions"]]
             rho = np.exp(tl - np.asarray(ep["logp"]))
             acc = 0.0
@@ -568,11 +579,6 @@ class BCConfig:
     rllib/algorithms/bc/bc.py:BCConfig)."""
 
     def __init__(self):
-        from ray_tpu.rllib.algorithm import AlgorithmConfig
-
-        # Compose rather than subclass AlgorithmConfig: BC shares the
-        # training knobs but has no env / env-runner surface.
-        self._base = AlgorithmConfig()
         self.input_: Any = None
         self.lr = 1e-3
         self.train_batch_size = 256

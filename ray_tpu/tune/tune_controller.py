@@ -260,10 +260,15 @@ class TuneController:
                     # nothing else can make progress (e.g. a bracket member
                     # died outside the scheduler's view): resume them all
                     # rather than hang. If the SAME set lands here again
-                    # (a checkpointless trial that re-pauses at the same
-                    # milestone forever), terminate it instead — a
-                    # bounded guard, not a livelock.
-                    ids = frozenset(t.trial_id for t in paused)
+                    # WITHOUT progress (same trials at the same
+                    # iteration — a checkpointless trial re-pausing at
+                    # one milestone forever), terminate it instead — a
+                    # bounded guard, not a livelock. Trials that advanced
+                    # between firings hash differently and get resumed.
+                    ids = frozenset(
+                        (t.trial_id,
+                         t.last_result.get("training_iteration", 0))
+                        for t in paused)
                     if ids == last_forced:
                         logger.warning(
                             "stall guard fired twice for the same %d "

@@ -215,3 +215,35 @@ def test_materialize_and_stats(ray_data_cluster):
 def test_train_test_split(ray_data_cluster):
     tr, te = rd.range(100).train_test_split(0.2)
     assert tr.count() == 80 and te.count() == 20
+
+
+def test_stats_per_operator(ray_data_cluster):
+    st = (rd.range(60, parallelism=4)
+          .map(lambda x: x + 1)
+          .random_shuffle(seed=0)
+          .stats())
+    assert st["num_rows"] == 60
+    names = [s["name"] for s in st["stages"]]
+    assert any(n.startswith("Read") for n in names)
+    assert any("Map" in n for n in names)
+    assert any("RandomShuffle" in n for n in names)
+    # Every stage saw all the rows and recorded remote exec time.
+    for s in st["stages"]:
+        assert s["num_rows"] == 60
+        assert s["task_exec_s"] > 0
+        assert s["driver_wall_s"] >= 0
+    assert st["total_wall_s"] > 0
+    assert "Operator" in st["summary"] and "Total wall" in st["summary"]
+
+
+def test_stats_actor_compute(ray_data_cluster):
+    class Ident:
+        def __call__(self, batch):
+            return batch
+
+    st = (rd.range(20, parallelism=2)
+          .map_batches(Ident, compute="actors", concurrency=1)
+          .stats())
+    map_stage = [s for s in st["stages"] if "MapBatches" in s["name"]][0]
+    assert map_stage["num_blocks"] == 2
+    assert map_stage["task_exec_s"] > 0
