@@ -114,6 +114,12 @@ class Config:
     task_events_report_interval_s: float = 1.0
     task_events_max_buffer_size: int = 10_000
 
+    # --- workers ---
+    # Spawn workers by forking a preimported forkserver process instead
+    # of a cold interpreter per worker (core/forkserver.py). POSIX only;
+    # falls back to Popen on any error.
+    worker_forkserver: bool = True
+
     # --- logging ---
     log_dir: str = ""
 

@@ -1841,15 +1841,20 @@ class CoreWorker:
             return
 
         async def poll():
+            # Refresh FIRST: the common case is not a slow actor but a
+            # subscription race — the actor flipped ALIVE before this
+            # driver's pubsub subscription landed (prestarted workers
+            # make creation near-instant), and sleeping first taxed
+            # every first call to a fresh actor ~0.5 s.
             while (state.queue and state.state != "DEAD"
                    and not self._shutdown):
-                await asyncio.sleep(0.5)
-                if not state.queue:
-                    return
                 try:
                     await self._refresh_actor_info(state.actor_id)
                 except Exception:
                     pass  # head briefly unreachable; keep polling
+                if not state.queue:
+                    return
+                await asyncio.sleep(0.5)
 
         state.poller = asyncio.ensure_future(poll())
 

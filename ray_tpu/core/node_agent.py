@@ -59,6 +59,7 @@ class NodeAgent:
         self.port: Optional[int] = None
         self.head_conn: Optional[rpc.Connection] = None
         self._procs: Dict[str, subprocess.Popen] = {}
+        self._forkserver = None  # lazily started ForkserverClient
         self._exit = asyncio.Event()
         self._peer_conns: Dict[tuple, rpc.Connection] = {}
         self._puller = object_transfer.ObjectPuller(self._get_peer_conn)
@@ -167,12 +168,34 @@ class NodeAgent:
         log_path = os.path.join(self.session_dir, "logs",
                                 f"worker-{worker_id[:12]}.log")
         os.makedirs(os.path.dirname(log_path), exist_ok=True)
-        with open(log_path, "ab") as log_file:
-            proc = subprocess.Popen(
-                [sys.executable, "-m", "ray_tpu.core.worker_main"],
-                env=env, stdout=log_file, stderr=subprocess.STDOUT,
-                start_new_session=True,
-            )
+        proc = None
+        from ray_tpu.core.config import get_config
+
+        if os.name == "posix" and get_config().worker_forkserver:
+            try:
+                if self._forkserver is None:
+                    from ray_tpu.core.forkserver import ForkserverClient
+
+                    self._forkserver = ForkserverClient(
+                        self.session_dir, env)
+                # The spawn blocks on the forkserver socket; first call
+                # pays the preimport (~2.5 s), later ones are ms-scale.
+                # Run in a thread to keep the agent's event loop live.
+                import asyncio
+
+                proc = await asyncio.get_running_loop().run_in_executor(
+                    None, self._forkserver.spawn, env, log_path)
+            except Exception:
+                logger.warning("agent forkserver spawn failed; cold "
+                               "start", exc_info=True)
+                proc = None
+        if proc is None:
+            with open(log_path, "ab") as log_file:
+                proc = subprocess.Popen(
+                    [sys.executable, "-m", "ray_tpu.core.worker_main"],
+                    env=env, stdout=log_file, stderr=subprocess.STDOUT,
+                    start_new_session=True,
+                )
         self._procs[worker_id] = proc
         return {"ok": True, "pid": proc.pid}
 
@@ -315,6 +338,9 @@ class NodeAgent:
                 except Exception:
                     pass
         self._procs.clear()
+        if self._forkserver is not None:
+            self._forkserver.stop()
+            self._forkserver = None
         if self.arena is not None:
             native_store.set_attached_arena(None)
             self.arena.destroy()

@@ -55,7 +55,15 @@ def _parse_np(args) -> int:
     args = list(args or [])
     for flag in ("-n", "-np", "--np"):
         if flag in args:
-            return int(args[args.index(flag) + 1])
+            idx = args.index(flag)
+            if idx + 1 >= len(args):
+                raise exc.RuntimeEnvSetupError(
+                    f"mpi args {args!r}: {flag} needs a rank count")
+            try:
+                return int(args[idx + 1])
+            except ValueError:
+                raise exc.RuntimeEnvSetupError(
+                    f"mpi args {args!r}: {flag} value is not an int")
     return 1
 
 
@@ -90,11 +98,13 @@ def run_under_mpi(mpi_cfg: Dict[str, Any], fn, args, kwargs) -> Any:
             procs = _launch_simulated(_parse_np(mpi_args), child, env)
             deadline = time.monotonic() + mpi_cfg.get("timeout", 600)
             try:
-                rcs = [p.wait(timeout=max(0.1,
-                                          deadline - time.monotonic()))
-                       for p in procs]
+                # Rank 0 carries the result; ranks > 0 run worker_entry
+                # loops that commonly never return on their own (they
+                # serve collectives) — like mpirun tearing the job down
+                # when the program ends, the gang dies with rank 0.
+                rc0 = procs[0].wait(
+                    timeout=max(0.1, deadline - time.monotonic()))
             except subprocess.TimeoutExpired:
-                # Kill the whole gang — a hung rank must not orphan.
                 for p in procs:
                     if p.poll() is None:
                         p.kill()
@@ -102,7 +112,21 @@ def run_under_mpi(mpi_cfg: Dict[str, Any], fn, args, kwargs) -> Any:
                     p.wait(timeout=10)
                 raise exc.RayTpuError(
                     "MPI gang timed out; all ranks killed")
-            bad = [rc for rc in rcs if rc != 0]
+            # Grace for ranks that exit on their own, then tear down.
+            grace_until = time.monotonic() + min(
+                5.0, max(0.1, deadline - time.monotonic()))
+            for p in procs[1:]:
+                try:
+                    p.wait(timeout=max(
+                        0.1, grace_until - time.monotonic()))
+                except subprocess.TimeoutExpired:
+                    p.terminate()
+            for p in procs[1:]:
+                try:
+                    p.wait(timeout=10)
+                except subprocess.TimeoutExpired:
+                    p.kill()
+            bad = [rc0] if rc0 != 0 else []
         else:
             if shutil.which(launcher) is None:
                 raise exc.RuntimeEnvSetupError(

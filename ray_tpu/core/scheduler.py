@@ -135,6 +135,7 @@ class WorkerPool:
         # Workers spawned but not yet registered.
         self.starting: Dict[WorkerID, WorkerHandle] = {}
         self._procs: Dict[WorkerID, subprocess.Popen] = {}
+        self._forkserver = None  # lazily started ForkserverClient
         self.on_worker_exit = on_worker_exit
         # Remote-node hooks (set by the head): spawn_remote(node_id,
         # worker_id) -> bool returns True when the node's agent handles
@@ -143,7 +144,11 @@ class WorkerPool:
         self.kill_remote: Optional[Callable] = None
 
     def spawn(self, node_id: NodeID, env_overrides: Optional[dict] = None
-              ) -> WorkerHandle:
+              ) -> Optional[WorkerHandle]:
+        """Start a worker for node_id. Returns None when the spawn is
+        DEFERRED — the forkserver is still preimporting (~2.5 s) and a
+        cold Popen herd would be slower than waiting for it; the
+        scheduling pump recomputes the deficit and retries next tick."""
         worker_id = WorkerID.from_random()
         if self.spawn_remote is not None and self.spawn_remote(node_id,
                                                                worker_id):
@@ -184,20 +189,50 @@ class WorkerPool:
         log_path = os.path.join(self.session_dir, "logs",
                                 f"worker-{worker_id.hex()[:12]}.log")
         os.makedirs(os.path.dirname(log_path), exist_ok=True)
-        log_file = open(log_path, "ab")
-        proc = subprocess.Popen(
-            [sys.executable, "-m", "ray_tpu.core.worker_main"],
-            env=env,
-            stdout=log_file,
-            stderr=subprocess.STDOUT,
-            start_new_session=True,
-        )
-        log_file.close()
+        proc = self._spawn_proc(env, log_path)
+        if proc is None:
+            return None  # deferred until the forkserver is ready
         handle = WorkerHandle(worker_id=worker_id, node_id=node_id, pid=proc.pid)
         self.workers[worker_id] = handle
         self.starting[worker_id] = handle
         self._procs[worker_id] = proc
         return handle
+
+    def _spawn_proc(self, env: dict, log_path: str):
+        """Fork from the preimported forkserver when it's ready
+        (ms-scale spawn); cold Popen otherwise. The forkserver starts in
+        the background on first use — this method is called from the
+        head's async pump, which must never block on the forkserver's
+        ~2.5 s preimport, so early spawns pay the cold path instead."""
+        from ray_tpu.core.config import get_config
+
+        if os.name == "posix" and get_config().worker_forkserver:
+            try:
+                if self._forkserver is None:
+                    from ray_tpu.core.forkserver import ForkserverClient
+
+                    self._forkserver = ForkserverClient(
+                        self.session_dir, env)
+                    self._forkserver.start_async()
+                if self._forkserver.ready():
+                    return self._forkserver.spawn(env, log_path)
+                if not self._forkserver.failed():
+                    # Still preimporting: DEFER rather than cold-start a
+                    # herd — a cold worker takes as long as the
+                    # forkserver itself, and N of them serialize on one
+                    # core while one preimport serves all N forks.
+                    return None
+            except Exception:
+                logger.warning("forkserver spawn failed; falling back "
+                               "to cold worker start", exc_info=True)
+        with open(log_path, "ab") as log_file:
+            return subprocess.Popen(
+                [sys.executable, "-m", "ray_tpu.core.worker_main"],
+                env=env,
+                stdout=log_file,
+                stderr=subprocess.STDOUT,
+                start_new_session=True,
+            )
 
     def on_registered(self, worker_id: WorkerID, address: tuple, connection
                       ) -> Optional[WorkerHandle]:
@@ -276,6 +311,9 @@ class WorkerPool:
     def shutdown(self):
         for worker_id in list(self._procs):
             self.kill(worker_id)
+        if self._forkserver is not None:
+            self._forkserver.stop()
+            self._forkserver = None
 
 
 class ClusterScheduler:

@@ -470,6 +470,12 @@ class Executor:
 
                 _tracing.setup_tracing("ray_tpu.worker")
             mpi_cfg = (spec.runtime_env or {}).get("mpi")
+            if mpi_cfg and spec.task_type != TaskType.NORMAL_TASK:
+                # Actors hold their env for life and never re-gang;
+                # silently running un-ganged would betray code that
+                # assumes N ranks (PARITY.md: normal tasks only).
+                raise exc.RayTpuError(
+                    "mpi runtime env supports normal tasks only")
             if spec.task_type == TaskType.NORMAL_TASK:
                 fn = self._load_callable(spec)
                 if mpi_cfg:

@@ -175,11 +175,13 @@ class _ShuffleReducer:
         if (map_key, j) in seen:
             return 0
         seen.add((map_key, j))
-        frags = self.parts.setdefault((shuffle_id, j), [])
-        frags.append(part)
-        # Incremental merge keeps buffers at O(rows), not O(fragments).
-        if len(frags) >= 16:
-            self.parts[(shuffle_id, j)] = [concat_blocks(frags)]
+        # Keyed by map index, NOT arrival order: finish() concatenates
+        # in sorted map order so a seeded shuffle is deterministic
+        # across runs (map completion order is a race). Fragment count
+        # per partition is bounded by the map count, so the per-object
+        # overhead an eager merge would save is modest.
+        frags = self.parts.setdefault((shuffle_id, j), {})
+        frags[map_key] = part
         return len(frags)
 
     def accept_many(self, shuffle_id: str, map_key,
@@ -198,7 +200,8 @@ class _ShuffleReducer:
         set dropped — popping it on the first finish would let a
         straggler duplicate push double-count rows in partitions this
         reducer still owns."""
-        out = concat_blocks(self.parts.pop((shuffle_id, j), []))
+        frag_map = self.parts.pop((shuffle_id, j), {})
+        out = concat_blocks([frag_map[k] for k in sorted(frag_map)])
         if last:
             self.parts.pop((shuffle_id, "seen"), None)
             if shuffle_id not in self.done_set:

@@ -10,42 +10,42 @@ import ray_tpu
 class ActorPool:
     def __init__(self, actors: List[Any]):
         self._idle = list(actors)
-        self._future_to_actor = {}
-        self._index_to_future = {}
-        self._next_task_index = 0
-        self._next_return_index = 0
-        self._pending_submits: List[tuple] = []
+        self._inflight = {}
+        self._result_futures = {}
+        self._submit_seq = 0
+        self._yield_seq = 0
+        self._backlog: List[tuple] = []
 
     def submit(self, fn: Callable[[Any, Any], Any], value: Any) -> None:
         if self._idle:
             actor = self._idle.pop()
             future = fn(actor, value)
-            self._future_to_actor[future] = (self._next_task_index, actor)
-            self._index_to_future[self._next_task_index] = future
-            self._next_task_index += 1
+            self._inflight[future] = (self._submit_seq, actor)
+            self._result_futures[self._submit_seq] = future
+            self._submit_seq += 1
         else:
-            self._pending_submits.append((fn, value))
+            self._backlog.append((fn, value))
 
     def has_next(self) -> bool:
-        return bool(self._index_to_future) or bool(self._pending_submits)
+        return bool(self._result_futures) or bool(self._backlog)
 
     def get_next(self, timeout=None):
         """Next result in submission order."""
-        if self._next_return_index >= self._next_task_index \
-                and not self._pending_submits:
+        if self._yield_seq >= self._submit_seq \
+                and not self._backlog:
             raise StopIteration("no pending results")
-        while self._next_return_index not in self._index_to_future:
-            if not self._pending_submits:
+        while self._yield_seq not in self._result_futures:
+            if not self._backlog:
                 raise StopIteration("no pending results")
             self._drain_one()
-        future = self._index_to_future[self._next_return_index]
+        future = self._result_futures[self._yield_seq]
         # Wait BEFORE mutating any pool state: a timeout must leave the
         # result fetchable and the actor accounted for.
         ready, _ = ray_tpu.wait([future], num_returns=1, timeout=timeout)
         if not ready:
             raise TimeoutError("timed out waiting for result")
-        del self._index_to_future[self._next_return_index]
-        self._next_return_index += 1
+        del self._result_futures[self._yield_seq]
+        self._yield_seq += 1
         value = ray_tpu.get(future)
         self._return_actor(future)
         return value
@@ -54,15 +54,15 @@ class ActorPool:
         """Any completed result."""
         if not self.has_next():
             raise StopIteration("no pending results")
-        if not self._future_to_actor and self._pending_submits:
+        if not self._inflight and self._backlog:
             self._drain_one()
-        ready, _ = ray_tpu.wait(list(self._future_to_actor),
+        ready, _ = ray_tpu.wait(list(self._inflight),
                                 num_returns=1, timeout=timeout)
         if not ready:
             raise TimeoutError("timed out waiting for result")
         future = ready[0]
-        index, _ = self._future_to_actor[future]
-        del self._index_to_future[index]
+        index, _ = self._inflight[future]
+        del self._result_futures[index]
         value = ray_tpu.get(future)
         self._return_actor(future)
         return value
@@ -70,19 +70,19 @@ class ActorPool:
     def _drain_one(self):
         # No idle actors by definition here; wait for any completion and
         # free that actor for the pending-submit queue (the completed
-        # result stays fetchable in _index_to_future).
-        ready, _ = ray_tpu.wait(list(self._future_to_actor),
+        # result stays fetchable in _result_futures).
+        ready, _ = ray_tpu.wait(list(self._inflight),
                                 num_returns=1, timeout=None)
         self._return_actor(ready[0])
 
     def _return_actor(self, future):
-        entry = self._future_to_actor.pop(future, None)
+        entry = self._inflight.pop(future, None)
         if entry is None:
             return
         _, actor = entry
         self._idle.append(actor)
-        if self._pending_submits:
-            fn, value = self._pending_submits.pop(0)
+        if self._backlog:
+            fn, value = self._backlog.pop(0)
             self.submit(fn, value)
 
     def map(self, fn: Callable[[Any, Any], Any], values: Iterable[Any]):

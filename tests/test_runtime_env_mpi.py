@@ -90,3 +90,24 @@ def test_parse_np():
     assert _parse_np(["-n", "4"]) == 4
     assert _parse_np(["-np", "8", "--oversubscribe"]) == 8
     assert _parse_np([]) == 1
+
+
+def test_mpi_rejected_on_actors(mpi_cluster):
+    @ray_tpu.remote
+    class A:
+        def ping(self):
+            return 1
+
+    a = A.options(runtime_env={
+        "mpi": {"args": ["-n", "2"], "launcher": "simulated"}}).remote()
+    with pytest.raises(Exception, match="normal tasks only"):
+        ray_tpu.get(a.ping.remote(), timeout=60)
+
+
+def test_parse_np_errors():
+    from ray_tpu.core.runtime_env_mpi import _parse_np
+
+    with pytest.raises(Exception, match="rank count"):
+        _parse_np(["-n"])
+    with pytest.raises(Exception, match="not an int"):
+        _parse_np(["-np", "four"])
