@@ -923,6 +923,7 @@ class HeadService:
             "max_restarts": info.max_restarts,
             "death_cause": info.death_cause,
             "job_id": info.job_id.hex(),
+            "node_id": info.node_id.hex() if info.node_id else None,
         }
 
     async def h_get_actor_info(self, conn, payload):

@@ -56,6 +56,19 @@ class ReplicaConfig:
     num_cpus: float = 1.0
     num_tpus: float = 0.0
     resources: Optional[Dict[str, float]] = None
+    # Replica placement (reference: deployment_scheduler.py): SPREAD
+    # (default — replicas across nodes), PACK (consolidate), DEFAULT
+    # (cluster scheduler's choice); cap per node optional.
+    placement_strategy: str = "SPREAD"
+    max_replicas_per_node: Optional[int] = None
+
+    def __post_init__(self):
+        from ray_tpu.serve.scheduler import DeploymentScheduler
+
+        # Invalid policy/cap fails at construction (deploy time), not
+        # at reconcile time inside the controller.
+        DeploymentScheduler(self.placement_strategy,
+                            self.max_replicas_per_node)
 
     def actor_options(self) -> Dict[str, Any]:
         out: Dict[str, Any] = {"num_cpus": self.num_cpus}

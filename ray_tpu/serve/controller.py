@@ -34,6 +34,12 @@ class DeploymentState:
         self.replicas: Dict[str, Any] = {}  # replica_id -> actor handle
         self.replica_started: Dict[str, float] = {}
         self.replica_ready: set = set()
+        # replica name -> node_id hex (resolved lazily from the actor
+        # table; feeds the deployment scheduler's per-node counts).
+        self.replica_node: Dict[str, str] = {}
+        # Names whose entry is the scheduler's INTENDED node, not yet
+        # confirmed from the actor table (soft affinity can spill).
+        self.replica_node_provisional: set = set()
         self.health_fail_counts: Dict[str, int] = {}
         self.pending_requests = 0  # reported by routers on empty table
         self._last_health_check = 0.0
@@ -302,12 +308,43 @@ class ServeController:
 
         changed = False
         for key, ds in list(self.deployments.items()):
+            first_placement = True
             while len(ds.replicas) < ds.target_replicas:
                 rid = f"{key}#g{ds.generation}#{ds._counter}"
                 ds._counter += 1
                 from ray_tpu.serve.replica import Replica
 
-                opts = dict(ds.spec["replica_config"].actor_options())
+                rc = ds.spec["replica_config"]
+                # Deployment scheduler: pick the replica's node (SPREAD/
+                # PACK/cap). Blocking actor-table lookups, so off-loop;
+                # unknown placements resolve once per deployment per
+                # tick (later creations reuse provisional entries — a
+                # per-creation resolve would be O(replicas^2) RPCs).
+                decision = await asyncio.get_event_loop().run_in_executor(
+                    None, self._place_replica, ds, rc, first_placement)
+                first_placement = False
+                if not decision.eligible:
+                    # Every node is at max_replicas_per_node: stay under
+                    # target until capacity appears (next reconcile).
+                    ds._counter -= 1
+                    break
+                opts = dict(rc.actor_options())
+                if decision.node_id is not None:
+                    from ray_tpu.core.task_spec import (
+                        NodeAffinitySchedulingStrategy,
+                    )
+
+                    # With a max_replicas_per_node cap the affinity is
+                    # HARD — soft spillover would silently break the
+                    # cap contract on whatever node it lands on (the
+                    # replica waits for node capacity instead).
+                    # Without a cap, soft: if the node fills between
+                    # decision and placement, the cluster scheduler may
+                    # still place it elsewhere.
+                    opts["scheduling_strategy"] = (
+                        NodeAffinitySchedulingStrategy(
+                            decision.node_id,
+                            soft=rc.max_replicas_per_node is None))
                 opts["name"] = f"SERVE_REPLICA::{rid}"
                 opts["lifetime"] = "detached"
                 # Adoption on controller restart: a replica that
@@ -334,16 +371,91 @@ class ServeController:
                 name = f"SERVE_REPLICA::{rid}"
                 ds.replicas[name] = actor
                 ds.replica_started[name] = time.time()
+                if decision.node_id is not None:
+                    # Provisional: a still-PENDING replica has no actor-
+                    # table placement yet, and without this the next
+                    # loop iteration would count it as "nowhere" and
+                    # stack every new replica on the same node. Soft
+                    # affinity makes this the actual node in all but
+                    # full-node spillover; the resolver replaces it with
+                    # the confirmed node once the replica is placed.
+                    ds.replica_node[name] = decision.node_id
+                    ds.replica_node_provisional.add(name)
                 changed = True
             while len(ds.replicas) > ds.target_replicas:
                 name, actor = sorted(ds.replicas.items())[-1]
                 del ds.replicas[name]
                 ds.replica_started.pop(name, None)
+                ds.replica_node.pop(name, None)
+                ds.replica_node_provisional.discard(name)
                 ds.replica_ready.discard(name)
                 asyncio.ensure_future(self._graceful_stop(actor, ds))
                 changed = True
         if changed:
             self.routing_version += 1
+
+    async def get_replica_nodes(self, deployment_key: str
+                                   ) -> Dict[str, Optional[str]]:
+        """Replica name -> node id (resolving unknowns), for tests and
+        the status surface."""
+        ds = self.deployments.get(deployment_key)
+        if ds is None:
+            return {}
+        await asyncio.get_event_loop().run_in_executor(
+            None, self._resolve_replica_nodes, ds)
+        return {name: ds.replica_node.get(name) for name in ds.replicas}
+
+    def _resolve_replica_nodes(self, ds: DeploymentState) -> None:
+        """Blocking actor-table lookups for replicas whose node is
+        unknown (executor thread only)."""
+        from ray_tpu import api as _api
+
+        cw = _api._require_worker()
+        for name, actor in list(ds.replicas.items()):
+            if (name in ds.replica_node
+                    and name not in ds.replica_node_provisional):
+                continue
+            try:
+                reply = cw.loop_thread.run(cw.head.call(
+                    "get_actor_info",
+                    {"actor_id": actor._actor_id.hex()}), timeout=10)
+            except Exception:
+                continue
+            node = reply.get("node_id") if reply.get("found") else None
+            if node:
+                ds.replica_node[name] = node
+                ds.replica_node_provisional.discard(name)
+
+    def _place_replica(self, ds: DeploymentState, rc,
+                       resolve: bool = True):
+        """Runs in an executor thread (blocking head calls). Resolves
+        unknown replica nodes from the actor table, then delegates to
+        the DeploymentScheduler (serve/scheduler.py)."""
+        import ray_tpu
+        from ray_tpu.serve.scheduler import (
+            DeploymentScheduler,
+            PlacementDecision,
+        )
+
+        sched = DeploymentScheduler(rc.placement_strategy,
+                                    rc.max_replicas_per_node)
+        try:
+            nodes = [n["node_id"] for n in ray_tpu.nodes()
+                     if n.get("state", "ALIVE") == "ALIVE"]
+        except Exception:
+            nodes = []
+        if (not nodes) or (len(nodes) == 1 and sched.cap is None):
+            # Single-node (or unknown) cluster with no cap: nothing to
+            # decide; skip the actor-table lookups.
+            return PlacementDecision(None, True)
+        if resolve:
+            self._resolve_replica_nodes(ds)
+        counts: Dict[str, int] = {}
+        for name in ds.replicas:
+            node = ds.replica_node.get(name)
+            if node:
+                counts[node] = counts.get(node, 0) + 1
+        return sched.choose_node(nodes, counts)
 
     async def _graceful_stop(self, actor, ds: DeploymentState):
         try:
@@ -358,6 +470,8 @@ class ServeController:
         for name, actor in list(ds.replicas.items()):
             asyncio.ensure_future(self._graceful_stop(actor, ds))
         ds.replicas.clear()
+        ds.replica_node.clear()
+        ds.replica_node_provisional.clear()
         self.routing_version += 1
 
     async def report_pending_request(self, deployment_key: str) -> None:
@@ -463,6 +577,8 @@ class ServeController:
             logger.warning("replica %s unhealthy; replacing", name)
             del ds.replicas[name]
             ds.replica_started.pop(name, None)
+            ds.replica_node.pop(name, None)
+            ds.replica_node_provisional.discard(name)
             ds.replica_ready.discard(name)
             ds.health_fail_counts.pop(name, None)
             await _kill_async(actor)

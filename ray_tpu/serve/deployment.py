@@ -61,6 +61,8 @@ class Deployment:
                 num_cpus: Optional[float] = None,
                 num_tpus: Optional[float] = None,
                 resources: Optional[Dict[str, float]] = None,
+                placement_strategy: Optional[str] = None,
+                max_replicas_per_node: Optional[int] = None,
                 route_prefix: Optional[str] = None) -> "Deployment":
         cfg = DeploymentConfig(
             num_replicas=(num_replicas if num_replicas is not None
@@ -80,6 +82,13 @@ class Deployment:
                       else self.replica_config.num_tpus),
             resources=(resources if resources is not None
                        else self.replica_config.resources),
+            placement_strategy=(
+                placement_strategy if placement_strategy is not None
+                else self.replica_config.placement_strategy),
+            max_replicas_per_node=(
+                max_replicas_per_node
+                if max_replicas_per_node is not None
+                else self.replica_config.max_replicas_per_node),
         )
         return Deployment(
             self.func_or_class,
@@ -103,6 +112,8 @@ def deployment(func_or_class=None, *, name: Optional[str] = None,
                autoscaling_config=None,
                num_cpus: float = 1.0, num_tpus: float = 0.0,
                resources: Optional[Dict[str, float]] = None,
+               placement_strategy: str = "SPREAD",
+               max_replicas_per_node: Optional[int] = None,
                route_prefix: Optional[str] = None):
     """@serve.deployment decorator (reference: serve/api.py:deployment)."""
 
@@ -118,7 +129,9 @@ def deployment(func_or_class=None, *, name: Optional[str] = None,
                     autoscaling_config, None),
             ),
             ReplicaConfig(num_cpus=num_cpus, num_tpus=num_tpus,
-                          resources=resources),
+                          resources=resources,
+                          placement_strategy=placement_strategy,
+                          max_replicas_per_node=max_replicas_per_node),
             route_prefix,
         )
 

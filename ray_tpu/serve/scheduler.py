@@ -1,0 +1,69 @@
+"""Deployment scheduler: where replicas go.
+
+Reference: serve/_private/deployment_scheduler.py — replica scheduling
+requests resolved against the cluster (SPREAD by default, compact/PACK
+for consolidation) with a ``max_replicas_per_node`` cap. Here the
+controller consults ``DeploymentScheduler.choose_node`` before every
+replica creation: the choice is pinned with a soft NodeAffinity so the
+cluster scheduler still has an escape hatch if the node fills between
+decision and placement; ``None`` with eligible=False means "no node can
+take a replica right now" and the controller leaves the deployment
+under target until the next reconcile tick.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+from typing import Dict, List, Optional
+
+SPREAD = "SPREAD"
+PACK = "PACK"
+DEFAULT = "DEFAULT"
+
+_POLICIES = (SPREAD, PACK, DEFAULT)
+
+
+@dataclass
+class PlacementDecision:
+    node_id: Optional[str]   # None = let the cluster scheduler pick
+    eligible: bool           # False = no node may take a replica now
+
+
+class DeploymentScheduler:
+    def __init__(self, policy: str = SPREAD,
+                 max_replicas_per_node: Optional[int] = None):
+        if policy not in _POLICIES:
+            raise ValueError(
+                f"placement_strategy must be one of {_POLICIES}, "
+                f"got {policy!r}")
+        if max_replicas_per_node is not None and max_replicas_per_node < 1:
+            raise ValueError("max_replicas_per_node must be >= 1")
+        self.policy = policy
+        self.cap = max_replicas_per_node
+
+    def choose_node(self, alive_node_ids: List[str],
+                    replicas_per_node: Dict[str, int]
+                    ) -> PlacementDecision:
+        """Pick a node for one new replica.
+
+        replicas_per_node counts THIS deployment's replicas whose node
+        is known; replicas with unknown placement are conservatively
+        ignored (they resolve within a reconcile tick or two).
+        """
+        if not alive_node_ids:
+            return PlacementDecision(None, True)
+        counts = {n: replicas_per_node.get(n, 0) for n in alive_node_ids}
+        eligible = (alive_node_ids if self.cap is None
+                    else [n for n in alive_node_ids
+                          if counts[n] < self.cap])
+        if not eligible:
+            return PlacementDecision(None, False)
+        if self.policy == DEFAULT and self.cap is None:
+            return PlacementDecision(None, True)
+        if self.policy == PACK:
+            # Fill the busiest eligible node first (consolidation);
+            # node-id tie-break keeps decisions deterministic.
+            chosen = max(eligible, key=lambda n: (counts[n], n))
+        else:  # SPREAD (and capped DEFAULT behaves like SPREAD)
+            chosen = min(eligible, key=lambda n: (counts[n], n))
+        return PlacementDecision(chosen, True)

@@ -358,3 +358,104 @@ def test_delete_then_immediate_redeploy(serve_cluster):
     h2 = serve.run(V.bind(), name="gen_app", proxy=False)
     assert h2.remote(2).result() == "v2:2"
     serve.delete("gen_app")
+
+
+# -- deployment scheduler (replica placement) --------------------------------
+
+
+def test_deployment_scheduler_policies():
+    from ray_tpu.serve.scheduler import DeploymentScheduler
+
+    nodes = ["a", "b", "c"]
+    # SPREAD: least-loaded first, deterministic tie-break.
+    d = DeploymentScheduler("SPREAD").choose_node(
+        nodes, {"a": 2, "b": 1, "c": 1})
+    assert d.node_id == "b" and d.eligible
+    # PACK: busiest first.
+    d = DeploymentScheduler("PACK").choose_node(
+        nodes, {"a": 2, "b": 1})
+    assert d.node_id == "a"
+    # Cap filters nodes; all-full -> ineligible.
+    d = DeploymentScheduler("SPREAD", max_replicas_per_node=2).choose_node(
+        nodes, {"a": 2, "b": 2, "c": 1})
+    assert d.node_id == "c"
+    d = DeploymentScheduler("SPREAD", max_replicas_per_node=1).choose_node(
+        ["a"], {"a": 1})
+    assert not d.eligible
+    # DEFAULT without cap defers to the cluster scheduler.
+    d = DeploymentScheduler("DEFAULT").choose_node(nodes, {})
+    assert d.node_id is None and d.eligible
+    with pytest.raises(ValueError):
+        DeploymentScheduler("DIAGONAL")
+    with pytest.raises(ValueError):
+        DeploymentScheduler("SPREAD", max_replicas_per_node=0)
+
+
+def test_replicas_spread_across_nodes(serve_cluster):
+    from ray_tpu import api
+
+    # Two extra virtual nodes (reference: cluster_utils fake nodes).
+    api._global_node.add_node({"CPU": 4.0})
+    api._global_node.add_node({"CPU": 4.0})
+
+    @serve.deployment(num_replicas=4, num_cpus=0.1)
+    class Where:
+        def __call__(self, _):
+            return "ok"
+
+    serve.run(Where.bind(), name="spread_app")
+    # Inspect controller-side placement state.
+    ctrl = ray_tpu.get_actor("SERVE_CONTROLLER")
+    deadline = time.time() + 60
+    per_node = {}
+    while time.time() < deadline:
+        snap = ray_tpu.get(ctrl.get_routing_snapshot.remote(), timeout=30)
+        key = "spread_app#Where"
+        row = snap["table"].get(key, {})
+        if len(row.get("replica_names", [])) >= 4:
+            reply = ray_tpu.get(
+                ctrl.get_replica_nodes.remote(key), timeout=30)
+            if len(reply) == 4 and all(reply.values()):
+                per_node = {}
+                for node in reply.values():
+                    per_node[node] = per_node.get(node, 0) + 1
+                break
+        time.sleep(0.5)
+    assert per_node, "replicas never resolved their nodes"
+    # 4 replicas over 3 nodes, SPREAD: max 2 on any one node.
+    assert max(per_node.values()) <= 2, per_node
+    assert len(per_node) >= 2, per_node
+    serve.delete("spread_app")
+
+
+def test_max_replicas_per_node_caps(serve_cluster):
+    from ray_tpu import api
+
+    # Self-sufficient: ensure >= 3 schedulable nodes regardless of what
+    # other tests in this module did to the shared cluster.
+    while len(ray_tpu.nodes()) < 3:
+        api._global_node.add_node({"CPU": 4.0})
+
+    @serve.deployment(num_replicas=3, num_cpus=0.1,
+                      max_replicas_per_node=1)
+    class Capped:
+        def __call__(self, _):
+            return "ok"
+
+    serve.run(Capped.bind(), name="capped_app")
+    ctrl = ray_tpu.get_actor("SERVE_CONTROLLER")
+    key = "capped_app#Capped"
+    deadline = time.time() + 60
+    reply = {}
+    while time.time() < deadline:
+        reply = ray_tpu.get(
+            ctrl.get_replica_nodes.remote(key), timeout=30)
+        if len(reply) == 3 and all(reply.values()):
+            break
+        time.sleep(0.5)
+    assert len(reply) == 3 and all(reply.values()), reply
+    per_node = {}
+    for node in reply.values():
+        per_node[node] = per_node.get(node, 0) + 1
+    assert max(per_node.values()) == 1, per_node
+    serve.delete("capped_app")
