@@ -443,6 +443,10 @@ class ServeController:
             nodes = [n["node_id"] for n in ray_tpu.nodes()
                      if n.get("state", "ALIVE") == "ALIVE"]
         except Exception:
+            if sched.cap is not None:
+                # With a cap, creating blind could silently overload a
+                # node past its contract; wait for the next tick.
+                return PlacementDecision(None, False)
             nodes = []
         if (not nodes) or (len(nodes) == 1 and sched.cap is None):
             # Single-node (or unknown) cluster with no cap: nothing to

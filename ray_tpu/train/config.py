@@ -80,6 +80,10 @@ class RunConfig:
     # Tune stop criteria: {"metric": threshold, "training_iteration": N}
     # or a callable (trial_id, result) -> bool (reference: RunConfig.stop).
     stop: Optional[object] = None
+    # Tune experiment callbacks (reference: air RunConfig.callbacks —
+    # tune/callback.py Callback subclasses, incl. the CSV/JSON/TBX
+    # logger callbacks).
+    callbacks: Optional[list] = None
 
     def resolved_storage_path(self) -> str:
         return os.path.expanduser(

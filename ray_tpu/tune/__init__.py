@@ -17,7 +17,14 @@ from ray_tpu.tune.search import (
     sample_from,
     uniform,
 )
+from ray_tpu.tune.callback import (
+    Callback,
+    CSVLoggerCallback,
+    JsonLoggerCallback,
+    TBXLoggerCallback,
+)
 from ray_tpu.tune.schedulers import (
+    PB2,
     AsyncHyperBandScheduler,
     FIFOScheduler,
     HyperBandScheduler,
@@ -39,12 +46,17 @@ __all__ = [
     "ASHAScheduler",
     "AsyncHyperBandScheduler",
     "BasicVariantGenerator",
+    "CSVLoggerCallback",
+    "Callback",
     "ConcurrencyLimiter",
     "FIFOScheduler",
     "HyperBandScheduler",
+    "JsonLoggerCallback",
     "MedianStoppingRule",
+    "PB2",
     "PopulationBasedTraining",
     "ResultGrid",
+    "TBXLoggerCallback",
     "SearchGenerator",
     "Searcher",
     "Trainable",

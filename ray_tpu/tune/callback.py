@@ -1,0 +1,217 @@
+"""Experiment callbacks + logger callbacks.
+
+Reference: python/ray/tune/callback.py (Callback hook points invoked by
+the trial controller) and tune/logger/ — csv.py (CSVLoggerCallback,
+per-trial progress.csv), json.py (JsonLoggerCallback, result.json lines
++ params.json), tensorboardx.py (TBXLoggerCallback, gated on the
+optional tensorboardX dependency). W&B/MLflow integrations are declared
+out in PARITY.md (external services).
+
+Callbacks are driver-side: they run inside the TuneController loop, so
+they see every result in order and must stay cheap.
+"""
+
+from __future__ import annotations
+
+import csv
+import json
+import logging
+import os
+from typing import Any, Dict, List, Optional
+
+logger = logging.getLogger(__name__)
+
+
+class Callback:
+    """Hook points (reference: tune/callback.py:Callback)."""
+
+    def setup(self, experiment_dir: str) -> None:
+        pass
+
+    def on_trial_start(self, iteration: int, trials: List, trial) -> None:
+        pass
+
+    def on_trial_result(self, iteration: int, trials: List, trial,
+                        result: Dict[str, Any]) -> None:
+        pass
+
+    def on_trial_complete(self, iteration: int, trials: List,
+                          trial) -> None:
+        pass
+
+    def on_trial_error(self, iteration: int, trials: List, trial) -> None:
+        pass
+
+    def on_checkpoint(self, iteration: int, trials: List, trial,
+                      checkpoint_path: str) -> None:
+        pass
+
+    def on_experiment_end(self, trials: List) -> None:
+        pass
+
+
+class _PerTrialFileCallback(Callback):
+    """Shared plumbing: lazily opened per-trial files under the trial
+    dir, closed at trial end/experiment end."""
+
+    def __init__(self):
+        self._files: Dict[str, Any] = {}
+
+    def _open(self, trial, filename: str, mode: str = "a"):
+        f = self._files.get(trial.trial_id)
+        if f is None:
+            os.makedirs(trial.trial_dir, exist_ok=True)
+            f = open(os.path.join(trial.trial_dir, filename), mode)
+            self._files[trial.trial_id] = f
+        return f
+
+    def _close(self, trial) -> None:
+        f = self._files.pop(trial.trial_id, None)
+        if f is not None:
+            f.close()
+
+    def on_trial_complete(self, iteration, trials, trial):
+        self._close(trial)
+
+    def on_trial_error(self, iteration, trials, trial):
+        self._close(trial)
+
+    def on_experiment_end(self, trials):
+        for f in self._files.values():
+            f.close()
+        self._files.clear()
+
+
+def _scalar_items(result: Dict[str, Any]):
+    for k, v in result.items():
+        if isinstance(v, (int, float, bool, str)) or v is None:
+            yield k, v
+
+
+class CSVLoggerCallback(_PerTrialFileCallback):
+    """progress.csv per trial (reference: tune/logger/csv.py). The
+    header is fixed by the first result; later keys are dropped (same
+    contract as the reference's CSV logger)."""
+
+    def __init__(self):
+        super().__init__()
+        self._writers: Dict[str, csv.DictWriter] = {}
+
+    def on_trial_result(self, iteration, trials, trial, result):
+        f = self._open(trial, "progress.csv")
+        w = self._writers.get(trial.trial_id)
+        row = dict(_scalar_items(result))
+        if w is None:
+            existing = None
+            if f.tell() > 0:
+                # Resumed experiment appending to a prior run's file:
+                # reuse its header instead of writing a second one
+                # mid-file.
+                with open(f.name) as rf:
+                    existing = next(csv.reader(rf), None)
+            w = csv.DictWriter(f, fieldnames=existing or list(row),
+                               extrasaction="ignore")
+            if existing is None:
+                w.writeheader()
+            self._writers[trial.trial_id] = w
+        w.writerow(row)
+        f.flush()
+
+    def on_trial_complete(self, iteration, trials, trial):
+        self._writers.pop(trial.trial_id, None)
+        super().on_trial_complete(iteration, trials, trial)
+
+    def on_trial_error(self, iteration, trials, trial):
+        self._writers.pop(trial.trial_id, None)
+        super().on_trial_error(iteration, trials, trial)
+
+
+class JsonLoggerCallback(_PerTrialFileCallback):
+    """result.json (one JSON object per line) + params.json with the
+    trial config (reference: tune/logger/json.py)."""
+
+    def on_trial_start(self, iteration, trials, trial):
+        os.makedirs(trial.trial_dir, exist_ok=True)
+        params = {k: v for k, v in trial.config.items()
+                  if isinstance(v, (int, float, bool, str, list, dict))
+                  or v is None}
+        with open(os.path.join(trial.trial_dir, "params.json"),
+                  "w") as f:
+            json.dump(params, f, indent=1)
+
+    def on_trial_result(self, iteration, trials, trial, result):
+        f = self._open(trial, "result.json")
+        f.write(json.dumps(dict(_scalar_items(result))) + "\n")
+        f.flush()
+
+
+class TBXLoggerCallback(Callback):
+    """TensorBoard scalars via tensorboardX when installed (reference:
+    tune/logger/tensorboardx.py); a no-op with a one-time warning
+    otherwise — the dependency is optional and absent from slim
+    images."""
+
+    def __init__(self):
+        self._writers: Dict[str, Any] = {}
+        self._available: Optional[bool] = None
+
+    def _writer(self, trial):
+        if self._available is None:
+            try:
+                import tensorboardX  # noqa: F401
+
+                self._available = True
+            except ImportError:
+                self._available = False
+                logger.warning(
+                    "tensorboardX is not installed; TBXLoggerCallback "
+                    "is a no-op")
+        if not self._available:
+            return None
+        w = self._writers.get(trial.trial_id)
+        if w is None:
+            from tensorboardX import SummaryWriter
+
+            w = SummaryWriter(logdir=trial.trial_dir)
+            self._writers[trial.trial_id] = w
+        return w
+
+    def on_trial_result(self, iteration, trials, trial, result):
+        w = self._writer(trial)
+        if w is None:
+            return
+        step = result.get("training_iteration", iteration)
+        for k, v in result.items():
+            if isinstance(v, (int, float)) and not isinstance(v, bool):
+                w.add_scalar(k, v, global_step=step)
+
+    def on_trial_complete(self, iteration, trials, trial):
+        w = self._writers.pop(trial.trial_id, None)
+        if w is not None:
+            w.close()
+
+    on_trial_error = on_trial_complete
+
+    def on_experiment_end(self, trials):
+        for w in self._writers.values():
+            w.close()
+        self._writers.clear()
+
+
+class CallbackList:
+    """Fans controller events out to callbacks; one failing callback
+    logs and never breaks the experiment."""
+
+    def __init__(self, callbacks: Optional[List[Callback]]):
+        self.callbacks = list(callbacks or [])
+
+    def __bool__(self):
+        return bool(self.callbacks)
+
+    def fire(self, hook: str, *args) -> None:
+        for cb in self.callbacks:
+            try:
+                getattr(cb, hook)(*args)
+            except Exception:
+                logger.exception("tune callback %s.%s failed",
+                                 type(cb).__name__, hook)

@@ -318,3 +318,110 @@ class PopulationBasedTraining(TrialScheduler):
 
     def on_trial_complete(self, trial, result):
         self._latest.pop(trial.trial_id, None)
+
+
+class PB2(PopulationBasedTraining):
+    """Population Based Bandits (reference: schedulers/pb2.py,
+    Parker-Holder et al., NeurIPS 2020). PBT's exploit step, but the
+    new hyperparameters come from a GP-bandit over the continuous
+    hyperparameter box instead of random multiply-by-1.2/0.8: every
+    perturbation window contributes an observation (normalized config →
+    score improvement), a numpy RBF-kernel GP fits them (no GPy
+    dependency — the posterior is a dense solve over at most
+    ``max_observations`` points), and a UCB acquisition over sampled
+    candidates picks where to go next. Falls back to uniform sampling
+    until enough observations exist.
+    """
+
+    def __init__(self, *, hyperparam_bounds: Dict[str, tuple],
+                 time_attr: str = "training_iteration",
+                 perturbation_interval: int = 5,
+                 quantile_fraction: float = 0.25,
+                 ucb_kappa: float = 1.5,
+                 max_observations: int = 128,
+                 seed: Optional[int] = None):
+        super().__init__(
+            time_attr=time_attr,
+            perturbation_interval=perturbation_interval,
+            hyperparam_mutations={k: list(v)
+                                  for k, v in hyperparam_bounds.items()},
+            quantile_fraction=quantile_fraction, seed=seed)
+        self.bounds = {k: (float(lo), float(hi))
+                       for k, (lo, hi) in hyperparam_bounds.items()}
+        self.kappa = ucb_kappa
+        self.max_obs = max_observations
+        # Observations: (normalized config vector, score delta over one
+        # perturbation window).
+        self._obs_x: List[List[float]] = []
+        self._obs_y: List[float] = []
+        self._score_at_obs: Dict[str, float] = {}
+        self._obs_time: Dict[str, float] = {}
+
+    def _normalize(self, config: dict) -> List[float]:
+        out = []
+        for k, (lo, hi) in self.bounds.items():
+            v = float(config.get(k, lo))
+            out.append((v - lo) / (hi - lo) if hi > lo else 0.0)
+        return out
+
+    def _denormalize(self, x) -> dict:
+        return {k: lo + float(xi) * (hi - lo)
+                for (k, (lo, hi)), xi in zip(self.bounds.items(), x)}
+
+    def on_result(self, trial, result):
+        t = result.get(self.time_attr, 0)
+        decision = super().on_result(trial, result)
+        s = self.score(result)
+        tid = trial.trial_id
+        if isinstance(decision, ExploitDirective):
+            # The trial is about to adopt another trial's checkpoint:
+            # its next score is the SOURCE's, and crediting that jump
+            # to the GP-chosen config would flood the posterior with
+            # spurious improvements. Re-baseline at the next result.
+            self._score_at_obs.pop(tid, None)
+            self._obs_time.pop(tid, None)
+        elif tid not in self._score_at_obs:
+            self._score_at_obs[tid] = s
+            self._obs_time[tid] = t
+        elif t - self._obs_time[tid] >= self.interval:
+            self._obs_x.append(self._normalize(trial.config))
+            self._obs_y.append(s - self._score_at_obs[tid])
+            self._score_at_obs[tid] = s
+            self._obs_time[tid] = t
+            if len(self._obs_y) > self.max_obs:
+                self._obs_x.pop(0)
+                self._obs_y.pop(0)
+        return decision
+
+    def _perturb(self, config: dict) -> dict:
+        import numpy as np
+
+        new = dict(config)
+        if len(self._obs_y) < 4:
+            # Cold start: uniform exploration of the box.
+            for k, (lo, hi) in self.bounds.items():
+                new[k] = lo + self.rng.random() * (hi - lo)
+            return new
+        X = np.asarray(self._obs_x)
+        y = np.asarray(self._obs_y)
+        y_std = y.std() or 1.0
+        y_n = (y - y.mean()) / y_std
+        length, jitter = 0.3, 1e-4
+        d2 = ((X[:, None, :] - X[None, :, :]) ** 2).sum(-1)
+        K = np.exp(-d2 / (2 * length ** 2))
+        K_inv = np.linalg.inv(K + jitter * np.eye(len(X)))
+        # Candidates: random box samples + jittered current config.
+        rng = np.random.default_rng(self.rng.randrange(1 << 30))
+        cand = rng.random((128, len(self.bounds)))
+        cur = np.asarray(self._normalize(config))
+        cand[:16] = np.clip(cur + rng.normal(0, 0.1,
+                                             (16, len(cur))), 0, 1)
+        d2c = ((cand[:, None, :] - X[None, :, :]) ** 2).sum(-1)
+        Kc = np.exp(-d2c / (2 * length ** 2))
+        mu = Kc @ K_inv @ y_n
+        var = np.maximum(1.0 - np.einsum("ij,jk,ik->i", Kc, K_inv, Kc),
+                         1e-9)
+        ucb = mu + self.kappa * np.sqrt(var)
+        best = cand[int(np.argmax(ucb))]
+        new.update(self._denormalize(best))
+        return new

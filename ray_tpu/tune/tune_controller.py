@@ -31,6 +31,7 @@ from ray_tpu.tune.schedulers import (
     FIFOScheduler,
     TrialScheduler,
 )
+from ray_tpu.tune.callback import CallbackList
 from ray_tpu.tune.search import ConcurrencyLimiter, SearchAlgorithm
 from ray_tpu.tune.trainable import _TrialRunner
 
@@ -87,6 +88,9 @@ class TuneController:
         os.makedirs(self.exp_dir, exist_ok=True)
         self.trials: List[Trial] = []
         self._counter = 0
+        self._iteration = 0  # controller loop ticks, for callbacks
+        self.callbacks = CallbackList(run_config.callbacks)
+        self.callbacks.fire("setup", self.exp_dir)
 
     # -- lifecycle ------------------------------------------------------
     def _new_trials(self):
@@ -112,6 +116,8 @@ class TuneController:
         if trial.checkpoint_path:
             ray_tpu.get(trial.actor.restore.remote(trial.checkpoint_path))
         trial.state = RUNNING
+        self.callbacks.fire("on_trial_start", self._iteration,
+                            self.trials, trial)
         trial.future = trial.actor.next_result.remote()
 
     def _stop_trial(self, trial: Trial, state: str, error: str = None):
@@ -131,6 +137,9 @@ class TuneController:
         self.search_alg.on_trial_complete(
             trial.trial_id, trial.last_result, error=state == ERROR)
         self.scheduler.on_trial_complete(trial, trial.last_result)
+        self.callbacks.fire(
+            "on_trial_error" if state == ERROR else "on_trial_complete",
+            self._iteration, self.trials, trial)
 
     def _pause_trial(self, trial: Trial):
         """Checkpoint and release the trial's actor; the scheduler later
@@ -183,6 +192,8 @@ class TuneController:
             path = ray_tpu.get(trial.actor.save.remote(), timeout=60)
             if path:
                 trial.checkpoint_path = path
+                self.callbacks.fire("on_checkpoint", self._iteration,
+                                    self.trials, trial, path)
         except Exception:
             logger.warning("checkpoint of %s failed", trial.trial_id)
 
@@ -232,6 +243,7 @@ class TuneController:
         search_exhausted = False
         last_forced: Optional[frozenset] = None
         while True:
+            self._iteration += 1
             self._new_trials()
             if not search_exhausted and self.search_alg.is_finished():
                 search_exhausted = True
@@ -301,6 +313,7 @@ class TuneController:
                 continue
             self._on_result(trial, result)
         self._save_state()
+        self.callbacks.fire("on_experiment_end", self.trials)
         return self.trials
 
     def _on_result(self, trial: Trial, result: Dict[str, Any]):
@@ -316,6 +329,8 @@ class TuneController:
         trial.last_result = result
         trial.history.append(dict(result))
         self.search_alg.on_trial_result(trial.trial_id, result)
+        self.callbacks.fire("on_trial_result", self._iteration,
+                            self.trials, trial, result)
         self._maybe_checkpoint(trial)
         if self._stop_criteria_met(trial, result):
             self._maybe_checkpoint(trial, force=bool(self.checkpoint_freq))
