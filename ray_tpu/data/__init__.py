@@ -5,6 +5,7 @@ executor). See dataset.py / executor.py for the TPU-first design notes.
 """
 
 from ray_tpu.data.block import Block, BlockAccessor, BlockMetadata
+from ray_tpu.data.context import DataContext
 from ray_tpu.data.dataset import (
     DataIterator,
     Dataset,
@@ -27,6 +28,7 @@ __all__ = [
     "Block",
     "BlockAccessor",
     "BlockMetadata",
+    "DataContext",
     "DataIterator",
     "Dataset",
     "GroupedData",

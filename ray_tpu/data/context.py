@@ -1,0 +1,65 @@
+"""DataContext: per-process execution options for Data pipelines.
+
+Reference: python/ray/data/context.py (DataContext.get_current() — the
+execution-option singleton) and
+_internal/execution/backpressure_policy/ (ConcurrencyCapBackpressure-
+Policy caps in-flight tasks; the resource-budget policies cap bytes).
+The streaming executor reads the context at plan start: block-count
+backpressure bounds concurrent tasks per stage, byte backpressure
+bounds the estimated data volume in flight (input-size proxy — the
+output size of a running task is unknowable until it finishes).
+"""
+
+from __future__ import annotations
+
+import os
+from dataclasses import dataclass
+from typing import Optional
+
+_current: Optional["DataContext"] = None
+
+
+@dataclass
+class DataContext:
+    # Max concurrently running tasks per streaming stage. None = auto
+    # (2 x cluster CPUs, the reference's effective default shape).
+    max_in_flight_blocks: Optional[int] = None
+    # Max estimated bytes in flight per stage (input-size proxy);
+    # None = unlimited. Guards pipelines whose blocks are much larger
+    # than their count suggests (e.g. wide tensors).
+    max_in_flight_bytes: Optional[int] = None
+    # Shuffle strategy: "auto" (push at >= 8 input blocks), "pull",
+    # "push". The RAY_TPU_SHUFFLE_STRATEGY env var overrides.
+    shuffle_strategy: str = "auto"
+
+    def __post_init__(self):
+        if self.shuffle_strategy not in ("auto", "pull", "push"):
+            raise ValueError(
+                f"shuffle_strategy must be auto|pull|push, got "
+                f"{self.shuffle_strategy!r}")
+        if (self.max_in_flight_blocks is not None
+                and self.max_in_flight_blocks < 1):
+            raise ValueError("max_in_flight_blocks must be >= 1")
+        if (self.max_in_flight_bytes is not None
+                and self.max_in_flight_bytes < 1):
+            raise ValueError("max_in_flight_bytes must be >= 1")
+
+    @staticmethod
+    def get_current() -> "DataContext":
+        global _current
+        if _current is None:
+            _current = DataContext()
+        return _current
+
+    def resolved_shuffle_strategy(self) -> str:
+        env = os.environ.get("RAY_TPU_SHUFFLE_STRATEGY")
+        if env is None:
+            return self.shuffle_strategy
+        if env not in ("auto", "pull", "push"):
+            import logging
+
+            logging.getLogger(__name__).warning(
+                "ignoring invalid RAY_TPU_SHUFFLE_STRATEGY=%r "
+                "(want auto|pull|push)", env)
+            return self.shuffle_strategy
+        return env

@@ -24,6 +24,7 @@ from typing import Any, Iterator, List, Optional, Tuple
 import numpy as np
 
 import ray_tpu
+from ray_tpu.data.context import DataContext
 from ray_tpu.data.block import (
     Block,
     BlockAccessor,
@@ -79,6 +80,13 @@ def _slice_concat(ranges, *blocks):
     meta = BlockAccessor(out).metadata()
     meta.exec_s = time.perf_counter() - t0
     return out, meta
+
+
+def _even_split_bytes(bundles: List[Bundle], n_out: int) -> int:
+    """Byte-backpressure estimate for an all-to-all output block: the
+    input total split evenly over the outputs."""
+    total = sum((m.size_bytes or 0) for _, m in bundles)
+    return total // max(1, n_out)
 
 
 def plan_row_slice(bundles: List[Bundle], lo: int, hi: int):
@@ -321,15 +329,22 @@ class _MapActor:
 class StreamingExecutor:
     def __init__(self, terminal_op, *, max_in_flight: Optional[int] = None,
                  stats=None):
+        ctx = DataContext.get_current()
         self.stages = fuse_plan(terminal_op)
         self.stats = stats  # data.stats.DatasetStats or None
+        if max_in_flight is None:
+            max_in_flight = ctx.max_in_flight_blocks
         if max_in_flight is None:
             try:
                 cpus = int(ray_tpu.cluster_resources().get("CPU", 4))
             except Exception:
                 cpus = 4
             max_in_flight = max(2, 2 * cpus)
-        self.max_in_flight = max_in_flight
+        # Clamp: direct attribute assignment on the context singleton
+        # bypasses __post_init__ validation, and a cap < 1 would make
+        # _windowed admit nothing (silently empty datasets).
+        self.max_in_flight = max(1, int(max_in_flight))
+        self.max_in_flight_bytes = ctx.max_in_flight_bytes
 
     # -- public --------------------------------------------------------
     def execute(self) -> Iterator[Bundle]:
@@ -376,22 +391,45 @@ class StreamingExecutor:
         raise TypeError(f"unknown stage {stage!r}")
 
     # -- streaming stages ----------------------------------------------
-    def _windowed(self, submits: Iterator[Tuple[Any, Any]]
+    def _windowed(self, submits: Iterator[tuple]
                   ) -> Iterator[Bundle]:
-        """Drive task submissions with a bounded in-flight window, yielding
-        results in submission order (deterministic output block order)."""
+        """Drive task submissions with a bounded in-flight window,
+        yielding results in submission order (deterministic output
+        block order). Backpressure is block-count based, plus
+        byte-based when DataContext.max_in_flight_bytes is set —
+        submits may yield (block_ref, meta_ref, est_bytes) triples
+        where est_bytes is the task's INPUT size (the output size is
+        unknowable until it finishes); at least one task is always in
+        flight so huge single blocks still make progress. Pulling from
+        ``submits`` launches the task, so the byte gate has one-task
+        lookahead: actual in-flight bytes can overshoot the cap by at
+        most one task's input."""
         window: collections.deque = collections.deque()
+        in_flight_bytes = 0
+        byte_cap = self.max_in_flight_bytes
         submits = iter(submits)
         exhausted = False
+        pending = None  # one prefetched submit awaiting byte budget
         while True:
             while not exhausted and len(window) < self.max_in_flight:
-                try:
-                    window.append(next(submits))
-                except StopIteration:
-                    exhausted = True
+                if pending is None:
+                    try:
+                        pending = next(submits)
+                    except StopIteration:
+                        exhausted = True
+                        break
+                cost = pending[2] if len(pending) > 2 else 0
+                if (byte_cap is not None and window
+                        and in_flight_bytes + cost > byte_cap):
+                    break  # wait for completions to free byte budget
+                window.append(pending)
+                in_flight_bytes += cost
+                pending = None
             if not window:
                 return
-            block_ref, meta_ref = window.popleft()
+            entry = window.popleft()
+            block_ref, meta_ref = entry[0], entry[1]
+            in_flight_bytes -= entry[2] if len(entry) > 2 else 0
             meta = ray_tpu.get(meta_ref)
             yield block_ref, meta
 
@@ -412,8 +450,9 @@ class StreamingExecutor:
         transforms = stage.transforms
 
         def submits():
-            for block_ref, _ in upstream:
-                yield tuple(fn.remote(transforms, block_ref))
+            for block_ref, meta in upstream:
+                yield (*fn.remote(transforms, block_ref),
+                       meta.size_bytes or 0)
 
         return self._windowed(submits())
 
@@ -428,11 +467,11 @@ class StreamingExecutor:
 
             def submits():
                 nonlocal idx
-                for block_ref, _ in upstream:
+                for block_ref, meta in upstream:
                     a = actors[idx % len(actors)]
                     idx += 1
-                    yield tuple(a.process.options(num_returns=2)
-                                .remote(block_ref))
+                    yield (*a.process.options(num_returns=2)
+                           .remote(block_ref), meta.size_bytes or 0)
 
             yield from self._windowed(submits())
         finally:
@@ -472,12 +511,15 @@ class StreamingExecutor:
         n_out = max(1, stage.num_blocks)
         cuts = np.linspace(0, total, n_out + 1).astype(int)
         fn = ray_tpu.remote(_slice_concat).options(num_returns=2)
+        # Byte-backpressure estimate: outputs are even row splits, so
+        # each costs ~ the input total / n_out.
+        est = _even_split_bytes(bundles, n_out)
 
         def submits():
             for j in range(n_out):
                 ranges, refs = plan_row_slice(
                     bundles, int(cuts[j]), int(cuts[j + 1]))
-                yield tuple(fn.remote(ranges, *refs))
+                yield (*fn.remote(ranges, *refs), est)
 
         return self._windowed(submits())
 
@@ -487,9 +529,7 @@ class StreamingExecutor:
         n_out = num_out or n_in
         if n_in == 0:
             return iter([])
-        import os
-
-        strategy = os.environ.get("RAY_TPU_SHUFFLE_STRATEGY", "auto")
+        strategy = DataContext.get_current().resolved_shuffle_strategy()
         if strategy == "push" or (strategy == "auto" and n_in >= 8):
             return self._push_shuffle(stage, bundles, n_out)
         map_fn = ray_tpu.remote(_shuffle_map).options(num_returns=n_out)
@@ -500,11 +540,13 @@ class StreamingExecutor:
             out = map_fn.remote(ref, n_out, seed)
             parts.append(out if isinstance(out, list) else [out])
 
+        est = _even_split_bytes(bundles, n_out)
+
         def submits():
             for j in range(n_out):
                 seed = None if stage.seed is None else stage.seed * 7919 + j
-                yield tuple(reduce_fn.remote(
-                    seed, *[parts[i][j] for i in range(n_in)]))
+                yield (*reduce_fn.remote(
+                    seed, *[parts[i][j] for i in range(n_in)]), est)
 
         return self._windowed(submits())
 
@@ -531,15 +573,16 @@ class StreamingExecutor:
                                       i, n_out, seed))
         ray_tpu.get(acks, timeout=1200)  # all fragments delivered
 
+        est = _even_split_bytes(bundles, n_out)
+
         def submits():
             for j in range(n_out):
                 seed = (None if stage.seed is None
                         else stage.seed * 7919 + j)
-                yield tuple(
-                    reducers[j % n_reducers].finish
-                    .options(num_returns=2).remote(
-                        shuffle_id, j, seed,
-                        j + n_reducers >= n_out))  # reducer's last owned j
+                yield (*reducers[j % n_reducers].finish
+                       .options(num_returns=2).remote(
+                           shuffle_id, j, seed,
+                           j + n_reducers >= n_out), est)
 
         yield from self._windowed(submits())
 
@@ -563,13 +606,15 @@ class StreamingExecutor:
                                 stage.descending)
             parts.append(out if isinstance(out, list) else [out])
 
+        est = _even_split_bytes(bundles, n_out)
+
         def submits():
             # sort_partitions already emits parts high-to-low for
             # descending sorts, so reduce order is always natural.
             for j in range(n_out):
-                yield tuple(reduce_fn.remote(
+                yield (*reduce_fn.remote(
                     stage.key, stage.descending,
-                    *[parts[i][j] for i in range(len(bundles))]))
+                    *[parts[i][j] for i in range(len(bundles))]), est)
 
         return self._windowed(submits())
 
@@ -590,9 +635,12 @@ class StreamingExecutor:
         zip_fn = ray_tpu.remote(_zip_blocks).options(num_returns=2)
 
         def submits():
-            for (lref, _), (lo, hi) in zip(left, cuts):
+            for (lref, lmeta), (lo, hi) in zip(left, cuts):
                 ranges, refs = plan_row_slice(right, lo, hi)
                 raligned, _m = fn_slice.remote(ranges, *refs)
-                yield tuple(zip_fn.remote(lref, raligned))
+                # Output carries both sides' columns: ~2x the left
+                # block's bytes.
+                yield (*zip_fn.remote(lref, raligned),
+                       2 * (lmeta.size_bytes or 0))
 
         return self._windowed(submits())

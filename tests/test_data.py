@@ -247,3 +247,31 @@ def test_stats_actor_compute(ray_data_cluster):
     map_stage = [s for s in st["stages"] if "MapBatches" in s["name"]][0]
     assert map_stage["num_blocks"] == 2
     assert map_stage["task_exec_s"] > 0
+
+
+def test_data_context_byte_backpressure(ray_data_cluster):
+    from ray_tpu.data.context import DataContext
+
+    ctx = DataContext.get_current()
+    old_bytes, old_blocks = ctx.max_in_flight_bytes, ctx.max_in_flight_blocks
+    try:
+        # Tiny byte budget: only ~1 task in flight at a time, but the
+        # pipeline still completes correctly (always-admit-one rule).
+        ctx.max_in_flight_bytes = 64
+        out = sorted(rd.range(100, parallelism=8)
+                     .map(lambda x: x + 1).take_all())
+        assert out == [i + 1 for i in range(100)]
+    finally:
+        ctx.max_in_flight_bytes = old_bytes
+        ctx.max_in_flight_blocks = old_blocks
+
+
+def test_data_context_validation():
+    from ray_tpu.data.context import DataContext
+
+    import pytest as _pytest
+
+    with _pytest.raises(ValueError):
+        DataContext(shuffle_strategy="sideways")
+    with _pytest.raises(ValueError):
+        DataContext(max_in_flight_blocks=0)
