@@ -1,6 +1,6 @@
 """Data: distributed ETL -> shuffle -> batched iteration into JAX.
 
-Run: JAX_PLATFORMS=cpu python examples/data_pipeline.py
+Run: PYTHONPATH=. JAX_PLATFORMS=cpu python examples/data_pipeline.py
 """
 import ray_tpu
 from ray_tpu import data as rd

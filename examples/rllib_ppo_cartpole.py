@@ -1,6 +1,6 @@
 """RLlib: PPO on the built-in vectorized CartPole.
 
-Run: JAX_PLATFORMS=cpu python examples/rllib_ppo_cartpole.py
+Run: PYTHONPATH=. JAX_PLATFORMS=cpu python examples/rllib_ppo_cartpole.py
 """
 import ray_tpu
 from ray_tpu import rllib as rl

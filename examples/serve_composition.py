@@ -1,6 +1,6 @@
 """Serve: HTTP ingress + model composition + dynamic batching.
 
-Run: JAX_PLATFORMS=cpu python examples/serve_composition.py
+Run: PYTHONPATH=. JAX_PLATFORMS=cpu python examples/serve_composition.py
 """
 import json
 import urllib.request

@@ -1,7 +1,7 @@
 """Data-parallel training of an MLP with JaxTrainer (the SURVEY §7.2
 minimum end-to-end slice): 2 workers, synthetic data, checkpoint+report.
 
-Run: JAX_PLATFORMS=cpu python examples/train_mnist_mlp.py
+Run: PYTHONPATH=. JAX_PLATFORMS=cpu python examples/train_mnist_mlp.py
 """
 import numpy as np
 

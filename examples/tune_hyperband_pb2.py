@@ -1,0 +1,66 @@
+"""Tune: synchronous HyperBand with checkpointable trainables, then
+PB2's GP-bandit population training, with CSV/JSON logger callbacks.
+
+Run: PYTHONPATH=. JAX_PLATFORMS=cpu python examples/tune_hyperband_pb2.py
+"""
+import os
+import tempfile
+
+import ray_tpu
+from ray_tpu import tune
+from ray_tpu.train.config import RunConfig
+
+
+class Quadratic(tune.Trainable):
+    """Score climbs toward 10 at a rate set by lr; best lr = 0.5."""
+
+    def setup(self, config):
+        self.lr = config["lr"]
+        self.val = 0.0
+
+    def step(self):
+        self.val += (1.0 - abs(self.lr - 0.5)) * (10 - self.val) * 0.1
+        return {"score": self.val}
+
+    def save_checkpoint(self, path):
+        with open(os.path.join(path, "v"), "w") as f:
+            f.write(str(self.val))
+
+    def load_checkpoint(self, path):
+        with open(os.path.join(path, "v")) as f:
+            self.val = float(f.read())
+
+
+if __name__ == "__main__":
+    ray_tpu.init(num_cpus=4, num_tpus=0)
+    storage = tempfile.mkdtemp()
+
+    # Synchronous HyperBand: brackets pause at rung milestones, keep
+    # the top 1/eta, resume survivors.
+    grid = tune.Tuner(
+        Quadratic,
+        param_space={"lr": tune.uniform(0.0, 1.0)},
+        tune_config=tune.TuneConfig(
+            metric="score", mode="max", num_samples=9,
+            scheduler=tune.HyperBandScheduler(max_t=9,
+                                              reduction_factor=3)),
+        run_config=RunConfig(name="hb", storage_path=storage,
+                             callbacks=[tune.CSVLoggerCallback(),
+                                        tune.JsonLoggerCallback()]),
+    ).fit()
+    best = grid.get_best_result()
+    print("HyperBand best:", round(best.metrics["score"], 3))
+
+    # PB2: exploit + GP-bandit hyperparameter selection.
+    grid = tune.Tuner(
+        Quadratic,
+        param_space={"lr": tune.uniform(0.0, 1.0)},
+        tune_config=tune.TuneConfig(
+            metric="score", mode="max", num_samples=4,
+            scheduler=tune.PB2(hyperparam_bounds={"lr": (0.0, 1.0)},
+                               perturbation_interval=3, seed=0)),
+        run_config=RunConfig(name="pb2", storage_path=storage,
+                             stop={"training_iteration": 15}),
+    ).fit()
+    print("PB2 best:", round(grid.get_best_result().metrics["score"], 3))
+    ray_tpu.shutdown()
