@@ -46,6 +46,9 @@ class AlgorithmConfig:
         # module-to-env transforms actions before env.step.
         self.env_to_module_connectors: list = []
         self.module_to_env_connectors: list = []
+        # Periodic greedy evaluation: 0 = only on explicit .evaluate().
+        self.evaluation_interval: int = 0
+        self.evaluation_num_episodes: int = 10
         self.extra: Dict[str, Any] = {}
 
     def environment(self, env=None, *, num_envs_per_env_runner=None
@@ -101,6 +104,17 @@ class AlgorithmConfig:
     def debugging(self, *, seed=None) -> "AlgorithmConfig":
         if seed is not None:
             self.seed = seed
+        return self
+
+    def evaluation(self, *, evaluation_interval=None,
+                   evaluation_num_episodes=None) -> "AlgorithmConfig":
+        """Greedy-policy evaluation (reference: AlgorithmConfig
+        .evaluation). With an interval, step() attaches an
+        ``evaluation`` block every N training iterations."""
+        if evaluation_interval is not None:
+            self.evaluation_interval = evaluation_interval
+        if evaluation_num_episodes is not None:
+            self.evaluation_num_episodes = evaluation_num_episodes
         return self
 
     def copy(self) -> "AlgorithmConfig":
@@ -203,8 +217,46 @@ class Algorithm(Trainable):
         result.setdefault("training_iteration", self.iteration)
         result["timesteps_total"] = self._timesteps_total
         result["episodes_total"] = self._episodes_total
+        interval = getattr(self.config, "evaluation_interval", 0)
+        if interval and self.iteration % interval == 0:
+            result["evaluation"] = self.evaluate(
+                self.config.evaluation_num_episodes)
         result["time_this_iter_s"] = time.time() - t0
         return result
+
+    def evaluate(self, num_episodes: Optional[int] = None
+                 ) -> Dict[str, Any]:
+        """Greedy-policy rollouts on fresh evaluation envs, split across
+        the env-runner set (reference: Algorithm.evaluate / the
+        evaluation worker group). Current learner weights are synced
+        first."""
+        n = (num_episodes if num_episodes is not None
+             else getattr(self.config, "evaluation_num_episodes", 10))
+        if n <= 0:
+            return {"episodes": 0}
+        self._broadcast_weights()
+        k = max(1, self.workers.num_actors)
+        # Equal share per runner (ceil: totals may slightly exceed n —
+        # a stateless closure survives the actor manager's retries).
+        per_actor = max(1, -(-n // k))
+        outs = self.workers.foreach(
+            lambda a: a.evaluate.remote(per_actor))
+        eps, rets, lens = 0, [], []
+        for _, m in outs:
+            got = m.get("episodes", 0)
+            if got:
+                eps += got
+                rets.append((m["episode_return_mean"], got))
+                lens.append((m["episode_len_mean"], got))
+        if not eps:
+            return {"episodes": 0}
+        return {
+            "episodes": eps,
+            "episode_return_mean": float(
+                sum(r * w for r, w in rets) / eps),
+            "episode_len_mean": float(
+                sum(l * w for l, w in lens) / eps),
+        }
 
     def train(self) -> Dict[str, Any]:
         return self.step()

@@ -49,6 +49,11 @@ class ConnectorV2:
     def set_state(self, state: dict) -> None:
         pass
 
+    def begin_eval(self) -> None:
+        """Prepare a COPY of this connector for evaluation rollouts:
+        freeze learned statistics, drop transient per-episode state.
+        Called on the deep copy, never the training instance."""
+
     @property
     def name(self) -> str:
         return type(self).__name__
@@ -112,6 +117,19 @@ class ConnectorPipelineV2(ConnectorV2):
         for i, c in enumerate(self.connectors):
             if i in state:
                 c.set_state(state[i])
+
+    def eval_copy(self) -> "ConnectorPipelineV2":
+        """An isolated pipeline for evaluation: a deep copy (so
+        instance-style connectors never share state with training) that
+        KEEPS learned statistics (the policy was trained on normalized
+        obs — reference RLlib likewise syncs filters to eval workers)
+        but freezes them and drops per-episode transients."""
+        import copy
+
+        clone = copy.deepcopy(self)
+        for c in clone.connectors:
+            c.begin_eval()
+        return clone
 
 
 # -- env-to-module connectors -----------------------------------------------
@@ -197,6 +215,9 @@ class NormalizeObs(ConnectorV2):
         self._mean = state["mean"]
         self._m2 = state["m2"]
 
+    def begin_eval(self):
+        self.update = False  # evaluate with frozen training statistics
+
 
 class FrameStackObs(ConnectorV2):
     """Stack the last k observations along the trailing axis
@@ -238,6 +259,9 @@ class FrameStackObs(ConnectorV2):
             data["obs"] = np.concatenate(
                 [self._stack[..., c:], obs], axis=-1)
         return data
+
+    def begin_eval(self):
+        self._stack = None  # eval episodes must not see training frames
 
     def transform_space(self, space: Space) -> Space:
         shape = tuple(space.shape[:-1]) + (space.shape[-1] * self.k,)

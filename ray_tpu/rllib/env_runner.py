@@ -22,6 +22,10 @@ class EnvRunner:
         from ray_tpu.rllib.env import make_vec
 
         self.env = make_vec(env_spec, num_envs, seed=seed)
+        self._env_spec = env_spec
+        self._seed = seed
+        self._env_to_module_raw = env_to_module
+        self._module_to_env_raw = module_to_env
         self.rollout_length = rollout_length
         self.gamma = gamma
         # Connector pipelines (reference: env_runner's env-to-module /
@@ -147,6 +151,61 @@ class EnvRunner:
             "episode_return_mean": float(np.mean(returns)),
             "episode_return_max": float(np.max(returns)),
             "episode_return_min": float(np.min(returns)),
+            "episode_len_mean": float(np.mean(lens)),
+        }
+
+    def evaluate(self, num_episodes: int, *, max_steps: int = 10_000,
+                 seed: Optional[int] = None) -> Dict[str, Any]:
+        """Greedy-policy evaluation on a FRESH env (reference: the
+        evaluation EnvRunner group). The training env, its episode
+        metrics, and connector pipelines are untouched — evaluation
+        runs on eval_copy() pipelines: isolated deep copies that keep
+        learned normalization statistics (frozen) but drop transient
+        frame-stack state."""
+        from ray_tpu.rllib.env import make_vec
+
+        seed = self._seed + 777 if seed is None else seed
+        env = make_vec(self._env_spec, self.env.num_envs, seed=seed)
+        e2m = (self.env_to_module.eval_copy()
+               if self.env_to_module is not None else None)
+        m2e = (self.module_to_env.eval_copy()
+               if self.module_to_env is not None else None)
+        obs = env.reset(seed=seed)
+        if e2m is not None:
+            obs = e2m({"obs": obs, "dones": None})["obs"]
+        B = env.num_envs
+        ep_ret = np.zeros(B, np.float32)
+        ep_len = np.zeros(B, np.int64)
+        done_eps: list = []
+        steps = 0
+        while len(done_eps) < num_episodes and steps < max_steps:
+            action = np.asarray(
+                self.forwards["inference"](self.params, obs))
+            if m2e is not None:
+                action = m2e({"actions": action})["actions"]
+            raw_obs, rew, term, trunc = env.step(action)
+            done = term | trunc
+            ep_ret += rew
+            ep_len += 1
+            if done.any():
+                for i in np.nonzero(done)[0]:
+                    done_eps.append((float(ep_ret[i]), int(ep_len[i])))
+                ep_ret[done] = 0.0
+                ep_len[done] = 0
+            obs = raw_obs
+            if e2m is not None:
+                obs = e2m({"obs": obs, "dones": done})["obs"]
+            steps += 1
+        done_eps = done_eps[:num_episodes]
+        if not done_eps:
+            return {"episodes": 0}
+        rets = [r for r, _ in done_eps]
+        lens = [l for _, l in done_eps]
+        return {
+            "episodes": len(done_eps),
+            "episode_return_mean": float(np.mean(rets)),
+            "episode_return_min": float(np.min(rets)),
+            "episode_return_max": float(np.max(rets)),
             "episode_len_mean": float(np.mean(lens)),
         }
 

@@ -268,3 +268,29 @@ def test_atari_like_env_contract():
     batch = runner.sample()
     assert batch["obs"].shape == (8, 4, 84, 84, 4)
     assert batch["obs"].dtype == np.uint8  # raw bytes in rollouts
+
+
+def test_algorithm_evaluate(rl_cluster):
+    algo = (rl.PPOConfig()
+            .environment("CartPole-v1", num_envs_per_env_runner=4)
+            .env_runners(num_env_runners=2, rollout_fragment_length=16,
+                         num_cpus_per_env_runner=0.5)
+            .training(train_batch_size=128, minibatch_size=64,
+                      num_epochs=1)
+            .evaluation(evaluation_interval=2,
+                        evaluation_num_episodes=6)
+            .debugging(seed=0)
+            .build())
+    try:
+        # Explicit evaluate(): greedy rollouts on fresh envs.
+        ev = algo.evaluate(6)
+        assert ev["episodes"] >= 6
+        assert ev["episode_return_mean"] > 0
+        assert ev["episode_len_mean"] > 0
+        # Interval-driven: iteration 2 carries an evaluation block.
+        r1 = algo.step()
+        assert "evaluation" not in r1
+        r2 = algo.step()
+        assert r2["evaluation"]["episodes"] >= 6
+    finally:
+        algo.stop()

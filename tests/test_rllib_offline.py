@@ -361,3 +361,26 @@ def test_collect_writer_matches_return(tmp_path):
     on_disk = list(JsonReader(str(tmp_path / "m")).read_episodes())
     assert len(eps) == 3
     assert len(on_disk) == 3
+
+
+def test_eval_copy_isolates_and_freezes():
+    from ray_tpu.rllib.connectors import ConnectorPipelineV2
+
+    norm = NormalizeObs()
+    fs = FrameStackObs(2)
+    pipe = ConnectorPipelineV2([norm, fs])
+    rng = np.random.default_rng(0)
+    for _ in range(10):
+        pipe({"obs": rng.normal(3.0, 1.0, (8, 2)).astype(np.float32),
+              "dones": None})
+    count_before = norm._count
+    ev = pipe.eval_copy()
+    # Learned stats inherited but frozen; frame stack dropped.
+    ev_norm, ev_fs = ev.connectors
+    assert ev_norm._count == count_before and not ev_norm.update
+    assert ev_fs._stack is None
+    ev({"obs": np.zeros((8, 2), np.float32), "dones": None})
+    # Training pipeline untouched by the eval copy's use.
+    assert norm._count == count_before
+    assert norm.update
+    assert fs._stack is not None
