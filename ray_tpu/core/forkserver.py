@@ -37,8 +37,11 @@ import time
 from typing import Dict, Optional
 
 
-def serve(sock_path: str) -> None:
-    """Forkserver main loop (runs as a dedicated process)."""
+def serve(sock_path: str, owner_pid: Optional[int] = None) -> None:
+    """Forkserver main loop (runs as a dedicated process). owner_pid is
+    the process whose death should take this forkserver down (passed
+    explicitly: by the time our own ppid is sampled we may already have
+    been reparented if the owner died during startup)."""
     import importlib
 
     importlib.import_module("ray_tpu.core.worker_main")  # heavy preimport
@@ -59,17 +62,36 @@ def serve(sock_path: str) -> None:
     srv.bind(sock_path + ".tmp")
     os.rename(sock_path + ".tmp", sock_path)  # appearance = ready
     srv.listen(64)
-    # Orphan watchdog: a crashed/killed parent (pytest -x abort, kill -9
+    # Orphan watchdog: a crashed/killed owner (pytest -x abort, kill -9
     # of the head) can never send the shutdown op, and an unsupervised
-    # forkserver would outlive its session forever. Poll ppid between
-    # accepts; reparenting to init means the owner is gone.
+    # forkserver would outlive its session forever. Poll the owner's
+    # liveness between accepts; without an explicit owner, fall back to
+    # detecting reparenting.
     parent = os.getppid()
     srv.settimeout(2.0)
+
+    def owner_gone() -> bool:
+        if owner_pid is not None:
+            # Both launch sites make us a direct child of the owner, so
+            # reparenting (even away from a zombie or recycled-pid
+            # owner, which kill(pid, 0) cannot distinguish from a live
+            # one) means the owner is gone.
+            if os.getppid() != owner_pid:
+                return True
+            try:
+                os.kill(owner_pid, 0)
+                return False
+            except ProcessLookupError:
+                return True
+            except PermissionError:
+                return False
+        return os.getppid() != parent
+
     while True:
         try:
             conn, _ = srv.accept()
         except socket.timeout:
-            if os.getppid() != parent:
+            if owner_gone():
                 break
             continue
         try:
@@ -218,7 +240,7 @@ class ForkserverClient:
         with open(log_path, "ab") as log_file:
             self._proc = subprocess.Popen(
                 [sys.executable, "-m", "ray_tpu.core.forkserver",
-                 self.sock_path],
+                 self.sock_path, str(os.getpid())],
                 env=self.env,
                 stdout=log_file,
                 stderr=subprocess.STDOUT,
@@ -276,4 +298,5 @@ class ForkserverClient:
 
 
 if __name__ == "__main__":
-    serve(sys.argv[1])
+    serve(sys.argv[1],
+          int(sys.argv[2]) if len(sys.argv) > 2 else None)

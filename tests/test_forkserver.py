@@ -1,0 +1,142 @@
+"""Tests for the worker forkserver (core/forkserver.py): spawn
+protocol, liveness shim, orphan watchdog, and the WorkerPool
+deferral/fallback logic."""
+
+import os
+import signal
+import subprocess
+import sys
+import tempfile
+import time
+
+import pytest
+
+from ray_tpu.core.forkserver import ForkedProc, ForkserverClient
+
+
+@pytest.fixture(scope="module")
+def fs_client():
+    sd = tempfile.mkdtemp()
+    os.makedirs(os.path.join(sd, "logs"), exist_ok=True)
+    client = ForkserverClient(sd, dict(os.environ))
+    client.ensure_started()
+    yield client, sd
+    client.stop()
+
+
+def test_spawn_is_fast_and_children_run(fs_client):
+    client, sd = fs_client
+    assert client.ready()
+    log = os.path.join(sd, "logs", "w.log")
+    t0 = time.perf_counter()
+    # The child runs worker_main.main() which exits quickly without a
+    # reachable head; what matters here is the fork round-trip.
+    proc = client.spawn({"RAY_TPU_HEAD_HOST": "127.0.0.1",
+                         "RAY_TPU_HEAD_PORT": "1",
+                         "RAY_TPU_WORKER_ID": "00" * 14,
+                         "RAY_TPU_NODE_ID": "00" * 14,
+                         "RAY_TPU_SESSION_DIR": sd}, log)
+    dt = time.perf_counter() - t0
+    assert dt < 1.0, f"fork round-trip took {dt:.2f}s"
+    assert proc.pid > 0
+    proc.wait(timeout=30)  # child exits (no head to register with)
+    assert proc.poll() is not None
+
+
+def test_forked_proc_poll_and_kill(fs_client):
+    client, sd = fs_client
+    # A child that hangs forever (bogus head, long connect timeout).
+    proc = client.spawn(
+        {"RAY_TPU_HEAD_HOST": "10.255.255.1", "RAY_TPU_HEAD_PORT": "1",
+         "RAY_TPU_WORKER_ID": "11" * 14, "RAY_TPU_NODE_ID": "00" * 14,
+         "RAY_TPU_SESSION_DIR": sd,
+         "RAY_TPU_RPC_CONNECT_TIMEOUT_S": "600"},
+        os.path.join(sd, "logs", "hang.log"))
+    assert proc.poll() is None  # alive
+    proc.kill()
+    deadline = time.time() + 10
+    while proc.poll() is None and time.time() < deadline:
+        time.sleep(0.05)
+    assert proc.poll() is not None
+
+
+def test_orphan_watchdog_exits_without_owner():
+    """A forkserver whose launching process dies must exit on its own
+    (crashed sessions must not leak preimported interpreters)."""
+    sd = tempfile.mkdtemp()
+    sock = os.path.join(sd, "fs.sock")
+    # Launch through an intermediate python that dies immediately after
+    # spawning the forkserver — the forkserver's ppid then changes.
+    code = (
+        "import os, subprocess, sys\n"
+        f"p = subprocess.Popen([sys.executable, '-m', "
+        f"'ray_tpu.core.forkserver', {sock!r}, str(os.getpid())], "
+        "stdout=subprocess.DEVNULL, stderr=subprocess.DEVNULL)\n"
+        "print(p.pid, flush=True)\n"
+    )
+    out = subprocess.run([sys.executable, "-c", code],
+                         capture_output=True, text=True, timeout=60)
+    fs_pid = int(out.stdout.strip())
+
+    def alive(pid: int) -> bool:
+        # kill(pid, 0) succeeds on zombies; read the real state.
+        try:
+            with open(f"/proc/{pid}/stat") as f:
+                return f.read().rsplit(")", 1)[1].split()[0] != "Z"
+        except (FileNotFoundError, ProcessLookupError):
+            return False
+
+    # Prove the server actually reached its accept loop (a startup
+    # crash would make the death-wait below pass vacuously) ...
+    deadline = time.time() + 30
+    while not os.path.exists(sock) and time.time() < deadline:
+        assert alive(fs_pid), "forkserver died during startup"
+        time.sleep(0.2)
+    assert os.path.exists(sock), "forkserver never became ready"
+    # ... then wait for the watchdog to notice the dead owner (2s poll).
+    deadline = time.time() + 30
+    while time.time() < deadline:
+        if not alive(fs_pid):
+            break  # exited
+        time.sleep(0.3)
+    else:
+        os.kill(fs_pid, signal.SIGKILL)
+        pytest.fail("orphaned forkserver did not exit")
+
+
+def test_worker_pool_defers_then_uses_forkserver(monkeypatch):
+    """_spawn_proc returns None (defer) while the forkserver is still
+    preimporting and forks once it's ready; Popen when disabled."""
+    from ray_tpu.core.ids import NodeID
+    from ray_tpu.core.scheduler import WorkerPool
+
+    sd = tempfile.mkdtemp()
+    os.makedirs(os.path.join(sd, "logs"), exist_ok=True)
+    pool = WorkerPool("127.0.0.1", 1, sd)
+    node = NodeID.from_random()
+    try:
+        # First spawns defer while the forkserver preimports.
+        first = pool.spawn(node)
+        assert first is None or first.pid > 0
+        deadline = time.time() + 60
+        handle = None
+        while handle is None and time.time() < deadline:
+            handle = pool.spawn(node)
+            if handle is None:
+                time.sleep(0.2)
+        assert handle is not None and handle.pid > 0
+        # Disabled -> immediate cold Popen, no deferral.
+        monkeypatch.setenv("RAY_TPU_WORKER_FORKSERVER", "0")
+        from ray_tpu.core import config as config_mod
+
+        config_mod._global_config = None  # re-read env
+        pool2 = WorkerPool("127.0.0.1", 1, sd)
+        h2 = pool2.spawn(node)
+        assert h2 is not None and h2.pid > 0
+        pool2.shutdown()
+    finally:
+        monkeypatch.delenv("RAY_TPU_WORKER_FORKSERVER", raising=False)
+        from ray_tpu.core import config as config_mod
+
+        config_mod._global_config = None
+        pool.shutdown()
