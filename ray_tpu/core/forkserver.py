@@ -124,6 +124,10 @@ def serve(sock_path: str, owner_pid: Optional[int] = None) -> None:
 
 
 def _spawn_worker(srv: socket.socket, req: dict) -> int:
+    # NOTE for operators: fork() copies argv, so `ps` shows workers
+    # under the forkserver's own command line; distinguish them by
+    # parent pid (workers are children of the forkserver) or by their
+    # RAY_TPU_WORKER_ID environment.
     pid = os.fork()
     if pid != 0:
         return pid
