@@ -1726,11 +1726,11 @@ class CoreWorker:
                 for state in self.scheduling_keys.values():
                     lw = state.workers.get(pending.pushed_to)
                     if lw is not None:
-                        asyncio.ensure_future(lw.conn.notify(
+                        lw.conn.notify_forget(
                             "cancel_task",
                             {"task_id": pending.spec.task_id.hex(),
                              "force": force},
-                        ))
+                        )
                         return
 
         self.loop.call_soon_threadsafe(go)

@@ -257,8 +257,8 @@ class HeadService:
     def _kill_remote(self, node_id: NodeID, worker_id: WorkerID) -> None:
         agent = self._node_agents.get(node_id)
         if agent is not None:
-            asyncio.ensure_future(agent.notify(
-                "kill_worker", {"worker_id": worker_id.hex()}))
+            agent.notify_forget("kill_worker",
+                                {"worker_id": worker_id.hex()})
 
     def _memory_monitor(self):
         """Lazy so tests can flip the threshold per-head via config."""
@@ -1064,8 +1064,8 @@ class HeadService:
     def _publish(self, channel: str, data):
         for peer in list(self.subscribers.get(channel, ())):
             try:
-                coro = peer.notify("pubsub", {"channel": channel, "data": data})
-                asyncio.get_running_loop().create_task(coro)
+                peer.notify_forget("pubsub",
+                                   {"channel": channel, "data": data})
             except Exception:
                 pass
 
@@ -1370,6 +1370,24 @@ class LocalPeer:
     async def notify(self, method: str, payload):
         if self._notify_handler:
             await self._notify_handler(method, payload)
+
+    def notify_forget(self, method: str, payload=None):
+        """Mirror rpc.Connection.notify_forget (pubsub publishes
+        through this interface for the in-process driver too). There is
+        no transport here — notify awaits the application handler
+        directly — so handler bugs are LOGGED, not swallowed."""
+        try:
+            loop = asyncio.get_running_loop()
+        except RuntimeError:  # interpreter teardown
+            return None
+
+        async def _run():
+            try:
+                await self.notify(method, payload)
+            except Exception:
+                logger.exception("in-process %s handler failed", method)
+
+        return loop.create_task(_run())
 
     def close(self):
         self.closed = True

@@ -256,6 +256,18 @@ class Connection:
     async def notify(self, method: str, payload: Any = None):
         await self._send({"t": "ntf", "i": 0, "m": method, "d": payload})
 
+    def notify_forget(self, method: str, payload: Any = None) -> None:
+        """Fire-and-forget notification, silencing transport errors —
+        the peer that raced away cannot receive it, and an
+        unretrieved-task traceback on every clean shutdown (pubsub to a
+        just-closed subscriber, kill to a dying worker) is noise, not
+        signal. Callers that need delivery feedback await notify().
+        Loop-thread only (rides notify_nowait's enqueue + flush)."""
+        try:
+            self.notify_nowait(method, payload)
+        except (RpcError, OSError, RuntimeError):
+            pass
+
     def notify_nowait(self, method: str, payload: Any = None):
         """Fire-and-forget notification without coroutine machinery —
         the hot completion path sends one of these per finished task.
