@@ -108,6 +108,37 @@ class Config:
     # Long-poll pubsub batch window.
     pubsub_poll_timeout_s: float = 30.0
 
+    # --- unified retry/backoff (core/retry.py RetryPolicy) ---
+    # Every retry site in the RPC stack (task/actor pushes, GCS client
+    # calls, object pulls, Serve assignment) shares this envelope:
+    # exponential backoff with jitter, bounded attempts.
+    rpc_retry_max_attempts: int = 5
+    rpc_retry_base_delay_s: float = 0.05
+    rpc_retry_max_delay_s: float = 2.0
+    rpc_retry_multiplier: float = 2.0
+    rpc_retry_jitter: float = 0.5
+
+    # --- network fault injection (core/rpc.py FaultInjector) ---
+    # Disabled by default; the idle plane costs one None check per
+    # frame. RAY_TPU_FAULT_INJECTION_ENABLED=1 activates it;
+    # RAY_TPU_FAULT_INJECTION_RULES takes a JSON list of rule dicts,
+    # e.g. '[{"action": "drop", "method": "push_tasks",
+    # "probability": 0.05}]'.
+    fault_injection_enabled: bool = False
+    fault_injection_seed: int = 0
+    fault_injection_rules: str = ""
+
+    # --- node-death grace (core/gcs.py) ---
+    # An agent health-channel close marks the node SUSPECT for this
+    # window instead of declaring it dead; the agent reconnects with
+    # backoff and reattaches (0 restores instant declare-dead).
+    gcs_node_death_grace_s: float = 3.0
+
+    # --- object transfer ---
+    # Full sweeps over the holder list per pull (transient drops heal
+    # instead of surfacing ObjectLostError).
+    object_pull_max_attempts: int = 3
+
     # --- metrics ---
     metrics_report_interval_s: float = 5.0
     # Task-event buffer flush (reference: task_event_buffer.h).
@@ -119,6 +150,22 @@ class Config:
     # of a cold interpreter per worker (core/forkserver.py). POSIX only;
     # falls back to Popen on any error.
     worker_forkserver: bool = True
+
+    # --- serve ---
+    # Router -> controller control calls (snapshot refresh, pending-
+    # request reports).
+    serve_control_timeout_s: float = 30.0
+    # How long the router waits for scale-from-zero to bring a replica
+    # up before retrying/failing an assignment.
+    serve_scale_wait_timeout_s: float = 30.0
+    # Assignment attempts per request (replica death between refreshes).
+    serve_assign_max_attempts: int = 3
+    # DeploymentResponse default resolve/result timeout.
+    serve_handle_resolve_timeout_s: float = 60.0
+    # Per-replica circuit breaker: consecutive send failures before the
+    # replica is shed, and how long it stays shed before a probe.
+    serve_cb_failure_threshold: int = 3
+    serve_cb_reset_timeout_s: float = 5.0
 
     # --- logging ---
     log_dir: str = ""

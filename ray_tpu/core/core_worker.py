@@ -30,7 +30,7 @@ from dataclasses import dataclass, field
 from typing import Any, Callable, Dict, List, Optional, Tuple
 
 from ray_tpu import exceptions as exc
-from ray_tpu.core import object_store, object_transfer, rpc, serialization
+from ray_tpu.core import object_store, object_transfer, retry, rpc, serialization
 from ray_tpu.core.config import Config
 from ray_tpu.core.ids import (
     ActorID,
@@ -410,6 +410,16 @@ class CoreWorker:
         self._lineage_bytes = 0
         # task_id -> in-flight recovery future (coalesces racing gets).
         self._recovering: Dict[TaskID, asyncio.Future] = {}
+        # Unified retry envelope for this process's RPC stack (task and
+        # actor pushes, control-plane polls, recovery probes). Shared so
+        # retry counts are observable in one place.
+        self._rpc_retry = retry.RetryPolicy.from_config(config)
+        # Slower envelope for state-convergence probes (object-directory
+        # re-checks, death-reason queries): the signal travels through
+        # third parties, so sub-100ms retries just burn RPCs.
+        self._probe_retry = retry.RetryPolicy.from_config(
+            config, base_delay_s=0.4, multiplier=2.5, max_delay_s=1.0,
+            jitter=0.0)
         # Burst-coalesced submission queue (API thread -> loop).
         self._submit_buf: List[TaskSpec] = []
         self._submit_lock = threading.Lock()
@@ -809,7 +819,7 @@ class CoreWorker:
             # Probe FIRST: if the directory already reports zero
             # copies, reconstruction starts with no added latency; the
             # sleeps only buy time when copies allegedly exist.
-            for delay in (0.0, 0.3, 1.0):
+            for delay in self._probe_retry.backoff_series(3):
                 if (deadline is not None
                         and time.monotonic() + delay >= deadline):
                     break
@@ -1137,18 +1147,15 @@ class CoreWorker:
         fn = self._function_cache.get(key)
         if fn is not None:
             return fn
-        deadline = time.monotonic() + timeout
-        while True:
-            reply = await self.head.call(
-                "kv_get", {"ns": "functions", "key": key.encode()}
-            )
-            blob = reply.get("value")
-            if blob is not None:
-                break
-            if time.monotonic() > deadline:
-                raise exc.RayTpuError(f"function {key} not found in GCS")
-            await asyncio.sleep(0.05)
-        fn = serialization.loads_control(blob)
+        try:
+            reply = await self._rpc_retry.poll(
+                lambda: self.head.call(
+                    "kv_get", {"ns": "functions", "key": key.encode()}),
+                predicate=lambda r: r.get("value") is not None,
+                deadline_s=timeout, label=f"fetch_function {key[-12:]}")
+        except retry.PollTimeout:
+            raise exc.RayTpuError(f"function {key} not found in GCS")
+        fn = serialization.loads_control(reply["value"])
         self._function_cache[key] = fn
         return fn
 
@@ -1385,7 +1392,16 @@ class CoreWorker:
 
         async def push():
             try:
-                await conn.notify("push_tasks", {"specs": blobs})
+                # Non-idempotent: the policy only retries a frame that
+                # provably never left this process (ConnectionLost with
+                # sent=False — closed transport or injected partition).
+                # A connection that actually died fails fast to the
+                # requeue machinery instead of burning backoff in place.
+                await self._rpc_retry.execute(
+                    lambda: conn.notify("push_tasks", {"specs": blobs}),
+                    idempotent=False,
+                    should_retry=lambda e: not conn.closed,
+                    label="push_tasks")
             except (rpc.ConnectionLost, rpc.RpcError, OSError) as e:
                 self._fail_worker_conn(conn, e)
 
@@ -1664,7 +1680,7 @@ class CoreWorker:
                     # The kill reason races this query: a node agent's
                     # report_oom_kill travels to the head concurrently
                     # with the dead worker's TCP reset reaching us.
-                    for delay in (0.0, 0.5, 1.0):
+                    for delay in self._probe_retry.backoff_series(3):
                         if delay:
                             await asyncio.sleep(delay)
                         try:
@@ -1969,7 +1985,15 @@ class CoreWorker:
 
         async def push():
             try:
-                await conn.notify("push_tasks", {"specs": blobs})
+                # sent=False-only retries (see _push_tasks_to_worker):
+                # a scripted partition heals in place with backoff; a
+                # dead actor connection falls through to the park/retry
+                # state machine immediately.
+                await self._rpc_retry.execute(
+                    lambda: conn.notify("push_tasks", {"specs": blobs}),
+                    idempotent=False,
+                    should_retry=lambda e: not conn.closed,
+                    label="actor push_tasks")
             except (rpc.ConnectionLost, rpc.RpcError, OSError) as e:
                 self._fail_worker_conn(conn, e)
 

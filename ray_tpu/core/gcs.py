@@ -27,7 +27,7 @@ import time
 from collections import deque
 from typing import Dict, List, Optional, Set
 
-from ray_tpu.core import object_transfer, serialization
+from ray_tpu.core import object_transfer, retry, serialization
 from ray_tpu.core.config import Config
 from ray_tpu.core.ids import ActorID, JobID, NodeID, ObjectID, PlacementGroupID, WorkerID
 from ray_tpu.core.object_store import ShmStore
@@ -75,6 +75,12 @@ class HeadService:
         self.object_locations: Dict[str, Set[NodeID]] = {}
         # agent connections for remote nodes: node_id -> rpc.Connection
         self._node_agents: Dict[NodeID, object] = {}
+        # Nodes whose agent health channel dropped, waiting out the
+        # death-grace window (node_id -> grace task). A reconnecting
+        # agent reattaches here instead of registering a fresh node.
+        self._node_grace: Dict[NodeID, asyncio.Task] = {}
+        # Unified retry envelope for head->agent pushes.
+        self._rpc_retry = retry.RetryPolicy.from_config(config)
         self._object_waiters: Dict[str, List[asyncio.Future]] = {}
         # worker connection -> WorkerHandle
         self._conn_to_worker: Dict[object, WorkerHandle] = {}
@@ -240,8 +246,20 @@ class HeadService:
             try:
                 if agent is None:
                     raise RuntimeError("node agent disconnected")
-                await agent.call("spawn_worker",
-                                 {"worker_id": worker_id.hex()})
+                # sent=False-only retries: a spawn frame that reached the
+                # agent may already have forked; replaying it would leak
+                # a second process for the same worker id.
+                # timeout_per_attempt bounds a lost/unanswered frame (a
+                # drop fault, a wedged agent) — without it the call
+                # awaits a response forever and the policy never runs.
+                await self._rpc_retry.execute(
+                    lambda: agent.call("spawn_worker",
+                                       {"worker_id": worker_id.hex()}),
+                    idempotent=False,
+                    timeout_per_attempt=30.0,
+                    should_retry=lambda e: not getattr(
+                        agent, "closed", False),
+                    label="spawn_worker")
             except Exception:
                 logger.warning("spawn_worker on node %s failed",
                                node_id.hex()[:12])
@@ -508,24 +526,116 @@ class HeadService:
 
     async def h_register_node(self, conn, payload):
         """A node agent (remote host) joins the cluster. Its connection
-        doubles as the health channel: close ⇒ node death (reference:
-        node_manager.cc heartbeats / gcs_node_manager death handling)."""
+        doubles as the health channel: close ⇒ grace window ⇒ node death
+        (reference: node_manager.cc heartbeats / gcs_node_manager death
+        handling). A payload carrying a known ``node_id`` is a reconnect
+        from a briefly partitioned agent: reattach instead of
+        registering a fresh node."""
+        prev_hex = payload.get("node_id")
+        if prev_hex:
+            node_id = NodeID.from_hex(prev_hex)
+            if self._reattach_node(node_id, conn, payload):
+                self._hook_agent_close(conn, node_id)
+                return {"ok": True, "node_id": node_id.hex()}
+            # Grace expired (node already removed) — fall through and
+            # register as a brand-new node.
         node_id = self.add_node(
             payload["resources"], payload.get("labels"),
             agent_address=(payload["host"], payload["port"]),
             agent_conn=conn,
         )
+        self._hook_agent_close(conn, node_id)
+        return {"ok": True, "node_id": node_id.hex()}
+
+    def _hook_agent_close(self, conn, node_id: NodeID):
         prev_close = conn.on_close
 
         def on_close(c, _prev=prev_close, _nid=node_id):
             if _prev:
                 _prev(c)
-            logger.warning("node agent %s disconnected; removing node",
-                           _nid.hex()[:12])
-            self.remove_node(_nid)
+            self._on_agent_conn_lost(_nid, c)
 
         conn.on_close = on_close
-        return {"ok": True, "node_id": node_id.hex()}
+
+    def _reattach_node(self, node_id: NodeID, conn, payload) -> bool:
+        """Reattach a reconnecting agent to its SUSPECT (or still-ALIVE)
+        node within the grace window. Returns False when the node is
+        gone (grace expired -> remove_node already ran)."""
+        info = self.nodes_info.get(node_id)
+        if info is None or info.state == "DEAD":
+            return False
+        grace_task = self._node_grace.pop(node_id, None)
+        if grace_task is not None:
+            grace_task.cancel()
+        info.state = "ALIVE"
+        sched_node = self.scheduler.nodes.get(node_id)
+        if sched_node is not None:
+            sched_node.state = "ALIVE"  # placements resume
+        info.agent_address = (payload["host"], payload["port"])
+        self._node_agents[node_id] = conn
+        logger.info("node agent %s reconnected within grace window",
+                    node_id.hex()[:12])
+        self._publish("node_state", {
+            "node_id": node_id.hex(), "state": "ALIVE",
+            "resources": dict(info.resources),
+        })
+        self._pump()
+        return True
+
+    def _on_agent_conn_lost(self, node_id: NodeID, conn=None):
+        """Agent health channel dropped. Instead of instantly promoting
+        conn-close to node death, hold the node SUSPECT for the
+        configured grace window — the agent reconnects with backoff and
+        reattaches; only a grace timeout declares the node dead
+        (reference: gcs_health_check_manager's failure threshold before
+        death, vs raw channel state)."""
+        info = self.nodes_info.get(node_id)
+        if info is None or info.state == "DEAD":
+            return
+        # Only the CURRENT agent connection's close counts: a stale
+        # close racing in after a successful reattach must not restart
+        # the grace clock on the healthy replacement channel.
+        if conn is not None and self._node_agents.get(node_id) not in (
+                None, conn):
+            return
+        self._node_agents.pop(node_id, None)
+        grace = self.config.gcs_node_death_grace_s
+        if grace <= 0 or self._shutdown:
+            logger.warning("node agent %s disconnected; removing node",
+                           node_id.hex()[:12])
+            self.remove_node(node_id)
+            return
+        if node_id in self._node_grace:
+            return
+        logger.warning(
+            "node agent %s disconnected; %.1fs grace before declaring "
+            "the node dead", node_id.hex()[:12], grace)
+        info.state = "SUSPECT"
+        # Mirror into the scheduler's node table: new leases must not
+        # land on a node whose agent can't fork workers right now (the
+        # spawn would fail and churn mark-dead/backoff for the whole
+        # window); existing workers/leases keep running untouched.
+        sched_node = self.scheduler.nodes.get(node_id)
+        if sched_node is not None:
+            sched_node.state = "SUSPECT"
+        self._publish("node_state", {
+            "node_id": node_id.hex(), "state": "SUSPECT",
+        })
+        self._node_grace[node_id] = asyncio.get_running_loop().create_task(
+            self._grace_then_remove(node_id, grace))
+
+    async def _grace_then_remove(self, node_id: NodeID, grace: float):
+        try:
+            await asyncio.sleep(grace)
+        except asyncio.CancelledError:
+            return  # agent reattached
+        self._node_grace.pop(node_id, None)
+        info = self.nodes_info.get(node_id)
+        if info is None or info.state != "SUSPECT":
+            return
+        logger.warning("node %s grace window expired; declaring dead",
+                       node_id.hex()[:12])
+        self.remove_node(node_id)
 
     async def h_worker_exited_early(self, conn, payload):
         """Agent-reported death of a spawned worker that never registered
@@ -1105,7 +1215,11 @@ class HeadService:
         locations = []
         for node_id in self.object_locations.get(hex_id, set()):
             info = self.nodes_info.get(node_id)
-            if info is None or info.state != "ALIVE":
+            # SUSPECT (in-grace) nodes stay listed: only the head-side
+            # health channel blipped; the pull plane may still reach
+            # them, and the puller's retry sweep tolerates the ones it
+            # can't.
+            if info is None or info.state == "DEAD":
                 continue
             if info.agent_address is not None:
                 locations.append(list(info.agent_address))
@@ -1352,6 +1466,9 @@ class HeadService:
         self._shutdown = True
         if self._pump_task:
             self._pump_task.cancel()
+        for task in self._node_grace.values():
+            task.cancel()
+        self._node_grace.clear()
         if self.pool:
             self.pool.shutdown()
         self.shm.cleanup()

@@ -36,7 +36,7 @@ import tempfile
 import time
 from typing import Dict, Optional
 
-from ray_tpu.core import native_store, object_store, object_transfer, rpc
+from ray_tpu.core import native_store, object_store, object_transfer, retry, rpc
 from ray_tpu.core.config import get_config
 from ray_tpu.core.ids import ObjectID, WorkerID
 
@@ -63,6 +63,9 @@ class NodeAgent:
         self._exit = asyncio.Event()
         self._peer_conns: Dict[tuple, rpc.Connection] = {}
         self._puller = object_transfer.ObjectPuller(self._get_peer_conn)
+        # Unified retry envelope for agent->head control calls.
+        self._retry = retry.RetryPolicy.from_config(get_config())
+        self._reconnecting = False
 
         capacity = object_store_memory or object_store.default_capacity(
             get_config().object_store_memory_proportion)
@@ -233,23 +236,88 @@ class NodeAgent:
         # follows the control plane's.
         os.environ.setdefault("RAY_TPU_BIND_HOST", bind)
         self.port = await self.server.start(bind, 0)
-        self.head_conn = await rpc.connect(
-            self.head_host, self.head_port, self.handlers(),
-            name="agent-head")
-        self.head_conn.on_close = lambda c: self._exit.set()
-        reply = await self.head_conn.call("register_node", {
-            "host": self.host,
-            "port": self.port,
-            "resources": self.resources,
-            "labels": self.labels,
-        })
-        if not reply.get("ok"):
-            raise RuntimeError(f"node registration rejected: {reply}")
-        self.node_id_hex = reply["node_id"]
+        # Head may still be coming up: dial under the unified policy.
+        await self._retry.execute(
+            lambda: self._dial_head(reconnect=False),
+            label="register_node")
         logger.info("node %s registered (%s:%s), %s",
                     self.node_id_hex[:12], self.host, self.port,
                     self.resources)
         asyncio.get_running_loop().create_task(self._reap_loop())
+
+    async def _dial_head(self, reconnect: bool) -> None:
+        """Dial the head and (re)register. On a reconnect the payload
+        carries our node id so the head reattaches us to the SUSPECT
+        node inside its death-grace window instead of minting a new
+        one."""
+        conn = await rpc.connect(
+            self.head_host, self.head_port, self.handlers(),
+            name="agent-head")
+        payload = {
+            "host": self.host,
+            "port": self.port,
+            "resources": self.resources,
+            "labels": self.labels,
+        }
+        if reconnect and self.node_id_hex:
+            payload["node_id"] = self.node_id_hex
+        try:
+            reply = await conn.call("register_node", payload, timeout=10.0)
+        except BaseException:
+            await conn.close()
+            raise
+        if not reply.get("ok"):
+            await conn.close()
+            raise RuntimeError(f"node registration rejected: {reply}")
+        conn.on_close = self._on_head_conn_lost
+        if conn.closed:
+            # Torn down between the reply and the hook install (the
+            # close callback fired with on_close still unset): surface
+            # it to the surrounding retry so the dial is repeated —
+            # silently keeping a dead head_conn makes a zombie agent.
+            raise rpc.ConnectionLost("head closed during registration")
+        self.head_conn = conn
+        if (reconnect and self.node_id_hex
+                and reply["node_id"] != self.node_id_hex):
+            # Grace expired head-side: we came back as a brand-new node.
+            # Workers of the old identity are unreachable from the head
+            # (it already restarted their actors elsewhere) — letting
+            # them run would double-execute side effects and double-book
+            # this host's resources.
+            logger.warning(
+                "re-registered as new node %s (was %s); killing %d "
+                "workers of the dead identity", reply["node_id"][:12],
+                self.node_id_hex[:12], len(self._procs))
+            self._kill_all_workers()
+        self.node_id_hex = reply["node_id"]
+
+    def _on_head_conn_lost(self, conn):
+        if self._exit.is_set() or self._reconnecting:
+            return
+        self._reconnecting = True
+        logger.warning("head connection lost; reconnecting with backoff")
+        asyncio.get_running_loop().create_task(self._reconnect_head())
+
+    async def _reconnect_head(self):
+        # Enough attempts to comfortably outlast the head's
+        # gcs_node_death_grace_s (reconnect inside the window keeps our
+        # node id, workers and store intact).
+        policy = retry.RetryPolicy.from_config(
+            get_config(), max_attempts=10, base_delay_s=0.25,
+            max_delay_s=2.0)
+        try:
+            await policy.execute(
+                lambda: self._dial_head(reconnect=True),
+                label="agent reconnect")
+            logger.info("reconnected to head as node %s",
+                        (self.node_id_hex or "")[:12])
+        except Exception:
+            logger.error(
+                "head unreachable after %d attempts; shutting down "
+                "node agent", policy.max_attempts)
+            self._exit.set()
+        finally:
+            self._reconnecting = False
 
     async def _reap_loop(self):
         from ray_tpu.core import memory_monitor as mm
@@ -278,9 +346,15 @@ class NodeAgent:
                 if proc.poll() is not None:
                     self._procs.pop(worker_id, None)
                     try:
-                        await self.head_conn.call(
-                            "worker_exited_early",
-                            {"worker_id": worker_id})
+                        # Idempotent at the head (no-op unless the worker
+                        # is still STARTING) — safe to replay through a
+                        # blip on the health channel.
+                        await self._retry.execute(
+                            lambda wid=worker_id: self.head_conn.call(
+                                "worker_exited_early",
+                                {"worker_id": wid}),
+                            timeout_per_attempt=10.0,
+                            label="worker_exited_early")
                     except Exception:
                         pass
             # Stream new worker output to subscribed drivers
@@ -304,8 +378,13 @@ class NodeAgent:
                 if killed is not None:
                     reason = self._last_oom_reason or "memory monitor kill"
                     try:
-                        await self.head_conn.call("report_oom_kill", {
-                            "worker_id": killed, "reason": reason})
+                        # Idempotent (overwrites the same reason row).
+                        await self._retry.execute(
+                            lambda: self.head_conn.call(
+                                "report_oom_kill",
+                                {"worker_id": killed, "reason": reason}),
+                            timeout_per_attempt=10.0,
+                            label="report_oom_kill")
                     except Exception:
                         pass
             await asyncio.sleep(0.5)
@@ -330,7 +409,7 @@ class NodeAgent:
         await self._exit.wait()
         self.shutdown()
 
-    def shutdown(self):
+    def _kill_all_workers(self):
         for proc in self._procs.values():
             if proc.poll() is None:
                 try:
@@ -338,6 +417,9 @@ class NodeAgent:
                 except Exception:
                     pass
         self._procs.clear()
+
+    def shutdown(self):
+        self._kill_all_workers()
         if self._forkserver is not None:
             self._forkserver.stop()
             self._forkserver = None

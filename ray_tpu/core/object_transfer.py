@@ -29,7 +29,7 @@ import threading
 import time
 from typing import Awaitable, Callable, Dict, List, Optional, Tuple
 
-from ray_tpu.core import object_store, rpc
+from ray_tpu.core import object_store, retry, rpc
 from ray_tpu.core.ids import ObjectID
 
 logger = logging.getLogger(__name__)
@@ -327,10 +327,21 @@ class ObjectPuller:
     """
 
     def __init__(self, get_connection: Callable[[Tuple[str, int]],
-                                                Awaitable]):
+                                                Awaitable],
+                 policy: Optional[retry.RetryPolicy] = None):
         self._get_connection = get_connection
         self._inflight: Dict[str, asyncio.Future] = {}
         self._budget = asyncio.Semaphore(MAX_INFLIGHT_BYTES // CHUNK_BYTES)
+        self._retry = policy
+
+    def _policy(self) -> retry.RetryPolicy:
+        if self._retry is None:
+            from ray_tpu.core.config import get_config
+
+            cfg = get_config()
+            self._retry = retry.RetryPolicy.from_config(
+                cfg, max_attempts=max(1, cfg.object_pull_max_attempts))
+        return self._retry
 
     async def pull(self, object_id: ObjectID,
                    locations: List[Tuple[str, int]]) -> bool:
@@ -357,15 +368,34 @@ class ObjectPuller:
 
     async def _pull_once(self, object_id: ObjectID,
                          locations: List[Tuple[str, int]]) -> bool:
+        """Sweep the holder list; retry the whole sweep under the
+        unified policy so a transient drop/partition to every holder
+        heals instead of surfacing as object loss."""
+        if not locations:
+            return False
         last_error: Optional[Exception] = None
-        for address in locations:
-            try:
-                if await self._pull_from(object_id, tuple(address)):
-                    return True
-            except Exception as e:  # holder died mid-pull: try the next
-                last_error = e
-                logger.debug("pull of %s from %s failed: %s",
-                             object_id.hex()[:12], address, e)
+        policy = self._policy()
+        for delay in policy.backoff_series():
+            if delay:
+                policy.total_retries += 1
+                await asyncio.sleep(delay)
+            sweep_error: Optional[Exception] = None
+            for address in locations:
+                try:
+                    if await self._pull_from(object_id, tuple(address)):
+                        return True
+                except asyncio.CancelledError:
+                    raise
+                except Exception as e:  # holder died mid-pull: try next
+                    sweep_error = e
+                    logger.debug("pull of %s from %s failed: %s",
+                                 object_id.hex()[:12], address, e)
+            if sweep_error is None:
+                # Every holder answered cleanly "not present": nothing
+                # transient to heal — fail fast into reconstruction
+                # instead of burning backoff on redundant sweeps.
+                break
+            last_error = sweep_error
         if last_error is not None:
             logger.info("pull of %s failed from all %d holders: %s",
                         object_id.hex()[:12], len(locations), last_error)

@@ -1,0 +1,328 @@
+"""Unified retry/backoff policy for the RPC stack.
+
+Reference: the reference runtime scatters retry loops across the GCS
+client (gcs_rpc_client.h retryable-grpc-client), the core worker's task
+resubmission, the object manager's pull retries and Serve's router.
+This module centralizes the policy so every retry site shares one
+envelope — exponential backoff with jitter, max-attempts, an overall
+deadline — and one safety rule: a ``ConnectionLost`` whose ``sent``
+flag is True is only retried when the caller declares the operation
+idempotent (at-most-once semantics for everything else).
+
+Consumers:
+- ``core_worker``: task/actor push frames, function-table polls,
+  death-reason probes, object-recovery probes.
+- ``gcs``/``node_agent``: agent-side spawn pushes; the agent's
+  reconnect-with-backoff to the head after a dropped health channel.
+- ``object_transfer``: pull sweeps across holders.
+- ``serve.router``: request assignment, plus the per-replica
+  ``CircuitBreaker`` that sheds traffic from broken replicas.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import logging
+import random
+import threading
+import time
+from dataclasses import dataclass, field
+from typing import Any, Awaitable, Callable, Dict, Iterator, Optional, Tuple, Type
+
+from ray_tpu.core import rpc
+
+logger = logging.getLogger(__name__)
+
+# Transport-level failures: the request may never have reached (or never
+# have left) the peer. Plain RpcError is deliberately excluded — it
+# carries a remote handler's exception, which is deterministic and must
+# not be replayed.
+TRANSIENT_ERRORS: Tuple[Type[BaseException], ...] = (
+    rpc.ConnectionLost,
+    asyncio.TimeoutError,
+    TimeoutError,
+    OSError,
+)
+
+
+class PollTimeout(Exception):
+    """RetryPolicy.poll exhausted its deadline without the predicate
+    ever holding. ``last_result``/``last_error`` carry the final poll's
+    outcome so the call site can raise a domain-specific error."""
+
+    def __init__(self, msg: str = "", last_result: Any = None,
+                 last_error: Optional[BaseException] = None):
+        super().__init__(msg)
+        self.last_result = last_result
+        self.last_error = last_error
+
+
+@dataclass
+class RetryPolicy:
+    """Exponential backoff with jitter, bounded attempts, optional
+    overall deadline.
+
+    One instance is typically shared per process/subsystem; the
+    ``total_attempts``/``total_retries`` counters make retry behavior
+    observable to tests and metrics without extra plumbing.
+    """
+
+    max_attempts: int = 5
+    base_delay_s: float = 0.05
+    max_delay_s: float = 2.0
+    multiplier: float = 2.0
+    #: Fractional jitter: each delay is scaled by a uniform factor in
+    #: [1 - jitter, 1 + jitter]. 0 disables (deterministic backoff).
+    jitter: float = 0.5
+    #: Exception classes considered transient. See TRANSIENT_ERRORS.
+    retry_on: Tuple[Type[BaseException], ...] = TRANSIENT_ERRORS
+    #: Seed for the jitter RNG (deterministic tests).
+    seed: Optional[int] = None
+
+    total_attempts: int = field(default=0, compare=False)
+    total_retries: int = field(default=0, compare=False)
+
+    def __post_init__(self):
+        self._rng = random.Random(self.seed)
+
+    @classmethod
+    def from_config(cls, config, **overrides) -> "RetryPolicy":
+        """Build from the ``rpc_retry_*`` knobs in core/config.py (each
+        overridable with a ``RAY_TPU_RPC_RETRY_*`` env var)."""
+        kw = dict(
+            max_attempts=config.rpc_retry_max_attempts,
+            base_delay_s=config.rpc_retry_base_delay_s,
+            max_delay_s=config.rpc_retry_max_delay_s,
+            multiplier=config.rpc_retry_multiplier,
+            jitter=config.rpc_retry_jitter,
+        )
+        kw.update(overrides)
+        return cls(**kw)
+
+    # -- delay schedule -------------------------------------------------
+
+    def backoff_delay(self, retry_index: int) -> float:
+        """Delay before retry ``retry_index`` (0-based)."""
+        delay = min(self.base_delay_s * (self.multiplier ** retry_index),
+                    self.max_delay_s)
+        if self.jitter:
+            delay *= 1.0 + self.jitter * (2.0 * self._rng.random() - 1.0)
+        return max(0.0, delay)
+
+    def backoff_series(self, n: Optional[int] = None) -> Iterator[float]:
+        """Yield ``n`` (default: max_attempts) delays starting with 0.0
+        — drop-in replacement for hand-rolled ``for delay in (0.0, 0.3,
+        1.0)`` probe loops."""
+        count = self.max_attempts if n is None else n
+        for i in range(count):
+            yield 0.0 if i == 0 else self.backoff_delay(i - 1)
+
+    # -- retryability ---------------------------------------------------
+
+    def is_transient(self, error: BaseException, idempotent: bool = True
+                     ) -> bool:
+        """True when ``error`` may be retried. A ``ConnectionLost`` with
+        ``sent=True`` means the peer may have executed the request; it
+        is retried only for idempotent operations (at-most-once for the
+        rest). ``sent=False`` is always a free retry — the frame never
+        hit the socket."""
+        if not isinstance(error, self.retry_on):
+            return False
+        if isinstance(error, rpc.ConnectionLost):
+            return idempotent or not error.sent
+        # Other transients (timeouts, resets) are ambiguous about
+        # whether the peer executed the request: idempotent-only.
+        return idempotent
+
+    # -- execution ------------------------------------------------------
+
+    def _retry_delay(self, error: BaseException, retry_index: int,
+                     idempotent: bool,
+                     should_retry: Optional[Callable[[BaseException], bool]],
+                     deadline: Optional[float], label: str
+                     ) -> Optional[float]:
+        """The one retry decision, shared by the async and sync drivers:
+        returns the backoff delay for the next attempt, or None when the
+        policy is exhausted / the error must propagate."""
+        if retry_index + 1 >= self.max_attempts:
+            return None
+        if not self.is_transient(error, idempotent):
+            return None
+        if should_retry is not None and not should_retry(error):
+            return None
+        delay = self.backoff_delay(retry_index)
+        if deadline is not None and time.monotonic() + delay >= deadline:
+            return None
+        self.total_retries += 1
+        logger.debug("retry %d/%d%s after %s: backoff %.3fs",
+                     retry_index + 1, self.max_attempts - 1,
+                     f" ({label})" if label else "",
+                     type(error).__name__, delay)
+        return delay
+
+    async def execute(self, fn: Callable[[], Awaitable[Any]], *,
+                      idempotent: bool = True,
+                      deadline_s: Optional[float] = None,
+                      timeout_per_attempt: Optional[float] = None,
+                      should_retry: Optional[Callable[[BaseException], bool]] = None,
+                      label: str = "") -> Any:
+        """Run ``await fn()`` under the policy.
+
+        ``deadline_s`` is an overall wall budget: it caps each attempt's
+        timeout AND stops retrying once the budget (minus the pending
+        backoff sleep) is spent — deadline propagation, not per-attempt
+        reset. ``should_retry`` is an extra caller veto evaluated after
+        the transient check (e.g. "only retry while the connection is
+        still open")."""
+        deadline = (time.monotonic() + deadline_s
+                    if deadline_s is not None else None)
+        retry_index = 0
+        while True:
+            self.total_attempts += 1
+            try:
+                timeout = timeout_per_attempt
+                if deadline is not None:
+                    remaining = deadline - time.monotonic()
+                    if remaining <= 0:
+                        raise asyncio.TimeoutError(
+                            f"deadline exhausted before attempt ({label})")
+                    timeout = (remaining if timeout is None
+                               else min(timeout, remaining))
+                if timeout is not None:
+                    return await asyncio.wait_for(fn(), timeout)
+                return await fn()
+            except BaseException as e:  # noqa: BLE001 — filtered below
+                delay = self._retry_delay(e, retry_index, idempotent,
+                                          should_retry, deadline, label)
+                if delay is None:
+                    raise
+                retry_index += 1
+                await asyncio.sleep(delay)
+
+    def execute_sync(self, fn: Callable[[], Any], *,
+                     idempotent: bool = True,
+                     deadline_s: Optional[float] = None,
+                     should_retry: Optional[Callable[[BaseException], bool]] = None,
+                     label: str = "") -> Any:
+        """Blocking-thread variant of ``execute`` (Serve router / other
+        non-asyncio callers)."""
+        deadline = (time.monotonic() + deadline_s
+                    if deadline_s is not None else None)
+        retry_index = 0
+        while True:
+            self.total_attempts += 1
+            try:
+                return fn()
+            except BaseException as e:  # noqa: BLE001 — filtered below
+                delay = self._retry_delay(e, retry_index, idempotent,
+                                          should_retry, deadline, label)
+                if delay is None:
+                    raise
+                retry_index += 1
+                time.sleep(delay)
+
+    async def poll(self, fn: Callable[[], Awaitable[Any]], *,
+                   predicate: Callable[[Any], bool] = bool,
+                   deadline_s: float,
+                   label: str = "") -> Any:
+        """Re-run ``fn`` until ``predicate(result)`` holds, sleeping the
+        policy's backoff between polls (attempts unbounded; the deadline
+        is the budget, and also bounds each in-flight await — a dropped
+        reply cannot hang the poll past it). Transient errors count as a
+        failed poll; other errors propagate. Raises ``PollTimeout`` at
+        the deadline."""
+        deadline = time.monotonic() + deadline_s
+        retry_index = 0
+        last_result: Any = None
+        last_error: Optional[BaseException] = None
+
+        def timed_out():
+            return PollTimeout(
+                f"poll{f' ({label})' if label else ''} deadline "
+                f"({deadline_s:.1f}s) exhausted",
+                last_result=last_result, last_error=last_error)
+
+        while True:
+            self.total_attempts += 1
+            remaining = deadline - time.monotonic()
+            if remaining <= 0:
+                raise timed_out()
+            try:
+                last_result = await asyncio.wait_for(fn(), remaining)
+                last_error = None
+                if predicate(last_result):
+                    return last_result
+            except BaseException as e:  # noqa: BLE001 — filtered below
+                if not self.is_transient(e, True):
+                    raise
+                last_error = e
+            delay = self.backoff_delay(retry_index)
+            retry_index += 1
+            self.total_retries += 1
+            if time.monotonic() + delay >= deadline:
+                raise timed_out()
+            await asyncio.sleep(delay)
+
+
+class CircuitBreaker:
+    """Per-key consecutive-failure breaker (Serve replicas, peers).
+
+    CLOSED: traffic flows. After ``failure_threshold`` consecutive
+    failures the key OPENs for ``reset_timeout_s`` — ``available``
+    returns False so routers shed to healthy keys. Once the window
+    elapses the key is HALF_OPEN: available again, and the next outcome
+    decides (success closes, failure re-opens for a fresh window).
+    Thread-safe: Serve's router is driven from arbitrary user threads.
+    """
+
+    def __init__(self, failure_threshold: int = 3,
+                 reset_timeout_s: float = 5.0,
+                 clock: Callable[[], float] = time.monotonic):
+        self.failure_threshold = max(1, failure_threshold)
+        self.reset_timeout_s = reset_timeout_s
+        self._clock = clock
+        self._lock = threading.Lock()
+        # key -> [consecutive_failures, open_until (0 when closed)]
+        self._entries: Dict[str, list] = {}
+
+    def record_success(self, key: str) -> None:
+        with self._lock:
+            self._entries.pop(key, None)
+
+    def record_failure(self, key: str) -> None:
+        with self._lock:
+            entry = self._entries.setdefault(key, [0, 0.0])
+            entry[0] += 1
+            if entry[0] >= self.failure_threshold:
+                entry[1] = self._clock() + self.reset_timeout_s
+                # Half-open probe failure re-opens with a fresh count.
+                entry[0] = self.failure_threshold - 1
+
+    def available(self, key: str) -> bool:
+        with self._lock:
+            entry = self._entries.get(key)
+            if entry is None:
+                return True
+            return self._clock() >= entry[1]
+
+    def state(self, key: str) -> str:
+        with self._lock:
+            entry = self._entries.get(key)
+            if entry is None:
+                return "CLOSED"
+            if self._clock() < entry[1]:
+                return "OPEN"
+            return "HALF_OPEN" if entry[1] else "CLOSED"
+
+    def forget(self, key: str) -> None:
+        with self._lock:
+            self._entries.pop(key, None)
+
+    def retain(self, keys) -> None:
+        """Drop every entry NOT in ``keys`` — callers sync the breaker
+        to a live-replica set so entries can't leak across churn."""
+        keys = set(keys)
+        with self._lock:
+            for key in list(self._entries):
+                if key not in keys:
+                    del self._entries[key]

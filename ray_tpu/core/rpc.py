@@ -22,8 +22,11 @@ from __future__ import annotations
 import asyncio
 import itertools
 import logging
+import os
 import threading
-from typing import Any, Awaitable, Callable, Dict, Optional
+import time
+from fnmatch import fnmatchcase
+from typing import Any, Awaitable, Callable, Dict, List, Optional, Tuple
 
 import msgpack
 
@@ -47,6 +50,208 @@ class ConnectionLost(RpcError):
     def __init__(self, msg: str = "", sent: bool = True):
         super().__init__(msg)
         self.sent = sent
+
+
+# ---------------------------------------------------------------------------
+# Network fault-injection plane (reference: Ray's chaos suites inject
+# network faults below the RPC clients — test_utils' kill-based killers
+# plus gRPC-level fault hooks). Rules live in a per-process injector;
+# every frame consults it ONLY when rules are installed, so the hot send
+# path pays a single module-global None check when the plane is idle.
+# ---------------------------------------------------------------------------
+
+DROP = "drop"
+DELAY = "delay"
+DUPLICATE = "duplicate"
+PARTITION = "partition"
+FAULT_ACTIONS = (DROP, DELAY, DUPLICATE, PARTITION)
+
+
+class FaultRule:
+    """One matchable fault. Matching is by direction ('send'/'recv'),
+    peer (fnmatch on Connection.name) and RPC method (fnmatch; response
+    frames carry no method and only match a '*' method pattern)."""
+
+    __slots__ = ("action", "peer", "method", "direction", "probability",
+                 "delay_s", "jitter_s", "max_matches", "duration_s",
+                 "rule_id", "matches", "installed_at")
+
+    def __init__(self, action: str, peer: str = "*", method: str = "*",
+                 direction: str = "both", probability: float = 1.0,
+                 delay_s: float = 0.0, jitter_s: float = 0.0,
+                 max_matches: int = 0, duration_s: float = 0.0):
+        if action not in FAULT_ACTIONS:
+            raise ValueError(f"unknown fault action {action!r}")
+        if direction not in ("send", "recv", "both"):
+            raise ValueError(f"unknown fault direction {direction!r}")
+        self.action = action
+        self.peer = peer
+        self.method = method
+        self.direction = direction
+        self.probability = probability
+        self.delay_s = delay_s
+        self.jitter_s = jitter_s
+        self.max_matches = max_matches
+        self.duration_s = duration_s
+        self.rule_id = 0
+        self.matches = 0
+        self.installed_at = 0.0
+
+    def expired(self, now: float) -> bool:
+        if self.duration_s and now - self.installed_at >= self.duration_s:
+            return True
+        return bool(self.max_matches and self.matches >= self.max_matches)
+
+    def __repr__(self):
+        return (f"FaultRule(#{self.rule_id} {self.action} peer={self.peer!r} "
+                f"method={self.method!r} dir={self.direction} "
+                f"p={self.probability} matches={self.matches})")
+
+
+class FaultInjector:
+    """Deterministic (seeded) per-process fault plane.
+
+    Tests install rules at runtime to script partitions around specific
+    calls; deployments can pre-install rules via RAY_TPU_FAULT_INJECTION_*
+    env vars (see core/config.py). All decisions flow through one seeded
+    RNG, so a fixed seed reproduces the exact same drop/delay pattern.
+    """
+
+    def __init__(self, seed: int = 0):
+        import random
+
+        self.rng = random.Random(seed)
+        self.rules: List[FaultRule] = []
+        self.stats: Dict[str, int] = {a: 0 for a in FAULT_ACTIONS}
+        self._lock = threading.Lock()
+        self._next_id = itertools.count(1)
+
+    def install(self, action: str, **kwargs) -> int:
+        """Install a rule; returns its id for targeted clear()."""
+        rule = action if isinstance(action, FaultRule) \
+            else FaultRule(action, **kwargs)
+        with self._lock:
+            rule.rule_id = next(self._next_id)
+            rule.installed_at = time.monotonic()
+            self.rules.append(rule)
+        logger.info("fault rule installed: %r", rule)
+        return rule.rule_id
+
+    def clear(self, rule_id: Optional[int] = None) -> None:
+        """Remove one rule by id, or every rule when id is None."""
+        with self._lock:
+            if rule_id is None:
+                self.rules.clear()
+            else:
+                self.rules = [r for r in self.rules
+                              if r.rule_id != rule_id]
+
+    def reset(self) -> None:
+        with self._lock:
+            self.rules.clear()
+            self.stats = {a: 0 for a in FAULT_ACTIONS}
+
+    def on_frame(self, direction: str, peer: str, method: Optional[str]
+                 ) -> Optional[Tuple[str, float]]:
+        """First-matching-rule verdict for one frame, or None to pass
+        through. Returns (action, delay_s)."""
+        now = time.monotonic()
+        with self._lock:
+            live = [r for r in self.rules if not r.expired(now)]
+            if len(live) != len(self.rules):
+                self.rules = live
+            for rule in live:
+                if rule.direction != "both" and rule.direction != direction:
+                    continue
+                if not fnmatchcase(peer or "", rule.peer):
+                    continue
+                if method is None:
+                    # Response frames carry no method: only a wildcard
+                    # method pattern (blanket rules, partitions) matches.
+                    if rule.method != "*":
+                        continue
+                elif not fnmatchcase(method, rule.method):
+                    continue
+                if rule.probability < 1.0 and \
+                        self.rng.random() >= rule.probability:
+                    continue
+                rule.matches += 1
+                self.stats[rule.action] = self.stats.get(rule.action, 0) + 1
+                delay = rule.delay_s
+                if rule.jitter_s:
+                    delay += self.rng.random() * rule.jitter_s
+                return rule.action, delay
+        return None
+
+
+#: None until someone enables injection — the idle-plane hot-path check
+#: is a single global load + None test.
+_fault_injector: Optional[FaultInjector] = None
+_env_checked = False
+
+
+def get_fault_injector() -> FaultInjector:
+    """The process's injector, created on first use. Seeded through
+    core/config.py (``fault_injection_seed`` — env var or
+    ``system_config``), falling back to the raw env var during partial
+    bootstrap."""
+    global _fault_injector
+    if _fault_injector is None:
+        try:
+            from ray_tpu.core.config import get_config
+
+            seed = get_config().fault_injection_seed
+        except Exception:
+            seed = int(os.environ.get("RAY_TPU_FAULT_INJECTION_SEED",
+                                      "0"))
+        _fault_injector = FaultInjector(seed=seed)
+    return _fault_injector
+
+
+def reset_fault_injector() -> None:
+    """Drop the process injector entirely (tests restore the zero-cost
+    disabled state)."""
+    global _fault_injector
+    _fault_injector = None
+
+
+def _maybe_init_fault_injection_from_env() -> None:
+    """Activate configured rules once per process (checked lazily on
+    the first Connection, so worker processes spawned with the
+    RAY_TPU_FAULT_INJECTION_* env vars inherit the plane without any
+    init-order coupling). Reads through core/config.py so both env vars
+    and ``system_config`` overrides apply."""
+    global _env_checked
+    if _env_checked:
+        return
+    _env_checked = True
+    try:
+        from ray_tpu.core.config import get_config
+
+        cfg = get_config()
+        enabled = cfg.fault_injection_enabled
+        rules_json = cfg.fault_injection_rules
+        seed = cfg.fault_injection_seed
+    except Exception:  # config unavailable (partial bootstrap): raw env
+        enabled = os.environ.get(
+            "RAY_TPU_FAULT_INJECTION_ENABLED", "").lower() in (
+                "1", "true", "yes")
+        rules_json = os.environ.get("RAY_TPU_FAULT_INJECTION_RULES", "")
+        seed = int(os.environ.get("RAY_TPU_FAULT_INJECTION_SEED", "0"))
+    if not enabled and not rules_json:
+        return
+    global _fault_injector
+    if _fault_injector is None:
+        _fault_injector = FaultInjector(seed=seed)
+    if rules_json:
+        import json
+
+        try:
+            for spec in json.loads(rules_json):
+                action = spec.pop("action")
+                _fault_injector.install(action, **spec)
+        except Exception:
+            logger.exception("bad RAY_TPU_FAULT_INJECTION_RULES; ignored")
 
 
 # StreamReader buffer: the data plane ships MiB chunk frames; the
@@ -77,6 +282,7 @@ class Connection:
 
     def __init__(self, reader: asyncio.StreamReader, writer: asyncio.StreamWriter,
                  handlers: Dict[str, Handler], name: str = ""):
+        _maybe_init_fault_injection_from_env()
         self.reader = reader
         self.writer = writer
         self.handlers = handlers
@@ -128,35 +334,20 @@ class Connection:
                         d = {} if d is None else {"value": d}
                         msg["d"] = d
                     d["__attachment__"] = blob
-                t = msg["t"]
-                if t == "res":
-                    fut = self._pending.pop(msg["i"], None)
-                    if fut is not None and not fut.done():
-                        if msg.get("e"):
-                            fut.set_exception(RpcError(msg["e"]))
-                        else:
-                            fut.set_result(msg.get("d"))
-                elif t == "ntf":
-                    handler = self.handlers.get(msg.get("m"))
-                    if handler is not None and not \
-                            asyncio.iscoroutinefunction(handler):
-                        # Sync fast path: notification handlers that
-                        # never await run inline — one asyncio Task per
-                        # tiny-task completion is the dominant loop
-                        # overhead at high task rates.
-                        try:
-                            handler(self, msg.get("d"))
-                        except Exception:
-                            logger.exception("notify handler %s failed",
-                                             msg.get("m"))
-                    else:
-                        asyncio.get_running_loop().create_task(
-                            self._dispatch(t, msg)
-                        )
-                elif t == "req":
-                    asyncio.get_running_loop().create_task(
-                        self._dispatch(t, msg)
-                    )
+                fi = _fault_injector
+                if fi is not None and fi.rules:
+                    verdict = fi.on_frame("recv", self.name, msg.get("m"))
+                    if verdict is not None:
+                        action, delay = verdict
+                        if action in (DROP, PARTITION):
+                            continue  # inbound frame lost on the wire
+                        if action == DELAY:
+                            asyncio.get_running_loop().call_later(
+                                delay, self._process_frame, msg)
+                            continue
+                        if action == DUPLICATE:
+                            self._process_frame(msg)
+                self._process_frame(msg)
         except (asyncio.IncompleteReadError, ConnectionResetError, OSError):
             pass
         except asyncio.CancelledError:
@@ -165,6 +356,35 @@ class Connection:
             logger.exception("rpc read loop error on %s", self.name)
         finally:
             await self._teardown()
+
+    def _process_frame(self, msg: dict) -> None:
+        """Route one inbound frame (factored from the read loop so the
+        fault plane can delay/duplicate processing)."""
+        t = msg["t"]
+        if t == "res":
+            fut = self._pending.pop(msg["i"], None)
+            if fut is not None and not fut.done():
+                if msg.get("e"):
+                    fut.set_exception(RpcError(msg["e"]))
+                else:
+                    fut.set_result(msg.get("d"))
+        elif t == "ntf":
+            handler = self.handlers.get(msg.get("m"))
+            if handler is not None and not \
+                    asyncio.iscoroutinefunction(handler):
+                # Sync fast path: notification handlers that
+                # never await run inline — one asyncio Task per
+                # tiny-task completion is the dominant loop
+                # overhead at high task rates.
+                try:
+                    handler(self, msg.get("d"))
+                except Exception:
+                    logger.exception("notify handler %s failed",
+                                     msg.get("m"))
+            else:
+                self._loop.create_task(self._dispatch(t, msg))
+        elif t == "req":
+            self._loop.create_task(self._dispatch(t, msg))
 
     async def _dispatch(self, t: str, msg: dict):
         method = msg.get("m")
@@ -188,6 +408,38 @@ class Connection:
                               "e": error}, attachment)
 
     def _enqueue_frame(self, msg: dict, attachment=None) -> bool:
+        """Fault-plane gate in front of ``_enqueue_now``: with no rules
+        installed this is one module-global load + None check (the
+        acceptance bar for the disabled plane's hot-path overhead)."""
+        fi = _fault_injector
+        if fi is not None and fi.rules:
+            verdict = fi.on_frame("send", self.name, msg.get("m"))
+            if verdict is not None:
+                action, delay = verdict
+                if action == DROP:
+                    return False  # frame lost on the wire; caller unaware
+                if action == PARTITION:
+                    # A partitioned peer is unreachable: surface the same
+                    # error an already-closed transport would, with
+                    # sent=False (the frame provably never left).
+                    raise ConnectionLost(
+                        f"injected partition to {self.name}", sent=False)
+                if action == DELAY:
+                    def _later(msg=msg, attachment=attachment):
+                        if self._closed:
+                            return
+                        try:
+                            if self._enqueue_now(msg, attachment):
+                                self._flush()
+                        except Exception:
+                            pass  # teardown race; read loop owns cleanup
+                    asyncio.get_running_loop().call_later(delay, _later)
+                    return False
+                if action == DUPLICATE:
+                    self._enqueue_now(msg, attachment)
+        return self._enqueue_now(msg, attachment)
+
+    def _enqueue_now(self, msg: dict, attachment=None) -> bool:
         """Append one frame (plus optional raw attachment) to the
         coalescing buffer and schedule the flush. Returns True when the
         transport is above the high-water mark (caller decides how to
@@ -289,6 +541,12 @@ class Connection:
         coalescing buffer, bytes pending in the transport, or the loop
         currently mid-flush."""
         if self._closed:
+            return False
+        fi = _fault_injector
+        if fi is not None and fi.rules:
+            # Fault rules apply on the loop path only; bypassing them
+            # through the raw socket would let frames dodge an installed
+            # partition.
             return False
         sock = self._sock
         if sock is None:

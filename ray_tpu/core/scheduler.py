@@ -410,7 +410,7 @@ class ClusterScheduler:
             return
         for st in states:
             node = self.nodes.get(st.node_id)
-            if node and node.state == "ALIVE":
+            if node and node.state != "DEAD":  # SUSPECT still returns
                 node.resources.release(st.resources)
 
     # ---- lease scheduling ----
@@ -533,7 +533,11 @@ class ClusterScheduler:
                 )
             return
         node = self.nodes.get(node_id)
-        if node and node.state == "ALIVE":
+        # != DEAD: a lease finishing while the node is SUSPECT (agent in
+        # its death-grace window) must still return capacity — skipping
+        # it would leak those units permanently once the agent
+        # reattaches.
+        if node and node.state != "DEAD":
             node.resources.release(resources)
 
     # ---- introspection ----

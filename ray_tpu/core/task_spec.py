@@ -154,7 +154,7 @@ class NodeInfo:
     address: str
     resources: Dict[str, float]
     labels: Dict[str, str] = field(default_factory=dict)
-    state: str = "ALIVE"  # ALIVE | DEAD
+    state: str = "ALIVE"  # ALIVE | SUSPECT (agent in death-grace) | DEAD
     # Remote hosts (node-agent processes): the agent's RPC address, which
     # doubles as the node's object fetch server for cross-node pulls.
     # None for head-host (virtual) nodes, whose store the head serves.

@@ -45,18 +45,30 @@ def _reset_router():
 
 class DeploymentResponse:
     """Future-like result of a handle call (reference: handle.py
-    DeploymentResponse)."""
+    DeploymentResponse). The default resolve/result timeout comes from
+    ``serve_handle_resolve_timeout_s`` in core/config.py
+    (RAY_TPU_SERVE_HANDLE_RESOLVE_TIMEOUT_S)."""
+
+    _UNSET = object()
 
     def __init__(self, ref=None, ref_future=None):
         self._ref = ref
         self._ref_future = ref_future
 
-    def _resolve_ref(self, timeout: Optional[float] = 60.0):
+    def _resolve_ref(self, timeout=_UNSET):
+        if timeout is DeploymentResponse._UNSET:
+            from ray_tpu.core.config import get_config
+
+            timeout = get_config().serve_handle_resolve_timeout_s
         if self._ref is None:
             self._ref = self._ref_future.result(timeout)
         return self._ref
 
-    def result(self, timeout: Optional[float] = 60.0) -> Any:
+    def result(self, timeout=_UNSET) -> Any:
+        if timeout is DeploymentResponse._UNSET:
+            from ray_tpu.core.config import get_config
+
+            timeout = get_config().serve_handle_resolve_timeout_s
         return ray_tpu.get(self._resolve_ref(timeout), timeout=timeout)
 
     def _to_object_ref(self):

@@ -6,6 +6,13 @@ route to the one with fewer ongoing requests. Queue lengths are tracked
 router-locally (incremented on send, decremented on completion), the
 same local-information design as the reference; the routing table is
 refreshed from the controller when its version moves.
+
+Robustness: assignment runs under the unified ``RetryPolicy``
+(core/retry.py) instead of a hand-rolled attempt loop, and a
+per-replica ``CircuitBreaker`` sheds traffic away from replicas whose
+sends keep failing while they back off (reference: the replica
+scheduler's blocklisting of unhealthy replicas). All timeouts come
+from ``core/config.py`` (``RAY_TPU_SERVE_*`` env overridable).
 """
 
 from __future__ import annotations
@@ -13,9 +20,11 @@ from __future__ import annotations
 import random
 import threading
 import time
-from typing import Any, Dict, List, Optional
+from typing import Any, Dict, Optional
 
 import ray_tpu
+from ray_tpu.core.config import get_config
+from ray_tpu.core.retry import CircuitBreaker, RetryPolicy
 
 
 class Router:
@@ -29,13 +38,27 @@ class Router:
         self._table: Dict[str, dict] = {}
         self._handles: Dict[str, Any] = {}  # replica name -> actor handle
         self._qlen: Dict[str, int] = {}
+        cfg = get_config()
+        self._control_timeout = cfg.serve_control_timeout_s
+        self._scale_wait_timeout = cfg.serve_scale_wait_timeout_s
+        # Assignment envelope: every failure mode inside one attempt
+        # (dead replica handle, no-replica window) is generic, so retry
+        # on any exception — the attempt count and backoff still come
+        # from the shared config knobs.
+        self._assign_policy = RetryPolicy.from_config(
+            cfg, max_attempts=max(1, cfg.serve_assign_max_attempts),
+            retry_on=(Exception,))
+        self._breaker = CircuitBreaker(
+            failure_threshold=cfg.serve_cb_failure_threshold,
+            reset_timeout_s=cfg.serve_cb_reset_timeout_s)
 
     def _refresh(self, force: bool = False):
         now = time.time()
         if not force and now - self._last_refresh < self._refresh_period:
             return
         snap = ray_tpu.get(
-            self._controller.get_routing_snapshot.remote(), timeout=30)
+            self._controller.get_routing_snapshot.remote(),
+            timeout=self._control_timeout)
         with self._lock:
             self._last_refresh = now
             if snap["version"] != self._version:
@@ -43,6 +66,10 @@ class Router:
                 self._table = snap["table"]
                 live = {n for e in self._table.values()
                         for n in e["replica_names"]}
+                # Sync the breaker to the live set (not to _handles —
+                # the assign failure path pops handles first, which
+                # would leak those replicas' breaker entries forever).
+                self._breaker.retain(live)
                 self._handles = {n: h for n, h in self._handles.items()
                                  if n in live}
                 self._qlen = {n: q for n, q in self._qlen.items()
@@ -70,7 +97,10 @@ class Router:
         return h
 
     def pick(self, deployment_key: str):
-        """Pow-2 choice -> (replica_name, actor_handle)."""
+        """Pow-2 choice among breaker-available replicas ->
+        (replica_name, actor_handle). Replicas with an OPEN breaker are
+        shed; if every replica is open, fall back to the full set (total
+        outage is worse than probing a suspect)."""
         self._refresh()
         entry = self._table.get(deployment_key)
         if not entry or not entry["replica_names"]:
@@ -80,50 +110,63 @@ class Router:
                 raise RuntimeError(
                     f"no replicas for deployment {deployment_key}")
         names = entry["replica_names"]
-        if len(names) == 1:
-            name = names[0]
+        healthy = [n for n in names if self._breaker.available(n)]
+        candidates = healthy or names
+        if len(candidates) == 1:
+            name = candidates[0]
         else:
-            a, b = random.sample(names, 2)
+            a, b = random.sample(candidates, 2)
             name = a if self._qlen.get(a, 0) <= self._qlen.get(b, 0) else b
         return name, self._replica_handle(name)
 
     def assign(self, deployment_key: str, method_name: str, args, kwargs):
-        last_err = None
-        for attempt in range(3):
-            try:
-                name, handle = self.pick(deployment_key)
-            except RuntimeError as e:
-                # No replicas: report the queued request (scale-from-zero
-                # signal) and wait for the autoscaler to bring one up.
-                last_err = e
-                ray_tpu.get(self._controller.report_pending_request.remote(
-                    deployment_key), timeout=30)
-                deadline = time.time() + 30
-                name = None
-                while time.time() < deadline:
-                    time.sleep(0.25)
-                    try:
-                        name, handle = self.pick(deployment_key)
-                        break
-                    except RuntimeError:
-                        continue
-                if name is None:
+        try:
+            return self._assign_policy.execute_sync(
+                lambda: self._assign_once(deployment_key, method_name,
+                                          args, kwargs),
+                label=f"serve assign {deployment_key}")
+        except Exception as e:
+            raise RuntimeError(f"could not assign request: {e}")
+
+    def _assign_once(self, deployment_key: str, method_name: str,
+                     args, kwargs):
+        try:
+            name, handle = self.pick(deployment_key)
+        except RuntimeError:
+            # No replicas: report the queued request (scale-from-zero
+            # signal) and wait for the autoscaler to bring one up.
+            ray_tpu.get(self._controller.report_pending_request.remote(
+                deployment_key), timeout=self._control_timeout)
+            deadline = time.time() + self._scale_wait_timeout
+            name = None
+            while time.time() < deadline:
+                time.sleep(0.25)
+                try:
+                    name, handle = self.pick(deployment_key)
+                    break
+                except RuntimeError:
                     continue
+            if name is None:
+                raise RuntimeError(
+                    f"no replicas for {deployment_key} after "
+                    f"{self._scale_wait_timeout:.0f}s scale-from-zero "
+                    f"wait")
+        with self._lock:
+            self._qlen[name] = self._qlen.get(name, 0) + 1
+        try:
+            ref = handle.handle_request.remote(method_name, args, kwargs)
+        except Exception:
+            # Replica died between table refreshes; trip its breaker,
+            # drop it and let the policy retry against the rest.
             with self._lock:
-                self._qlen[name] = self._qlen.get(name, 0) + 1
-            try:
-                ref = handle.handle_request.remote(method_name, args, kwargs)
-            except Exception as e:
-                # Replica died between table refreshes; drop and retry.
-                last_err = e
-                with self._lock:
-                    self._qlen[name] = max(0, self._qlen.get(name, 1) - 1)
-                    self._handles.pop(name, None)
-                self._refresh(force=True)
-                continue
-            self._attach_completion(name, ref)
-            return ref
-        raise RuntimeError(f"could not assign request: {last_err}")
+                self._qlen[name] = max(0, self._qlen.get(name, 1) - 1)
+                self._handles.pop(name, None)
+            self._breaker.record_failure(name)
+            self._refresh(force=True)
+            raise
+        self._breaker.record_success(name)
+        self._attach_completion(name, ref)
+        return ref
 
     def _attach_completion(self, name: str, ref):
         def done(_):

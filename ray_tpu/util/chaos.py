@@ -3,36 +3,100 @@
 Reference: _private/test_utils.py:1396 (ResourceKillerActor),
 :1527 (WorkerKillerActor) — actors that kill workers/actors on a
 schedule, used by chaos test suites to validate fault tolerance.
+
+Process-granular killers live here; network-granular faults (drop /
+delay / duplicate / partition) live in ``core/rpc.py``'s
+``FaultInjector`` — together they form the chaos lane (pytest -m
+chaos).
 """
 
 from __future__ import annotations
 
 import asyncio
+import logging
 import os
 import random
 import signal
+import time
 from typing import List, Optional
 
+logger = logging.getLogger(__name__)
 
-class WorkerKiller:
+
+class _KillerBase:
+    """Shared schedule/bookkeeping for the kill actors: seeded RNG,
+    kill budget, error counter, and a ``max_duration_s`` deadline so a
+    soak run whose candidate set never materializes cannot hang the
+    suite."""
+
+    def __init__(self, kill_interval_s: float, max_kills: int, seed: int,
+                 max_duration_s: Optional[float] = None):
+        self.kill_interval_s = kill_interval_s
+        self.max_kills = max_kills
+        self.max_duration_s = max_duration_s
+        self.rng = random.Random(seed)
+        self.killed: List = []
+        # Kill attempts that failed (victim vanished first, lookup
+        # errors). Exposed rather than swallowed — a chaos run whose
+        # kills all silently failed proves nothing.
+        self.errors = 0
+        self._running = False
+        self._started_at: Optional[float] = None
+
+    def _start_clock(self):
+        self._running = True
+        self._started_at = time.monotonic()
+
+    def _sleep_s(self) -> float:
+        """Next poll sleep, clipped so max_duration_s is honored even
+        when the kill interval is longer than the remaining budget."""
+        if self.max_duration_s is None:
+            return self.kill_interval_s
+        remaining = (self.max_duration_s
+                     - (time.monotonic() - self._started_at))
+        return max(0.0, min(self.kill_interval_s, remaining))
+
+    def _keep_running(self) -> bool:
+        if not self._running or len(self.killed) >= self.max_kills:
+            return False
+        if (self.max_duration_s is not None
+                and time.monotonic() - self._started_at
+                >= self.max_duration_s):
+            logger.debug("%s: max_duration_s=%.1f reached after %d kills",
+                         type(self).__name__, self.max_duration_s,
+                         len(self.killed))
+            return False
+        return True
+
+    async def stop(self) -> List:
+        self._running = False
+        return self.killed
+
+    async def get_killed(self) -> List:
+        return list(self.killed)
+
+    async def get_errors(self) -> int:
+        return self.errors
+
+
+class WorkerKiller(_KillerBase):
     """Async actor that SIGKILLs random task-running worker processes."""
 
     def __init__(self, kill_interval_s: float = 1.0,
-                 max_kills: int = 5, seed: int = 0):
-        self.kill_interval_s = kill_interval_s
-        self.max_kills = max_kills
-        self.rng = random.Random(seed)
-        self.killed: List[int] = []
-        self._running = False
+                 max_kills: int = 5, seed: int = 0,
+                 max_duration_s: Optional[float] = None):
+        super().__init__(kill_interval_s, max_kills, seed, max_duration_s)
 
     async def run(self) -> int:
         import ray_tpu
         from ray_tpu.util.state import list_workers
 
-        self._running = True
+        self._start_clock()
         me = os.getpid()
-        while self._running and len(self.killed) < self.max_kills:
-            await asyncio.sleep(self.kill_interval_s)
+        while self._keep_running():
+            await asyncio.sleep(self._sleep_s())
+            if not self._keep_running():
+                break
             loop = asyncio.get_event_loop()
             workers = await loop.run_in_executor(None, list_workers)
             candidates = [w for w in workers
@@ -44,37 +108,33 @@ class WorkerKiller:
                 os.kill(victim["pid"], signal.SIGKILL)
                 self.killed.append(victim["pid"])
             except ProcessLookupError:
-                pass
+                # Victim exited between the listing and the kill — not a
+                # fault of the killer, but worth counting.
+                self.errors += 1
+                logger.debug("worker kill of pid %s failed: gone",
+                             victim["pid"])
         return len(self.killed)
 
-    async def stop(self) -> List[int]:
-        self._running = False
-        return self.killed
 
-    async def get_killed(self) -> List[int]:
-        return list(self.killed)
-
-
-class ActorKiller:
+class ActorKiller(_KillerBase):
     """Kills named/visible actors at random (reference: chaos killers
     targeting actors instead of raw workers)."""
 
     def __init__(self, kill_interval_s: float = 1.0, max_kills: int = 3,
-                 name_prefix: str = "", seed: int = 0):
-        self.kill_interval_s = kill_interval_s
-        self.max_kills = max_kills
+                 name_prefix: str = "", seed: int = 0,
+                 max_duration_s: Optional[float] = None):
+        super().__init__(kill_interval_s, max_kills, seed, max_duration_s)
         self.name_prefix = name_prefix
-        self.rng = random.Random(seed)
-        self.killed: List[str] = []
-        self._running = False
 
     async def run(self) -> int:
         import ray_tpu
         from ray_tpu.util.state import list_actors
 
-        self._running = True
-        while self._running and len(self.killed) < self.max_kills:
-            await asyncio.sleep(self.kill_interval_s)
+        self._start_clock()
+        while self._keep_running():
+            await asyncio.sleep(self._sleep_s())
+            if not self._keep_running():
+                break
             loop = asyncio.get_event_loop()
             actors = await loop.run_in_executor(None, list_actors)
             candidates = [
@@ -91,10 +151,11 @@ class ActorKiller:
                 await loop.run_in_executor(
                     None, lambda: ray_tpu.kill(handle))
                 self.killed.append(victim["name"])
-            except Exception:
-                pass
+            except Exception as e:  # noqa: BLE001 — counted, not hidden
+                # Mirror LocalPeer's handler policy: failures are
+                # surfaced (counter + debug log), never swallowed — a
+                # kill that keeps missing its victim is signal.
+                self.errors += 1
+                logger.debug("actor kill of %r failed: %s",
+                             victim["name"], e)
         return len(self.killed)
-
-    async def stop(self) -> List[str]:
-        self._running = False
-        return self.killed
