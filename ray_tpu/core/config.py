@@ -139,7 +139,15 @@ class Config:
     # instead of surfacing ObjectLostError).
     object_pull_max_attempts: int = 3
 
-    # --- metrics ---
+    # --- metrics / tracing ---
+    # Built-in ray_tpu_* metrics plane (util/telemetry.py). On by
+    # default: instruments RPC, retry, scheduler, object, GCS, Serve and
+    # train hot paths; RAY_TPU_METRICS_ENABLED=0 turns it all off.
+    metrics_enabled: bool = True
+    # Per-RPC client/server spans (core/rpc.py). Off by default — one
+    # span pair per request is too hot for production; turn on to see
+    # individual control-plane calls inside a trace.
+    trace_rpc: bool = False
     metrics_report_interval_s: float = 5.0
     # Task-event buffer flush (reference: task_event_buffer.h).
     task_events_report_interval_s: float = 1.0

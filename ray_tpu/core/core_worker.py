@@ -1213,6 +1213,9 @@ class CoreWorker:
         self.pending_tasks[task_id] = PendingTask(
             spec=spec, retries_left=max_retries
         )
+        from ray_tpu.util import telemetry
+
+        telemetry.inc("ray_tpu_tasks_total", 1, {"state": "SUBMITTED"})
         if num_returns == TaskSpec.STREAMING:
             gen = ObjectRefGenerator(
                 task_id, cleanup=lambda: self._release_stream(task_id))
@@ -1469,6 +1472,9 @@ class CoreWorker:
     # ------------------------------------------------------------------
 
     def record_task_event(self, spec, state: str):
+        from ray_tpu.util import telemetry
+
+        telemetry.inc("ray_tpu_tasks_total", 1, {"state": state})
         event = {
             "task_id": spec.task_id.hex(),
             "name": spec.name,

@@ -21,6 +21,7 @@ driver's ``LocalPeer``.
 from __future__ import annotations
 
 import asyncio
+import json
 import logging
 import os
 import time
@@ -359,11 +360,47 @@ class HeadService:
                 if entries:
                     self._publish("worker_logs",
                                   {"node": "head", "entries": entries})
+                self._report_node_metrics()
             except Exception:
                 logger.exception("scheduler pump failed")
             if os.environ.get("RAY_TPU_DEBUG_PUMP"):
                 self._debug_dump()
             await asyncio.sleep(0.2)
+
+    _last_node_metrics = 0.0
+
+    def _report_node_metrics(self):
+        """Node states as gauges, SUSPECT (death-grace window) occupancy
+        included — the one signal that distinguishes a healing partition
+        from a real node loss."""
+        now = time.monotonic()
+        if now - self._last_node_metrics < 1.0:
+            return
+        self._last_node_metrics = now
+        from ray_tpu.util import telemetry
+
+        counts = {"ALIVE": 0, "SUSPECT": 0, "DEAD": 0}
+        for info in self.nodes_info.values():
+            counts[info.state] = counts.get(info.state, 0) + 1
+        for state, n in counts.items():
+            telemetry.set_gauge("ray_tpu_gcs_nodes", n, {"state": state})
+        from ray_tpu.core.object_ref import get_core_worker
+
+        if get_core_worker() is None:
+            # Standalone head (head_main): no CoreWorker to push
+            # through — write this process's snapshot straight into the
+            # local KV so head-side metrics (scheduler, gcs nodes)
+            # still reach collect_metrics / the dashboard. Ephemeral:
+            # deliberately not persisted to the sqlite store.
+            try:
+                from ray_tpu.util import metrics as um
+
+                snap = um.local_snapshot()
+                if snap:
+                    self.kv.setdefault("metrics", {})[b"metrics:head"] = (
+                        json.dumps(snap).encode())
+            except Exception:
+                pass
 
     _last_debug_dump = 0.0
 
@@ -728,6 +765,13 @@ class HeadService:
         logger.info("worker %s died (state=%s)", handle.worker_id.hex()[:12],
                     handle.state)
         self.pool.mark_dead(handle.worker_id)
+        # Drop the dead process's telemetry snapshots: its last pushed
+        # gauges (in-flight RPCs, router queue depth) would otherwise
+        # read as live values forever — worst exactly during the chaos
+        # soaks this plane instruments.
+        wid = handle.worker_id.hex()
+        self.kv.get("metrics", {}).pop(f"metrics:{wid}".encode(), None)
+        self.kv.get("timeline", {}).pop(f"timeline:{wid}".encode(), None)
         if handle.lease_id:
             self.scheduler.release_lease(handle.lease_id)
         # Actor death?
@@ -1118,14 +1162,22 @@ class HeadService:
         await asyncio.get_running_loop().run_in_executor(
             None, self.storage.flush)
 
+    #: KV namespaces holding live telemetry: never persisted — every
+    #: process re-pushes within seconds, a restarted head must not
+    #: resurrect dead workers' gauges, and the 2s push cadence must not
+    #: pay the sqlite fsync path.
+    EPHEMERAL_KV_NS = ("metrics", "timeline")
+
     async def h_kv_put(self, conn, payload):
-        ns = self.kv.setdefault(payload.get("ns", ""), {})
+        ns_name = payload.get("ns", "")
+        ns = self.kv.setdefault(ns_name, {})
         key = payload["key"]
         if not payload.get("overwrite", True) and key in ns:
             return {"added": False}
         ns[key] = payload["value"]
-        self._persist_kv(payload.get("ns", ""), key, payload["value"])
-        await self._commit_barrier()
+        if ns_name not in self.EPHEMERAL_KV_NS:
+            self._persist_kv(ns_name, key, payload["value"])
+            await self._commit_barrier()
         return {"added": True}
 
     async def h_kv_get(self, conn, payload):
@@ -1133,10 +1185,11 @@ class HeadService:
         return {"value": ns.get(payload["key"])}
 
     async def h_kv_del(self, conn, payload):
-        ns = self.kv.get(payload.get("ns", ""), {})
+        ns_name = payload.get("ns", "")
+        ns = self.kv.get(ns_name, {})
         existed = ns.pop(payload["key"], None) is not None
-        if existed:
-            self._persist_kv(payload.get("ns", ""), payload["key"], None,
+        if existed and ns_name not in self.EPHEMERAL_KV_NS:
+            self._persist_kv(ns_name, payload["key"], None,
                              deleted=True)
             await self._commit_barrier()
         return {"deleted": existed}

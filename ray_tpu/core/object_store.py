@@ -35,6 +35,19 @@ from ray_tpu.exceptions import ObjectStoreFullError
 _ALIGN = 64
 
 
+def _report_store_usage(used_bytes: int, num_objects: int) -> None:
+    """Node-store gauges, tagged by node: every process on a node
+    reports the same authoritative accounting, so last-write-wins per
+    node tag yields the true per-node (and summable cluster) totals."""
+    from ray_tpu.util import telemetry
+
+    tags = {"node": telemetry.node_tag()}
+    telemetry.set_gauge("ray_tpu_object_store_used_bytes", used_bytes,
+                        tags)
+    telemetry.set_gauge("ray_tpu_object_store_objects", num_objects,
+                        tags)
+
+
 def _aligned(n: int) -> int:
     return (n + _ALIGN - 1) & ~(_ALIGN - 1)
 
@@ -216,7 +229,11 @@ class ShmStore:
         with self._lock:
             if object_id.hex() in self._entries:
                 self._entries[object_id.hex()]["sealed"] = True
+        self._report_usage()
         return size
+
+    def _report_usage(self):
+        _report_store_usage(self.used_bytes(), self.num_objects())
 
     def _reserve(self, hex_id: str, size: int):
         with self._lock:
@@ -306,6 +323,7 @@ class ShmStore:
             else:
                 self._entries[hex_id]["sealed"] = True
             self._entries.move_to_end(hex_id)
+        self._report_usage()
 
     def contains(self, object_id: ObjectID) -> bool:
         with self._lock:
@@ -329,6 +347,7 @@ class ShmStore:
         self._release(hex_id)
         _unlink_segment(hex_id)
         spill_delete(object_id)
+        self._report_usage()
 
     def used_bytes(self) -> int:
         with self._lock:
@@ -405,7 +424,11 @@ class NativeShmStore:
         finally:
             del view
         self.arena.seal_reserved(idx, object_id.binary())
+        self._report_usage()
         return size
+
+    def _report_usage(self):
+        _report_store_usage(self.used_bytes(), self.num_objects())
 
     def mark_sealed(self, object_id: ObjectID, size: int):
         # The arena is authoritative; the seal already happened in the
@@ -430,6 +453,7 @@ class NativeShmStore:
     def delete(self, object_id: ObjectID):
         self.arena.delete(object_id.binary())
         spill_delete(object_id)
+        self._report_usage()
 
     def used_bytes(self) -> int:
         return self.arena.used_bytes()
@@ -461,12 +485,19 @@ def _spill_path(object_id: ObjectID) -> str:
 
 
 def _spill_write(object_id: ObjectID, data: bytes) -> int:
+    from ray_tpu.util import telemetry
+
+    t0 = time.time()
     path = _spill_path(object_id)
     os.makedirs(os.path.dirname(path), exist_ok=True)
     tmp = path + f".tmp{os.getpid()}"
     with open(tmp, "wb") as f:
         f.write(data)
     os.replace(tmp, path)
+    telemetry.inc("ray_tpu_object_spilled_total")
+    telemetry.inc("ray_tpu_object_spilled_bytes_total", len(data))
+    telemetry.event("objects", f"spill {object_id.hex()[:8]}", ts=t0,
+                    dur=time.time() - t0, args={"bytes": len(data)})
     return len(data)
 
 
@@ -483,7 +514,13 @@ def _spill_open(object_id: ObjectID) -> Optional[SerializedObject]:
         mapped = mmap.mmap(f.fileno(), 0, access=mmap.ACCESS_READ)
     finally:
         f.close()
-    return parse_packed(memoryview(mapped))
+    obj = parse_packed(memoryview(mapped))
+    if obj is not None:
+        from ray_tpu.util import telemetry
+
+        telemetry.inc("ray_tpu_object_restored_total")
+        telemetry.event("objects", f"restore {object_id.hex()[:8]}")
+    return obj
 
 
 # Serve-side cache of spill mmaps (object hex -> memoryview); dropped on
@@ -532,6 +569,15 @@ def node_store_write_packed(object_id: ObjectID, data,
                            pack_bytes=lambda: data, primary=primary)
 
 
+def _report_arena_usage(arena) -> None:
+    """Node-store gauges from the shared arena's accounting — the
+    arena is the authority every process on the node writes through."""
+    try:
+        _report_store_usage(arena.used_bytes(), arena.num_objects())
+    except Exception:
+        pass
+
+
 def _node_store_put(object_id: ObjectID, size: int, fill, pack_bytes,
                     primary: bool) -> int:
     """One store-selection policy for both the local write path
@@ -557,6 +603,7 @@ def _node_store_put(object_id: ObjectID, size: int, fill, pack_bytes,
             del view  # release the slot view before sealing
         arena.seal_reserved(idx, object_id.binary(),
                             pin_primary=primary)
+        _report_arena_usage(arena)
         return size
     try:
         seg = shared_memory.SharedMemory(
@@ -662,6 +709,7 @@ class NodeStoreWriter:
             self._arena.seal_reserved(self._idx,
                                       self._object_id.binary(),
                                       pin_primary=False)
+            _report_arena_usage(self._arena)
         elif self._kind == "shm":
             if self._magic is not None:
                 self._seg.buf[0:4] = self._magic  # publish LAST

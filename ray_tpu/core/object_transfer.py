@@ -354,8 +354,14 @@ class ObjectPuller:
             return await asyncio.shield(fut)
         fut = asyncio.get_running_loop().create_future()
         self._inflight[hex_id] = fut
+        from ray_tpu.util import telemetry
+
+        t_wall = time.time()
+        t0 = time.perf_counter()
+        status = "error"
         try:
             ok = await self._pull_once(object_id, locations)
+            status = "ok" if ok else "miss"
             fut.set_result(ok)
             return ok
         except Exception as e:
@@ -365,6 +371,11 @@ class ObjectPuller:
             raise
         finally:
             self._inflight.pop(hex_id, None)
+            elapsed = time.perf_counter() - t0
+            telemetry.observe("ray_tpu_object_pull_seconds", elapsed,
+                              {"status": status})
+            telemetry.event("objects", f"pull {hex_id[:8]}", ts=t_wall,
+                            dur=elapsed, args={"status": status})
 
     async def _pull_once(self, object_id: ObjectID,
                          locations: List[Tuple[str, int]]) -> bool:

@@ -33,6 +33,40 @@ from ray_tpu.core import rpc
 
 logger = logging.getLogger(__name__)
 
+
+def _metric_site(label: str) -> str:
+    """Bounded-cardinality tag from a free-form retry label: drop
+    tokens that look like ids (hex suffixes, deployment keys, digits)
+    so per-call-site labels don't explode the tag space."""
+    words = []
+    for w in (label or "").split():
+        if "#" in w or any(c.isdigit() for c in w):
+            continue
+        if len(w) >= 10 and all(c in "0123456789abcdef" for c in w):
+            continue
+        words.append(w)
+    return " ".join(words) or "unlabeled"
+
+
+def _record_retry(label: str, delay: float,
+                  error: Optional[BaseException]) -> None:
+    from ray_tpu.util import telemetry
+
+    site = _metric_site(label)
+    telemetry.inc("ray_tpu_retries_total", 1, {"site": site})
+    telemetry.inc("ray_tpu_retry_backoff_seconds_total", delay,
+                  {"site": site})
+    telemetry.event("retry", f"retry {label or site}", dur=delay,
+                    args={"error": (type(error).__name__ if error
+                                    else "predicate_false")})
+
+
+def _record_deadline_exhausted(label: str) -> None:
+    from ray_tpu.util import telemetry
+
+    telemetry.inc("ray_tpu_retry_deadline_exhausted_total", 1,
+                  {"site": _metric_site(label)})
+
 # Transport-level failures: the request may never have reached (or never
 # have left) the peer. Plain RpcError is deliberately excluded — it
 # carries a remote handler's exception, which is deterministic and must
@@ -152,8 +186,10 @@ class RetryPolicy:
             return None
         delay = self.backoff_delay(retry_index)
         if deadline is not None and time.monotonic() + delay >= deadline:
+            _record_deadline_exhausted(label)
             return None
         self.total_retries += 1
+        _record_retry(label, delay, error)
         logger.debug("retry %d/%d%s after %s: backoff %.3fs",
                      retry_index + 1, self.max_attempts - 1,
                      f" ({label})" if label else "",
@@ -184,6 +220,7 @@ class RetryPolicy:
                 if deadline is not None:
                     remaining = deadline - time.monotonic()
                     if remaining <= 0:
+                        _record_deadline_exhausted(label)
                         raise asyncio.TimeoutError(
                             f"deadline exhausted before attempt ({label})")
                     timeout = (remaining if timeout is None
@@ -237,6 +274,7 @@ class RetryPolicy:
         last_error: Optional[BaseException] = None
 
         def timed_out():
+            _record_deadline_exhausted(label)
             return PollTimeout(
                 f"poll{f' ({label})' if label else ''} deadline "
                 f"({deadline_s:.1f}s) exhausted",
@@ -261,6 +299,7 @@ class RetryPolicy:
             self.total_retries += 1
             if time.monotonic() + delay >= deadline:
                 raise timed_out()
+            _record_retry(label, delay, last_error)
             await asyncio.sleep(delay)
 
 
@@ -287,16 +326,36 @@ class CircuitBreaker:
 
     def record_success(self, key: str) -> None:
         with self._lock:
-            self._entries.pop(key, None)
+            entry = self._entries.pop(key, None)
+        if entry is not None and entry[1]:
+            # A previously tripped key recovering (half-open probe
+            # success) is a CLOSED transition worth observing.
+            from ray_tpu.util import telemetry
+
+            telemetry.inc("ray_tpu_circuit_breaker_transitions_total", 1,
+                          {"state": "closed"})
+            telemetry.event("breaker", f"{key} closed")
 
     def record_failure(self, key: str) -> None:
+        opened = False
         with self._lock:
             entry = self._entries.setdefault(key, [0, 0.0])
+            now = self._clock()
+            was_open = now < entry[1]
             entry[0] += 1
             if entry[0] >= self.failure_threshold:
-                entry[1] = self._clock() + self.reset_timeout_s
+                entry[1] = now + self.reset_timeout_s
                 # Half-open probe failure re-opens with a fresh count.
                 entry[0] = self.failure_threshold - 1
+                # A failure while ALREADY open extends the window but is
+                # not a new transition — one trip, one count.
+                opened = not was_open
+        if opened:
+            from ray_tpu.util import telemetry
+
+            telemetry.inc("ray_tpu_circuit_breaker_transitions_total", 1,
+                          {"state": "open"})
+            telemetry.event("breaker", f"{key} open")
 
     def available(self, key: str) -> bool:
         with self._lock:

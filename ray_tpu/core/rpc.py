@@ -20,6 +20,7 @@ Wire format: 4-byte little-endian length, then msgpack map:
 from __future__ import annotations
 
 import asyncio
+import contextlib
 import itertools
 import logging
 import os
@@ -35,6 +36,60 @@ logger = logging.getLogger(__name__)
 MAX_FRAME = 1 << 31
 
 Handler = Callable[["Connection", Any], Awaitable[Any]]
+
+# Telemetry is bound lazily: this module is imported during core
+# bootstrap, before ray_tpu.util (whose package init pulls in higher
+# layers) is safe to import. First use is always post-bootstrap.
+_telemetry = None
+
+
+def _tm():
+    global _telemetry
+    if _telemetry is None:
+        from ray_tpu.util import telemetry
+
+        _telemetry = telemetry
+    return _telemetry
+
+
+#: Cached config gate for per-RPC client/server spans (``trace_rpc`` /
+#: RAY_TPU_TRACE_RPC). None until first read; tests reset it directly.
+_trace_rpc_flag: Optional[bool] = None
+
+
+def _rpc_tracing_on() -> bool:
+    global _trace_rpc_flag
+    if _trace_rpc_flag is None:
+        try:
+            from ray_tpu.core.config import get_config
+
+            _trace_rpc_flag = bool(get_config().trace_rpc)
+        except Exception:
+            _trace_rpc_flag = os.environ.get(
+                "RAY_TPU_TRACE_RPC", "").lower() in ("1", "true", "yes")
+    if not _trace_rpc_flag:
+        return False
+    from ray_tpu.util import tracing
+
+    tracing.maybe_setup_worker_tracing()
+    return tracing.is_enabled()
+
+
+#: Requests awaiting replies in this process. Locked: a process can run
+#: several event loops (driver + embedded head), and an unsynchronized
+#: read-modify-write would let the gauge drift permanently.
+_in_flight = 0
+_in_flight_lock = threading.Lock()
+
+
+def _track_in_flight(delta: int) -> None:
+    global _in_flight
+    with _in_flight_lock:
+        _in_flight += delta
+        count = _in_flight
+    tm = _tm()
+    tm.set_gauge("ray_tpu_rpc_in_flight_requests", count,
+                 {"proc": tm.proc_tag()})
 
 
 class RpcError(Exception):
@@ -177,6 +232,8 @@ class FaultInjector:
                     continue
                 rule.matches += 1
                 self.stats[rule.action] = self.stats.get(rule.action, 0) + 1
+                _tm().inc("ray_tpu_rpc_faults_injected_total", 1,
+                          {"action": rule.action})
                 delay = rule.delay_s
                 if rule.jitter_s:
                     delay += self.rng.random() * rule.jitter_s
@@ -320,6 +377,7 @@ class Connection:
                 if length > MAX_FRAME:
                     raise RpcError(f"frame too large: {length}")
                 body = await self.reader.readexactly(length)
+                nbytes = 4 + length
                 msg = msgpack.unpackb(body, raw=False)
                 if msg.pop("b", False):
                     # Raw sidecar attachment follows the frame.
@@ -329,11 +387,13 @@ class Connection:
                         raise RpcError(
                             f"attachment too large: {blen}")
                     blob = await self.reader.readexactly(blen)
+                    nbytes += 8 + blen
                     d = msg.get("d")
                     if not isinstance(d, dict):
                         d = {} if d is None else {"value": d}
                         msg["d"] = d
                     d["__attachment__"] = blob
+                _tm().inc("ray_tpu_rpc_recv_bytes_total", nbytes)
                 fi = _fault_injector
                 if fi is not None and fi.rules:
                     verdict = fi.on_frame("recv", self.name, msg.get("m"))
@@ -394,11 +454,18 @@ class Connection:
         if handler is None:
             error = f"no handler for method {method!r}"
         else:
-            try:
-                result = await handler(self, msg.get("d"))
-            except Exception as e:
-                logger.exception("handler %s failed", method)
-                error = f"{type(e).__name__}: {e}"
+            with contextlib.ExitStack() as stack:
+                tc = msg.get("tc")
+                if tc is not None and _rpc_tracing_on():
+                    from ray_tpu.util import tracing
+
+                    stack.enter_context(
+                        tracing.span(f"rpc.handle {method}", tc))
+                try:
+                    result = await handler(self, msg.get("d"))
+                except Exception as e:
+                    logger.exception("handler %s failed", method)
+                    error = f"{type(e).__name__}: {e}"
         if t == "req":
             attachment = None
             if isinstance(result, WithAttachment):
@@ -449,12 +516,15 @@ class Connection:
         if attachment is not None:
             msg["b"] = True
         data = msgpack.packb(msg, use_bin_type=True)
+        nbytes = 4 + len(data)
         self._outbuf.append(len(data).to_bytes(4, "little"))
         self._outbuf.append(data)
         if attachment is not None:
             mv = memoryview(attachment).cast("B")
+            nbytes += 8 + mv.nbytes
             self._outbuf.append(mv.nbytes.to_bytes(8, "little"))
             self._outbuf.append(mv)  # flushed without joining (below)
+        _tm().inc("ray_tpu_rpc_sent_bytes_total", nbytes)
         if not self._flush_scheduled:
             self._flush_scheduled = True
             asyncio.get_running_loop().call_soon(self._flush)
@@ -496,14 +566,35 @@ class Connection:
         req_id = next(self._req_counter)
         fut = asyncio.get_running_loop().create_future()
         self._pending[req_id] = fut
-        try:
-            await self._send({"t": "req", "i": req_id, "m": method, "d": payload})
-        except Exception:
-            self._pending.pop(req_id, None)
-            raise
-        if timeout is not None:
-            return await asyncio.wait_for(fut, timeout)
-        return await fut
+        msg = {"t": "req", "i": req_id, "m": method, "d": payload}
+        # ExitStack so a failing call closes the client span with the
+        # real exception info (error status on otel spans).
+        with contextlib.ExitStack() as stack:
+            if _rpc_tracing_on():
+                from ray_tpu.util import tracing
+
+                stack.enter_context(tracing.span(f"rpc {method}"))
+                carrier = tracing.inject_context()
+                if carrier:
+                    # Carrier rides the frame; receivers without the
+                    # flag ignore the extra key.
+                    msg["tc"] = carrier
+            t0 = time.perf_counter()
+            _track_in_flight(1)
+            try:
+                try:
+                    await self._send(msg)
+                except Exception:
+                    self._pending.pop(req_id, None)
+                    raise
+                if timeout is not None:
+                    return await asyncio.wait_for(fut, timeout)
+                return await fut
+            finally:
+                _track_in_flight(-1)
+                _tm().observe("ray_tpu_rpc_client_latency_seconds",
+                              time.perf_counter() - t0,
+                              {"method": method})
 
     async def notify(self, method: str, payload: Any = None):
         await self._send({"t": "ntf", "i": 0, "m": method, "d": payload})
@@ -610,6 +701,7 @@ class Connection:
                 # Broken pipe / socket closed under us (teardown race):
                 # the read loop notices and owns the cleanup.
                 return sent_any
+            _tm().inc("ray_tpu_rpc_sent_bytes_total", 4 + len(data))
             return True
         finally:
             mutex.release()

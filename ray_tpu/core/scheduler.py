@@ -514,6 +514,18 @@ class ClusterScheduler:
             idle_worker = self.pool.pop_idle(node.node_id)
             grants.append((lease, node, pg_id, bundle_index, idle_worker))
         self.pending = remaining
+        from ray_tpu.util import telemetry
+
+        if grants:
+            telemetry.inc("ray_tpu_scheduler_leases_granted_total",
+                          len(grants))
+            now = time.monotonic()
+            for lease, *_rest in grants:
+                telemetry.observe(
+                    "ray_tpu_scheduler_placement_latency_seconds",
+                    max(0.0, now - lease.queued_at))
+        telemetry.set_gauge("ray_tpu_scheduler_pending_leases",
+                            len(remaining))
         return grants
 
     def record_lease(self, lease_id: str, node_id: NodeID,

@@ -102,6 +102,21 @@ def cmd_status(args):
         print(f"  instances: {cluster.get('by_status', {})}")
         if asc.get("last_error"):
             print(f"  last error: {asc['last_error']}")
+    from ray_tpu.util import metrics as um
+
+    try:
+        merged = um.collect_metrics()
+    except Exception:
+        merged = {}
+    builtin = {n: d for n, d in merged.items()
+               if n.startswith("ray_tpu_")}
+    if builtin:
+        print(f"== metrics: {len(builtin)} ray_tpu_* series "
+              f"(`python -m ray_tpu metrics` for detail) ==")
+        for name, data in sorted(builtin.items()):
+            if data["type"] == "counter":
+                total = sum(data["values"].values())
+                print(f"  {name}: {total:g}")
     ray_tpu.shutdown()
 
 
@@ -130,6 +145,41 @@ def cmd_list(args):
         "placement-groups": ust.list_placement_groups,
     }[args.kind]
     print(json.dumps(fn(), indent=2, default=str))
+    ray_tpu.shutdown()
+
+
+def _fmt_tags(tk) -> str:
+    if not tk:
+        return ""
+    return "{" + ",".join(f"{k}={v}" for k, v in tk) + "}"
+
+
+def cmd_metrics(args):
+    """Merged cluster metrics snapshot (reference: the dashboard's
+    Prometheus scrape, as a one-shot CLI)."""
+    ray_tpu = _attach()
+    from ray_tpu.util import metrics as um
+
+    if args.format == "prometheus":
+        print(um.prometheus_text(), end="")
+        ray_tpu.shutdown()
+        return
+    merged = um.collect_metrics()
+    if not merged:
+        print("no metrics reported yet")
+    for name, data in sorted(merged.items()):
+        print(f"{name} ({data['type']})"
+              + (f" — {data['description']}" if data.get("description")
+                 else ""))
+        if data["type"] == "histogram":
+            for tk, h in sorted(data["values"].items()):
+                count, total = h[-1], h[-2]
+                mean_ms = (total / count * 1e3) if count else 0.0
+                print(f"  {_fmt_tags(tk) or '(no tags)'}: "
+                      f"count={count} mean={mean_ms:.2f}ms")
+        else:
+            for tk, v in sorted(data["values"].items()):
+                print(f"  {_fmt_tags(tk) or '(no tags)'}: {v:g}")
     ray_tpu.shutdown()
 
 
@@ -199,6 +249,11 @@ def main(argv=None):
                                     "objects", "jobs",
                                     "placement-groups"])
     p.set_defaults(fn=cmd_list)
+
+    p = sub.add_parser("metrics", help="merged cluster metrics snapshot")
+    p.add_argument("--format", choices=["summary", "prometheus"],
+                   default="summary")
+    p.set_defaults(fn=cmd_metrics)
 
     p = sub.add_parser("timeline", help="dump chrome-tracing timeline")
     p.add_argument("--output", "-o", default="timeline.json")

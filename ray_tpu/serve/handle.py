@@ -122,6 +122,12 @@ class DeploymentHandle:
         if self._multiplexed_model_id:
             kwargs["__serve_multiplexed_model_id"] = \
                 self._multiplexed_model_id
+        # Capture the caller's trace context on THIS thread: composition
+        # calls offload to the handle executor, where thread-local span
+        # state is gone.
+        from ray_tpu.util import tracing
+
+        carrier = tracing.inject_context() if tracing.is_enabled() else None
         try:
             asyncio.get_running_loop()
             on_loop = True
@@ -130,10 +136,11 @@ class DeploymentHandle:
         if on_loop:
             fut = _offload.submit(
                 lambda: _get_router().assign(
-                    self.deployment_key, self._method, args, kwargs))
+                    self.deployment_key, self._method, args, kwargs,
+                    trace_carrier=carrier))
             return DeploymentResponse(ref_future=fut)
         ref = _get_router().assign(self.deployment_key, self._method,
-                                   args, kwargs)
+                                   args, kwargs, trace_carrier=carrier)
         return DeploymentResponse(ref)
 
     def __reduce__(self):

@@ -12,6 +12,7 @@ from __future__ import annotations
 import asyncio
 import json
 import logging
+import time
 from typing import Any, Optional
 
 logger = logging.getLogger(__name__)
@@ -131,6 +132,33 @@ class ProxyActor:
 
         model_id = request.headers.get("serve_multiplexed_model_id", "")
 
+        from ray_tpu.util import telemetry, tracing
+
+        tracing.maybe_setup_worker_tracing()
+        t0 = time.perf_counter()
+        if tracing.is_enabled():
+            # The proxy span is the trace root of an HTTP request; its
+            # carrier hops to the router's executor thread explicitly
+            # (thread-local context doesn't survive run_in_executor) and
+            # from there into the replica, so one trace id spans
+            # proxy -> router -> replica across processes.
+            with tracing.span(f"proxy {request.method} {path}"):
+                carrier = tracing.inject_context()
+                route, resp = await self._dispatch(loop, path, req,
+                                                   model_id, carrier)
+        else:
+            route, resp = await self._dispatch(loop, path, req,
+                                               model_id, None)
+        telemetry.observe("ray_tpu_serve_http_latency_seconds",
+                          time.perf_counter() - t0, {"route": route})
+        telemetry.inc("ray_tpu_serve_http_requests_total", 1,
+                      {"route": route, "code": str(resp.status)})
+        return resp
+
+    async def _dispatch(self, loop, path, req, model_id, carrier):
+        """Route + await one request; returns (route tag, response)."""
+        from aiohttp import web
+
         def assign_sync():
             router = self._get_router()
             key = router.route_for_prefix(path)
@@ -141,17 +169,21 @@ class ProxyActor:
                 return None, None
             kwargs = ({"__serve_multiplexed_model_id": model_id}
                       if model_id else {})
-            return key, router.assign(key, "__call__", (req,), kwargs)
+            return key, router.assign(key, "__call__", (req,), kwargs,
+                                      trace_carrier=carrier)
 
+        key = None
         try:
             key, ref = await loop.run_in_executor(None, assign_sync)
             if key is None:
-                return web.Response(status=404, text=f"no route for {path}")
+                return "unmatched", web.Response(
+                    status=404, text=f"no route for {path}")
             result = await ref
         except Exception as e:
             logger.exception("proxy request failed")
-            return web.Response(status=500, text=str(e))
-        return _to_response(result)
+            return key or "unmatched", web.Response(status=500,
+                                                    text=str(e))
+        return key, _to_response(result)
 
     async def shutdown(self):
         if self._grpc is not None:

@@ -52,27 +52,52 @@ class Replica:
 
     async def handle_request(self, method_name: str, args: tuple,
                              kwargs: dict) -> Any:
+        import contextlib
+
         from ray_tpu.serve import context as _ctx
+        from ray_tpu.util import telemetry, tracing
 
         model_id = kwargs.pop("__serve_multiplexed_model_id", "")
-        _ctx._set_request_context(_ctx.RequestContext(
-            multiplexed_model_id=model_id,
-            deployment=self.deployment_name))
-        self.num_ongoing += 1
-        try:
-            fn = getattr(self.callable, method_name, None)
-            if fn is None and method_name == "__call__":
-                fn = self.callable
-            if fn is None:
-                raise AttributeError(
-                    f"{self.deployment_name} has no method {method_name!r}")
-            out = fn(*args, **kwargs)
-            if inspect.isawaitable(out):
-                out = await out
-            return out
-        finally:
-            self.num_ongoing -= 1
-            self.total_served += 1
+        trace_ctx = kwargs.pop("__serve_trace_ctx", None)
+        # ExitStack so a raising request closes the span with the real
+        # exception info (error status on otel spans).
+        with contextlib.ExitStack() as stack:
+            if trace_ctx is not None:
+                # The carrier's presence proves the driver enabled
+                # tracing (same contract as worker_main's task path).
+                tracing.setup_tracing("ray_tpu.serve.replica")
+                stack.enter_context(
+                    tracing.span(f"replica {self.deployment_name}",
+                                 trace_ctx))
+            _ctx._set_request_context(_ctx.RequestContext(
+                multiplexed_model_id=model_id,
+                deployment=self.deployment_name))
+            self.num_ongoing += 1
+            t0 = time.perf_counter()
+            status = "error"
+            try:
+                fn = getattr(self.callable, method_name, None)
+                if fn is None and method_name == "__call__":
+                    fn = self.callable
+                if fn is None:
+                    raise AttributeError(
+                        f"{self.deployment_name} has no method "
+                        f"{method_name!r}")
+                out = fn(*args, **kwargs)
+                if inspect.isawaitable(out):
+                    out = await out
+                status = "ok"
+                return out
+            finally:
+                self.num_ongoing -= 1
+                self.total_served += 1
+                telemetry.inc("ray_tpu_serve_replica_requests_total", 1,
+                              {"deployment": self.deployment_name,
+                               "status": status})
+                telemetry.observe(
+                    "ray_tpu_serve_replica_latency_seconds",
+                    time.perf_counter() - t0,
+                    {"deployment": self.deployment_name})
 
     async def metrics(self) -> Dict[str, Any]:
         return {

@@ -17,6 +17,7 @@ from ``core/config.py`` (``RAY_TPU_SERVE_*`` env overridable).
 
 from __future__ import annotations
 
+import contextlib
 import random
 import threading
 import time
@@ -25,6 +26,7 @@ from typing import Any, Dict, Optional
 import ray_tpu
 from ray_tpu.core.config import get_config
 from ray_tpu.core.retry import CircuitBreaker, RetryPolicy
+from ray_tpu.util import telemetry, tracing
 
 
 class Router:
@@ -111,6 +113,10 @@ class Router:
                     f"no replicas for deployment {deployment_key}")
         names = entry["replica_names"]
         healthy = [n for n in names if self._breaker.available(n)]
+        if len(healthy) < len(names):
+            telemetry.inc("ray_tpu_serve_replica_sheds_total",
+                          len(names) - len(healthy),
+                          {"deployment": deployment_key})
         candidates = healthy or names
         if len(candidates) == 1:
             name = candidates[0]
@@ -119,17 +125,37 @@ class Router:
             name = a if self._qlen.get(a, 0) <= self._qlen.get(b, 0) else b
         return name, self._replica_handle(name)
 
-    def assign(self, deployment_key: str, method_name: str, args, kwargs):
-        try:
-            return self._assign_policy.execute_sync(
-                lambda: self._assign_once(deployment_key, method_name,
-                                          args, kwargs),
-                label=f"serve assign {deployment_key}")
-        except Exception as e:
-            raise RuntimeError(f"could not assign request: {e}")
+    def assign(self, deployment_key: str, method_name: str, args, kwargs,
+               trace_carrier=None):
+        """Route one request. ``trace_carrier`` parents the router span
+        when the caller's span lives on another thread/process (the
+        proxy's event loop, a composing replica) — thread-local context
+        does not survive the executor hop, so the carrier rides
+        explicitly and continues into the replica via a hidden kwarg."""
+        if trace_carrier is None and tracing.is_enabled():
+            trace_carrier = tracing.inject_context()
+        with contextlib.ExitStack() as stack:
+            # ExitStack so a raising assignment closes the span with
+            # the real exception info (error status on otel spans).
+            if tracing.is_enabled():
+                stack.enter_context(
+                    tracing.span(f"router {deployment_key}",
+                                 trace_carrier))
+                child = tracing.inject_context()
+                if child:
+                    kwargs = dict(kwargs)
+                    kwargs["__serve_trace_ctx"] = child
+            t0 = time.time()
+            try:
+                return self._assign_policy.execute_sync(
+                    lambda: self._assign_once(deployment_key, method_name,
+                                              args, kwargs, t0),
+                    label=f"serve assign {deployment_key}")
+            except Exception as e:
+                raise RuntimeError(f"could not assign request: {e}")
 
     def _assign_once(self, deployment_key: str, method_name: str,
-                     args, kwargs):
+                     args, kwargs, t0=None):
         try:
             name, handle = self.pick(deployment_key)
         except RuntimeError:
@@ -153,6 +179,7 @@ class Router:
                     f"wait")
         with self._lock:
             self._qlen[name] = self._qlen.get(name, 0) + 1
+        self._report_queue_depth(deployment_key)
         try:
             ref = handle.handle_request.remote(method_name, args, kwargs)
         except Exception:
@@ -165,12 +192,30 @@ class Router:
             self._refresh(force=True)
             raise
         self._breaker.record_success(name)
-        self._attach_completion(name, ref)
+        self._attach_completion(name, ref, deployment_key, t0)
         return ref
 
-    def _attach_completion(self, name: str, ref):
+    def _report_queue_depth(self, deployment_key: str) -> None:
+        """Current (not peak) ongoing-request depth for one deployment,
+        reported on BOTH send and completion."""
+        with self._lock:
+            entry = self._table.get(deployment_key) or {}
+            depth = sum(self._qlen.get(n, 0)
+                        for n in entry.get("replica_names", ()))
+        telemetry.set_gauge("ray_tpu_serve_router_queue_depth", depth,
+                            {"deployment": deployment_key,
+                             "proc": telemetry.proc_tag()})
+
+    def _attach_completion(self, name: str, ref, deployment_key=None,
+                           t0=None):
         def done(_):
             with self._lock:
                 self._qlen[name] = max(0, self._qlen.get(name, 1) - 1)
+            if deployment_key is not None:
+                self._report_queue_depth(deployment_key)
+            if t0 is not None:
+                telemetry.observe("ray_tpu_serve_request_latency_seconds",
+                                  max(0.0, time.time() - t0),
+                                  {"deployment": deployment_key})
 
         ref.future().add_done_callback(done)

@@ -11,6 +11,7 @@ from __future__ import annotations
 import dataclasses
 import queue
 import threading
+import time
 from typing import Any, Dict, Optional
 
 from ray_tpu.train.checkpoint import Checkpoint
@@ -53,9 +54,19 @@ class _TrainSession:
         self.datasets = datasets or {}
         self.outbox: "queue.Queue" = queue.Queue()
         self.stop_requested = threading.Event()
+        self._last_report_t = time.perf_counter()
 
     def report(self, metrics: Dict[str, Any],
                checkpoint: Optional[Checkpoint] = None) -> None:
+        from ray_tpu.util import telemetry
+
+        now = time.perf_counter()
+        # report() is called once per step by convention, so the gap
+        # between consecutive calls IS the step time.
+        telemetry.observe("ray_tpu_train_step_seconds",
+                          now - self._last_report_t)
+        telemetry.inc("ray_tpu_train_reports_total")
+        self._last_report_t = now
         self.outbox.put(("report", dict(metrics), checkpoint))
         # Cooperative early stop (Tune schedulers): raising here unwinds
         # the user loop; the executor turns it into a clean finish.

@@ -14,31 +14,69 @@ import bisect
 import json
 import threading
 import time
-from typing import Dict, List, Optional, Sequence, Tuple
+from typing import Callable, Dict, List, Optional, Sequence, Tuple
 
 _registry_lock = threading.Lock()
 _registry: Dict[str, "Metric"] = {}
 _last_push = 0.0
 _PUSH_INTERVAL_S = 2.0
+# Called with the core worker after each metrics push; the telemetry
+# module's timeline-event push rides the same throttle window.
+_push_hooks: List[Callable] = []
 
 
 class Metric:
     metric_type = "untyped"
 
-    def __init__(self, name: str, description: str = "",
-                 tag_keys: Optional[Sequence[str]] = None):
+    def __new__(cls, name: str, *args, **kwargs):
+        # Idempotent registration: instrumented modules are imported in
+        # every process, and two subsystems may declare the same metric;
+        # re-creation by name hands back the live instance (keeping its
+        # recorded values) instead of silently replacing it in the
+        # registry. A name reused across metric TYPES is a programming
+        # error and raises. The ENTIRE mutable state is built inside
+        # this one lock section — __init__ is a pure declaration merge
+        # — so two threads racing the first creation converge on one
+        # instance whose value store is never re-created.
         if not name:
             raise ValueError("metric name required")
-        self.name = name
-        self.description = description
-        self.tag_keys = tuple(tag_keys or ())
-        self._default_tags: Dict[str, str] = {}
-        # frozen tag tuple -> value(s); guarded by _mutex (recorded from
-        # executor threads, snapshotted by whichever thread pushes).
-        self._values: Dict[Tuple[Tuple[str, str], ...], float] = {}
-        self._mutex = threading.Lock()
         with _registry_lock:
-            _registry[name] = self
+            existing = _registry.get(name)
+            if existing is not None:
+                if existing.metric_type != cls.metric_type:
+                    raise TypeError(
+                        f"metric {name!r} already registered as "
+                        f"{existing.metric_type}; cannot re-register as "
+                        f"{cls.metric_type}")
+                return existing
+            inst = super().__new__(cls)
+            inst.name = name
+            inst.description = ""
+            inst.tag_keys = ()
+            inst._default_tags = {}
+            # frozen tag tuple -> value(s); guarded by _mutex (recorded
+            # from executor threads, snapshotted by whichever thread
+            # pushes).
+            inst._values = {}
+            inst._mutex = threading.Lock()
+            cls._init_state(inst)
+            _registry[name] = inst
+            return inst
+
+    @classmethod
+    def _init_state(cls, inst):
+        """Subclass hook: extra mutable state, created once under the
+        registry lock."""
+
+    def __init__(self, name: str, description: str = "",
+                 tag_keys: Optional[Sequence[str]] = None):
+        # Runs on every (re-)creation: merge the declaration, keep the
+        # recorded values untouched.
+        if description and not self.description:
+            self.description = description
+        if tag_keys:
+            self.tag_keys = tuple(sorted(
+                set(self.tag_keys) | set(tag_keys)))
 
     def set_default_tags(self, tags: Dict[str, str]):
         self._default_tags = dict(tags)
@@ -94,13 +132,23 @@ DEFAULT_BOUNDARIES = [0.005, 0.01, 0.025, 0.05, 0.1, 0.25, 0.5, 1.0,
 class Histogram(Metric):
     metric_type = "histogram"
 
+    @classmethod
+    def _init_state(cls, inst):
+        inst.boundaries = None  # fixed by the first declaration below
+        # tag key -> [bucket counts..., +inf count, sum, count]
+        inst._hists = {}
+
     def __init__(self, name: str, description: str = "",
                  boundaries: Optional[List[float]] = None,
                  tag_keys: Optional[Sequence[str]] = None):
         super().__init__(name, description, tag_keys)
-        self.boundaries = sorted(boundaries or DEFAULT_BOUNDARIES)
-        # tag key -> [bucket counts..., +inf count, sum, count]
-        self._hists: Dict[tuple, list] = {}
+        with self._mutex:
+            if self.boundaries is None:
+                self.boundaries = sorted(boundaries or DEFAULT_BOUNDARIES)
+            elif boundaries and sorted(boundaries) != self.boundaries:
+                raise TypeError(
+                    f"histogram {name!r} re-registered with different "
+                    f"boundaries")
 
     def observe(self, value: float,
                 tags: Optional[Dict[str, str]] = None):
@@ -127,33 +175,106 @@ class Histogram(Metric):
         }
 
 
-def _maybe_push(force: bool = False):
+def register_push_hook(fn: Callable) -> None:
+    """Register ``fn(core_worker)`` to run after each metrics push —
+    piggyback channel for data that should ride the same throttle (the
+    telemetry module pushes its timeline-event buffer this way)."""
+    if fn not in _push_hooks:
+        _push_hooks.append(fn)
+
+
+_flush_timer = None
+_flush_timer_lock = threading.Lock()
+
+#: Series the metrics push itself moves (the kv_put rides the
+#: instrumented RPC path). The trailing-flush quiesce check ignores
+#: them — otherwise each push re-dirties the registry and the one-shot
+#: trailing flush becomes a perpetual idle heartbeat.
+_SELF_NOISE = frozenset({
+    "ray_tpu_rpc_sent_bytes_total",
+    "ray_tpu_rpc_recv_bytes_total",
+    "ray_tpu_rpc_client_latency_seconds",
+    "ray_tpu_rpc_in_flight_requests",
+})
+_last_app_blob: Optional[str] = None
+
+
+def _schedule_trailing_flush(delay: float) -> None:
+    """Arm a one-shot timer so values recorded inside the throttle
+    window still reach the KV within one interval — without it, a
+    process that records a burst and then goes idle (a Serve proxy
+    after its last request) never ships its final counts."""
+    global _flush_timer
+    if _flush_timer is not None:
+        return  # benign race: the locked re-check below is the arbiter
+    with _flush_timer_lock:
+        if _flush_timer is not None:
+            return
+        _flush_timer = threading.Timer(delay + 0.05, _trailing_flush)
+        _flush_timer.daemon = True
+        _flush_timer.start()
+
+
+def _trailing_flush() -> None:
+    global _flush_timer
+    with _flush_timer_lock:
+        _flush_timer = None
+    _maybe_push(force=True, idle_skip=True)
+
+
+def _maybe_push(force: bool = False, idle_skip: bool = False):
     """Throttled push of this process's registry to the head KV."""
-    global _last_push
+    global _last_push, _last_app_blob
     now = time.time()
     if not force and now - _last_push < _PUSH_INTERVAL_S:
+        _schedule_trailing_flush(_PUSH_INTERVAL_S - (now - _last_push))
         return
-    _last_push = now
     try:
         from ray_tpu.core.object_ref import get_core_worker
 
         cw = get_core_worker()
         if cw is None:
+            # Leave _last_push untouched: a process that records metrics
+            # before its worker is up must not consume the throttle
+            # window, or the first real push is delayed by a full
+            # interval.
             return
         with _registry_lock:
             snap = {name: m._snapshot() for name, m in _registry.items()}
+        app_blob = json.dumps(
+            {k: v for k, v in snap.items() if k not in _SELF_NOISE},
+            sort_keys=True)
+        if idle_skip and app_blob == _last_app_blob:
+            # Trailing flush with nothing new beyond our own push
+            # traffic: quiesce (the next real record re-arms).
+            return
+        _last_push = now
+        _last_app_blob = app_blob
         blob = json.dumps(snap).encode()
         key = f"metrics:{cw.worker_id.hex()}".encode()
         cw.loop_thread.submit(cw.head.call("kv_put", {
             "ns": "metrics", "key": key, "value": blob,
             "overwrite": True,
         }))
+        for hook in list(_push_hooks):
+            try:
+                hook(cw)
+            except Exception:
+                pass
     except Exception:
         pass
 
 
 def flush_metrics():
     _maybe_push(force=True)
+
+
+def local_snapshot() -> Dict[str, dict]:
+    """This process's registry as push-shaped snapshots — for hosts
+    that own the KV directly (a standalone head has no CoreWorker to
+    push through)."""
+    with _registry_lock:
+        return {name: m._snapshot() for name, m in _registry.items()}
 
 
 def collect_metrics() -> Dict[str, dict]:
@@ -198,8 +319,14 @@ def collect_metrics() -> Dict[str, dict]:
 
 
 def prometheus_text() -> str:
-    """Render merged metrics in Prometheus exposition format (reference:
-    the metrics agent's OpenCensus->Prometheus proxy)."""
+    """Render the cluster's merged metrics in Prometheus exposition
+    format (reference: the metrics agent's OpenCensus->Prometheus
+    proxy)."""
+    return render_prometheus(collect_metrics())
+
+
+def render_prometheus(merged: Dict[str, dict]) -> str:
+    """Render a ``collect_metrics``-shaped dict as Prometheus text."""
     out: List[str] = []
 
     def fmt_tags(tk) -> str:
@@ -208,7 +335,7 @@ def prometheus_text() -> str:
         inner = ",".join(f'{k}="{v}"' for k, v in tk)
         return "{" + inner + "}"
 
-    for name, data in sorted(collect_metrics().items()):
+    for name, data in sorted(merged.items()):
         out.append(f"# HELP {name} {data['description']}")
         out.append(f"# TYPE {name} {data['type']}")
         if data["type"] == "histogram":

@@ -1,0 +1,350 @@
+"""Built-in runtime telemetry: the ``ray_tpu_*`` metric catalog.
+
+Reference: Ray ships hundreds of built-in ``ray_*`` metrics
+(python/ray/_private/metrics_agent.py + src/ray/stats/metric_defs.cc)
+because a distributed runtime without telemetry cannot be operated at
+scale. Here ONE module owns the namespace: every built-in metric is
+declared in ``CATALOG`` and instantiated lazily on first record, so an
+idle process pays nothing and the tier-1 catalog lint
+(tests/test_telemetry_catalog.py) can statically verify that names are
+unique, ``ray_tpu_``-prefixed, and carry only declared tag keys.
+
+Hot-path contract: every recorder checks one cached ``enabled`` bool
+first (``RAY_TPU_METRICS_ENABLED=0`` / ``system_config`` turns the whole
+plane off), and instrumented modules import this module lazily so the
+core bootstrap order is unchanged.
+
+Alongside metrics, ``event()`` feeds a small per-process ring buffer of
+timeline events (object transfers, retries, breaker trips) that rides
+the metrics push throttle to the head KV; ``util/timeline.py`` merges
+them into extra chrome-tracing lanes next to the task lanes.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import threading
+import time
+from typing import Any, Dict, List, Optional
+
+from ray_tpu.util import metrics as _metrics
+
+COUNTER = "counter"
+GAUGE = "gauge"
+HISTOGRAM = "histogram"
+
+# Sub-millisecond RPCs up to multi-second stragglers.
+LATENCY_BOUNDARIES = [0.0005, 0.001, 0.0025, 0.005, 0.01, 0.025, 0.05,
+                      0.1, 0.25, 0.5, 1.0, 2.5, 5.0, 10.0]
+# Serve/train paths: first-request jit compiles can take tens of seconds.
+SLOW_BOUNDARIES = [0.005, 0.01, 0.025, 0.05, 0.1, 0.25, 0.5, 1.0, 2.5,
+                   5.0, 10.0, 30.0, 60.0]
+
+#: name -> (type, description, tag_keys, histogram boundaries or None).
+#: The single source of truth for the built-in namespace; the guard test
+#: lints every ``ray_tpu_*`` registration against this table.
+CATALOG: Dict[str, tuple] = {
+    # --- rpc (core/rpc.py) ---
+    "ray_tpu_rpc_client_latency_seconds": (
+        HISTOGRAM, "Round-trip latency of RPC request/reply calls.",
+        ("method",), LATENCY_BOUNDARIES),
+    "ray_tpu_rpc_sent_bytes_total": (
+        COUNTER, "Bytes written to RPC transports (frames + sidecars).",
+        (), None),
+    "ray_tpu_rpc_recv_bytes_total": (
+        COUNTER, "Bytes read from RPC transports (frames + sidecars).",
+        (), None),
+    # Per-process gauge: the "proc" tag keeps each process's series
+    # distinct — collect_metrics merges gauges last-write-wins per tag
+    # set, so an untagged per-process gauge would collapse to whichever
+    # process pushed last.
+    "ray_tpu_rpc_in_flight_requests": (
+        GAUGE, "RPC requests awaiting a reply, per process.",
+        ("proc",), None),
+    "ray_tpu_rpc_faults_injected_total": (
+        COUNTER, "Frames matched by the network fault-injection plane.",
+        ("action",), None),
+    # --- unified retry / circuit breaker (core/retry.py) ---
+    "ray_tpu_retries_total": (
+        COUNTER, "Retries performed by the unified RetryPolicy.",
+        ("site",), None),
+    "ray_tpu_retry_backoff_seconds_total": (
+        COUNTER, "Cumulative backoff delay slept before retries.",
+        ("site",), None),
+    "ray_tpu_retry_deadline_exhausted_total": (
+        COUNTER, "Retry/poll envelopes that exhausted their deadline.",
+        ("site",), None),
+    "ray_tpu_circuit_breaker_transitions_total": (
+        COUNTER, "Circuit-breaker state transitions.",
+        ("state",), None),
+    # --- scheduler (core/scheduler.py) ---
+    "ray_tpu_scheduler_pending_leases": (
+        GAUGE, "Lease requests parked in the cluster scheduler queue.",
+        (), None),
+    "ray_tpu_scheduler_leases_granted_total": (
+        COUNTER, "Worker leases granted by the cluster scheduler.",
+        (), None),
+    "ray_tpu_scheduler_placement_latency_seconds": (
+        HISTOGRAM, "Queue-to-grant latency of lease requests.",
+        (), LATENCY_BOUNDARIES),
+    # --- tasks (core/core_worker.py) ---
+    "ray_tpu_tasks_total": (
+        COUNTER, "Task state transitions observed by this process.",
+        ("state",), None),
+    # --- object plane (core/object_store.py, core/object_transfer.py) ---
+    # Per-node gauges: every process on a node reports the same shared
+    # arena, so last-write-wins per node tag is exactly right.
+    "ray_tpu_object_store_used_bytes": (
+        GAUGE, "Bytes used in the node shared-memory object store.",
+        ("node",), None),
+    "ray_tpu_object_store_objects": (
+        GAUGE, "Objects resident in the node shared-memory store.",
+        ("node",), None),
+    "ray_tpu_object_spilled_total": (
+        COUNTER, "Objects spilled to disk.", (), None),
+    "ray_tpu_object_spilled_bytes_total": (
+        COUNTER, "Bytes spilled to disk.", (), None),
+    "ray_tpu_object_restored_total": (
+        COUNTER, "Objects restored from spill files.", (), None),
+    "ray_tpu_object_pull_seconds": (
+        HISTOGRAM, "Latency of object pull sweeps across holders.",
+        ("status",), SLOW_BOUNDARIES),
+    # --- gcs (core/gcs.py) ---
+    "ray_tpu_gcs_nodes": (
+        GAUGE, "Cluster nodes by state (SUSPECT = death-grace window).",
+        ("state",), None),
+    # --- serve (serve/proxy.py, serve/router.py, serve/replica.py) ---
+    "ray_tpu_serve_http_requests_total": (
+        COUNTER, "HTTP requests handled by the Serve proxy.",
+        ("route", "code"), None),
+    "ray_tpu_serve_http_latency_seconds": (
+        HISTOGRAM, "End-to-end Serve proxy HTTP request latency.",
+        ("route",), SLOW_BOUNDARIES),
+    # Routers are per-process (proxy, composing replicas, drivers):
+    # the "proc" tag keeps their local queue views from clobbering each
+    # other in the gauge merge.
+    "ray_tpu_serve_router_queue_depth": (
+        GAUGE, "Router-tracked ongoing requests per deployment.",
+        ("deployment", "proc"), None),
+    "ray_tpu_serve_request_latency_seconds": (
+        HISTOGRAM, "Assign-to-completion latency of routed requests.",
+        ("deployment",), SLOW_BOUNDARIES),
+    "ray_tpu_serve_replica_sheds_total": (
+        COUNTER, "Replicas shed from routing by an open breaker.",
+        ("deployment",), None),
+    "ray_tpu_serve_replica_requests_total": (
+        COUNTER, "Requests executed by replicas.",
+        ("deployment", "status"), None),
+    "ray_tpu_serve_replica_latency_seconds": (
+        HISTOGRAM, "Replica-side request execution latency.",
+        ("deployment",), SLOW_BOUNDARIES),
+    # --- train (train/session.py) ---
+    "ray_tpu_train_reports_total": (
+        COUNTER, "train.report() calls across training workers.",
+        (), None),
+    "ray_tpu_train_step_seconds": (
+        HISTOGRAM, "Wall time between consecutive train.report() calls.",
+        (), SLOW_BOUNDARIES),
+}
+
+_KIND_TO_CLS = {
+    COUNTER: _metrics.Counter,
+    GAUGE: _metrics.Gauge,
+    HISTOGRAM: _metrics.Histogram,
+}
+
+_enabled: Optional[bool] = None
+_instances: Dict[str, _metrics.Metric] = {}
+_instances_lock = threading.Lock()
+
+# Timeline event ring buffer (see module docstring).
+_EVENT_CAP = 1000
+_events: List[dict] = []
+_events_lock = threading.Lock()
+
+
+_proc_tag: Optional[str] = None
+_node_tag: Optional[str] = None
+
+
+def proc_tag() -> str:
+    """This process's identity for per-process gauges."""
+    global _proc_tag
+    if _proc_tag is None:
+        _proc_tag = str(os.getpid())
+    return _proc_tag
+
+
+def node_tag() -> str:
+    """This node's identity for per-node gauges (the head process has
+    no RAY_TPU_NODE_ID in its environment)."""
+    global _node_tag
+    if _node_tag is None:
+        _node_tag = os.environ.get("RAY_TPU_NODE_ID", "head")[:12]
+    return _node_tag
+
+
+def enabled() -> bool:
+    """Cached per-process switch (config ``metrics_enabled`` /
+    ``RAY_TPU_METRICS_ENABLED``). Default on: the acceptance bar for the
+    runtime is that it is observable out of the box."""
+    global _enabled
+    if _enabled is None:
+        try:
+            from ray_tpu.core.config import get_config
+
+            _enabled = bool(get_config().metrics_enabled)
+        except Exception:
+            _enabled = os.environ.get(
+                "RAY_TPU_METRICS_ENABLED", "1").lower() not in (
+                    "0", "false", "no")
+    return _enabled
+
+
+def reset_for_testing() -> None:
+    """Drop cached state (enabled flag, metric instances, events) AND
+    unregister the catalog metrics, so recorded values don't leak into
+    the next test — without this, the idempotent registry would hand
+    the old instances (old values included) right back."""
+    global _enabled
+    _enabled = None
+    with _instances_lock:
+        _instances.clear()
+    with _events_lock:
+        _events.clear()
+    with _metrics._registry_lock:
+        for name in CATALOG:
+            _metrics._registry.pop(name, None)
+
+
+def metric(name: str) -> _metrics.Metric:
+    """The live instance for a catalog metric, created on first use."""
+    m = _instances.get(name)
+    if m is not None:
+        return m
+    with _instances_lock:
+        m = _instances.get(name)
+        if m is None:
+            kind, desc, tag_keys, bounds = CATALOG[name]
+            cls = _KIND_TO_CLS[kind]
+            if kind == HISTOGRAM:
+                m = cls(name, desc, boundaries=bounds, tag_keys=tag_keys)
+            else:
+                m = cls(name, desc, tag_keys=tag_keys)
+            _instances[name] = m
+    return m
+
+
+def ensure_all() -> None:
+    """Instantiate every catalog metric (guard test / exposition
+    completeness: a scrape shows the full namespace, not just metrics
+    that happened to fire)."""
+    for name in CATALOG:
+        metric(name)
+
+
+# -- hot-path recorders (each a no-op when the plane is disabled) -------
+
+def inc(name: str, value: float = 1.0,
+        tags: Optional[Dict[str, str]] = None) -> None:
+    if not enabled():
+        return
+    try:
+        metric(name).inc(value, tags)
+    except Exception:
+        pass
+
+
+def set_gauge(name: str, value: float,
+              tags: Optional[Dict[str, str]] = None) -> None:
+    if not enabled():
+        return
+    try:
+        metric(name).set(value, tags)
+    except Exception:
+        pass
+
+
+def observe(name: str, value: float,
+            tags: Optional[Dict[str, str]] = None) -> None:
+    if not enabled():
+        return
+    try:
+        metric(name).observe(value, tags)
+    except Exception:
+        pass
+
+
+def flush() -> None:
+    _metrics.flush_metrics()
+
+
+# -- timeline events ----------------------------------------------------
+
+def event(cat: str, name: str, ts: Optional[float] = None,
+          dur: Optional[float] = None,
+          args: Optional[Dict[str, Any]] = None) -> None:
+    """Record one timeline event (chrome-tracing lane ``cat``). ``ts``
+    is wall-clock seconds (defaults to now); ``dur`` seconds makes it a
+    complete event, None an instant marker."""
+    if not enabled():
+        return
+    ev = {"cat": cat, "name": name,
+          "ts": time.time() if ts is None else ts}
+    if dur is not None:
+        ev["dur"] = dur
+    if args:
+        ev["args"] = args
+    with _events_lock:
+        _events.append(ev)
+        if len(_events) > _EVENT_CAP:
+            del _events[:_EVENT_CAP // 2]
+
+
+def local_timeline_events() -> List[dict]:
+    with _events_lock:
+        return [dict(ev) for ev in _events]
+
+
+def _push_events(cw) -> None:
+    """Metrics push hook: ship this process's event buffer to the head
+    KV (overwrite — the buffer is the retained window)."""
+    with _events_lock:
+        if not _events:
+            return
+        payload = list(_events)
+    blob = json.dumps(payload).encode()
+    key = f"timeline:{cw.worker_id.hex()}".encode()
+    cw.loop_thread.submit(cw.head.call("kv_put", {
+        "ns": "timeline", "key": key, "value": blob,
+        "overwrite": True,
+    }))
+
+
+_metrics.register_push_hook(_push_events)
+
+
+def collect_timeline_events() -> List[dict]:
+    """Merge every process's pushed timeline events (driver-side)."""
+    from ray_tpu.core.object_ref import get_core_worker
+
+    cw = get_core_worker()
+    if cw is None:
+        raise RuntimeError("ray_tpu not initialized")
+    keys = cw.loop_thread.run(
+        cw.head.call("kv_keys", {"ns": "timeline",
+                                 "prefix": b"timeline:"}))
+    merged: List[dict] = []
+    for key in keys.get("keys", []):
+        reply = cw.loop_thread.run(
+            cw.head.call("kv_get", {"ns": "timeline", "key": key}))
+        blob = reply.get("value")
+        if not blob:
+            continue
+        try:
+            merged.extend(json.loads(bytes(blob).decode()))
+        except ValueError:
+            continue
+    merged.sort(key=lambda ev: ev.get("ts", 0.0))
+    return merged

@@ -3,6 +3,11 @@
 Reference: `ray timeline` (_private/state.py:434 chrome_tracing_dump) —
 task state transitions from the event store become complete events
 ("ph": "X") grouped by worker, loadable in chrome://tracing / Perfetto.
+
+Beyond task events, the export merges the telemetry event stream
+(util/telemetry.py) into extra lanes: object transfers (pulls, spills,
+restores), retries, and circuit-breaker trips each get their own track,
+so a fault-injection soak reads as one coherent picture.
 """
 
 from __future__ import annotations
@@ -10,11 +15,8 @@ from __future__ import annotations
 import json
 from typing import List, Optional
 
-from ray_tpu.util.state import list_task_events
 
-
-def timeline(filename: Optional[str] = None) -> List[dict]:
-    events = list_task_events(limit=100000)
+def _task_trace_events(events: List[dict]) -> List[dict]:
     # Pair RUNNING -> FINISHED/FAILED per task.
     start_ts = {}
     trace: List[dict] = []
@@ -35,6 +37,62 @@ def timeline(filename: Optional[str] = None) -> List[dict]:
                 "args": {"task_id": tid,
                          "state": ev["state"]},
             })
+    # Still-RUNNING tasks appear as open "B" begin events: a hung task
+    # must be visible in the timeline, not silently dropped.
+    for tid, begin in start_ts.items():
+        trace.append({
+            "name": begin.get("name") or tid[:8],
+            "cat": begin.get("type", "task"),
+            "ph": "B",
+            "ts": begin["ts"] * 1e6,
+            "pid": "ray_tpu",
+            "tid": begin.get("worker_id", "?")[:12],
+            "args": {"task_id": tid, "state": "RUNNING"},
+        })
+    return trace
+
+
+def telemetry_trace_events(events: List[dict]) -> List[dict]:
+    """Convert telemetry events (util/telemetry.py ``event()`` dicts)
+    into chrome-tracing events, one lane (tid) per category."""
+    trace: List[dict] = []
+    for ev in events:
+        cat = ev.get("cat", "event")
+        out = {
+            "name": ev.get("name", "?"),
+            "cat": cat,
+            "ts": float(ev.get("ts", 0.0)) * 1e6,
+            "pid": "ray_tpu",
+            "tid": cat,
+            "args": ev.get("args") or {},
+        }
+        dur = ev.get("dur")
+        if dur is not None:
+            out["ph"] = "X"
+            out["dur"] = max(0.0, float(dur) * 1e6)
+        else:
+            out["ph"] = "i"
+            out["s"] = "p"
+        trace.append(out)
+    return trace
+
+
+def timeline(filename: Optional[str] = None,
+             events: Optional[List[dict]] = None,
+             include_telemetry: bool = True) -> List[dict]:
+    if events is None:
+        from ray_tpu.util.state import list_task_events
+
+        events = list_task_events(limit=100000)
+    trace = _task_trace_events(events)
+    if include_telemetry:
+        try:
+            from ray_tpu.util import telemetry
+
+            trace.extend(
+                telemetry_trace_events(telemetry.collect_timeline_events()))
+        except Exception:
+            pass  # no cluster attached / nothing pushed yet
     if filename:
         with open(filename, "w") as f:
             json.dump(trace, f)

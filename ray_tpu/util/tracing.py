@@ -24,6 +24,7 @@ Usage:
 
 from __future__ import annotations
 
+import contextvars
 import json
 import logging
 import os
@@ -65,13 +66,20 @@ class _MiniSpan:
                 "attributes": self.attributes}
 
 
-_local = threading.local()
+# Task-local, not thread-local: spans are held across awaits (a Serve
+# proxy handler, an RPC call awaiting its reply), and on one shared
+# event loop a threading.local would leak the open span into every
+# other coroutine interleaved with it — concurrent requests would merge
+# into one trace. Each asyncio task (and each plain thread) gets its
+# own context.
+_current_span: "contextvars.ContextVar[Optional[_MiniSpan]]" = (
+    contextvars.ContextVar("ray_tpu_mini_span", default=None))
 _recorded: List[_MiniSpan] = []
 _record_lock = threading.Lock()
 
 
 def _current_mini() -> Optional[_MiniSpan]:
-    return getattr(_local, "span", None)
+    return _current_span.get()
 
 
 def get_recorded_spans() -> List[dict]:
@@ -104,11 +112,16 @@ def _mini_span(name: str, trace_id: Optional[str],
     if parent_id is None and parent is not None:
         parent_id = parent.span_id
     span = _MiniSpan(name, trace_id, secrets.token_hex(8), parent_id)
-    prev, _local.span = getattr(_local, "span", None), span
+    token = _current_span.set(span)
     try:
         yield span
     finally:
-        _local.span = prev
+        try:
+            _current_span.reset(token)
+        except ValueError:
+            # Token from another context (exotic executor reuse): just
+            # clear rather than corrupt the stack.
+            _current_span.set(None)
         _record(span)
 
 
@@ -199,6 +212,31 @@ def _parse_traceparent(carrier: Optional[Dict[str, str]]):
         return trace_id, span_id
     except (KeyError, ValueError):
         return None, None
+
+
+@contextmanager
+def span(name: str, carrier: Optional[Dict[str, str]] = None):
+    """Generic span: parents to ``carrier`` when given (cross-process /
+    cross-thread propagation — Serve proxy -> router -> replica, RPC
+    client -> server), else to the calling thread's current span."""
+    if not _enabled:
+        yield None
+        return
+    if _backend == "otel":
+        ctx = None
+        if carrier:
+            try:
+                from opentelemetry import propagate
+
+                ctx = propagate.extract(carrier)
+            except Exception:
+                ctx = None
+        with _otel_tracer.start_as_current_span(name, context=ctx) as s:
+            yield s
+        return
+    trace_id, parent_id = _parse_traceparent(carrier)
+    with _mini_span(name, trace_id, parent_id) as s:
+        yield s
 
 
 @contextmanager

@@ -1,0 +1,367 @@
+"""Built-in metrics plane: registry semantics, merge/render math, the
+push throttle, and an end-to-end instrumented round-trip (reference
+strategy: test_metrics_agent.py + test_metrics.py — app metrics flow out
+to Prometheus and built-in ray_* metrics cover the runtime)."""
+
+import time
+
+import pytest
+
+import ray_tpu
+from ray_tpu.util import metrics as um
+from ray_tpu.util import telemetry
+
+
+# ---------------------------------------------------------------------------
+# registry semantics (satellite: idempotent duplicate-name registration)
+# ---------------------------------------------------------------------------
+
+
+def test_duplicate_name_returns_existing_counter():
+    c1 = um.Counter("tm_dup_counter", "first", tag_keys=("k",))
+    c1.inc(2, {"k": "a"})
+    c2 = um.Counter("tm_dup_counter", "second", tag_keys=("k",))
+    assert c2 is c1
+    c2.inc(3, {"k": "a"})
+    assert c1._values[(("k", "a"),)] == 5.0
+
+
+def test_duplicate_registration_merges_tag_keys():
+    g1 = um.Gauge("tm_dup_gauge", tag_keys=("a",))
+    g2 = um.Gauge("tm_dup_gauge", tag_keys=("b",))
+    assert g2 is g1
+    # Both declarations' tags usable after the merge.
+    g1.set(1.0, {"a": "x"})
+    g1.set(2.0, {"b": "y"})
+
+
+def test_duplicate_name_type_mismatch_raises():
+    um.Counter("tm_dup_mismatch")
+    with pytest.raises(TypeError):
+        um.Gauge("tm_dup_mismatch")
+    with pytest.raises(TypeError):
+        um.Histogram("tm_dup_mismatch")
+
+
+def test_histogram_reregistration_keeps_buckets():
+    h1 = um.Histogram("tm_dup_hist", boundaries=[0.1, 1.0])
+    h1.observe(0.5)
+    h2 = um.Histogram("tm_dup_hist")
+    assert h2 is h1
+    assert h1.boundaries == [0.1, 1.0]
+    with pytest.raises(TypeError):
+        um.Histogram("tm_dup_hist", boundaries=[0.2, 2.0])
+
+
+def test_undeclared_tag_key_rejected():
+    c = um.Counter("tm_tagcheck", tag_keys=("k",))
+    with pytest.raises(ValueError):
+        c.inc(1, {"nope": "x"})
+
+
+# ---------------------------------------------------------------------------
+# histogram bucket math + rendering
+# ---------------------------------------------------------------------------
+
+
+def test_histogram_bucket_math():
+    h = um.Histogram("tm_hist_math", boundaries=[0.1, 1.0])
+    for v in (0.05, 0.5, 5.0):
+        h.observe(v)
+    snap = h._snapshot()
+    [(tags, counts)] = snap["hists"]
+    assert tags == []
+    # [<=0.1, <=1.0, +inf, sum, count]
+    assert counts == [1, 1, 1, 5.55, 3]
+
+
+def test_render_prometheus_golden():
+    merged = {
+        "tm_requests_total": {
+            "type": "counter", "description": "reqs",
+            "values": {(("m", "get"),): 3.0},
+        },
+        "tm_lat_seconds": {
+            "type": "histogram", "description": "lat",
+            "boundaries": [0.1, 1.0],
+            "values": {(): [1, 1, 1, 5.55, 3]},
+        },
+    }
+    text = um.render_prometheus(merged)
+    assert text == (
+        "# HELP tm_lat_seconds lat\n"
+        "# TYPE tm_lat_seconds histogram\n"
+        'tm_lat_seconds_bucket{le="0.1"} 1\n'
+        'tm_lat_seconds_bucket{le="1.0"} 2\n'
+        'tm_lat_seconds_bucket{le="+Inf"} 3\n'
+        "tm_lat_seconds_sum 5.55\n"
+        "tm_lat_seconds_count 3\n"
+        "# HELP tm_requests_total reqs\n"
+        "# TYPE tm_requests_total counter\n"
+        'tm_requests_total{m="get"} 3.0\n'
+    )
+
+
+# ---------------------------------------------------------------------------
+# push throttle (satellite: cw-less call must not consume the window)
+# ---------------------------------------------------------------------------
+
+
+def test_maybe_push_does_not_consume_window_without_worker(monkeypatch):
+    import ray_tpu.core.object_ref as object_ref_mod
+
+    monkeypatch.setattr(object_ref_mod, "get_core_worker", lambda: None)
+    saved = um._last_push
+    um._last_push = 0.0
+    try:
+        um._maybe_push()
+        assert um._last_push == 0.0, (
+            "throttle window consumed before a push was possible")
+    finally:
+        um._last_push = saved
+
+
+def test_maybe_push_delivers_once_worker_exists(monkeypatch):
+    import ray_tpu.core.object_ref as object_ref_mod
+
+    pushed = []
+
+    class _WID:
+        @staticmethod
+        def hex():
+            return "f" * 32
+
+    class _Head:
+        @staticmethod
+        def call(method, payload):
+            async def _noop():
+                return {}
+
+            pushed.append((method, payload))
+            return _noop()
+
+    class _Loop:
+        @staticmethod
+        def submit(coro):
+            coro.close()
+
+    class _CW:
+        worker_id = _WID()
+        head = _Head()
+        loop_thread = _Loop()
+
+    monkeypatch.setattr(object_ref_mod, "get_core_worker", lambda: _CW())
+    saved = um._last_push
+    um._last_push = 0.0
+    try:
+        um.Counter("tm_push_probe").inc()
+        assert um._last_push > 0.0
+        assert any(p[0] == "kv_put" and p[1]["ns"] == "metrics"
+                   for p in pushed)
+    finally:
+        um._last_push = saved
+
+
+# ---------------------------------------------------------------------------
+# timeline export (satellite: still-RUNNING tasks stay visible)
+# ---------------------------------------------------------------------------
+
+
+def _timeline_mod():
+    # ray_tpu.util re-exports the timeline FUNCTION under the module's
+    # name; go through sys.modules for the module itself.
+    import importlib
+
+    return importlib.import_module("ray_tpu.util.timeline")
+
+
+def test_timeline_emits_open_begin_events():
+    tl = _timeline_mod()
+    events = [
+        {"task_id": "t1", "state": "RUNNING", "ts": 1.0, "name": "f",
+         "worker_id": "w1", "type": "NORMAL_TASK"},
+        {"task_id": "t1", "state": "FINISHED", "ts": 2.0, "name": "f",
+         "worker_id": "w1", "type": "NORMAL_TASK"},
+        {"task_id": "t2", "state": "RUNNING", "ts": 1.5, "name": "hung",
+         "worker_id": "w2", "type": "NORMAL_TASK"},
+    ]
+    trace = tl.timeline(events=events, include_telemetry=False)
+    by_ph = {ev["ph"]: ev for ev in trace}
+    assert set(by_ph) == {"X", "B"}
+    assert by_ph["X"]["name"] == "f"
+    assert by_ph["X"]["dur"] == pytest.approx(1e6)
+    assert by_ph["B"]["name"] == "hung"  # visible, not dropped
+    assert by_ph["B"]["args"]["state"] == "RUNNING"
+
+
+def test_timeline_telemetry_lanes():
+    tl = _timeline_mod()
+    evs = [
+        {"cat": "objects", "name": "pull abc", "ts": 1.0, "dur": 0.5,
+         "args": {"status": "ok"}},
+        {"cat": "retry", "name": "retry push_tasks", "ts": 2.0},
+    ]
+    trace = tl.telemetry_trace_events(evs)
+    assert trace[0]["ph"] == "X" and trace[0]["tid"] == "objects"
+    assert trace[0]["dur"] == pytest.approx(0.5e6)
+    assert trace[1]["ph"] == "i" and trace[1]["tid"] == "retry"
+
+
+# ---------------------------------------------------------------------------
+# end-to-end: instrumented round-trip + cross-process merge
+# ---------------------------------------------------------------------------
+
+
+def _wait_for_metrics(predicate, timeout=45.0):
+    deadline = time.time() + timeout
+    merged = {}
+    while time.time() < deadline:
+        um.flush_metrics()
+        merged = um.collect_metrics()
+        if predicate(merged):
+            return merged
+        time.sleep(0.3)
+    raise AssertionError(
+        f"metrics never satisfied predicate; have {sorted(merged)}")
+
+
+def _counter_total(merged, name):
+    return sum(merged[name]["values"].values()) if name in merged else 0.0
+
+
+def test_roundtrip_increments_core_metrics(ray_start):
+    @ray_tpu.remote
+    def flush_and_echo(x):
+        # Flush from inside the task so the worker's RUNNING counter is
+        # in the KV before the driver collects.
+        from ray_tpu.util import metrics as wm
+
+        wm.flush_metrics()
+        return x + 1
+
+    assert ray_tpu.get(flush_and_echo.remote(1), timeout=120) == 2
+
+    need = ["ray_tpu_rpc_client_latency_seconds",
+            "ray_tpu_rpc_sent_bytes_total",
+            "ray_tpu_rpc_recv_bytes_total",
+            "ray_tpu_tasks_total",
+            "ray_tpu_scheduler_leases_granted_total",
+            "ray_tpu_scheduler_placement_latency_seconds"]
+
+    def _tasks_by_state(m):
+        return {dict(tk).get("state"): v
+                for tk, v in m["ray_tpu_tasks_total"]["values"].items()}
+
+    # The RUNNING count arrives with the WORKER's async push — wait for
+    # it too, not just for the metric names (driver-only snapshot).
+    merged = _wait_for_metrics(
+        lambda m: all(n in m for n in need)
+        and _tasks_by_state(m).get("RUNNING", 0) >= 1)
+    tasks = _tasks_by_state(merged)
+    assert tasks.get("SUBMITTED", 0) >= 1  # driver side
+    assert tasks.get("RUNNING", 0) >= 1    # worker side (merged)
+    assert _counter_total(merged, "ray_tpu_rpc_sent_bytes_total") > 0
+    # The histogram merged across processes keeps count/sum coherent.
+    hist = merged["ray_tpu_rpc_client_latency_seconds"]
+    for counts in hist["values"].values():
+        assert counts[-1] >= 1
+    # Prometheus rendering of the merged view is non-empty and typed.
+    text = um.prometheus_text()
+    assert "# TYPE ray_tpu_tasks_total counter" in text
+    assert "ray_tpu_rpc_client_latency_seconds_bucket" in text
+
+
+def test_fault_injected_partition_shows_in_retry_counters(ray_start):
+    from ray_tpu.core import rpc as rpc_mod
+
+    before_retries = 0.0
+    um.flush_metrics()
+    try:
+        merged = um.collect_metrics()
+        before_retries = _counter_total(merged, "ray_tpu_retries_total")
+    except Exception:
+        pass
+
+    fi = rpc_mod.get_fault_injector()
+    fi.install("partition", method="push_tasks", direction="send",
+               max_matches=1)
+    try:
+        @ray_tpu.remote
+        def g():
+            return 42
+
+        assert ray_tpu.get(g.remote(), timeout=120) == 42
+    finally:
+        fi.reset()
+        rpc_mod.reset_fault_injector()
+
+    merged = _wait_for_metrics(
+        lambda m: ("ray_tpu_rpc_faults_injected_total" in m
+                   and _counter_total(m, "ray_tpu_retries_total")
+                   > before_retries))
+    faults = {dict(tk).get("action"): v for tk, v in
+              merged["ray_tpu_rpc_faults_injected_total"]["values"].items()}
+    assert faults.get("partition", 0) >= 1
+    sites = {dict(tk).get("site") for tk in
+             merged["ray_tpu_retries_total"]["values"]}
+    assert "push_tasks" in sites
+
+
+def test_histogram_cross_process_merge(ray_start):
+    name = "tm_merge_hist"
+    h = um.Histogram(name, boundaries=[0.1, 1.0])
+    h.observe(0.05)
+
+    @ray_tpu.remote
+    def observe_remote():
+        from ray_tpu.util import metrics as wm
+
+        wh = wm.Histogram("tm_merge_hist", boundaries=[0.1, 1.0])
+        wh.observe(0.5)
+        wm.flush_metrics()
+        return True
+
+    assert ray_tpu.get(observe_remote.remote(), timeout=120)
+    merged = _wait_for_metrics(
+        lambda m: name in m
+        and next(iter(m[name]["values"].values()))[-1] >= 2)
+    [(tags, counts)] = list(merged[name]["values"].items())
+    assert counts[-1] >= 2          # merged count
+    assert counts[0] >= 1           # <=0.1 bucket (driver)
+    assert counts[1] >= 1           # <=1.0 bucket (worker)
+
+
+def test_counter_cross_process_merge(ray_start):
+    c = um.Counter("tm_merge_counter", tag_keys=("who",))
+    c.inc(1, {"who": "driver"})
+
+    @ray_tpu.remote
+    def inc_remote():
+        from ray_tpu.util import metrics as wm
+
+        wc = wm.Counter("tm_merge_counter", tag_keys=("who",))
+        wc.inc(2, {"who": "worker"})
+        wm.flush_metrics()
+        return True
+
+    assert ray_tpu.get(inc_remote.remote(), timeout=120)
+    merged = _wait_for_metrics(
+        lambda m: "tm_merge_counter" in m
+        and len(m["tm_merge_counter"]["values"]) >= 2)
+    vals = {dict(tk)["who"]: v
+            for tk, v in merged["tm_merge_counter"]["values"].items()}
+    assert vals.get("driver") == 1.0
+    assert vals.get("worker") == 2.0
+
+
+def test_timeline_export_from_live_cluster(ray_start):
+    @ray_tpu.remote
+    def noop():
+        return None
+
+    ray_tpu.get(noop.remote(), timeout=120)
+    time.sleep(1.5)  # task-event buffer flush interval
+    tl = _timeline_mod()
+    trace = tl.timeline()
+    assert trace, "timeline empty after running tasks"
+    assert any(ev["ph"] in ("X", "B") for ev in trace)

@@ -1,0 +1,108 @@
+"""Serve observability: one HTTP request yields a single cross-process
+trace (proxy -> router -> replica spans share a trace id) and populates
+the serve metric namespace (reference strategy: Serve's request-context
+propagation tests + test_metrics.py's serve counters)."""
+
+import json
+import os
+import time
+import urllib.request
+
+import pytest
+
+from ray_tpu.util import tracing
+
+HTTP_PORT = 18731
+
+
+@pytest.fixture(scope="module")
+def traced_serve_cluster(tmp_path_factory):
+    # The trace file and enable flag must be in the environment BEFORE
+    # init so spawned workers (proxy/replica actors) inherit them.
+    trace_file = str(tmp_path_factory.mktemp("traces") / "spans.jsonl")
+    os.environ["RAY_TPU_TRACE_FILE"] = trace_file
+    tracing.setup_tracing("serve-observability-test")
+    import ray_tpu
+    from ray_tpu import serve
+
+    ray_tpu.init(num_cpus=4, num_tpus=0)
+    yield trace_file
+    serve.shutdown()
+    ray_tpu.shutdown()
+    os.environ.pop("RAY_TPU_TRACE_FILE", None)
+
+
+def _read_spans(trace_file):
+    try:
+        with open(trace_file) as f:
+            return [json.loads(line) for line in f if line.strip()]
+    except FileNotFoundError:
+        return []
+
+
+def test_http_request_single_trace_and_serve_metrics(traced_serve_cluster):
+    trace_file = traced_serve_cluster
+    from ray_tpu import serve
+
+    @serve.deployment
+    class Obs:
+        def __call__(self, request):
+            return {"ok": True}
+
+    serve.run(Obs.bind(), name="obs_app", route_prefix="/obs",
+              http_port=HTTP_PORT)
+    with urllib.request.urlopen(
+            f"http://127.0.0.1:{HTTP_PORT}/obs", timeout=60) as resp:
+        assert json.loads(resp.read()) == {"ok": True}
+
+    # --- one trace across proxy -> router -> replica ---
+    deadline = time.time() + 30
+    proxy = router = replica = []
+    while time.time() < deadline:
+        spans = _read_spans(trace_file)
+        proxy = [s for s in spans if s["name"].startswith("proxy ")]
+        router = [s for s in spans if s["name"].startswith("router ")]
+        replica = [s for s in spans if s["name"].startswith("replica ")]
+        if proxy and router and replica:
+            break
+        time.sleep(0.5)
+    assert proxy and router and replica, (
+        f"missing spans: proxy={len(proxy)} router={len(router)} "
+        f"replica={len(replica)}")
+    trace_id = proxy[-1]["trace_id"]
+    assert any(s["trace_id"] == trace_id for s in router)
+    assert any(s["trace_id"] == trace_id for s in replica)
+
+    # --- serve metric namespace populated cluster-wide ---
+    from ray_tpu.util import metrics as um
+
+    need = ["ray_tpu_serve_http_requests_total",
+            "ray_tpu_serve_http_latency_seconds",
+            "ray_tpu_serve_request_latency_seconds",
+            "ray_tpu_serve_replica_requests_total"]
+
+    def _served_200(m):
+        # Names alone aren't enough: ensure_all() (e.g. the catalog
+        # guard) registers every catalog metric with EMPTY values in
+        # the driver — wait for the proxy's real 200 sample.
+        if not all(n in m for n in need):
+            return False
+        http = m["ray_tpu_serve_http_requests_total"]["values"]
+        return any(dict(tk).get("code") == "200" and v >= 1
+                   for tk, v in http.items())
+
+    deadline = time.time() + 45
+    merged = {}
+    while time.time() < deadline:
+        um.flush_metrics()
+        merged = um.collect_metrics()
+        if _served_200(merged):
+            break
+        time.sleep(0.5)
+    assert _served_200(merged), (
+        f"serve metrics incomplete; have "
+        f"{ {n: merged.get(n, {}).get('values') for n in need} }")
+    # The dashboard's /metrics content renders the serve series.
+    text = um.prometheus_text()
+    assert "ray_tpu_serve_http_requests_total" in text
+    serve.delete("obs_app")

@@ -1,0 +1,101 @@
+"""Tier-1 guard: the built-in ``ray_tpu_*`` metric namespace stays
+coherent as instrumentation grows.
+
+Every runtime module must import with metrics enabled (instrumentation
+must never break an import), and every ``ray_tpu_``-prefixed metric that
+ends up in the registry must come from the telemetry CATALOG with a
+lowercase snake_case name and only declared, lowercase tag keys. New
+instrumentation that invents a metric outside the catalog — or reuses a
+name with a different type — fails here, not in production."""
+
+import importlib
+import pkgutil
+import re
+import warnings
+
+import ray_tpu
+from ray_tpu.util import metrics as um
+from ray_tpu.util import telemetry
+
+NAME_RE = re.compile(r"^ray_tpu_[a-z0-9]+(_[a-z0-9]+)*$")
+TAG_RE = re.compile(r"^[a-z][a-z0-9_]*$")
+
+
+def _walk_module_names():
+    for info in pkgutil.walk_packages(ray_tpu.__path__, prefix="ray_tpu."):
+        # __main__ modules execute their CLI on import.
+        if info.name.rsplit(".", 1)[-1] == "__main__":
+            continue
+        yield info.name
+
+
+def test_every_module_imports_with_metrics_enabled():
+    assert telemetry.enabled(), (
+        "metrics plane disabled in the test environment; the guard "
+        "must run with instrumentation live")
+    failures = []
+    for name in _walk_module_names():
+        try:
+            with warnings.catch_warnings():
+                warnings.simplefilter("ignore")
+                importlib.import_module(name)
+        except Exception as e:  # noqa: BLE001 — collecting all failures
+            failures.append(f"{name}: {type(e).__name__}: {e}")
+    assert not failures, "modules failed to import:\n" + "\n".join(failures)
+
+
+def test_catalog_names_and_tags_conform():
+    assert telemetry.CATALOG, "catalog must not be empty"
+    for name, (kind, desc, tag_keys, bounds) in telemetry.CATALOG.items():
+        assert NAME_RE.match(name), f"bad metric name {name!r}"
+        assert name == name.lower()
+        assert kind in (telemetry.COUNTER, telemetry.GAUGE,
+                        telemetry.HISTOGRAM), name
+        assert desc, f"{name} missing description"
+        for key in tag_keys:
+            assert TAG_RE.match(key), f"{name}: bad tag key {key!r}"
+        if kind == telemetry.HISTOGRAM:
+            assert bounds and list(bounds) == sorted(bounds), (
+                f"{name}: histogram boundaries must be sorted")
+        else:
+            assert bounds is None, f"{name}: boundaries on non-histogram"
+        # Counters follow the Prometheus _total convention; latency
+        # histograms the _seconds convention.
+        if kind == telemetry.COUNTER:
+            assert name.endswith("_total"), name
+
+
+def test_registry_matches_catalog():
+    # Instantiate the full catalog, then lint EVERYTHING ray_tpu_* that
+    # any import-time or test-time instrumentation registered.
+    telemetry.ensure_all()
+    with um._registry_lock:
+        registered = dict(um._registry)
+    seen = [n for n in registered if n.startswith("ray_tpu_")]
+    assert len(seen) >= len(telemetry.CATALOG)
+    for name in seen:
+        assert name in telemetry.CATALOG, (
+            f"metric {name!r} registered outside the telemetry catalog")
+        kind, _desc, tag_keys, bounds = telemetry.CATALOG[name]
+        m = registered[name]
+        assert m.metric_type == kind, (
+            f"{name}: registered as {m.metric_type}, catalog says {kind}")
+        assert set(m.tag_keys) <= set(tag_keys), (
+            f"{name}: undeclared tag keys "
+            f"{set(m.tag_keys) - set(tag_keys)}")
+        if kind == telemetry.HISTOGRAM:
+            assert m.boundaries == sorted(bounds)
+
+
+def test_catalog_metric_roundtrip():
+    telemetry.reset_for_testing()
+    try:
+        telemetry.inc("ray_tpu_tasks_total", 1, {"state": "GUARD_TEST"})
+        m = telemetry.metric("ray_tpu_tasks_total")
+        assert m._values.get((("state", "GUARD_TEST"),), 0) >= 1
+        # Unknown names never create registry entries.
+        telemetry.inc("ray_tpu_not_in_catalog_total", 1)
+        with um._registry_lock:
+            assert "ray_tpu_not_in_catalog_total" not in um._registry
+    finally:
+        telemetry.reset_for_testing()

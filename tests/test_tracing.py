@@ -43,3 +43,47 @@ def test_trace_ctx_rides_task_kwargs(ray_start):
     # Same trace across the process boundary.
     assert (task_carrier["traceparent"].split("-")[1]
             == outer_carrier["traceparent"].split("-")[1])
+
+
+def test_generic_span_parents_to_carrier():
+    tracing.setup_tracing("test-span")
+    with tracing.span("parent"):
+        carrier = tracing.inject_context()
+    with tracing.span("child", carrier):
+        pass
+    if tracing.backend() == "mini":
+        spans = {s["name"]: s for s in tracing.get_recorded_spans()}
+        assert spans["child"]["trace_id"] == spans["parent"]["trace_id"]
+        assert spans["child"]["parent_id"] == spans["parent"]["span_id"]
+
+
+def test_rpc_spans_gated_on_config_flag(monkeypatch):
+    """trace_rpc=1 wraps Connection.call / handler dispatch in
+    client+server spans sharing one trace; off by default."""
+    from ray_tpu.core import rpc
+
+    tracing.setup_tracing("test-rpc-span")
+    assert rpc._rpc_tracing_on() is False  # default off (warms cache)
+    monkeypatch.setattr(rpc, "_trace_rpc_flag", True)
+
+    lt = rpc.EventLoopThread(name="trace-rpc-test-io")
+
+    async def h_echo(conn, payload):
+        return {"v": payload["v"]}
+
+    server = rpc.Server({"echo": h_echo}, name="tsrv")
+    try:
+        port = lt.run(server.start("127.0.0.1", 0))
+        conn = lt.run(rpc.connect("127.0.0.1", port, {}, name="tcli"))
+        assert lt.run(conn.call("echo", {"v": 7}, timeout=10)) == {"v": 7}
+        lt.run(conn.close(), timeout=5)
+        lt.run(server.stop(), timeout=5)
+    finally:
+        lt.stop()
+
+    if tracing.backend() == "mini":
+        spans = tracing.get_recorded_spans()
+        client = [s for s in spans if s["name"] == "rpc echo"]
+        handler = [s for s in spans if s["name"] == "rpc.handle echo"]
+        assert client and handler
+        assert handler[-1]["trace_id"] == client[-1]["trace_id"]
