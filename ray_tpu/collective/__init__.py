@@ -14,6 +14,8 @@ from ray_tpu.collective.collective import (
     get_rank,
     init_collective_group,
     is_group_initialized,
+    list_declared_groups,
+    local_group_names,
     recv,
     reducescatter,
     send,
@@ -35,6 +37,8 @@ __all__ = [
     "get_rank",
     "init_collective_group",
     "is_group_initialized",
+    "list_declared_groups",
+    "local_group_names",
     "recv",
     "reducescatter",
     "send",

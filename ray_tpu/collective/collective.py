@@ -197,6 +197,24 @@ def is_group_initialized(group_name: str = _DEFAULT_GROUP) -> bool:
         return group_name in _groups
 
 
+def local_group_names() -> list:
+    """Group names this process has initialized (train gang heartbeats
+    report these so the driver can destroy exactly the gang's groups on
+    abort, waking peers blocked in ``exchange``)."""
+    with _lock:
+        return sorted(_groups)
+
+
+def list_declared_groups() -> list:
+    """Cluster-wide view: every group currently declared in the
+    rendezvous store, callable from any process (gang-abort forensics —
+    e.g. checking which groups survived a ``destroy_collective_group``
+    sweep)."""
+    ray_tpu = _api()
+    store = _get_store()
+    return ray_tpu.get(store.list_groups.remote())
+
+
 def get_rank(group_name: str = _DEFAULT_GROUP) -> int:
     return _get_ctx(group_name).rank
 

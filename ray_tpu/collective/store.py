@@ -79,6 +79,10 @@ class CollectiveStore:
     async def get_group(self, group_name: str) -> Optional[dict]:
         return self._groups.get(group_name)
 
+    async def list_groups(self) -> list:
+        """Names of all declared groups (gang abort introspection)."""
+        return sorted(self._groups)
+
     async def destroy_group(self, group_name: str) -> None:
         self._groups.pop(group_name, None)
         for key in [k for k in self._sessions if k[0] == group_name]:

@@ -3,6 +3,10 @@ python/ray/train/) + GSPMD train-step construction (spmd.py)."""
 
 from ray_tpu.train.backend import Backend, JaxBackend
 from ray_tpu.train.checkpoint import Checkpoint
+from ray_tpu.train.checkpoint_manager import (
+    CheckpointManager,
+    TornCheckpointError,
+)
 from ray_tpu.train.config import (
     CheckpointConfig,
     FailureConfig,
@@ -19,19 +23,23 @@ from ray_tpu.train.session import (
 )
 from ray_tpu.train.predictor import JaxPredictor, predict_dataset
 from ray_tpu.train.trainer import DataParallelTrainer, JaxTrainer
+from ray_tpu.train.worker_group import GangPlacementError
 
 __all__ = [
     "Backend",
     "Checkpoint",
     "CheckpointConfig",
+    "CheckpointManager",
     "DataParallelTrainer",
     "FailureConfig",
+    "GangPlacementError",
     "JaxBackend",
     "JaxPredictor",
     "JaxTrainer",
     "Result",
     "RunConfig",
     "ScalingConfig",
+    "TornCheckpointError",
     "TrainContext",
     "get_checkpoint",
     "get_context",

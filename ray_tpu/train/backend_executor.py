@@ -3,40 +3,150 @@
 Reference surface: python/ray/train/_internal/backend_executor.py
 (start:124, start_training:438, get_next_results:552). Streams per-report
 results from all ranks; rank-0's checkpoints feed the CheckpointManager.
+
+Gang health monitoring (reference FailureConfig semantics, TPU flavor):
+a monitor thread polls every rank's ``heartbeat`` — served on the
+actor's RPC lane while the train loop runs on its own thread —
+independently of the report cadence. It attributes failures ("rank 3
+hung in step 41" vs "rank 3 actor died"), destroys the gang's
+collective groups so peers blocked in ``exchange`` wake immediately,
+and pushes abort events into every live rank's outbox so a driver
+blocked in ``next_report`` aborts in seconds instead of burning the
+report timeout.
 """
 
 from __future__ import annotations
 
-from typing import Any, Callable, Dict, List, Optional
+import logging
+import threading
+import time
+from typing import Any, Callable, Dict, List, Optional, Tuple
 
 from ray_tpu.train.backend import Backend, JaxBackend
 from ray_tpu.train.checkpoint import Checkpoint
-from ray_tpu.train.config import ScalingConfig
+from ray_tpu.train.config import FailureConfig, ScalingConfig
 from ray_tpu.train.worker_group import WorkerGroup
+
+logger = logging.getLogger(__name__)
+
+#: Consecutive heartbeat misses (timeouts / transport errors, not actor
+#: death) before a rank is declared unresponsive.
+_HEARTBEAT_MISS_THRESHOLD = 3
 
 
 class TrainingWorkerError(RuntimeError):
     pass
 
 
+class _GangHealthMonitor(threading.Thread):
+    """Polls per-rank liveness + progress; aborts the gang on failure."""
+
+    def __init__(self, executor: "BackendExecutor",
+                 interval_s: float, hang_timeout_s: Optional[float]):
+        super().__init__(daemon=True, name="train_gang_monitor")
+        self.executor = executor
+        self.interval_s = interval_s
+        self.hang_timeout_s = hang_timeout_s
+        self._stop = threading.Event()
+        self._misses: Dict[int, int] = {}
+        #: Collective group names observed in heartbeats — the destroy
+        #: set on abort (queried while ranks are alive, because a dead
+        #: rank can no longer be asked).
+        self.seen_groups: set = set()
+
+    def stop(self) -> None:
+        self._stop.set()
+
+    def run(self) -> None:
+        import ray_tpu
+        from ray_tpu import exceptions as exc
+
+        wg = self.executor.worker_group
+        hb_timeout = max(2.0, 2 * self.interval_s)
+        while not self._stop.wait(self.interval_s):
+            if wg is not self.executor.worker_group:
+                return  # executor moved on (shutdown/restart race)
+            # Fan out all heartbeats first, then gather against ONE
+            # sweep deadline: detection latency stays O(1) in world
+            # size instead of one slow rank serializing the sweep.
+            refs = [w.heartbeat.remote() for w in wg.workers]
+            deadline = time.monotonic() + hb_timeout
+            for rank, ref in enumerate(refs):
+                if self._stop.is_set():
+                    return
+                try:
+                    hb = ray_tpu.get(
+                        ref, timeout=max(0.05,
+                                         deadline - time.monotonic()))
+                except exc.ActorDiedError as e:
+                    self._abort(
+                        "died", rank,
+                        f"rank {rank} actor died: {e.reason or e}")
+                    return
+                except Exception as e:  # noqa: BLE001 — transport noise
+                    misses = self._misses.get(rank, 0) + 1
+                    self._misses[rank] = misses
+                    logger.debug("heartbeat miss %d for rank %d: %s",
+                                 misses, rank, e)
+                    if misses >= _HEARTBEAT_MISS_THRESHOLD:
+                        self._abort(
+                            "unresponsive", rank,
+                            f"rank {rank} unresponsive after {misses} "
+                            f"missed heartbeats ({type(e).__name__}: {e})")
+                        return
+                    continue
+                self._misses[rank] = 0
+                self.seen_groups.update(hb.get("groups") or ())
+                if (hb.get("running") and self.hang_timeout_s
+                        and hb.get("idle_s", 0.0) > self.hang_timeout_s):
+                    self._abort(
+                        "hung", rank,
+                        f"rank {rank} hung in step {hb.get('reports', 0)}"
+                        f" (no progress for {hb['idle_s']:.1f}s, "
+                        f"hang_timeout_s={self.hang_timeout_s:.1f})")
+                    return
+
+    def _abort(self, kind: str, rank: int, message: str) -> None:
+        if self._stop.is_set():
+            return  # shutdown race: workers are being torn down on purpose
+        logger.warning("gang health monitor aborting: %s", message)
+        self.executor._on_gang_failure(kind, message,
+                                       groups=self.seen_groups,
+                                       dead_rank=rank if kind == "died"
+                                       else None)
+
+
 class BackendExecutor:
     def __init__(self, scaling_config: ScalingConfig,
                  backend: Optional[Backend] = None,
                  experiment_name: str = "train",
-                 trial_id: str = ""):
+                 trial_id: str = "",
+                 failure_config: Optional[FailureConfig] = None,
+                 placement_timeout_s: Optional[float] = None):
         self.scaling = scaling_config
         self.backend = backend or JaxBackend()
         self.experiment_name = experiment_name
         self.trial_id = trial_id
+        self.failure_config = failure_config or FailureConfig()
+        self.placement_timeout_s = (
+            placement_timeout_s
+            if placement_timeout_s is not None
+            else self.failure_config.resource_wait_timeout_s)
         self.worker_group: Optional[WorkerGroup] = None
         self._stop_requested = False
+        self._monitor: Optional[_GangHealthMonitor] = None
+        self._failure_lock = threading.Lock()
+        #: (kind, message) recorded by the health monitor / abort path.
+        self.health_failure: Optional[Tuple[str, str]] = None
 
     def start(self) -> None:
         self._stop_requested = False
+        self.health_failure = None
         self.worker_group = WorkerGroup(
             self.scaling.total_workers,
             self.scaling.worker_resources(),
             self.scaling.placement_strategy,
+            placement_timeout_s=self.placement_timeout_s,
         )
         world = self.worker_group.num_workers
         # Rank/topology env before any jax import in the workers
@@ -53,8 +163,20 @@ class BackendExecutor:
         refs = [w.setup_env.remote(_env(rank))
                 for rank, w in enumerate(self.worker_group.workers)]
         import ray_tpu
+        from ray_tpu import exceptions as exc
+        from ray_tpu.train.worker_group import GangPlacementError
 
-        ray_tpu.get(refs)
+        try:
+            # Bounded: placement budget + startup grace. Without this
+            # the no-placement-group path (world=1) would block forever
+            # on an unschedulable actor instead of raising into the
+            # elastic-restart policy like the PG path does.
+            ray_tpu.get(refs, timeout=self.placement_timeout_s + 30.0)
+        except exc.GetTimeoutError as e:
+            raise GangPlacementError(
+                f"gang workers not schedulable within "
+                f"{self.placement_timeout_s + 30.0:.1f}s "
+                f"({world} x {self.scaling.worker_resources()})") from e
         self.backend.on_start(self.worker_group, self.scaling)
 
     def start_training(self, train_fn: Callable[[dict], None],
@@ -79,18 +201,99 @@ class BackendExecutor:
 
         ray_tpu.get(refs)
         wg.execute("start_training", train_fn, config)
+        interval = self.failure_config.health_check_interval_s
+        if interval and interval > 0:
+            self._monitor = _GangHealthMonitor(
+                self, interval, self.failure_config.hang_timeout_s)
+            self._monitor.start()
+
+    # -- gang failure handling ------------------------------------------
+
+    def _on_gang_failure(self, kind: str, message: str,
+                         groups: Optional[set] = None,
+                         dead_rank: Optional[int] = None) -> None:
+        """Record + propagate a gang failure: destroy the gang's
+        collective groups (wakes ranks blocked in ``exchange``) and push
+        abort events into every live rank's outbox (wakes the driver
+        blocked in ``next_report``). Idempotent; first recorder wins —
+        whichever of the monitor / blocked driver noticed first."""
+        from ray_tpu.util import telemetry
+
+        with self._failure_lock:
+            if self.health_failure is not None:
+                return
+            self.health_failure = (kind, message)
+        if kind == "hung":
+            telemetry.inc("ray_tpu_train_hang_detections_total")
+        elif kind == "died":
+            telemetry.inc("ray_tpu_train_worker_deaths_total")
+        telemetry.event("train", f"gang abort: {kind}",
+                        args={"message": message})
+        self._destroy_collective_groups(groups or set())
+        wg = self.worker_group
+        if wg is None:
+            return
+        for rank, worker in enumerate(wg.workers):
+            if rank == dead_rank:
+                continue
+            try:
+                worker.abort_report.remote(f"gang aborted: {message}")
+            except Exception:  # noqa: BLE001 — best-effort wakeup
+                pass
+
+    def _destroy_collective_groups(self, groups: set) -> None:
+        if not groups:
+            return
+        from ray_tpu.collective import destroy_collective_group
+
+        for name in sorted(groups):
+            try:
+                destroy_collective_group(name)
+                logger.info("destroyed collective group %r on gang abort",
+                            name)
+            except Exception as e:  # noqa: BLE001 — best-effort wakeup
+                logger.debug("destroy of collective group %r failed: %s",
+                             name, e)
+
+    def _rank_of_actor(self, actor_id_hex: str) -> Optional[int]:
+        if not self.worker_group:
+            return None
+        for rank, w in enumerate(self.worker_group.workers):
+            if w._actor_id.hex() == actor_id_hex:
+                return rank
+        return None
 
     def get_next_results(self, timeout: float = 600.0
                          ) -> Optional[List[dict]]:
         """One event per rank, synchronized (reference: all ranks must
         report in lockstep). Returns None when training is done; raises on
         any rank error."""
+        from ray_tpu import exceptions as exc
+
         wg = self.worker_group
-        events = wg.execute("next_report", timeout)
+        try:
+            events = wg.execute("next_report", timeout)
+        except exc.ActorDiedError as e:
+            # The monitor usually notices first, but the blocked driver
+            # can beat its next poll tick: attribute + abort here too so
+            # peers wake regardless of which side won the race.
+            rank = self._rank_of_actor(e.actor_id_hex)
+            msg = (f"rank {rank} actor died: {e.reason or e}"
+                   if rank is not None else f"train worker died: {e}")
+            monitor = self._monitor
+            self._on_gang_failure(
+                "died", msg,
+                groups=monitor.seen_groups if monitor else set(),
+                dead_rank=rank)
+            raise TrainingWorkerError(self.health_failure[1]) from e
+        except Exception as e:
+            if self.health_failure is not None:
+                raise TrainingWorkerError(self.health_failure[1]) from e
+            raise
         kinds = {k for k, _, _ in events}
         if "error" in kinds:
             msgs = [p for k, p, _ in events if k == "error"]
-            raise TrainingWorkerError("\n---\n".join(msgs))
+            raise TrainingWorkerError("\n---\n".join(dict.fromkeys(msgs)))
         if "timeout" in kinds:
             raise TrainingWorkerError(
                 f"worker report timed out after {timeout}s "
@@ -126,6 +329,9 @@ class BackendExecutor:
             self.worker_group.execute("request_stop")
 
     def shutdown(self):
+        if self._monitor is not None:
+            self._monitor.stop()
+            self._monitor = None
         if self.worker_group is not None:
             try:
                 self.backend.on_shutdown(self.worker_group)

@@ -7,17 +7,57 @@ shard of a sharded jax pytree; restore re-shards onto the running mesh.
 
 Pytree persistence uses flax.serialization msgpack for leaves plus a
 pickled treedef skeleton — no framework lock-in in the directory format:
-``checkpoint_dir/{shard_<rank>.msgpack, meta.pkl, <user files>}``.
+``checkpoint_dir/{shard_<rank>.msgpack, meta.pkl, COMMIT, <user files>}``.
+
+Crash consistency (orbax-style atomic save): every file lands via
+temp-name + ``os.replace`` + fsync, ``meta.pkl`` strictly before any
+shard, and rank 0 writes a ``COMMIT`` marker last — a JSON record of the
+expected shard set (with byte sizes where known). Readers that honor the
+marker (CheckpointManager.register / recover_from_dir) never see a torn
+directory: no marker, a listed shard missing, or a size mismatch all
+mean the writer crashed mid-save.
 """
 
 from __future__ import annotations
 
 import contextlib
+import json
 import os
 import pickle
 import shutil
 import tempfile
-from typing import Any, Iterator, Optional
+from typing import Any, Dict, Iterator, Optional
+
+#: Commit-marker file name. Present + consistent == the directory is a
+#: complete checkpoint; anything else is torn and must not be resumed.
+COMMIT_MARKER = "COMMIT"
+
+
+def _fsync_dir(path: str) -> None:
+    """Durably record directory-entry renames (POSIX: the rename itself
+    is atomic but not durable until the directory is fsynced)."""
+    try:
+        fd = os.open(path, os.O_RDONLY)
+    except OSError:
+        return
+    try:
+        os.fsync(fd)
+    except OSError:
+        pass
+    finally:
+        os.close(fd)
+
+
+def _atomic_write(path: str, data: bytes) -> None:
+    """Write ``data`` so readers see either nothing or all of it: temp
+    name in the same directory, fsync, ``os.replace``, dir fsync."""
+    tmp = f"{path}.tmp.{os.getpid()}"
+    with open(tmp, "wb") as f:
+        f.write(data)
+        f.flush()
+        os.fsync(f.fileno())
+    os.replace(tmp, path)
+    _fsync_dir(os.path.dirname(path))
 
 
 class Checkpoint:
@@ -50,14 +90,27 @@ class Checkpoint:
     @classmethod
     def from_pytree(cls, tree: Any, path: str, *,
                     shard_rank: Optional[int] = None,
+                    world_size: Optional[int] = None,
                     user_meta: Optional[dict] = None) -> "Checkpoint":
         """Write ``tree`` (host-local arrays or a process's addressable
         shards) as this rank's shard file. Multi-host: every rank calls
         this with the same ``path`` on shared storage.
 
-        ``shard_rank`` defaults to the calling worker's world rank when a
-        train session is active (so concurrent ranks never clobber each
-        other's shard file), else 0."""
+        ``shard_rank`` defaults to the calling worker's world rank when
+        a train session is active (so concurrent ranks never clobber
+        each other's shard file), else 0.
+
+        Write order is crash-safe: ``meta.pkl`` first, then the shard,
+        each atomically — a reader can never see a shard without its
+        treedef metadata. Rank 0 commits last: the ``COMMIT`` marker
+        records the shards this writer itself guarantees (its own, with
+        exact size — so a rank-0-only replicated save is complete and
+        registrable by itself), plus the full ``shard_0..world_size-1``
+        set as existence-only expectations when ``world_size`` is
+        passed explicitly. Peer shards a direct shared-path caller did
+        not declare are unprotected until the trainer's gang-commit
+        rewrites the marker from the merged shard set (which it does
+        only after every rank reported)."""
         import jax
         from flax import serialization
 
@@ -73,14 +126,73 @@ class Checkpoint:
         leaves, treedef = jax.tree.flatten(host_tree)
         blob = serialization.msgpack_serialize(
             {str(i): leaf for i, leaf in enumerate(leaves)})
-        with open(os.path.join(path, f"shard_{shard_rank}.msgpack"),
-                  "wb") as f:
-            f.write(blob)
+        shard_name = f"shard_{shard_rank}.msgpack"
         if shard_rank == 0:
-            with open(os.path.join(path, "meta.pkl"), "wb") as f:
-                pickle.dump({"treedef": treedef,
-                             "user_meta": user_meta or {}}, f)
-        return cls(path)
+            meta_blob = pickle.dumps({"treedef": treedef,
+                                      "user_meta": user_meta or {}})
+            _atomic_write(os.path.join(path, "meta.pkl"), meta_blob)
+        _atomic_write(os.path.join(path, shard_name), blob)
+        ckpt = cls(path)
+        if shard_rank == 0:
+            shards: Dict[str, Optional[int]] = {
+                f"shard_{r}.msgpack": None
+                for r in range(world_size or 0)}
+            shards[shard_name] = len(blob)
+            ckpt.commit(shards=shards)
+        return ckpt
+
+    # -- commit marker -----------------------------------------------------
+
+    def commit(self, shards: Optional[Dict[str, Optional[int]]] = None,
+               extra: Optional[dict] = None) -> None:
+        """Write the ``COMMIT`` marker (last, fsynced). ``shards`` maps
+        shard file name -> expected byte size (None = existence-only);
+        defaults to the sizes of the shard files currently on disk."""
+        if shards is None:
+            shards = {
+                name: os.path.getsize(os.path.join(self.path, name))
+                for name in self.shard_files()
+            }
+        record = {
+            "shards": shards,
+            "has_meta": os.path.exists(os.path.join(self.path, "meta.pkl")),
+        }
+        if extra:
+            record.update(extra)
+        _atomic_write(os.path.join(self.path, COMMIT_MARKER),
+                      json.dumps(record, sort_keys=True).encode())
+
+    def commit_info(self) -> Optional[dict]:
+        """The parsed COMMIT marker, or None when absent/unreadable."""
+        try:
+            with open(os.path.join(self.path, COMMIT_MARKER), "rb") as f:
+                return json.loads(f.read().decode())
+        except (OSError, ValueError):
+            return None
+
+    def validate_committed(self) -> Optional[str]:
+        """None when this directory is a complete, committed checkpoint;
+        otherwise a human-readable torn-ness reason. Directories holding
+        neither a marker nor shard files (opaque user checkpoints) pass —
+        there is nothing to validate."""
+        info = self.commit_info()
+        if info is None:
+            if os.path.exists(os.path.join(self.path, COMMIT_MARKER)):
+                return "unreadable COMMIT marker"
+            if self.shard_files():
+                return "shard files present but no COMMIT marker"
+            return None
+        for name, size in (info.get("shards") or {}).items():
+            full = os.path.join(self.path, name)
+            if not os.path.exists(full):
+                return f"missing shard {name}"
+            if size is not None and os.path.getsize(full) != size:
+                return (f"truncated shard {name} "
+                        f"({os.path.getsize(full)} != {size} bytes)")
+        if info.get("has_meta") and not os.path.exists(
+                os.path.join(self.path, "meta.pkl")):
+            return "missing meta.pkl"
+        return None
 
     def to_pytree(self, *, shard_rank: Optional[int] = None) -> Any:
         """Restore this rank's shard as a pytree of numpy arrays; callers

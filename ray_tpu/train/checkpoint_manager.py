@@ -1,17 +1,31 @@
 """Top-K checkpoint retention keyed on a reported metric.
 
 Reference: python/ray/train/_internal/checkpoint_manager.py (keep best K
-by score attribute, always keep the latest).
+by score attribute, always keep the latest) + orbax-style commit
+discipline: only directories whose ``COMMIT`` marker validates are ever
+tracked, and ``recover_from_dir`` rebuilds the top-K state from disk
+after a driver restart, skipping torn directories instead of resuming
+from them.
 """
 
 from __future__ import annotations
 
+import logging
 import os
+import re
 import shutil
 from typing import List, Optional, Tuple
 
 from ray_tpu.train.checkpoint import Checkpoint
 from ray_tpu.train.config import CheckpointConfig
+
+logger = logging.getLogger(__name__)
+
+_CKPT_DIR_RE = re.compile(r"^checkpoint_(\d+)$")
+
+
+class TornCheckpointError(ValueError):
+    """The directory is not a complete committed checkpoint."""
 
 
 class CheckpointManager:
@@ -25,6 +39,11 @@ class CheckpointManager:
 
     def register(self, checkpoint: Checkpoint,
                  metrics: Optional[dict] = None) -> None:
+        torn = checkpoint.validate_committed()
+        if torn is not None:
+            raise TornCheckpointError(
+                f"refusing to track torn checkpoint {checkpoint.path}: "
+                f"{torn}")
         attr = self.config.checkpoint_score_attribute
         score = None
         if attr and metrics and attr in metrics:
@@ -64,3 +83,47 @@ class CheckpointManager:
                 shutil.rmtree(ckpt.path, ignore_errors=True)
         # Best must point at a directory that still exists.
         self._update_best()
+
+    # -- crash recovery ----------------------------------------------------
+
+    def recover_from_dir(self, exp_dir: str) -> int:
+        """Rebuild top-K state from an experiment directory after a
+        driver restart: register every committed ``checkpoint_<seq>``
+        child in sequence order (scores come from the metrics the gang
+        commit recorded in each COMMIT marker) and skip torn ones — a
+        directory truncated mid-write must never become the resume
+        anchor. Returns the number of checkpoints recovered."""
+        from ray_tpu.util import telemetry
+
+        if not os.path.isdir(exp_dir):
+            return 0
+        found: List[Tuple[int, str]] = []
+        for name in os.listdir(exp_dir):
+            m = _CKPT_DIR_RE.match(name)
+            if m and os.path.isdir(os.path.join(exp_dir, name)):
+                found.append((int(m.group(1)), name))
+        recovered = 0
+        for _, name in sorted(found):
+            ckpt = Checkpoint(os.path.join(exp_dir, name))
+            torn = ckpt.validate_committed()
+            if torn is not None:
+                logger.warning(
+                    "skipping torn checkpoint %s during recovery: %s",
+                    ckpt.path, torn)
+                telemetry.inc("ray_tpu_train_torn_checkpoint_skips_total")
+                telemetry.event("train", f"torn checkpoint skipped {name}",
+                                args={"reason": torn})
+                continue
+            info = ckpt.commit_info() or {}
+            self.register(ckpt, info.get("metrics"))
+            recovered += 1
+        return recovered
+
+    @staticmethod
+    def next_seq_on_disk(exp_dir: str) -> int:
+        """First unused ``checkpoint_<seq>`` number in ``exp_dir`` —
+        restarted drivers must not clobber surviving directories."""
+        seqs = [int(m.group(1)) for name in (
+                    os.listdir(exp_dir) if os.path.isdir(exp_dir) else [])
+                for m in [_CKPT_DIR_RE.match(name)] if m]
+        return max(seqs) + 1 if seqs else 0

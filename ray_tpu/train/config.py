@@ -49,9 +49,32 @@ class FailureConfig:
     """Elastic-recovery policy (reference: air/config.py FailureConfig).
     ``max_failures``: group restarts (from latest checkpoint) before the
     run errors out; TPU note — a slice failure is a gang failure, the
-    whole worker group restarts."""
+    whole worker group restarts.
+
+    Gang health monitoring: the BackendExecutor polls every rank's
+    liveness and progress every ``health_check_interval_s`` seconds,
+    independently of the report cadence. A rank whose actor died aborts
+    the gang immediately; a rank whose train loop made no progress
+    (no ``train.report`` / activity) for ``hang_timeout_s`` is declared
+    hung — set ``hang_timeout_s`` above the longest legitimate gap
+    between reports (first-step jit compiles included). ``None``
+    disables hang detection; ``health_check_interval_s=0`` disables the
+    monitor entirely (back to report-timeout-only detection).
+
+    Elastic restart: between gang restarts the trainer backs off
+    exponentially starting at ``restart_backoff_s``; it waits up to
+    ``resource_wait_timeout_s`` for the full-size placement group to
+    become placeable and, when the dead node's resources never return,
+    may re-form a smaller gang down to ``min_workers`` (datasets are
+    re-sharded for the new world size). ``min_workers=None`` pins the
+    gang at its configured size (no shrink)."""
 
     max_failures: int = 0
+    restart_backoff_s: float = 1.0
+    resource_wait_timeout_s: float = 60.0
+    min_workers: Optional[int] = None
+    health_check_interval_s: float = 2.0
+    hang_timeout_s: Optional[float] = 300.0
 
 
 @dataclasses.dataclass
@@ -76,6 +99,12 @@ class RunConfig:
     storage_path: Optional[str] = None
     failure_config: Optional[FailureConfig] = None
     checkpoint_config: Optional[CheckpointConfig] = None
+    #: Resume from committed checkpoints already in the experiment dir
+    #: (driver crash recovery). Default on: an unnamed run gets a
+    #: timestamped dir, so this only triggers when the caller reuses a
+    #: ``name`` — set False for a deliberate from-scratch rerun under
+    #: the same name.
+    auto_resume: bool = True
     verbose: int = 0
     # Tune stop criteria: {"metric": threshold, "training_iteration": N}
     # or a callable (trial_id, result) -> bool (reference: RunConfig.stop).

@@ -55,11 +55,24 @@ class _TrainSession:
         self.outbox: "queue.Queue" = queue.Queue()
         self.stop_requested = threading.Event()
         self._last_report_t = time.perf_counter()
+        # Gang-health bookkeeping, read by TrainWorker.heartbeat():
+        # report_count is the monitor's notion of per-rank progress,
+        # last_activity its staleness clock (monotonic).
+        self.report_count = 0
+        self.last_activity = time.monotonic()
+        # Chaos lane (util/chaos.py TrainWorkerKiller "hang" mode):
+        # stalls the train loop inside report() WITHOUT blocking the
+        # actor's RPC loop, so heartbeats stay healthy while progress
+        # stops — exactly the signature of a wedged collective/device.
+        self.chaos_hang_until = 0.0
 
     def report(self, metrics: Dict[str, Any],
                checkpoint: Optional[Checkpoint] = None) -> None:
         from ray_tpu.util import telemetry
 
+        while (time.monotonic() < self.chaos_hang_until
+               and not self.stop_requested.is_set()):
+            time.sleep(0.05)
         now = time.perf_counter()
         # report() is called once per step by convention, so the gap
         # between consecutive calls IS the step time.
@@ -67,6 +80,8 @@ class _TrainSession:
                           now - self._last_report_t)
         telemetry.inc("ray_tpu_train_reports_total")
         self._last_report_t = now
+        self.report_count += 1
+        self.last_activity = time.monotonic()
         self.outbox.put(("report", dict(metrics), checkpoint))
         # Cooperative early stop (Tune schedulers): raising here unwinds
         # the user loop; the executor turns it into a clean finish.

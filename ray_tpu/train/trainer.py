@@ -10,11 +10,24 @@ through a Tune controller.
 Failure handling (reference FailureConfig semantics, TPU gang flavor):
 any worker failure kills the whole gang; up to ``max_failures`` restarts
 re-run the loop from the latest registered checkpoint via
-``session.get_checkpoint()``.
+``session.get_checkpoint()``. Restarts back off exponentially
+(core/retry.RetryPolicy), wait up to ``resource_wait_timeout_s`` for the
+gang's placement group, and may elastically re-form a smaller gang down
+to ``min_workers`` when the dead node's resources never return —
+datasets are re-sharded for the new world size.
+
+Checkpoint commit discipline: reported per-rank checkpoint dirs merge
+into a hidden staging directory; the COMMIT marker (shard set + sizes +
+metrics) is rewritten there and the staging dir is atomically renamed to
+``checkpoint_<seq>`` only after every shard landed. A driver crash can
+leave stale staging dirs but never a torn ``checkpoint_<seq>``; on the
+next fit() ``CheckpointManager.recover_from_dir`` rebuilds top-K state
+from the committed directories and skips anything torn.
 """
 
 from __future__ import annotations
 
+import dataclasses
 import logging
 import os
 import shutil
@@ -26,7 +39,7 @@ from ray_tpu.train.backend_executor import (
     BackendExecutor,
     TrainingWorkerError,
 )
-from ray_tpu.train.checkpoint import Checkpoint
+from ray_tpu.train.checkpoint import Checkpoint, _fsync_dir
 from ray_tpu.train.checkpoint_manager import CheckpointManager
 from ray_tpu.train.config import (
     CheckpointConfig,
@@ -35,8 +48,19 @@ from ray_tpu.train.config import (
     ScalingConfig,
 )
 from ray_tpu.train.result import Result
+from ray_tpu.train.worker_group import GangPlacementError
 
 logger = logging.getLogger(__name__)
+
+#: Staging-dir prefix for in-flight gang commits. Dot-prefixed so
+#: nothing scanning for ``checkpoint_*`` (tests, recovery, users) can
+#: mistake a partially-merged directory for a real checkpoint.
+_STAGING_PREFIX = ".staging_checkpoint_"
+
+#: Placement probe budget per shrunken gang size during elastic
+#: formation (the configured resource_wait_timeout_s is spent waiting
+#: for the FULL gang first; smaller sizes just need a quick yes/no).
+_SHRINK_PROBE_TIMEOUT_S = 5.0
 
 
 def _merge_move_tree(src: str, dest: str) -> None:
@@ -86,27 +110,140 @@ class JaxTrainer:
         os.makedirs(path, exist_ok=True)
         return path
 
+    # -- elastic gang formation --------------------------------------------
+
+    def _form_executor(self, world: int, failure_config: FailureConfig,
+                       exp_dir: str, placement_timeout_s: float
+                       ) -> BackendExecutor:
+        scaling = (self.scaling_config if world ==
+                   self.scaling_config.total_workers else
+                   dataclasses.replace(self.scaling_config,
+                                       num_workers=world))
+        executor = BackendExecutor(
+            scaling, self.backend,
+            experiment_name=os.path.basename(exp_dir),
+            failure_config=failure_config,
+            placement_timeout_s=placement_timeout_s)
+        try:
+            executor.start()
+        except BaseException:
+            executor.shutdown()  # reap a half-formed gang
+            raise
+        return executor
+
+    def _probe_placeable(self, world: int, timeout_s: float) -> bool:
+        """Cheap placeability probe: a throwaway placement group, no
+        actors. Racy by nature (resources can vanish between probe and
+        formation) — formation failure afterwards still raises into the
+        restart policy."""
+        import ray_tpu
+
+        resources = self.scaling_config.worker_resources()
+        pg = ray_tpu.placement_group(
+            [dict(resources) for _ in range(world)],
+            strategy=self.scaling_config.placement_strategy)
+        try:
+            return bool(pg.ready(timeout=timeout_s))
+        finally:
+            ray_tpu.remove_placement_group(pg)
+
+    def _form_gang(self, failure_config: FailureConfig,
+                   exp_dir: str) -> BackendExecutor:
+        """Start a worker gang at full size, waiting up to
+        ``resource_wait_timeout_s`` for placement; when the cluster
+        cannot place the full gang (e.g. a dead node's resources never
+        returned), binary-search the largest placeable size down to
+        ``min_workers`` (placeability is monotone in gang size, so this
+        is O(log n) probes, not O(n) gang formations) and run
+        elastically at that size."""
+        from ray_tpu.util import telemetry
+
+        full = self.scaling_config.total_workers
+        min_workers = failure_config.min_workers or full
+        min_workers = max(1, min(min_workers, full))
+        try:
+            return self._form_executor(
+                full, failure_config, exp_dir,
+                failure_config.resource_wait_timeout_s)
+        except GangPlacementError as e:
+            if min_workers >= full:
+                raise
+            last = e
+        probe_timeout = min(_SHRINK_PROBE_TIMEOUT_S,
+                            failure_config.resource_wait_timeout_s)
+        if not self._probe_placeable(min_workers, probe_timeout):
+            raise GangPlacementError(
+                f"no gang size in [{min_workers}, {full}] was placeable "
+                f"within the resource wait budget") from last
+        lo, hi = min_workers, full - 1  # lo is known placeable
+        while lo < hi:
+            mid = (lo + hi + 1) // 2
+            if self._probe_placeable(mid, probe_timeout):
+                lo = mid
+            else:
+                hi = mid - 1
+        executor = self._form_executor(lo, failure_config, exp_dir,
+                                       probe_timeout)
+        logger.warning(
+            "elastic restart: re-formed gang at %d/%d workers "
+            "(full-size placement unavailable); datasets re-shard "
+            "for the new world size", lo, full)
+        telemetry.inc("ray_tpu_train_elastic_resizes_total")
+        telemetry.event("train", "elastic gang resize",
+                        args={"from": full, "to": lo})
+        return executor
+
+    # -- fit ---------------------------------------------------------------
+
     def fit(self) -> Result:
+        from ray_tpu.core.retry import RetryPolicy
+        from ray_tpu.util import telemetry
+
         exp_dir = self._experiment_dir()
         ckpt_config = self.run_config.checkpoint_config or CheckpointConfig()
         failure_config = self.run_config.failure_config or FailureConfig()
         manager = CheckpointManager(ckpt_config)
-        resume = self.resume_from_checkpoint
+        # Crash recovery: committed checkpoints from a previous driver
+        # run (same experiment dir) rebuild top-K state; torn dirs are
+        # skipped, stale staging dirs swept. RunConfig.auto_resume=False
+        # opts a deliberate from-scratch rerun out of the resume.
+        self._sweep_staging(exp_dir)
+        if self.run_config.auto_resume:
+            recovered = manager.recover_from_dir(exp_dir)
+            if recovered:
+                logger.info(
+                    "recovered %d committed checkpoint(s) from %s "
+                    "(auto_resume=False for a fresh run)",
+                    recovered, exp_dir)
+        ckpt_seq = CheckpointManager.next_seq_on_disk(exp_dir)
+        # An explicitly passed checkpoint out-ranks disk recovery at run
+        # start (the user may be deliberately rolling back past a bad
+        # latest); after an in-run failure the freshest committed
+        # checkpoint is the right anchor again.
+        resume = self.resume_from_checkpoint or manager.latest
         history: list = []
         last_metrics: Dict[str, Any] = {}
         attempts = failure_config.max_failures + 1
+        backoff = RetryPolicy(
+            max_attempts=max(attempts, 2),
+            base_delay_s=failure_config.restart_backoff_s,
+            max_delay_s=max(failure_config.restart_backoff_s * 8, 30.0),
+            jitter=0.25)
         error: Optional[str] = None
 
         for attempt in range(attempts):
-            executor = BackendExecutor(
-                self.scaling_config, self.backend,
-                experiment_name=os.path.basename(exp_dir))
+            if attempt > 0 and failure_config.restart_backoff_s > 0:
+                delay = backoff.backoff_delay(attempt - 1)
+                logger.info("backing off %.2fs before restart %d/%d",
+                            delay, attempt, attempts - 1)
+                time.sleep(delay)
+            executor: Optional[BackendExecutor] = None
             try:
-                executor.start()
+                executor = self._form_gang(failure_config, exp_dir)
+                self._warn_shard_mismatch(executor, resume)
                 executor.start_training(
                     self.train_loop, self.train_loop_config,
                     resume_checkpoint=resume, datasets=self.datasets)
-                ckpt_seq = len(history)
                 while True:
                     results = executor.get_next_results()
                     if results is None:
@@ -115,7 +252,7 @@ class JaxTrainer:
                     last_metrics = rank0["metrics"]
                     history.append(dict(last_metrics))
                     ckpt = self._collect_checkpoint(
-                        results, exp_dir, ckpt_seq)
+                        results, exp_dir, ckpt_seq, last_metrics)
                     ckpt_seq += 1
                     if ckpt is not None:
                         manager.register(ckpt, last_metrics)
@@ -124,12 +261,24 @@ class JaxTrainer:
                 break
             except Exception as e:  # worker death, report error, infra
                 error = str(e)
+                reason = "error"
+                if executor is not None and executor.health_failure:
+                    reason = executor.health_failure[0]
+                elif isinstance(e, GangPlacementError):
+                    reason = "placement"
                 logger.warning(
-                    "training attempt %d/%d failed: %s",
-                    attempt + 1, attempts, e)
+                    "training attempt %d/%d failed (%s): %s",
+                    attempt + 1, attempts, reason, e)
+                if attempt + 1 < attempts:
+                    telemetry.inc("ray_tpu_train_restarts_total", 1,
+                                  {"reason": reason})
+                    telemetry.event("train", "gang restart",
+                                    args={"attempt": attempt + 1,
+                                          "reason": reason})
                 resume = manager.latest or self.resume_from_checkpoint
             finally:
-                executor.shutdown()
+                if executor is not None:
+                    executor.shutdown()
 
         return Result(
             metrics=last_metrics,
@@ -140,22 +289,88 @@ class JaxTrainer:
             best_checkpoint=manager.best,
         )
 
-    def _collect_checkpoint(self, results, exp_dir: str,
-                            seq: int) -> Optional[Checkpoint]:
-        """Move reported checkpoint dirs into the experiment dir. Multi-rank
-        reports merge into one directory (each rank wrote distinct shard
-        files — the orbax recipe)."""
+    @staticmethod
+    def _warn_shard_mismatch(executor: BackendExecutor,
+                             resume: Optional[Checkpoint]) -> None:
+        """An elastically shrunken gang resuming a checkpoint sharded
+        for a larger world would silently drop the lost ranks' shards
+        (each rank restores only its own shard): surface it loudly —
+        per-rank-sharded state needs user-side re-sharding, replicated
+        (single-shard) checkpoints resume cleanly at any size."""
+        if resume is None or executor.worker_group is None:
+            return
+        try:
+            shards = len(resume.shard_files())
+        except OSError:
+            return
+        world = executor.worker_group.num_workers
+        if shards > max(world, 1):
+            from ray_tpu.util import telemetry
+
+            logger.warning(
+                "resume checkpoint %s has %d per-rank shards but the "
+                "gang re-formed with only %d workers: shards beyond "
+                "rank %d will NOT be restored by any rank. Re-shard the "
+                "checkpoint (or save replicated state from rank 0) "
+                "before shrinking.", resume.path, shards, world,
+                world - 1)
+            telemetry.event("train", "shard/world mismatch on resume",
+                            args={"shards": shards, "world": world})
+
+    # -- checkpoint collection ---------------------------------------------
+
+    @staticmethod
+    def _sweep_staging(exp_dir: str) -> None:
+        """Remove staging dirs a crashed driver left behind — by
+        construction they never contain the only copy of a committed
+        checkpoint."""
+        for name in os.listdir(exp_dir):
+            if name.startswith(_STAGING_PREFIX):
+                shutil.rmtree(os.path.join(exp_dir, name),
+                              ignore_errors=True)
+
+    def _collect_checkpoint(self, results, exp_dir: str, seq: int,
+                            metrics: Optional[dict] = None
+                            ) -> Optional[Checkpoint]:
+        """Gang-commit reported checkpoint dirs into the experiment dir.
+        Multi-rank reports merge into one staging directory (each rank
+        wrote distinct shard files — the orbax recipe); the COMMIT
+        marker is rewritten from the merged shard set (+ report
+        metrics, for recover_from_dir), and only then is the directory
+        atomically renamed to its final ``checkpoint_<seq>`` name. A
+        crash at any point leaves either the previous state or a
+        sweepable staging dir — never a torn checkpoint."""
         paths = [r["checkpoint_path"] for r in results
                  if r["checkpoint_path"]]
         if not paths:
             return None
         dest = os.path.join(exp_dir, f"checkpoint_{seq:06d}")
-        os.makedirs(dest, exist_ok=True)
+        staging = os.path.join(exp_dir, f"{_STAGING_PREFIX}{seq:06d}")
+        shutil.rmtree(staging, ignore_errors=True)
+        os.makedirs(staging)
         for p in dict.fromkeys(paths):  # dedupe, keep order
-            if os.path.abspath(p) == os.path.abspath(dest):
-                continue
+            # A rank that reported dest itself (wrote straight into the
+            # final location) merges like any other source — its files
+            # move to staging and come back at the rename below, instead
+            # of being destroyed with the stale dest.
             if os.path.isdir(p):
-                _merge_move_tree(p, dest)
+                _merge_move_tree(p, staging)
+        staged = Checkpoint(staging)
+        # The authoritative commit: every rank that reported has merged
+        # its shards by now, so expected set == observed set, with exact
+        # sizes. Metrics ride along so recover_from_dir can re-score.
+        staged.commit(extra={"metrics": metrics or {}, "seq": seq})
+        if os.path.exists(dest):
+            # A previous driver crashed between writing dest and
+            # recording it (rename is the commit point), or a rank
+            # reported dest directly (its files are in staging now
+            # either way). This seq belongs to the current run: replace.
+            shutil.rmtree(dest, ignore_errors=True)
+        os.replace(staging, dest)
+        # The rename IS the commit: make it durable (the shard/marker
+        # writers fsync their files and the staging dir, but the final
+        # directory-entry swap lives in exp_dir's journal).
+        _fsync_dir(exp_dir)
         return Checkpoint(dest)
 
     def as_trainable(self):

@@ -13,8 +13,15 @@ import os
 import queue
 import socket
 import threading
+import time
 import traceback
 from typing import Any, Callable, Dict, List, Optional
+
+
+class GangPlacementError(RuntimeError):
+    """The gang's placement group did not become placeable in time —
+    distinct from worker failures so the trainer's elastic-restart
+    policy can shrink the gang instead of burning a restart attempt."""
 
 
 class TrainWorker:
@@ -113,6 +120,45 @@ class TrainWorker:
         if self._session is not None:
             self._session.stop_requested.set()
 
+    def heartbeat(self) -> Dict[str, Any]:
+        """Liveness + progress probe for the gang health monitor. Runs
+        on the actor's RPC lane (the train loop is a separate thread),
+        so it answers even while the loop is wedged in a collective —
+        that is exactly what lets the monitor tell 'hung' from 'dead'."""
+        from ray_tpu.collective.collective import local_group_names
+
+        sess = self._session
+        out: Dict[str, Any] = {"rank": self.world_rank,
+                               "ready": sess is not None}
+        if sess is None:
+            return out
+        thread = self._thread
+        out.update(
+            reports=sess.report_count,
+            running=bool(thread is not None and thread.is_alive()),
+            idle_s=time.monotonic() - sess.last_activity,
+            groups=local_group_names(),
+        )
+        return out
+
+    def abort_report(self, reason: str) -> None:
+        """Driver-side gang abort: push an error event into the report
+        outbox so a driver blocked in next_report() wakes immediately
+        instead of burning the report timeout, and ask the user loop to
+        unwind at its next report."""
+        if self._session is None:
+            return
+        self._session.stop_requested.set()
+        self._session.outbox.put(("error", reason, None))
+
+    def chaos_hang(self, duration_s: float) -> None:
+        """Chaos lane: stall this rank's train loop (not its RPC lane)
+        for ``duration_s`` at its next report — simulates a wedged
+        device/collective that the health monitor must flag as a hang."""
+        if self._session is not None:
+            self._session.chaos_hang_until = (
+                time.monotonic() + duration_s)
+
     def shutdown_session(self) -> None:
         from ray_tpu.train import session as session_mod
 
@@ -122,7 +168,8 @@ class TrainWorker:
 
 class WorkerGroup:
     def __init__(self, num_workers: int, resources: Dict[str, float],
-                 placement_strategy: str = "PACK"):
+                 placement_strategy: str = "PACK",
+                 placement_timeout_s: float = 60.0):
         import ray_tpu
 
         self.num_workers = num_workers
@@ -134,6 +181,10 @@ class WorkerGroup:
             memory=resources.get("memory"),
             resources={k: v for k, v in resources.items()
                        if k not in ("CPU", "TPU", "memory")} or None,
+            # The health monitor's heartbeat/abort_report calls must be
+            # served while next_report blocks inside the actor, so the
+            # worker cannot be a one-lane sync actor.
+            max_concurrency=8,
         )
         if num_workers > 1:
             from ray_tpu.core.task_spec import (
@@ -144,9 +195,10 @@ class WorkerGroup:
                 [dict(resources) for _ in range(num_workers)],
                 strategy=placement_strategy)
             try:
-                if not self.pg.ready(timeout=60):
-                    raise RuntimeError(
+                if not self.pg.ready(timeout=placement_timeout_s):
+                    raise GangPlacementError(
                         "placement group for worker gang not placeable "
+                        f"within {placement_timeout_s:.1f}s "
                         f"({num_workers} x {resources})")
                 self.workers = [
                     actor_cls.options(

@@ -57,6 +57,10 @@ class Trial:
     actor: Any = None
     future: Any = None
     retries: int = 0
+    # Restart backoff (FailureConfig.restart_backoff_s): a retried
+    # trial stays PENDING but is not started before this monotonic
+    # time, so the controller loop never sleeps on its behalf.
+    retry_at: float = 0.0
 
 
 class TuneController:
@@ -65,7 +69,8 @@ class TuneController:
                  metric: Optional[str], mode: str,
                  run_config: RunConfig, max_concurrent: int,
                  resources_per_trial: Optional[Dict[str, float]] = None,
-                 checkpoint_freq: int = 0, max_failures: int = 0,
+                 checkpoint_freq: int = 0,
+                 max_failures: Optional[int] = None,
                  experiment_dir: Optional[str] = None):
         self.trainable = trainable
         self.search_alg = search_alg
@@ -81,7 +86,14 @@ class TuneController:
         self.max_concurrent = max_concurrent
         self.resources = resources_per_trial or {"num_cpus": 1}
         self.checkpoint_freq = checkpoint_freq
+        # Trial-level failure policy comes from RunConfig.failure_config
+        # (reference semantics) unless explicitly overridden.
+        failure = run_config.failure_config
+        if max_failures is None:
+            max_failures = failure.max_failures if failure else 0
         self.max_failures = max_failures
+        self.restart_backoff_s = (
+            failure.restart_backoff_s if failure else 0.0)
         name = run_config.name or f"tune_{int(time.time())}"
         self.exp_dir = experiment_dir or os.path.join(
             run_config.resolved_storage_path(), name)
@@ -251,20 +263,36 @@ class TuneController:
             self._apply_paused_actions()
             pending = [t for t in self.trials if t.state == PENDING]
             running = [t for t in self.trials if t.state == RUNNING]
+            now = time.monotonic()
             for t in pending:
                 if len(running) >= self.max_concurrent:
                     break
+                if t.retry_at > now:
+                    continue  # restart backoff window still open
                 try:
                     self._start_trial(t)
                     running.append(t)
                 except Exception as e:
-                    # _stop_trial notifies the scheduler and searcher —
-                    # a silently ERROR'd trial would wedge a HyperBand
-                    # bracket (never halves) and starve a sequential
-                    # searcher waiting for its completion.
-                    self._stop_trial(t, ERROR, error=str(e))
+                    # Start failures consume the same retry budget as
+                    # runtime failures (a node that can't place the
+                    # trial actor is a failure, not a terminal error) —
+                    # _on_trial_error retries from the latest checkpoint
+                    # or, once the budget is spent, notifies scheduler +
+                    # searcher via _stop_trial so a HyperBand bracket
+                    # can't wedge and a sequential searcher can't starve.
+                    self._on_trial_error(t, e)
             running = [t for t in self.trials if t.state == RUNNING]
             pending = [t for t in self.trials if t.state == PENDING]
+            if not running and pending:
+                # Nothing running but startable trials remain — either
+                # inside a backoff window (wait it out) or freshly
+                # expired mid-iteration (sleep 0). Looping here instead
+                # of falling through to the no-futures exit below is
+                # what keeps a retried trial from being stranded in
+                # PENDING forever.
+                time.sleep(max(0.0, min(t.retry_at for t in pending)
+                               - time.monotonic()))
+                continue
             if not running and not pending:
                 paused = [t for t in self.trials if t.state == PAUSED]
                 if paused:
@@ -367,14 +395,27 @@ class TuneController:
     def _on_trial_error(self, trial: Trial, error: Exception):
         logger.warning("trial %s failed: %s", trial.trial_id, error)
         if trial.retries < self.max_failures:
+            from ray_tpu.util import telemetry
+
             trial.retries += 1
-            try:
-                ray_tpu.kill(trial.actor)
-            except Exception:
-                pass
+            if trial.actor is not None:
+                try:
+                    ray_tpu.kill(trial.actor)
+                except Exception:
+                    pass
             trial.actor = None
+            # Back to PENDING: _start_trial restores from the trial's
+            # latest checkpoint (trial.checkpoint_path), so the retry
+            # resumes instead of restarting from scratch.
             trial.state = PENDING
             trial.future = None
+            trial.retry_at = time.monotonic() + self.restart_backoff_s
+            telemetry.inc("ray_tpu_tune_trial_retries_total")
+            logger.info(
+                "retrying trial %s (%d/%d) from checkpoint %s after "
+                "%.1fs backoff", trial.trial_id, trial.retries,
+                self.max_failures, trial.checkpoint_path or "<none>",
+                self.restart_backoff_s)
         else:
             self._stop_trial(trial, ERROR, error=str(error))
         self._save_state()

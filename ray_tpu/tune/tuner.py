@@ -121,7 +121,6 @@ class Tuner:
             if (isinstance(inner, SearchGenerator)
                     and inner.num_samples is None):
                 inner.num_samples = tc.num_samples
-        failure = self.run_config.failure_config
         controller = TuneController(
             self.trainable,
             search_alg=search_alg,
@@ -132,7 +131,6 @@ class Tuner:
             max_concurrent=tc.max_concurrent_trials,
             resources_per_trial=self.resources_per_trial,
             checkpoint_freq=tc.checkpoint_freq,
-            max_failures=failure.max_failures if failure else 0,
             experiment_dir=self._experiment_dir,
         )
         trials = controller.run()
@@ -193,7 +191,6 @@ class Tuner:
 
         unfinished = [t for t in state["trials"]
                       if t["state"] != "TERMINATED"]
-        failure = self.run_config.failure_config
         controller = TuneController(
             self.trainable,
             search_alg=_Restorer(state["trials"]),
@@ -204,7 +201,6 @@ class Tuner:
             max_concurrent=tc.max_concurrent_trials,
             resources_per_trial=self.resources_per_trial,
             checkpoint_freq=tc.checkpoint_freq,
-            max_failures=failure.max_failures if failure else 0,
             experiment_dir=self._experiment_dir,
         )
         # Seed checkpoints so restarted trials resume, not restart.

@@ -18,16 +18,18 @@ import os
 import random
 import signal
 import time
-from typing import List, Optional
+from typing import Any, List, Optional
 
 logger = logging.getLogger(__name__)
 
 
 class _KillerBase:
-    """Shared schedule/bookkeeping for the kill actors: seeded RNG,
+    """Shared schedule/strike loop for the kill actors: seeded RNG,
     kill budget, error counter, and a ``max_duration_s`` deadline so a
     soak run whose candidate set never materializes cannot hang the
-    suite."""
+    suite. Subclasses implement ``_victims()`` (candidate listing) and
+    ``_strike(victim)`` (the kill itself, returning the token recorded
+    in ``killed``); ``run()`` is the one poll/choose/strike loop."""
 
     def __init__(self, kill_interval_s: float, max_kills: int, seed: int,
                  max_duration_s: Optional[float] = None):
@@ -36,7 +38,7 @@ class _KillerBase:
         self.max_duration_s = max_duration_s
         self.rng = random.Random(seed)
         self.killed: List = []
-        # Kill attempts that failed (victim vanished first, lookup
+        # Strike attempts that failed (victim vanished first, lookup
         # errors). Exposed rather than swallowed — a chaos run whose
         # kills all silently failed proves nothing.
         self.errors = 0
@@ -68,6 +70,49 @@ class _KillerBase:
             return False
         return True
 
+    # -- subclass surface ------------------------------------------------
+
+    def _victims(self) -> List[Any]:
+        """Current strike candidates (runs in an executor thread)."""
+        raise NotImplementedError
+
+    def _strike(self, victim: Any) -> Any:
+        """Kill/disrupt one victim (executor thread); the return value
+        is recorded in ``killed``. Raise to count an error instead."""
+        raise NotImplementedError
+
+    async def run(self) -> int:
+        self._start_clock()
+        loop = asyncio.get_event_loop()
+        while self._keep_running():
+            await asyncio.sleep(self._sleep_s())
+            if not self._keep_running():
+                break
+            try:
+                candidates = await loop.run_in_executor(
+                    None, self._victims)
+            except Exception as e:  # noqa: BLE001 — counted, not hidden
+                self.errors += 1
+                logger.debug("%s victim listing failed: %s",
+                             type(self).__name__, e)
+                continue
+            if not candidates:
+                continue
+            victim = self.rng.choice(candidates)
+            try:
+                token = await loop.run_in_executor(
+                    None, lambda: self._strike(victim))
+                self.killed.append(token)
+                logger.info("%s struck %r", type(self).__name__, token)
+            except Exception as e:  # noqa: BLE001 — counted, not hidden
+                # Mirror LocalPeer's handler policy: failures are
+                # surfaced (counter + debug log), never swallowed — a
+                # kill that keeps missing its victim is signal.
+                self.errors += 1
+                logger.debug("%s strike of %r failed: %s",
+                             type(self).__name__, victim, e)
+        return len(self.killed)
+
     async def stop(self) -> List:
         self._running = False
         return self.killed
@@ -87,33 +132,19 @@ class WorkerKiller(_KillerBase):
                  max_duration_s: Optional[float] = None):
         super().__init__(kill_interval_s, max_kills, seed, max_duration_s)
 
-    async def run(self) -> int:
-        import ray_tpu
+    def _victims(self) -> List[dict]:
         from ray_tpu.util.state import list_workers
 
-        self._start_clock()
         me = os.getpid()
-        while self._keep_running():
-            await asyncio.sleep(self._sleep_s())
-            if not self._keep_running():
-                break
-            loop = asyncio.get_event_loop()
-            workers = await loop.run_in_executor(None, list_workers)
-            candidates = [w for w in workers
-                          if w["state"] == "LEASED" and w["pid"] != me]
-            if not candidates:
-                continue
-            victim = self.rng.choice(candidates)
-            try:
-                os.kill(victim["pid"], signal.SIGKILL)
-                self.killed.append(victim["pid"])
-            except ProcessLookupError:
-                # Victim exited between the listing and the kill — not a
-                # fault of the killer, but worth counting.
-                self.errors += 1
-                logger.debug("worker kill of pid %s failed: gone",
-                             victim["pid"])
-        return len(self.killed)
+        return [w for w in list_workers()
+                if w["state"] == "LEASED" and w["pid"] != me]
+
+    def _strike(self, victim: dict) -> int:
+        # ProcessLookupError (victim exited between the listing and the
+        # kill) propagates to the error counter — not a fault of the
+        # killer, but worth counting.
+        os.kill(victim["pid"], signal.SIGKILL)
+        return victim["pid"]
 
 
 class ActorKiller(_KillerBase):
@@ -126,36 +157,67 @@ class ActorKiller(_KillerBase):
         super().__init__(kill_interval_s, max_kills, seed, max_duration_s)
         self.name_prefix = name_prefix
 
-    async def run(self) -> int:
-        import ray_tpu
+    def _victims(self) -> List[dict]:
         from ray_tpu.util.state import list_actors
 
-        self._start_clock()
-        while self._keep_running():
-            await asyncio.sleep(self._sleep_s())
-            if not self._keep_running():
-                break
-            loop = asyncio.get_event_loop()
-            actors = await loop.run_in_executor(None, list_actors)
-            candidates = [
-                a for a in actors
-                if a["state"] == "ALIVE" and a.get("name")
-                and a["name"].startswith(self.name_prefix)
-                and not a["name"].startswith("_chaos")]
-            if not candidates:
-                continue
-            victim = self.rng.choice(candidates)
-            try:
-                handle = await loop.run_in_executor(
-                    None, lambda: ray_tpu.get_actor(victim["name"]))
-                await loop.run_in_executor(
-                    None, lambda: ray_tpu.kill(handle))
-                self.killed.append(victim["name"])
-            except Exception as e:  # noqa: BLE001 — counted, not hidden
-                # Mirror LocalPeer's handler policy: failures are
-                # surfaced (counter + debug log), never swallowed — a
-                # kill that keeps missing its victim is signal.
-                self.errors += 1
-                logger.debug("actor kill of %r failed: %s",
-                             victim["name"], e)
-        return len(self.killed)
+        return [
+            a for a in list_actors()
+            if a["state"] == "ALIVE" and a.get("name")
+            and a["name"].startswith(self.name_prefix)
+            and not a["name"].startswith("_chaos")]
+
+    def _strike(self, victim: dict) -> str:
+        import ray_tpu
+
+        handle = ray_tpu.get_actor(victim["name"])
+        ray_tpu.kill(handle)
+        return victim["name"]
+
+
+class TrainWorkerKiller(_KillerBase):
+    """Train-aware chaos lane: kills or hangs a random ``TrainWorker``
+    gang actor mid-run, exercising the trainer's gang health monitor
+    (death/hang attribution), crash-consistent checkpoint resume, and
+    elastic restart. ``mode="kill"`` destroys the actor outright;
+    ``mode="hang"`` stalls the victim's train loop for ``hang_s``
+    without touching its RPC lane — heartbeats stay green while
+    progress stops, which is exactly the hang signature the monitor
+    must catch."""
+
+    def __init__(self, kill_interval_s: float = 1.0, max_kills: int = 2,
+                 seed: int = 0, mode: str = "kill",
+                 hang_s: float = 120.0,
+                 max_duration_s: Optional[float] = None):
+        if mode not in ("kill", "hang"):
+            raise ValueError(f"mode must be 'kill' or 'hang', got {mode!r}")
+        super().__init__(kill_interval_s, max_kills, seed, max_duration_s)
+        self.mode = mode
+        self.hang_s = hang_s
+
+    def _victims(self) -> List[dict]:
+        from ray_tpu.util.state import list_actors
+
+        return [a for a in list_actors()
+                if a["state"] == "ALIVE"
+                and a.get("class_name") == "TrainWorker"]
+
+    def _strike(self, victim: dict) -> str:
+        import ray_tpu
+        from ray_tpu.api import ActorHandle, _require_worker
+        from ray_tpu.core.ids import ActorID
+
+        actor_id = ActorID.from_hex(victim["actor_id"])
+        cw = _require_worker()
+        if self.mode == "kill":
+            cw.kill_actor(actor_id, True)
+            return victim["actor_id"]
+        # Hang: needs a callable handle — hydrate actor state from the
+        # head the same way get_actor does for named actors.
+        reply = cw.loop_thread.run(cw.head.call(
+            "get_actor_info", {"actor_id": victim["actor_id"]}))
+        if not reply.get("found"):
+            raise RuntimeError(f"actor {victim['actor_id']} vanished")
+        cw._on_actor_state_threadsafe(reply)
+        handle = ActorHandle(actor_id)
+        ray_tpu.get(handle.chaos_hang.remote(self.hang_s), timeout=10)
+        return victim["actor_id"]

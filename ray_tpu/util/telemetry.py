@@ -146,6 +146,27 @@ CATALOG: Dict[str, tuple] = {
     "ray_tpu_train_step_seconds": (
         HISTOGRAM, "Wall time between consecutive train.report() calls.",
         (), SLOW_BOUNDARIES),
+    # --- train recovery (train/backend_executor.py, train/trainer.py,
+    # train/checkpoint_manager.py, tune/tune_controller.py) ---
+    "ray_tpu_train_restarts_total": (
+        COUNTER, "Gang restarts performed by the trainer, by failure "
+        "kind (died / hung / unresponsive / error).",
+        ("reason",), None),
+    "ray_tpu_train_hang_detections_total": (
+        COUNTER, "Ranks declared hung by the gang health monitor "
+        "(no progress past hang_timeout_s).", (), None),
+    "ray_tpu_train_worker_deaths_total": (
+        COUNTER, "Train worker actor deaths observed by the gang "
+        "health monitor or the report stream.", (), None),
+    "ray_tpu_train_torn_checkpoint_skips_total": (
+        COUNTER, "Checkpoint directories skipped during recovery for a "
+        "missing/invalid COMMIT marker or truncated shard.", (), None),
+    "ray_tpu_train_elastic_resizes_total": (
+        COUNTER, "Gang re-formations at a smaller world size after "
+        "resources failed to return.", (), None),
+    "ray_tpu_tune_trial_retries_total": (
+        COUNTER, "Failed Tune trials restarted from their latest "
+        "checkpoint under RunConfig.failure_config.", (), None),
 }
 
 _KIND_TO_CLS = {

@@ -126,6 +126,18 @@ class LazyRank:
         return col.get_rank(group_name)
 
 
+def test_list_declared_groups_and_destroy_sweep(ray_start):
+    """Cluster-wide group introspection: declared groups are visible
+    from the driver and disappear after destroy — the gang-abort flow's
+    forensics surface."""
+    col.init_collective_group(1, 0, group_name="g_listed")
+    assert "g_listed" in col.list_declared_groups()
+    assert "g_listed" in col.local_group_names()
+    col.destroy_collective_group("g_listed")
+    assert "g_listed" not in col.list_declared_groups()
+    assert "g_listed" not in col.local_group_names()
+
+
 def test_declarative_group(ray_start):
     world = 2
     actors = [LazyRank.remote() for _ in range(world)]

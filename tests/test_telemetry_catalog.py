@@ -87,6 +87,27 @@ def test_registry_matches_catalog():
             assert m.boundaries == sorted(bounds)
 
 
+def test_train_recovery_metrics_in_catalog():
+    """The training-plane recovery metrics (PR: gang health monitoring /
+    crash-consistent checkpoints / elastic restart) stay declared — the
+    recovery paths emit through these names and a rename/removal would
+    silently drop the telemetry."""
+    expected = {
+        "ray_tpu_train_restarts_total": (telemetry.COUNTER, ("reason",)),
+        "ray_tpu_train_hang_detections_total": (telemetry.COUNTER, ()),
+        "ray_tpu_train_worker_deaths_total": (telemetry.COUNTER, ()),
+        "ray_tpu_train_torn_checkpoint_skips_total": (
+            telemetry.COUNTER, ()),
+        "ray_tpu_train_elastic_resizes_total": (telemetry.COUNTER, ()),
+        "ray_tpu_tune_trial_retries_total": (telemetry.COUNTER, ()),
+    }
+    for name, (kind, tag_keys) in expected.items():
+        assert name in telemetry.CATALOG, name
+        got_kind, _desc, got_tags, _bounds = telemetry.CATALOG[name]
+        assert got_kind == kind, name
+        assert tuple(got_tags) == tag_keys, name
+
+
 def test_catalog_metric_roundtrip():
     telemetry.reset_for_testing()
     try:

@@ -302,6 +302,34 @@ def test_trial_failure_retry(tune_cluster, tmp_path):
     assert grid.get_best_result().metrics["ok"] == 1.0
 
 
+def test_trial_failure_retry_resumes_from_checkpoint(tune_cluster,
+                                                     tmp_path):
+    """RunConfig.failure_config at trial level: the retried trial
+    restores the trial's latest checkpoint instead of restarting from
+    scratch (a _resumable that crashed at it=3 finishes without ever
+    re-reporting it=1)."""
+    from ray_tpu.train.config import FailureConfig
+
+    tuner = tune.Tuner(
+        _resumable,
+        param_space={"crash_at": tune.grid_search([3])},
+        tune_config=tune.TuneConfig(metric="it", mode="max"),
+        run_config=RunConfig(
+            name="retry_resume", storage_path=str(tmp_path),
+            failure_config=FailureConfig(max_failures=1,
+                                         restart_backoff_s=0.1)),
+    )
+    grid = tuner.fit()
+    assert not grid.errors
+    best = grid.get_best_result()
+    assert best.metrics["it"] == 6
+    # The retry resumed at it=3 (checkpoint from the crashing report):
+    # its history never revisits the early iterations.
+    retried = [m["it"] for m in best.metrics_history]
+    assert retried.count(1) == 1
+    assert retried[-1] == 6
+
+
 # -- HyperBand (synchronous brackets) ---------------------------------------
 
 
