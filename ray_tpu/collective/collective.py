@@ -161,6 +161,11 @@ def create_collective_group(actors: Sequence[Any], world_size: int,
     ray_tpu = _api()
     ray_tpu.get(store.declare_group.remote(group_name, world_size, be.value,
                                            members))
+    from ray_tpu.util import flight_recorder
+
+    flight_recorder.record("collective", "group_created",
+                           group=group_name, world_size=world_size,
+                           backend=be.value)
 
 
 def _get_ctx(group_name: str) -> GroupContext:
@@ -231,6 +236,10 @@ def destroy_collective_group(group_name: str = _DEFAULT_GROUP) -> None:
         _groups.pop(group_name, None)
     store = _get_store()
     ray_tpu.get(store.destroy_group.remote(group_name))
+    from ray_tpu.util import flight_recorder
+
+    flight_recorder.record("collective", "group_destroyed",
+                           severity="warn", group=group_name)
 
 
 # ---------------------------------------------------------------------------

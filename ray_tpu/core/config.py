@@ -153,6 +153,16 @@ class Config:
     task_events_report_interval_s: float = 1.0
     task_events_max_buffer_size: int = 10_000
 
+    # --- flight recorder / debug plane (util/flight_recorder.py) ---
+    # Always-on per-process ring of structured decision events (scheduler
+    # wait reasons, object lifecycle, retries/breakers, node states,
+    # gang health). On by default: the idle cost is one deque append;
+    # RAY_TPU_FLIGHT_RECORDER_ENABLED=0 turns it off.
+    flight_recorder_enabled: bool = True
+    # Events retained per process (a fixed-size ring; older entries are
+    # overwritten).
+    flight_recorder_capacity: int = 2048
+
     # --- workers ---
     # Spawn workers by forking a preimported forkserver process instead
     # of a cold interpreter per worker (core/forkserver.py). POSIX only;

@@ -448,7 +448,30 @@ class CoreWorker:
             "task_accepted": self.h_task_accepted,
             "task_done": self.h_task_done,
             "ping": self.h_ping,
+            "debug_dump": self.h_debug_dump,
         }
+
+    async def h_debug_dump(self, conn, payload):
+        """On-demand debug plane (reference: `ray stack` / the reporter
+        agent's py-spy hooks): this process's flight-recorder ring plus
+        live stacks of every thread. The head fans this out cluster-wide
+        (h_debug_dump_cluster)."""
+        payload = payload or {}
+        from ray_tpu.util import flight_recorder
+
+        out = {
+            "pid": os.getpid(),
+            "worker_id": self.worker_id.hex(),
+            "mode": self.mode,
+            "node_id": self.node_id_hex,
+            "ts": time.time(),
+            "stacks": (flight_recorder.dump_stacks()
+                       if payload.get("include_stacks", True) else {}),
+        }
+        if payload.get("include_events", True):
+            out["events"] = flight_recorder.snapshot(
+                limit=payload.get("event_limit"))
+        return out
 
     def h_task_accepted(self, conn, payload):
         # Sync notification handler (rpc fast path: no Task per frame).
@@ -595,7 +618,15 @@ class CoreWorker:
         self.reference_counter.register_owned(object_id, in_shm)
 
     def _seal_to_shm(self, object_id: ObjectID, obj: SerializedObject) -> int:
-        return object_store.node_store_write(object_id, obj)
+        size = object_store.node_store_write(object_id, obj)
+        from ray_tpu.util import flight_recorder
+
+        # Only shm-plane objects are recorded: tiny in-process values
+        # churn far too fast for a forensic ring.
+        flight_recorder.record("object", "sealed",
+                               object=object_id.hex()[:16], bytes=size,
+                               node=self.node_id_hex or "head")
+        return size
 
     def _check_not_on_loop(self, api: str):
         if threading.get_ident() == getattr(self, "_loop_thread_ident", None):
@@ -847,6 +878,12 @@ class CoreWorker:
             logger.info("recovering lost object %s by resubmitting task %s",
                         object_id.hex()[:12], spec.name or
                         spec.task_id.hex()[:12])
+            from ray_tpu.util import flight_recorder
+
+            flight_recorder.record(
+                "object", "lost", severity="error",
+                object=object_id.hex()[:16],
+                task=spec.task_id.hex()[:16], name=spec.name or "")
             # Reset terminal state so the reply path treats this as a
             # fresh attempt of the same task (same return object ids).
             self._finished_task_ids.discard(spec.task_id)
@@ -885,6 +922,11 @@ class CoreWorker:
         obj = object_store.node_store_open(object_id)
         if obj is None:
             obj = await self._pull_remote(object_id)
+        if obj is not None:
+            from ray_tpu.util import flight_recorder
+
+            flight_recorder.record("object", "recovered",
+                                   object=object_id.hex()[:16])
         return obj
 
     async def _delegate_or_pull(self, object_id: ObjectID,
@@ -986,6 +1028,10 @@ class CoreWorker:
         self.memory_store.delete(object_id)
         self._drop_lineage(object_id)
         if in_shm and not self._shutdown:
+            from ray_tpu.util import flight_recorder
+
+            flight_recorder.record("object", "freed",
+                                   object=object_id.hex()[:16])
             try:
                 self.loop_thread.submit(
                     self.head.call("free_objects",

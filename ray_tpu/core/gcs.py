@@ -439,6 +439,12 @@ class HeadService:
             self.default_node_id = node_id
         if agent_conn is not None:
             self._node_agents[node_id] = agent_conn
+        from ray_tpu.util import flight_recorder
+
+        flight_recorder.record("gcs", "node_alive",
+                               node=node_id.hex()[:12],
+                               resources=str(dict(resources)),
+                               remote=agent_conn is not None)
         self._publish("node_state", {
             "node_id": node_id.hex(), "state": "ALIVE",
             "resources": dict(resources),
@@ -453,6 +459,10 @@ class HeadService:
         return node_id
 
     def remove_node(self, node_id: NodeID):
+        from ray_tpu.util import flight_recorder
+
+        flight_recorder.record("gcs", "node_dead", severity="error",
+                               node=node_id.hex()[:12])
         self.scheduler.remove_node(node_id)
         info = self.nodes_info.get(node_id)
         if info:
@@ -531,6 +541,8 @@ class HeadService:
             "report_oom_kill": self.h_report_oom_kill,
             "ping": self.h_ping,
             "autoscaler_status": self.h_autoscaler_status,
+            "debug_dump_cluster": self.h_debug_dump_cluster,
+            "debug_sched_state": self.h_debug_sched_state,
             # Serve the head-host node store for cross-node pulls.
             **object_transfer.serve_handlers(),
         }
@@ -610,6 +622,10 @@ class HeadService:
             sched_node.state = "ALIVE"  # placements resume
         info.agent_address = (payload["host"], payload["port"])
         self._node_agents[node_id] = conn
+        from ray_tpu.util import flight_recorder
+
+        flight_recorder.record("gcs", "node_reattached",
+                               node=node_id.hex()[:12])
         logger.info("node agent %s reconnected within grace window",
                     node_id.hex()[:12])
         self._publish("node_state", {
@@ -647,6 +663,10 @@ class HeadService:
         logger.warning(
             "node agent %s disconnected; %.1fs grace before declaring "
             "the node dead", node_id.hex()[:12], grace)
+        from ray_tpu.util import flight_recorder
+
+        flight_recorder.record("gcs", "node_suspect", severity="warn",
+                               node=node_id.hex()[:12], grace_s=grace)
         info.state = "SUSPECT"
         # Mirror into the scheduler's node table: new leases must not
         # land on a node whose agent can't fork workers right now (the
@@ -764,6 +784,13 @@ class HeadService:
     def _on_worker_dead(self, handle: WorkerHandle):
         logger.info("worker %s died (state=%s)", handle.worker_id.hex()[:12],
                     handle.state)
+        from ray_tpu.util import flight_recorder
+
+        flight_recorder.record(
+            "gcs", "worker_dead", severity="warn",
+            worker=handle.worker_id.hex()[:12],
+            node=handle.node_id.hex()[:12], state=handle.state,
+            reason=self._death_reasons.get(handle.worker_id.hex(), ""))
         self.pool.mark_dead(handle.worker_id)
         # Drop the dead process's telemetry snapshots: its last pushed
         # gauges (in-flight RPCs, router queue depth) would otherwise
@@ -1050,6 +1077,13 @@ class HeadService:
         self._publish_actor(info)
 
     def _publish_actor(self, info: ActorInfo):
+        from ray_tpu.util import flight_recorder
+
+        flight_recorder.record(
+            "gcs", "actor_state",
+            severity="error" if info.state == "DEAD" else "info",
+            actor=info.actor_id.hex()[:16], state=info.state,
+            restarts=info.num_restarts, cause=info.death_cause or "")
         self._publish("actor_state", {
             "actor_id": info.actor_id.hex(),
             "state": info.state,
@@ -1465,10 +1499,24 @@ class HeadService:
         return {"actors": out}
 
     async def h_list_objects(self, conn, payload):
-        return {"objects": [
-            {"object_id": oid, "size_bytes": size}
-            for oid, size in self.sealed_objects.items()
-        ]}
+        from ray_tpu.core.object_store import _spill_path
+
+        rows = []
+        for oid, size in self.sealed_objects.items():
+            locs = sorted(n.hex() for n in
+                          self.object_locations.get(oid, set()))
+            object_id = ObjectID.from_hex(oid)
+            in_head = self.shm.contains(object_id)
+            if locs or in_head:
+                state = "SEALED"
+            elif os.path.exists(_spill_path(object_id)):
+                # Head-node store overflowed this one to disk.
+                state = "SPILLED"
+            else:
+                state = "LOST"
+            rows.append({"object_id": oid, "size_bytes": size,
+                         "state": state, "locations": locs})
+        return {"objects": rows}
 
     async def h_list_jobs(self, conn, payload):
         return {"jobs": [
@@ -1512,6 +1560,116 @@ class HeadService:
         if monitor is None:
             return {"enabled": False}
         return {"enabled": True, **monitor.status()}
+
+    # ------------------------------------------------------------------
+    # debug plane (reference: `ray stack` / state-API debug dumps)
+    # ------------------------------------------------------------------
+
+    async def h_debug_dump_cluster(self, conn, payload):
+        """Fan the per-process ``debug_dump`` out to every reachable
+        process — registered workers (over their head connections) and
+        remote node agents — plus this head process itself. Unreachable
+        peers come back as error entries instead of failing the dump:
+        a debug plane that dies with the thing it debugs is useless."""
+        payload = payload or {}
+        req = {
+            "include_events": payload.get("include_events", True),
+            "include_stacks": payload.get("include_stacks", True),
+            "event_limit": payload.get("event_limit"),
+        }
+        timeout = payload.get("timeout_s", 5.0)
+        targets = []
+        for h in self.pool.workers.values():
+            c = h.connection
+            if c is not None and not getattr(c, "closed", False):
+                targets.append((f"worker:{h.worker_id.hex()}",
+                                h.node_id.hex(), h.pid, c))
+        for node_id, agent in self._node_agents.items():
+            if not getattr(agent, "closed", False):
+                targets.append((f"agent:{node_id.hex()}",
+                                node_id.hex(), None, agent))
+
+        async def one(source, node_hex, pid, c):
+            try:
+                rep = await c.call("debug_dump", req, timeout=timeout)
+                rep["source"] = source
+                rep.setdefault("node_id", node_hex)
+                if pid is not None and pid > 0:
+                    rep.setdefault("pid", pid)
+                return rep
+            except Exception as e:  # noqa: BLE001 — dump must survive
+                return {"source": source, "node_id": node_hex,
+                        "error": f"{type(e).__name__}: {e}"}
+
+        entries = list(await asyncio.gather(
+            *(one(*t) for t in targets)))
+        from ray_tpu.util import flight_recorder
+
+        head_entry = {
+            "source": "head",
+            "pid": os.getpid(),
+            "node_id": (self.default_node_id.hex()
+                        if hasattr(self, "default_node_id") else None),
+            "ts": time.time(),
+            "stacks": (flight_recorder.dump_stacks()
+                       if req["include_stacks"] else {}),
+        }
+        if req["include_events"]:
+            head_entry["events"] = flight_recorder.snapshot(
+                limit=req["event_limit"])
+        return {"entries": [head_entry] + entries, "ts": time.time()}
+
+    async def h_debug_sched_state(self, conn, payload):
+        """The scheduler's live waiting state, for the `why` explainer:
+        every pending lease with its wait reason, node capacity, PG
+        placement, and spawn backoffs."""
+        sch = self.scheduler
+        now = time.monotonic()
+        pending = []
+        for lease in sch.pending:
+            spec = lease.spec
+            strategy = spec.scheduling_strategy
+            pending.append({
+                "task_id": spec.task_id.hex(),
+                "name": spec.name,
+                "actor_id": spec.actor_id.hex() if spec.actor_id else None,
+                "is_actor_creation": lease.is_actor_creation,
+                "resources": lease.resources.to_dict(),
+                "strategy": (type(strategy).__name__
+                             if strategy is not None else "default"),
+                "age_s": round(now - lease.queued_at, 3),
+                "wait_reason": lease.wait_reason,
+            })
+        nodes = []
+        for info in self.nodes_info.values():
+            node = sch.nodes.get(info.node_id)
+            nodes.append({
+                "node_id": info.node_id.hex(),
+                "state": info.state,
+                "total": dict(info.resources),
+                "available": (node.resources.available.to_dict()
+                              if node and node.state == "ALIVE" else {}),
+            })
+        pgs = []
+        for pg_id, info in self.placement_groups.items():
+            placed = sum(1 for b in info.bundles if b.node_id is not None)
+            pgs.append({
+                "pg_id": pg_id.hex(), "state": info.state,
+                "strategy": info.strategy, "name": info.name,
+                "bundles": len(info.bundles), "bundles_placed": placed,
+            })
+        return {
+            "pending": pending,
+            "nodes": nodes,
+            "pgs": pgs,
+            "active_leases": len(sch.active_leases),
+            "waiting_grants": {nid.hex(): len(q) for nid, q in
+                               self._waiting_grants.items() if q},
+            "spawn_backoff_s": {
+                nid.hex(): round(until - now, 3)
+                for nid, until in self._spawn_backoff_until.items()
+                if until > now},
+        }
 
     # ------------------------------------------------------------------
 

@@ -98,8 +98,28 @@ class NodeAgent:
             "ping": self.h_ping,
             "pull_object": self.h_pull_object,
             "shutdown_node": self.h_shutdown_node,
+            "debug_dump": self.h_debug_dump,
             **object_transfer.serve_handlers(),
         }
+
+    async def h_debug_dump(self, conn, payload):
+        """The agent's slice of the cluster debug plane: its own
+        flight-recorder ring + all-thread stacks."""
+        payload = payload or {}
+        from ray_tpu.util import flight_recorder
+
+        out = {
+            "pid": os.getpid(),
+            "node_id": self.node_id_hex,
+            "mode": "agent",
+            "ts": time.time(),
+            "stacks": (flight_recorder.dump_stacks()
+                       if payload.get("include_stacks", True) else {}),
+        }
+        if payload.get("include_events", True):
+            out["events"] = flight_recorder.snapshot(
+                limit=payload.get("event_limit"))
+        return out
 
     async def h_pull_object(self, conn, payload):
         """Workers delegate cross-node pulls here (reference: the
@@ -475,10 +495,16 @@ def main():
     p.add_argument("--resources", default=None,
                    help='extra custom resources as JSON, e.g. \'{"hostB":1}\'')
     args = p.parse_args()
+    from ray_tpu.util import flight_recorder
+
+    flight_recorder.install_crash_handler()
     try:
         code = asyncio.run(_amain(args))
     except KeyboardInterrupt:
         code = 0
+    except BaseException as e:  # crashed agent loop: leave evidence
+        flight_recorder.flush_postmortem(f"{type(e).__name__}: {e}")
+        raise
     os._exit(code or 0)
 
 

@@ -498,6 +498,11 @@ def _spill_write(object_id: ObjectID, data: bytes) -> int:
     telemetry.inc("ray_tpu_object_spilled_bytes_total", len(data))
     telemetry.event("objects", f"spill {object_id.hex()[:8]}", ts=t0,
                     dur=time.time() - t0, args={"bytes": len(data)})
+    from ray_tpu.util import flight_recorder
+
+    flight_recorder.record("object", "spilled",
+                           object=object_id.hex()[:16],
+                           bytes=len(data))
     return len(data)
 
 
@@ -516,10 +521,12 @@ def _spill_open(object_id: ObjectID) -> Optional[SerializedObject]:
         f.close()
     obj = parse_packed(memoryview(mapped))
     if obj is not None:
-        from ray_tpu.util import telemetry
+        from ray_tpu.util import flight_recorder, telemetry
 
         telemetry.inc("ray_tpu_object_restored_total")
         telemetry.event("objects", f"restore {object_id.hex()[:8]}")
+        flight_recorder.record("object", "restored",
+                               object=object_id.hex()[:16])
     return obj
 
 

@@ -376,6 +376,13 @@ class ObjectPuller:
                               {"status": status})
             telemetry.event("objects", f"pull {hex_id[:8]}", ts=t_wall,
                             dur=elapsed, args={"status": status})
+            from ray_tpu.util import flight_recorder
+
+            flight_recorder.record(
+                "object", "pulled",
+                severity="info" if status == "ok" else "warn",
+                object=hex_id[:16], status=status,
+                dur_s=round(elapsed, 4))
 
     async def _pull_once(self, object_id: ObjectID,
                          locations: List[Tuple[str, int]]) -> bool:

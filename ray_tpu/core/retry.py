@@ -50,7 +50,7 @@ def _metric_site(label: str) -> str:
 
 def _record_retry(label: str, delay: float,
                   error: Optional[BaseException]) -> None:
-    from ray_tpu.util import telemetry
+    from ray_tpu.util import flight_recorder, telemetry
 
     site = _metric_site(label)
     telemetry.inc("ray_tpu_retries_total", 1, {"site": site})
@@ -59,13 +59,19 @@ def _record_retry(label: str, delay: float,
     telemetry.event("retry", f"retry {label or site}", dur=delay,
                     args={"error": (type(error).__name__ if error
                                     else "predicate_false")})
+    flight_recorder.record(
+        "rpc", "retry", severity="warn", site=label or site,
+        backoff_s=round(delay, 4),
+        error=(type(error).__name__ if error else "predicate_false"))
 
 
 def _record_deadline_exhausted(label: str) -> None:
-    from ray_tpu.util import telemetry
+    from ray_tpu.util import flight_recorder, telemetry
 
     telemetry.inc("ray_tpu_retry_deadline_exhausted_total", 1,
                   {"site": _metric_site(label)})
+    flight_recorder.record("rpc", "deadline_exhausted",
+                           severity="error", site=label or "unlabeled")
 
 # Transport-level failures: the request may never have reached (or never
 # have left) the peer. Plain RpcError is deliberately excluded — it
@@ -330,11 +336,12 @@ class CircuitBreaker:
         if entry is not None and entry[1]:
             # A previously tripped key recovering (half-open probe
             # success) is a CLOSED transition worth observing.
-            from ray_tpu.util import telemetry
+            from ray_tpu.util import flight_recorder, telemetry
 
             telemetry.inc("ray_tpu_circuit_breaker_transitions_total", 1,
                           {"state": "closed"})
             telemetry.event("breaker", f"{key} closed")
+            flight_recorder.record("rpc", "breaker_closed", key=key)
 
     def record_failure(self, key: str) -> None:
         opened = False
@@ -351,11 +358,13 @@ class CircuitBreaker:
                 # not a new transition — one trip, one count.
                 opened = not was_open
         if opened:
-            from ray_tpu.util import telemetry
+            from ray_tpu.util import flight_recorder, telemetry
 
             telemetry.inc("ray_tpu_circuit_breaker_transitions_total", 1,
                           {"state": "open"})
             telemetry.event("breaker", f"{key} open")
+            flight_recorder.record("rpc", "breaker_open", severity="warn",
+                                   key=key)
 
     def available(self, key: str) -> bool:
         with self._lock:

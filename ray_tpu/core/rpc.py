@@ -52,6 +52,19 @@ def _tm():
     return _telemetry
 
 
+# Flight recorder, bound lazily for the same bootstrap-order reason.
+_flight = None
+
+
+def _fr():
+    global _flight
+    if _flight is None:
+        from ray_tpu.util import flight_recorder
+
+        _flight = flight_recorder
+    return _flight
+
+
 #: Cached config gate for per-RPC client/server spans (``trace_rpc`` /
 #: RAY_TPU_TRACE_RPC). None until first read; tests reset it directly.
 _trace_rpc_flag: Optional[bool] = None
@@ -234,6 +247,9 @@ class FaultInjector:
                 self.stats[rule.action] = self.stats.get(rule.action, 0) + 1
                 _tm().inc("ray_tpu_rpc_faults_injected_total", 1,
                           {"action": rule.action})
+                _fr().record("rpc", "fault_injected", severity="warn",
+                             action=rule.action, direction=direction,
+                             peer=peer or "", method=method or "")
                 delay = rule.delay_s
                 if rule.jitter_s:
                     delay += self.rng.random() * rule.jitter_s
@@ -728,6 +744,15 @@ class Connection:
             except OSError:
                 pass
             self._sock = None
+        if self._pending:
+            # Only losses that strand in-flight requests are recorded —
+            # clean closes at shutdown are noise, not evidence.
+            try:
+                _fr().record("rpc", "conn_lost", severity="warn",
+                             peer=self.name,
+                             in_flight=len(self._pending))
+            except Exception:
+                pass  # interpreter teardown
         for fut in self._pending.values():
             if not fut.done():
                 fut.set_exception(ConnectionLost(self.name))

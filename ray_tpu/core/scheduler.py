@@ -62,6 +62,11 @@ class PendingLease:
     future: asyncio.Future  # resolves to WorkerHandle
     is_actor_creation: bool = False
     queued_at: float = field(default_factory=time.monotonic)
+    # Why this lease is still pending, refreshed by every _pick_node
+    # attempt — the `ray_tpu debug why` explainer reads it live, and the
+    # flight recorder logs it whenever it CHANGES (not per pump tick).
+    wait_reason: str = ""
+    _reason_recorded: str = field(default="", repr=False)
 
 
 @dataclass
@@ -447,6 +452,10 @@ class ClusterScheduler:
                     node = self.nodes.get(st.node_id)
                     if node and node.state == "ALIVE":
                         return (node, pg_id, i)
+            lease.wait_reason = (
+                f"waiting on placement group {pg_id.hex()[:8]}: no bundle "
+                f"of {len(states)} has {request.to_dict()} free (bundle "
+                f"nodes may be SUSPECT/DEAD or capacity in use)")
             return None
 
         if isinstance(strategy, NodeAffinitySchedulingStrategy):
@@ -460,6 +469,10 @@ class ClusterScheduler:
                 return (node, None, -1)
             elif not strategy.soft:
                 if node.resources.feasible(request):
+                    lease.wait_reason = (
+                        f"affinity node {strategy.node_id_hex[:8]} busy: "
+                        f"{request.to_dict()} not free now (available "
+                        f"{node.resources.available.to_dict()})")
                     return None
                 raise ValueError("affinity node cannot ever fit request")
 
@@ -470,6 +483,10 @@ class ClusterScheduler:
             )
         fitting = [n for n in feasible if n.resources.can_fit(request)]
         if not fitting:
+            lease.wait_reason = (
+                f"waiting for resources {request.to_dict()}: feasible on "
+                f"{len(feasible)}/{len(alive)} alive node(s), none has "
+                f"them free now")
             return None
 
         if isinstance(strategy, SpreadSchedulingStrategy):
@@ -492,6 +509,8 @@ class ClusterScheduler:
         grants; idle_worker may be None, in which case the caller must spawn
         a worker on that node and complete the grant on registration.
         """
+        from ray_tpu.util import flight_recorder
+
         grants = []
         remaining = []
         for lease in self.pending:
@@ -500,9 +519,21 @@ class ClusterScheduler:
             try:
                 picked = self._pick_node(lease)
             except ValueError as e:
+                flight_recorder.record(
+                    "sched", "lease_infeasible", severity="error",
+                    task=lease.spec.task_id.hex()[:16],
+                    name=lease.spec.name, reason=str(e))
                 lease.future.set_exception(e)
                 continue
             if picked is None:
+                if lease.wait_reason != lease._reason_recorded:
+                    # Only reason CHANGES hit the ring — a parked lease
+                    # must not spam an entry per 0.2s pump tick.
+                    lease._reason_recorded = lease.wait_reason
+                    flight_recorder.record(
+                        "sched", "lease_wait", severity="warn",
+                        task=lease.spec.task_id.hex()[:16],
+                        name=lease.spec.name, reason=lease.wait_reason)
                 remaining.append(lease)
                 continue
             node, pg_id, bundle_index = picked
@@ -520,10 +551,15 @@ class ClusterScheduler:
             telemetry.inc("ray_tpu_scheduler_leases_granted_total",
                           len(grants))
             now = time.monotonic()
-            for lease, *_rest in grants:
+            for lease, node, *_rest in grants:
                 telemetry.observe(
                     "ray_tpu_scheduler_placement_latency_seconds",
                     max(0.0, now - lease.queued_at))
+                flight_recorder.record(
+                    "sched", "lease_granted",
+                    task=lease.spec.task_id.hex()[:16],
+                    name=lease.spec.name, node=node.node_id.hex()[:12],
+                    waited_s=round(now - lease.queued_at, 4))
         telemetry.set_gauge("ray_tpu_scheduler_pending_leases",
                             len(remaining))
         return grants

@@ -950,6 +950,12 @@ def main():
         faulthandler.register(_signal.SIGUSR1, all_threads=True)
     except (AttributeError, ValueError):
         pass
+    # Crash postmortem: an unhandled exception anywhere in the process
+    # flushes the flight-recorder ring + all-thread stacks to
+    # <session>/logs/postmortem-<pid>.json before the interpreter dies.
+    from ray_tpu.util import flight_recorder
+
+    flight_recorder.install_crash_handler()
     # On-demand worker profiling (reference: profile_manager.py's
     # py-spy hooks): RAY_TPU_WORKER_PROFILE=<path> dumps cProfile
     # stats for the event loop (and .sync for the executor thread).
@@ -994,6 +1000,9 @@ def main():
         code = asyncio.run(_amain())
     except KeyboardInterrupt:
         code = 0
+    except BaseException as e:  # crashed main loop: leave evidence
+        flight_recorder.flush_postmortem(f"{type(e).__name__}: {e}")
+        raise
     for path, prof in _PROFILERS.items():
         try:
             prof.disable()

@@ -38,6 +38,8 @@ class DashboardActor:
         app.router.add_get("/api/jobs", self._jobs)
         app.router.add_get("/api/objects", self._objects)
         app.router.add_get("/api/autoscaler", self._autoscaler)
+        app.router.add_get("/debug", self._debug)
+        app.router.add_get("/api/debug", self._debug)
         app.router.add_get("/metrics", self._metrics)
         app.router.add_get("/healthz", self._healthz)
         self._runner = web.AppRunner(app)
@@ -114,6 +116,24 @@ class DashboardActor:
         from ray_tpu.util import state as ust
 
         return await self._json(ust.list_objects)
+
+    async def _debug(self, request):
+        """Cluster debug dump (flight-recorder rings + live stacks +
+        scheduler wait state) as JSON — the HTTP face of
+        ``ray_tpu debug dump``."""
+        def produce():
+            from ray_tpu.util import debug as udebug
+            from ray_tpu.util.state import _call
+
+            include_stacks = request.query.get("stacks", "1") != "0"
+            out = udebug.cluster_debug_dump(include_stacks=include_stacks)
+            try:
+                out["sched_state"] = _call("debug_sched_state")
+            except Exception:
+                pass
+            return out
+
+        return await self._json(produce)
 
     async def _metrics(self, request):
         from aiohttp import web

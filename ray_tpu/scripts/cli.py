@@ -91,6 +91,12 @@ def cmd_status(args):
     for w in workers:
         by_state[w["state"]] = by_state.get(w["state"], 0) + 1
     print(f"== workers: {by_state} ==")
+    objs = ust.summarize_objects()
+    if objs:
+        parts = ", ".join(
+            f"{st}: {d['count']} ({d['bytes']} B)"
+            for st, d in sorted(objs.items()))
+        print(f"== objects: {parts} ==")
     asc = ust._call("autoscaler_status")
     if asc.get("enabled"):
         summary = asc.get("last_summary", {})
@@ -127,8 +133,40 @@ def cmd_summary(args):
     print(json.dumps({
         "tasks": ust.summarize_tasks(),
         "actors": ust.summarize_actors(),
+        "objects": ust.summarize_objects(),
     }, indent=2))
     ray_tpu.shutdown()
+
+
+def cmd_debug(args):
+    ray_tpu = _attach()
+    from ray_tpu.util import debug as udebug
+
+    try:
+        if args.debug_cmd == "stacks":
+            for source, threads in sorted(
+                    udebug.cluster_stacks(args.timeout).items()):
+                print(f"==== {source} ====")
+                for thread, frames in threads.items():
+                    print(f"--- {thread} ---")
+                    for line in frames:
+                        print(line)
+                print()
+        elif args.debug_cmd == "dump":
+            manifest = udebug.write_debug_bundle(args.out,
+                                                timeout_s=args.timeout)
+            print(f"wrote debug bundle to {args.out}")
+            print(f"  sources: {len(manifest['sources'])} "
+                  f"({', '.join(manifest['sources'])})")
+            print(f"  nodes: {len(manifest['nodes'])}")
+            if manifest["errors"]:
+                print(f"  partial sections: "
+                      f"{json.dumps(manifest['errors'])}")
+        else:  # why
+            print(udebug.why(args.kind, args.id,
+                             timeout_s=args.timeout))
+    finally:
+        ray_tpu.shutdown()
 
 
 def cmd_list(args):
@@ -258,6 +296,26 @@ def main(argv=None):
     p = sub.add_parser("timeline", help="dump chrome-tracing timeline")
     p.add_argument("--output", "-o", default="timeline.json")
     p.set_defaults(fn=cmd_timeline)
+
+    p = sub.add_parser(
+        "debug", help="flight recorder / debug-dump plane")
+    dsub = p.add_subparsers(dest="debug_cmd", required=True)
+    d = dsub.add_parser("stacks",
+                        help="live stacks of every process")
+    d.add_argument("--timeout", type=float, default=5.0)
+    d.set_defaults(fn=cmd_debug)
+    d = dsub.add_parser(
+        "dump", help="write a cluster-wide debug bundle "
+        "(rings + stacks + state tables + metrics + timeline)")
+    d.add_argument("--out", "-o", default="ray_tpu_debug")
+    d.add_argument("--timeout", type=float, default=10.0)
+    d.set_defaults(fn=cmd_debug)
+    d = dsub.add_parser(
+        "why", help="explain why a task/actor/object is in its state")
+    d.add_argument("kind", choices=["task", "actor", "object"])
+    d.add_argument("id", help="full or prefix hex id")
+    d.add_argument("--timeout", type=float, default=5.0)
+    d.set_defaults(fn=cmd_debug)
 
     p = sub.add_parser("submit", help="submit a job")
     p.add_argument("--working-dir", default=None)

@@ -117,6 +117,12 @@ class Router:
             telemetry.inc("ray_tpu_serve_replica_sheds_total",
                           len(names) - len(healthy),
                           {"deployment": deployment_key})
+            from ray_tpu.util import flight_recorder
+
+            flight_recorder.record(
+                "serve", "replica_shed", severity="warn",
+                deployment=deployment_key,
+                shed=len(names) - len(healthy), total=len(names))
         candidates = healthy or names
         if len(candidates) == 1:
             name = candidates[0]

@@ -88,6 +88,16 @@ class _GangHealthMonitor(threading.Thread):
                     self._misses[rank] = misses
                     logger.debug("heartbeat miss %d for rank %d: %s",
                                  misses, rank, e)
+                    from ray_tpu.util import flight_recorder, telemetry
+
+                    telemetry.event(
+                        "train", "heartbeat miss",
+                        args={"rank": rank, "misses": misses,
+                              "error": type(e).__name__})
+                    flight_recorder.record(
+                        "train", "heartbeat_miss", severity="warn",
+                        rank=rank, misses=misses,
+                        error=type(e).__name__)
                     if misses >= _HEARTBEAT_MISS_THRESHOLD:
                         self._abort(
                             "unresponsive", rank,
@@ -229,6 +239,12 @@ class BackendExecutor:
             telemetry.inc("ray_tpu_train_worker_deaths_total")
         telemetry.event("train", f"gang abort: {kind}",
                         args={"message": message})
+        from ray_tpu.util import flight_recorder
+
+        flight_recorder.record(
+            "train", "gang_abort", severity="error", kind=kind,
+            rank=dead_rank if dead_rank is not None else -1,
+            message=message)
         self._destroy_collective_groups(groups or set())
         wg = self.worker_group
         if wg is None:

@@ -191,6 +191,10 @@ class JaxTrainer:
         telemetry.inc("ray_tpu_train_elastic_resizes_total")
         telemetry.event("train", "elastic gang resize",
                         args={"from": full, "to": lo})
+        from ray_tpu.util import flight_recorder
+
+        flight_recorder.record("train", "elastic_resize", severity="warn",
+                               from_world=full, to_world=lo)
         return executor
 
     # -- fit ---------------------------------------------------------------
@@ -275,6 +279,11 @@ class JaxTrainer:
                     telemetry.event("train", "gang restart",
                                     args={"attempt": attempt + 1,
                                           "reason": reason})
+                    from ray_tpu.util import flight_recorder
+
+                    flight_recorder.record(
+                        "train", "gang_restart", severity="warn",
+                        attempt=attempt + 1, reason=reason)
                 resume = manager.latest or self.resume_from_checkpoint
             finally:
                 if executor is not None:

@@ -1,0 +1,362 @@
+"""Cluster debug plane: on-demand dumps and the "why is it stuck"
+explainer.
+
+Reference: ``ray stack`` / ``ray debug`` + the state API's summaries
+(Ray paper, arXiv:1712.05889 §state). Driver-side veneer over the
+head's fan-out handlers:
+
+- ``cluster_debug_dump()`` — every process's flight-recorder ring +
+  live all-thread stacks (head, workers, node agents, this driver).
+- ``write_debug_bundle(out_dir)`` — a post-mortem bundle: rings,
+  stacks, state-API tables, scheduler wait state, a merged metrics
+  snapshot and the chrome-tracing timeline.
+- ``why(kind, ident)`` — walks the recorded events and live state
+  tables to print the causal chain behind a task/actor/object's
+  current state (e.g. "PENDING: waiting for resources {'TPU': 4.0}:
+  feasible on 0/2 alive node(s)").
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import time
+from typing import Any, Dict, List, Optional
+
+from ray_tpu.util import flight_recorder
+from ray_tpu.util.state import _call
+
+
+def cluster_debug_dump(include_events: bool = True,
+                       include_stacks: bool = True,
+                       timeout_s: float = 5.0) -> dict:
+    """Fan out ``debug_dump`` cluster-wide and splice in this driver
+    process's own slice (the head can't dial an in-process driver)."""
+    reply = _call("debug_dump_cluster", {
+        "include_events": include_events,
+        "include_stacks": include_stacks,
+        "timeout_s": timeout_s,
+    })
+    entries = reply.get("entries", [])
+    pids = {e.get("pid") for e in entries if e.get("pid")}
+    if os.getpid() not in pids:
+        local = {
+            "source": "driver",
+            "pid": os.getpid(),
+            "ts": time.time(),
+            "stacks": (flight_recorder.dump_stacks()
+                       if include_stacks else {}),
+        }
+        if include_events:
+            local["events"] = flight_recorder.snapshot()
+        entries.append(local)
+    return {"entries": entries, "ts": reply.get("ts", time.time())}
+
+
+def cluster_stacks(timeout_s: float = 5.0) -> Dict[str, Dict[str, list]]:
+    """``{source: {thread: [frame lines]}}`` for every process."""
+    dump = cluster_debug_dump(include_events=False, timeout_s=timeout_s)
+    out: Dict[str, Dict[str, list]] = {}
+    for entry in dump["entries"]:
+        key = entry.get("source", "?")
+        if entry.get("error"):
+            out[key] = {"<error>": [entry["error"]]}
+        else:
+            out[key] = entry.get("stacks", {})
+    return out
+
+
+def _sanitize(name: str) -> str:
+    return "".join(c if c.isalnum() or c in "-_." else "-" for c in name)
+
+
+def _jsonable_metrics(merged: Dict[str, dict]) -> Dict[str, dict]:
+    """collect_metrics keys values by tag *tuples*; re-shape for json."""
+    out = {}
+    for name, data in merged.items():
+        row = {k: v for k, v in data.items() if k != "values"}
+        row["values"] = [[list(map(list, tk)), v]
+                         for tk, v in data["values"].items()]
+        out[name] = row
+    return out
+
+
+def write_debug_bundle(out_dir: str, timeout_s: float = 10.0) -> dict:
+    """Write a cluster-wide post-mortem bundle and return its manifest.
+
+    Layout: ``rings/<source>.json``, ``stacks/<source>.txt``,
+    ``state/{nodes,workers,actors,tasks,objects,placement_groups,
+    jobs}.json``, ``sched_state.json``, ``metrics.json``,
+    ``timeline.json``, ``manifest.json``. Sections that fail (a dead
+    subsystem is exactly when you need the rest) are recorded in the
+    manifest's ``errors`` instead of aborting the bundle."""
+    os.makedirs(out_dir, exist_ok=True)
+    manifest: Dict[str, Any] = {"created": time.time(), "errors": {},
+                                "sources": [], "nodes": []}
+
+    dump = cluster_debug_dump(timeout_s=timeout_s)
+    rings_dir = os.path.join(out_dir, "rings")
+    stacks_dir = os.path.join(out_dir, "stacks")
+    os.makedirs(rings_dir, exist_ok=True)
+    os.makedirs(stacks_dir, exist_ok=True)
+    nodes_seen = set()
+    for entry in dump["entries"]:
+        source = _sanitize(entry.get("source", "unknown"))
+        manifest["sources"].append(entry.get("source", "unknown"))
+        if entry.get("node_id"):
+            nodes_seen.add(entry["node_id"])
+        if entry.get("error"):
+            manifest["errors"][source] = entry["error"]
+            continue
+        with open(os.path.join(rings_dir, f"{source}.json"), "w") as f:
+            json.dump({k: v for k, v in entry.items() if k != "stacks"},
+                      f, indent=1)
+        with open(os.path.join(stacks_dir, f"{source}.txt"), "w") as f:
+            for thread, frames in (entry.get("stacks") or {}).items():
+                f.write(f"--- {thread} ---\n")
+                for line in frames:
+                    f.write(line + "\n")
+                f.write("\n")
+    manifest["nodes"] = sorted(nodes_seen)
+
+    state_dir = os.path.join(out_dir, "state")
+    os.makedirs(state_dir, exist_ok=True)
+    from ray_tpu.util import state as ust
+
+    tables = {
+        "nodes": ust.list_nodes,
+        "workers": ust.list_workers,
+        "actors": ust.list_actors,
+        "tasks": lambda: ust.list_tasks(limit=10000),
+        "objects": ust.list_objects,
+        "placement_groups": ust.list_placement_groups,
+        "jobs": ust.list_jobs,
+    }
+    for name, fn in tables.items():
+        try:
+            with open(os.path.join(state_dir, f"{name}.json"), "w") as f:
+                json.dump(fn(), f, indent=1, default=str)
+        except Exception as e:  # noqa: BLE001 — partial bundles are fine
+            manifest["errors"][f"state/{name}"] = f"{type(e).__name__}: {e}"
+
+    for name, producer in (
+        ("sched_state.json", lambda: _call("debug_sched_state")),
+        ("metrics.json", _collect_metrics_json),
+        ("timeline.json", _timeline_json),
+    ):
+        try:
+            with open(os.path.join(out_dir, name), "w") as f:
+                json.dump(producer(), f, indent=1, default=str)
+        except Exception as e:  # noqa: BLE001
+            manifest["errors"][name] = f"{type(e).__name__}: {e}"
+
+    with open(os.path.join(out_dir, "manifest.json"), "w") as f:
+        json.dump(manifest, f, indent=1)
+    return manifest
+
+
+def _collect_metrics_json():
+    from ray_tpu.util import metrics as um
+
+    return _jsonable_metrics(um.collect_metrics())
+
+
+def _timeline_json():
+    from ray_tpu.util.timeline import timeline
+
+    return timeline(None)
+
+
+# ---------------------------------------------------------------------------
+# the "why is it stuck" explainer
+# ---------------------------------------------------------------------------
+
+def why(kind: str, ident: str, timeout_s: float = 5.0) -> str:
+    """Explain a task/actor/object's current state causally. ``ident``
+    is a full or prefix hex id (objects need the full hex to consult
+    the directory). One cluster-wide ring fetch serves every evidence
+    trail the explanation needs (including the object→task recursion)."""
+    kind = kind.lower()
+    ident = ident.lower()
+    try:
+        dump = cluster_debug_dump(include_stacks=False,
+                                  timeout_s=timeout_s)
+    except Exception:
+        dump = {"entries": []}
+    if kind == "task":
+        return "\n".join(_why_task(ident, dump))
+    if kind == "actor":
+        return "\n".join(_why_actor(ident, dump))
+    if kind == "object":
+        return "\n".join(_why_object(ident, dump))
+    raise ValueError(f"unknown kind {kind!r} (task|actor|object)")
+
+
+def _matching_flight_events(tag_key: str, ident: str, dump: dict,
+                            limit: int = 12) -> List[str]:
+    """Recorded events from an already-fetched cluster dump whose
+    ``tag_key`` tag matches the id prefix — the flight recorder is the
+    causal evidence trail."""
+    rows = []
+    for entry in dump["entries"]:
+        for ev in entry.get("events") or []:
+            tags = ev.get("tags") or {}
+            value = str(tags.get(tag_key, ""))
+            # Tags hold truncated ids; match on either being a prefix
+            # of the other.
+            if value and (value.startswith(ident)
+                          or ident.startswith(value)):
+                rows.append((ev["ts"], entry.get("source", "?"), ev))
+    rows.sort(key=lambda r: r[0])
+    out = []
+    for ts, source, ev in rows[-limit:]:
+        tags = ev.get("tags") or {}
+        detail = ", ".join(f"{k}={v}" for k, v in tags.items()
+                           if k != tag_key)
+        stamp = time.strftime("%H:%M:%S", time.localtime(ts))
+        out.append(f"  [{stamp}] {source}: {ev['subsystem']}/"
+                   f"{ev['event']} ({detail})" if detail else
+                   f"  [{stamp}] {source}: {ev['subsystem']}/"
+                   f"{ev['event']}")
+    return out
+
+
+def _cluster_availability_line(sched: dict) -> str:
+    parts = []
+    for n in sched.get("nodes", []):
+        parts.append(f"{n['node_id'][:8]}[{n['state']}] "
+                     f"avail={n.get('available', {})}")
+    return "; ".join(parts)
+
+
+def _why_task(ident: str, dump: dict) -> List[str]:
+    lines: List[str] = []
+    sched = _call("debug_sched_state")
+    pend = [p for p in sched.get("pending", [])
+            if p["task_id"].startswith(ident)]
+    from ray_tpu.util import state as ust
+
+    events = [e for e in ust.list_task_events(limit=100000)
+              if e["task_id"].startswith(ident)]
+    if pend:
+        p = pend[0]
+        what = ("actor creation" if p["is_actor_creation"] else "task")
+        lines.append(f"{what} {p['name'] or p['task_id'][:16]} is "
+                     f"PENDING (queued {p['age_s']:.1f}s)")
+        lines.append(f"  last scheduler decision: "
+                     f"{p['wait_reason'] or 'not yet evaluated'}")
+        lines.append(f"  requested resources: {p['resources']} "
+                     f"(strategy: {p['strategy']})")
+        lines.append(f"  cluster: {_cluster_availability_line(sched)}")
+        for pg in sched.get("pgs", []):
+            if pg["pg_id"][:8] in (p["wait_reason"] or ""):
+                lines.append(
+                    f"  placement group {pg['pg_id'][:8]}: "
+                    f"{pg['state']}, {pg['bundles_placed']}/"
+                    f"{pg['bundles']} bundles placed "
+                    f"({pg['strategy']})")
+    elif events:
+        last = events[-1]
+        state = last["state"]
+        age = time.time() - last["ts"]
+        lines.append(f"task {last.get('name') or last['task_id'][:16]} "
+                     f"is {state} (for {age:.1f}s)")
+        if state == "RUNNING":
+            lines.append(f"  executing on worker "
+                         f"{(last.get('worker_id') or '?')[:12]} — "
+                         f"`ray_tpu debug stacks` shows its frames")
+        elif state == "PENDING_EXECUTION":
+            lines.append(f"  queued on leased worker "
+                         f"{(last.get('worker_id') or '?')[:12]}, "
+                         f"waiting for the executor")
+        elif state == "FAILED":
+            lines.append("  terminal failure — the error object holds "
+                         "the traceback (get() raises it)")
+    else:
+        lines.append(f"no records for task id {ident!r}: it never "
+                     "reached the scheduler or event store (wrong id, "
+                     "or events already rotated out)")
+    trail = _matching_flight_events("task", ident, dump)
+    if trail:
+        lines.append("recorded events:")
+        lines.extend(trail)
+    return lines
+
+
+def _why_actor(ident: str, dump: dict) -> List[str]:
+    from ray_tpu.util import state as ust
+
+    lines: List[str] = []
+    actors = [a for a in ust.list_actors()
+              if a["actor_id"].startswith(ident)]
+    if not actors:
+        return [f"no actor with id prefix {ident!r}"]
+    a = actors[0]
+    name = a.get("name") or a.get("class_name") or a["actor_id"][:16]
+    lines.append(f"actor {name} is {a['state']} "
+                 f"(restarts: {a['num_restarts']}/"
+                 f"{a['max_restarts'] if a['max_restarts'] >= 0 else '∞'})")
+    if a["state"] in ("PENDING", "RESTARTING"):
+        sched = _call("debug_sched_state")
+        creations = [p for p in sched.get("pending", [])
+                     if p.get("actor_id")
+                     and p["actor_id"].startswith(a["actor_id"][:16])]
+        if creations:
+            p = creations[0]
+            lines.append(f"  creation lease pending "
+                         f"{p['age_s']:.1f}s: "
+                         f"{p['wait_reason'] or 'not yet evaluated'}")
+            lines.append(f"  requested resources: {p['resources']}")
+            lines.append(f"  cluster: {_cluster_availability_line(sched)}")
+        else:
+            lines.append("  creation in flight (worker leased, "
+                         "constructor running or being pushed)")
+        if a["state"] == "RESTARTING" and a.get("death_cause"):
+            lines.append(f"  last death: {a['death_cause']}")
+    elif a["state"] == "DEAD":
+        lines.append(f"  death cause: {a.get('death_cause') or 'unknown'}")
+    elif a["state"] == "ALIVE" and a.get("address"):
+        lines.append(f"  running on worker {a['address'][2][:12]} "
+                     f"at {a['address'][0]}:{a['address'][1]}")
+    trail = _matching_flight_events("actor", ident, dump)
+    if trail:
+        lines.append("recorded events:")
+        lines.extend(trail)
+    return lines
+
+
+def _why_object(ident: str, dump: dict) -> List[str]:
+    lines: List[str] = []
+    reply = None
+    try:
+        reply = _call("locate_object", {"object_id": ident})
+    except Exception:
+        pass
+    if reply and reply.get("found"):
+        nodes = reply.get("nodes", [])
+        lines.append(f"object {ident[:16]} is SEALED "
+                     f"({reply.get('size', 0)} bytes) with "
+                     f"{len(nodes)} copy/copies")
+        for n in nodes:
+            lines.append(f"  copy on node {n[:12]}")
+        if not reply.get("locations"):
+            lines.append("  no reachable holder right now — a get() "
+                         "would wait on pull/recovery")
+    else:
+        lines.append(f"object {ident[:16]} is NOT sealed in the "
+                     "cluster store")
+        # Causal walk: an unsealed object is produced by its task.
+        try:
+            from ray_tpu.core.ids import ObjectID
+
+            task_hex = ObjectID.from_hex(ident).task_id().hex()
+            lines.append(f"  producing task {task_hex[:16]}:")
+            lines.extend("  " + ln for ln in _why_task(task_hex, dump))
+        except Exception:
+            lines.append("  (id is not a full object hex; cannot derive "
+                         "the producing task)")
+    trail = _matching_flight_events("object", ident, dump)
+    if trail:
+        lines.append("recorded events:")
+        lines.extend(trail)
+    return lines

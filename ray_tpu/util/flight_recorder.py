@@ -1,0 +1,290 @@
+"""Always-on, near-zero-cost flight recorder.
+
+Reference: Ray's task-event / state-API plane plus ``ray stack`` — when
+a soak stalls or a chaos run dies, metrics say *that* something is
+wrong; reconstructing *why* needs the sequence of decisions every layer
+took. This module keeps a fixed-size per-process ring of structured
+events ``(ts, subsystem, event, severity, tags)`` appended from the hot
+paths of every layer: scheduler placement decisions and wait reasons,
+object lifecycle (spill/restore/pull/free/recover), RPC
+retry/breaker/fault-injection outcomes, GCS node-state transitions,
+collective group create/destroy, train gang health and Serve shedding.
+
+Hot-path contract (the acceptance bar): ``record()`` is one cached
+enabled-bool check plus a single append to a preallocated
+``collections.deque(maxlen=...)`` — deque appends are atomic in
+CPython, so NO lock is taken on the record path and none is ever held
+across I/O. ``snapshot()`` (the cold read path) copies the ring,
+retrying the rare concurrent-mutation race.
+
+The (subsystem, event) namespace is pinned by ``CATALOG`` and linted by
+tests/test_flight_recorder.py: call sites must use literal names from
+the catalog, so names can't drift or collide as instrumentation grows.
+Variable data (ids, counts, reasons) goes in the ``tags``.
+
+On top of the ring, the debug plane (CoreWorker/node-agent
+``debug_dump`` RPC, ``ray_tpu debug`` CLI) ships ring contents plus
+``dump_stacks()`` (live frames of every thread) cluster-wide, and
+``install_crash_handler()`` flushes the ring to a postmortem file in
+the worker log dir when a process dies to an unhandled exception.
+"""
+
+from __future__ import annotations
+
+import collections
+import json
+import os
+import sys
+import threading
+import time
+import traceback
+from typing import Any, Dict, List, Optional
+
+INFO = "info"
+WARN = "warn"
+ERROR = "error"
+SEVERITIES = (INFO, WARN, ERROR)
+
+#: subsystem -> allowed event names. The single source of truth for the
+#: recorder namespace; the tier-1 lint in tests/test_flight_recorder.py
+#: statically checks every ``record(...)`` call site against this table
+#: (and that every declared event is actually recorded somewhere).
+CATALOG: Dict[str, tuple] = {
+    # core/scheduler.py + core/gcs.py lease plane
+    "sched": ("lease_granted", "lease_wait", "lease_infeasible"),
+    # object lifecycle (core/object_store.py, core/object_transfer.py,
+    # core/core_worker.py)
+    "object": ("sealed", "spilled", "restored", "pulled", "freed",
+               "lost", "recovered"),
+    # core/rpc.py + core/retry.py
+    "rpc": ("fault_injected", "conn_lost", "retry",
+            "deadline_exhausted", "breaker_open", "breaker_closed"),
+    # core/gcs.py cluster membership
+    "gcs": ("node_alive", "node_suspect", "node_dead",
+            "node_reattached", "worker_dead", "actor_state"),
+    # collective/collective.py
+    "collective": ("group_created", "group_destroyed"),
+    # train/backend_executor.py + train/trainer.py
+    "train": ("heartbeat_miss", "gang_abort", "gang_restart",
+              "elastic_resize"),
+    # serve/router.py
+    "serve": ("replica_shed",),
+    # the debug plane itself (util/flight_recorder.py)
+    "debug": ("postmortem",),
+}
+
+_DEFAULT_CAPACITY = 2048
+
+_enabled: Optional[bool] = None
+_ring: Optional[collections.deque] = None
+# Guards ring (re)creation and snapshot retries only — NEVER taken by
+# record()'s append.
+_setup_lock = threading.Lock()
+
+
+def enabled() -> bool:
+    """Cached per-process switch (config ``flight_recorder_enabled`` /
+    ``RAY_TPU_FLIGHT_RECORDER_ENABLED``). Default on — the recorder is
+    the post-mortem evidence plane; its idle cost is one deque append."""
+    global _enabled
+    if _enabled is None:
+        try:
+            from ray_tpu.core.config import get_config
+
+            _enabled = bool(get_config().flight_recorder_enabled)
+        except Exception:
+            _enabled = os.environ.get(
+                "RAY_TPU_FLIGHT_RECORDER_ENABLED", "1").lower() not in (
+                    "0", "false", "no")
+    return _enabled
+
+
+def _capacity() -> int:
+    try:
+        from ray_tpu.core.config import get_config
+
+        return max(16, int(get_config().flight_recorder_capacity))
+    except Exception:
+        try:
+            return max(16, int(os.environ.get(
+                "RAY_TPU_FLIGHT_RECORDER_CAPACITY", _DEFAULT_CAPACITY)))
+        except ValueError:
+            return _DEFAULT_CAPACITY
+
+
+def _get_ring() -> collections.deque:
+    global _ring
+    ring = _ring
+    if ring is None:
+        with _setup_lock:
+            if _ring is None:
+                _ring = collections.deque(maxlen=_capacity())
+            ring = _ring
+    return ring
+
+
+def record(subsystem: str, event: str, severity: str = INFO,
+           **tags: Any) -> None:
+    """Append one event. ``subsystem`` and ``event`` MUST be literal
+    names from ``CATALOG`` (lint-enforced); variable detail rides in
+    ``tags``. Hot-path cost when enabled: one time() call + one atomic
+    deque append; when disabled: one cached bool check."""
+    if not enabled():
+        return
+    ring = _ring
+    if ring is None:
+        ring = _get_ring()
+    ring.append((time.time(), subsystem, event, severity, tags or None))
+
+
+def snapshot(limit: Optional[int] = None) -> List[dict]:
+    """The ring as a list of dicts, oldest first. Copying may race a
+    concurrent append (CPython raises on mutation-during-iteration);
+    retry a few times, then fall back to a locked copy-by-pop-free
+    best effort."""
+    ring = _ring
+    if ring is None:
+        return []
+    # record() is deliberately lock-free, so nothing can quiesce the
+    # writers; just retry the copy. Each attempt only fails if an
+    # append lands mid-iteration, so consecutive failures decay
+    # geometrically — 20 in a row is effectively impossible.
+    items = None
+    for _ in range(20):
+        try:
+            items = list(ring)
+            break
+        except RuntimeError:
+            continue
+    if items is None:
+        return []
+    if limit is not None:
+        items = items[-limit:]
+    out = []
+    for ts, subsystem, event, severity, tags in items:
+        row = {"ts": ts, "subsystem": subsystem, "event": event,
+               "severity": severity}
+        if tags:
+            row["tags"] = {k: _coerce(v) for k, v in tags.items()}
+        out.append(row)
+    return out
+
+
+def _coerce(value: Any):
+    """Tags must survive msgpack/json on the debug plane."""
+    if value is None or isinstance(value, (str, int, float, bool)):
+        return value
+    return str(value)
+
+
+def reset_for_testing(capacity: Optional[int] = None) -> None:
+    """Drop cached state; optionally pin a new ring capacity."""
+    global _enabled, _ring
+    with _setup_lock:
+        _enabled = None
+        if capacity is not None:
+            _ring = collections.deque(maxlen=max(1, capacity))
+        else:
+            _ring = None
+
+
+# ---------------------------------------------------------------------------
+# live stacks (the `ray stack` analog, stdlib-only)
+# ---------------------------------------------------------------------------
+
+def dump_stacks() -> Dict[str, List[str]]:
+    """Current stacks of every thread in this process, formatted —
+    ``{"<thread name> (<ident>)": [frame lines...]}``. Like a
+    faulthandler dump but returned as data instead of written to an fd,
+    so it can ride the debug-dump RPC."""
+    threads = {t.ident: t for t in threading.enumerate()}
+    out: Dict[str, List[str]] = {}
+    for ident, frame in sys._current_frames().items():
+        t = threads.get(ident)
+        name = f"{t.name if t is not None else '?'} ({ident})"
+        try:
+            lines = traceback.format_stack(frame)
+        except Exception:
+            lines = ["<unreadable stack>\n"]
+        out[name] = [ln.rstrip("\n") for ln in lines]
+    return out
+
+
+# ---------------------------------------------------------------------------
+# crash postmortem
+# ---------------------------------------------------------------------------
+
+def postmortem_dir() -> str:
+    base = os.environ.get("RAY_TPU_SESSION_DIR")
+    if base:
+        return os.path.join(base, "logs")
+    import tempfile
+
+    return os.path.join(tempfile.gettempdir(), "ray_tpu", "postmortem")
+
+
+def flush_postmortem(reason: str, out_dir: Optional[str] = None
+                     ) -> Optional[str]:
+    """Write the ring + all-thread stacks to
+    ``<log dir>/postmortem-<pid>.json``; returns the path (None when
+    the write itself fails — a crashing process must never crash harder
+    in its crash handler)."""
+    record("debug", "postmortem", severity=ERROR, reason=reason[:500])
+    path = os.path.join(out_dir or postmortem_dir(),
+                        f"postmortem-{os.getpid()}.json")
+    payload = {
+        "pid": os.getpid(),
+        "ts": time.time(),
+        "reason": reason,
+        "worker_id": os.environ.get("RAY_TPU_WORKER_ID"),
+        "node_id": os.environ.get("RAY_TPU_NODE_ID"),
+        "events": snapshot(),
+        "stacks": dump_stacks(),
+    }
+    try:
+        os.makedirs(os.path.dirname(path), exist_ok=True)
+        tmp = path + ".tmp"
+        with open(tmp, "w") as f:
+            json.dump(payload, f)
+        os.replace(tmp, path)
+        return path
+    except Exception:
+        return None
+
+
+_crash_handler_installed = False
+
+
+def install_crash_handler() -> None:
+    """Chain onto ``sys.excepthook`` / ``threading.excepthook`` so an
+    unhandled crash anywhere in the process flushes the ring as a
+    postmortem file before the interpreter dies. Idempotent."""
+    global _crash_handler_installed
+    if _crash_handler_installed:
+        return
+    _crash_handler_installed = True
+    prev_sys = sys.excepthook
+
+    def on_crash(exc_type, exc, tb):
+        try:
+            flush_postmortem(f"{exc_type.__name__}: {exc}")
+        except Exception:
+            pass
+        prev_sys(exc_type, exc, tb)
+
+    sys.excepthook = on_crash
+    prev_thread = threading.excepthook
+
+    def on_thread_crash(args):
+        # SystemExit from daemon threads is routine teardown, not a
+        # crash worth a postmortem.
+        if args.exc_type is not SystemExit:
+            try:
+                flush_postmortem(
+                    f"{args.exc_type.__name__}: {args.exc_value} "
+                    f"(thread {getattr(args.thread, 'name', '?')})")
+            except Exception:
+                pass
+        prev_thread(args)
+
+    threading.excepthook = on_thread_crash

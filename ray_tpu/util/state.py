@@ -49,13 +49,19 @@ def list_placement_groups() -> List[Dict[str, Any]]:
 def list_tasks(*, limit: int = 1000,
                filters: Optional[List[tuple]] = None
                ) -> List[Dict[str, Any]]:
-    """Latest state per task, from the task-event store."""
-    events = _call("list_task_events", {"limit": 10 * limit})["events"]
+    """Latest state per task, from the task-event store. Filters apply
+    BEFORE the limit truncation — filtering a window that was already
+    truncated would silently drop matching rows older than the newest
+    ``limit`` tasks. Filtered queries fetch the store's whole retained
+    window for the same reason (the head ring is bounded by
+    ``task_events_max_buffer_size``, so this is capped server-side)."""
+    fetch = 10 * limit if not filters else max(10 * limit, 1_000_000)
+    events = _call("list_task_events", {"limit": fetch})["events"]
     latest: Dict[str, Dict[str, Any]] = {}
     for ev in events:
         latest[ev["task_id"]] = ev
-    tasks = list(latest.values())[-limit:]
-    return _apply_filters(tasks, filters)
+    tasks = _apply_filters(list(latest.values()), filters)
+    return tasks[-limit:]
 
 
 def list_task_events(*, limit: int = 1000) -> List[Dict[str, Any]]:
@@ -78,7 +84,23 @@ def summarize_actors() -> Dict[str, int]:
     return dict(out)
 
 
+def summarize_objects() -> Dict[str, Dict[str, int]]:
+    """Cluster store occupancy by object state — ``{state: {"count",
+    "bytes"}}`` (states: SEALED / SPILLED / LOST)."""
+    summary: Dict[str, Dict[str, int]] = {}
+    for obj in list_objects():
+        entry = summary.setdefault(obj.get("state", "SEALED"),
+                                   {"count": 0, "bytes": 0})
+        entry["count"] += 1
+        entry["bytes"] += int(obj.get("size_bytes") or 0)
+    return summary
+
+
 def _apply_filters(rows: List[dict], filters) -> List[dict]:
+    """Filter rows by ``(key, op, value)`` triples. Ops: ``=``/``==``,
+    ``!=``, ``in`` (row value ∈ given collection), ``contains`` (given
+    value ∈ row's value — substring / membership), ``<`` and ``>``
+    (numeric; non-numeric rows never match)."""
     if not filters:
         return rows
     out = []
@@ -90,6 +112,24 @@ def _apply_filters(rows: List[dict], filters) -> List[dict]:
                 ok = have == value
             elif op == "!=":
                 ok = have != value
+            elif op == "in":
+                try:
+                    ok = have in value
+                except TypeError:
+                    ok = False
+            elif op == "contains":
+                try:
+                    ok = have is not None and value in have
+                except TypeError:
+                    ok = False
+            elif op in ("<", ">"):
+                try:
+                    have_f, value_f = float(have), float(value)
+                except (TypeError, ValueError):
+                    ok = False
+                else:
+                    ok = (have_f < value_f if op == "<"
+                          else have_f > value_f)
             else:
                 raise ValueError(f"unsupported filter op {op!r}")
             if not ok:

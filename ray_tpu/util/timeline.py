@@ -6,8 +6,13 @@ task state transitions from the event store become complete events
 
 Beyond task events, the export merges the telemetry event stream
 (util/telemetry.py) into extra lanes: object transfers (pulls, spills,
-restores), retries, and circuit-breaker trips each get their own track,
-so a fault-injection soak reads as one coherent picture.
+restores), retries, circuit-breaker trips, and the train-plane health
+lanes (heartbeat misses, hang/death attributions, gang aborts, elastic
+resizes) each get their own track, so a fault-injection soak reads as
+one coherent picture. The local flight-recorder ring
+(util/flight_recorder.py) is merged the same way under ``fr:<subsystem>``
+lanes — scheduler wait reasons and node-state transitions land next to
+the task lanes they explain.
 """
 
 from __future__ import annotations
@@ -77,9 +82,31 @@ def telemetry_trace_events(events: List[dict]) -> List[dict]:
     return trace
 
 
+def flight_trace_events(events: List[dict]) -> List[dict]:
+    """Convert flight-recorder snapshot rows into chrome-tracing
+    instant events, one lane per subsystem (``fr:sched``, ``fr:gcs``,
+    ...)."""
+    trace: List[dict] = []
+    for ev in events:
+        subsystem = ev.get("subsystem", "?")
+        trace.append({
+            "name": ev.get("event", "?"),
+            "cat": f"fr:{subsystem}",
+            "ts": float(ev.get("ts", 0.0)) * 1e6,
+            "ph": "i",
+            "s": "p",
+            "pid": "ray_tpu",
+            "tid": f"fr:{subsystem}",
+            "args": dict(ev.get("tags") or {},
+                         severity=ev.get("severity", "info")),
+        })
+    return trace
+
+
 def timeline(filename: Optional[str] = None,
              events: Optional[List[dict]] = None,
-             include_telemetry: bool = True) -> List[dict]:
+             include_telemetry: bool = True,
+             include_flight: bool = True) -> List[dict]:
     if events is None:
         from ray_tpu.util.state import list_task_events
 
@@ -93,6 +120,13 @@ def timeline(filename: Optional[str] = None,
                 telemetry_trace_events(telemetry.collect_timeline_events()))
         except Exception:
             pass  # no cluster attached / nothing pushed yet
+    if include_flight:
+        try:
+            from ray_tpu.util import flight_recorder
+
+            trace.extend(flight_trace_events(flight_recorder.snapshot()))
+        except Exception:
+            pass
     if filename:
         with open(filename, "w") as f:
             json.dump(trace, f)

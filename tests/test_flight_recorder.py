@@ -1,0 +1,232 @@
+"""Flight recorder: ring semantics, the pinned event-name catalog, and
+crash postmortems (no cluster needed — these are the tier-1 unit lanes;
+the e2e debug plane lives in tests/test_debug_dump.py)."""
+
+import json
+import re
+import threading
+
+import ray_tpu
+from ray_tpu.util import flight_recorder as fr
+
+
+def teardown_function(_fn):
+    fr.reset_for_testing()
+
+
+# ---------------------------------------------------------------------------
+# ring semantics
+# ---------------------------------------------------------------------------
+
+def test_ring_bounded_and_overwrites_in_order():
+    fr.reset_for_testing(capacity=8)
+    for i in range(20):
+        fr.record("sched", "lease_wait", i=i)
+    events = fr.snapshot()
+    assert len(events) == 8
+    # Oldest entries were overwritten; survivors are the newest 20-8..19
+    # in append order.
+    assert [e["tags"]["i"] for e in events] == list(range(12, 20))
+    assert all(e["subsystem"] == "sched" for e in events)
+    ts = [e["ts"] for e in events]
+    assert ts == sorted(ts)
+
+
+def test_record_fields_and_severity():
+    fr.reset_for_testing(capacity=16)
+    fr.record("gcs", "node_dead", severity="error", node="abc123",
+              detail=None, count=3, obj=object())
+    (ev,) = fr.snapshot()
+    assert ev["event"] == "node_dead"
+    assert ev["severity"] == "error"
+    assert ev["tags"]["node"] == "abc123"
+    assert ev["tags"]["count"] == 3
+    # Non-primitive tag values are coerced so the debug-dump RPC can
+    # always serialize a snapshot.
+    assert isinstance(ev["tags"]["obj"], str)
+
+
+def test_snapshot_limit():
+    fr.reset_for_testing(capacity=32)
+    for i in range(10):
+        fr.record("rpc", "retry", i=i)
+    assert [e["tags"]["i"] for e in fr.snapshot(limit=3)] == [7, 8, 9]
+
+
+def test_thread_safety_under_concurrent_append_and_snapshot():
+    fr.reset_for_testing(capacity=128)
+    errors = []
+    stop = threading.Event()
+
+    def writer(tid):
+        try:
+            for i in range(500):
+                fr.record("rpc", "retry", tid=tid, i=i)
+        except Exception as e:  # noqa: BLE001
+            errors.append(e)
+
+    def reader():
+        try:
+            while not stop.is_set():
+                for ev in fr.snapshot():
+                    assert ev["subsystem"] == "rpc"
+        except Exception as e:  # noqa: BLE001
+            errors.append(e)
+
+    threads = [threading.Thread(target=writer, args=(t,))
+               for t in range(8)]
+    snap_threads = [threading.Thread(target=reader) for _ in range(2)]
+    for t in threads + snap_threads:
+        t.start()
+    for t in threads:
+        t.join()
+    stop.set()
+    for t in snap_threads:
+        t.join()
+    assert not errors
+    assert len(fr.snapshot()) == 128
+
+
+def test_disabled_recorder_is_a_noop():
+    from ray_tpu.core.config import get_config
+
+    cfg = get_config()
+    old = cfg.flight_recorder_enabled
+    cfg.flight_recorder_enabled = False
+    try:
+        fr.reset_for_testing(capacity=8)
+        fr.record("sched", "lease_wait", i=1)
+        assert fr.snapshot() == []
+    finally:
+        cfg.flight_recorder_enabled = old
+        fr.reset_for_testing()
+
+
+# ---------------------------------------------------------------------------
+# the pinned (subsystem, event) catalog — the telemetry-catalog lint's
+# sibling: call sites use literal names, so this static scan is exact.
+# ---------------------------------------------------------------------------
+
+_CALL_RE = re.compile(
+    r"""(?:flight_recorder\.|_fr\(\)\.|(?<![\w.]))record\(\s*
+        ['"]([a-z0-9_]+)['"]\s*,\s*['"]([a-z0-9_]+)['"]""",
+    re.VERBOSE)
+
+
+def _recorded_pairs():
+    import pathlib
+
+    pkg = pathlib.Path(ray_tpu.__file__).parent
+    pairs = {}
+    for path in pkg.rglob("*.py"):
+        text = path.read_text()
+        for m in _CALL_RE.finditer(text):
+            pairs.setdefault((m.group(1), m.group(2)), []).append(
+                str(path.relative_to(pkg)))
+    return pairs
+
+
+def test_catalog_names_conform():
+    name_re = re.compile(r"^[a-z][a-z0-9_]*$")
+    assert fr.CATALOG, "catalog must not be empty"
+    for subsystem, events in fr.CATALOG.items():
+        assert name_re.match(subsystem), subsystem
+        assert events, f"subsystem {subsystem} declares no events"
+        assert len(set(events)) == len(events), (
+            f"duplicate event names in {subsystem}")
+        for event in events:
+            assert name_re.match(event), f"{subsystem}/{event}"
+
+
+def test_every_call_site_uses_a_catalog_name():
+    pairs = _recorded_pairs()
+    assert pairs, "no flight_recorder.record call sites found"
+    stray = {p: files for p, files in pairs.items()
+             if p[0] not in fr.CATALOG or p[1] not in fr.CATALOG[p[0]]}
+    assert not stray, (
+        "record() call sites outside flight_recorder.CATALOG "
+        f"(add them to the catalog or fix the name): {stray}")
+
+
+def test_every_catalog_event_has_a_call_site():
+    """The reverse direction: a catalog entry nothing records is drift —
+    either the call site was renamed (silently orphaning the name) or
+    the event was removed without updating the pin."""
+    pairs = set(_recorded_pairs())
+    dead = [(s, e) for s, events in fr.CATALOG.items()
+            for e in events if (s, e) not in pairs]
+    assert not dead, f"catalog events never recorded anywhere: {dead}"
+
+
+# ---------------------------------------------------------------------------
+# postmortem + stacks
+# ---------------------------------------------------------------------------
+
+def test_dump_stacks_sees_this_thread():
+    stacks = fr.dump_stacks()
+    assert any("MainThread" in name for name in stacks)
+    joined = "\n".join("\n".join(v) for v in stacks.values())
+    assert "test_dump_stacks_sees_this_thread" in joined
+
+
+def test_flush_postmortem(tmp_path):
+    fr.reset_for_testing(capacity=32)
+    fr.record("gcs", "node_dead", severity="error", node="deadbeef")
+    path = fr.flush_postmortem("BoomError: synthetic", str(tmp_path))
+    assert path is not None
+    data = json.loads(open(path).read())
+    assert data["reason"].startswith("BoomError")
+    assert any(e["event"] == "node_dead" for e in data["events"])
+    # The flush itself is recorded as evidence.
+    assert any(e["event"] == "postmortem" for e in data["events"])
+    assert data["stacks"]
+
+
+# ---------------------------------------------------------------------------
+# timeline merge (flight lanes ride next to task/telemetry lanes)
+# ---------------------------------------------------------------------------
+
+def test_timeline_merges_flight_lanes():
+    from ray_tpu.util.timeline import timeline
+
+    fr.reset_for_testing(capacity=32)
+    fr.record("sched", "lease_wait", severity="warn", reason="no TPU")
+    fr.record("train", "heartbeat_miss", severity="warn", rank=2)
+    trace = timeline(events=[], include_telemetry=False)
+    lanes = {ev["tid"] for ev in trace}
+    assert "fr:sched" in lanes and "fr:train" in lanes
+    hb = next(ev for ev in trace if ev["tid"] == "fr:train")
+    assert hb["name"] == "heartbeat_miss"
+    assert hb["args"]["rank"] == 2
+    assert hb["args"]["severity"] == "warn"
+
+
+# ---------------------------------------------------------------------------
+# state-API satellite: the extended filter ops (pure function)
+# ---------------------------------------------------------------------------
+
+def test_apply_filters_extended_ops():
+    from ray_tpu.util.state import _apply_filters
+
+    rows = [
+        {"name": "alpha", "state": "RUNNING", "dur": 1.5},
+        {"name": "beta", "state": "FAILED", "dur": 9.0},
+        {"name": "gamma", "state": "FINISHED", "dur": None},
+    ]
+    assert [r["name"] for r in _apply_filters(
+        rows, [("state", "in", ("RUNNING", "FAILED"))])] == ["alpha",
+                                                             "beta"]
+    assert [r["name"] for r in _apply_filters(
+        rows, [("name", "contains", "am")])] == ["gamma"]
+    assert [r["name"] for r in _apply_filters(
+        rows, [("dur", ">", 2)])] == ["beta"]
+    # None / non-numeric rows never match numeric comparisons.
+    assert [r["name"] for r in _apply_filters(
+        rows, [("dur", "<", 2)])] == ["alpha"]
+    # A row missing the key never matches 'in' (no TypeError against a
+    # string collection).
+    assert _apply_filters(rows, [("missing", "in", "abc")]) == []
+    import pytest
+
+    with pytest.raises(ValueError):
+        _apply_filters(rows, [("name", "~", "x")])

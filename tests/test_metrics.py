@@ -185,7 +185,10 @@ def test_timeline_emits_open_begin_events():
         {"task_id": "t2", "state": "RUNNING", "ts": 1.5, "name": "hung",
          "worker_id": "w2", "type": "NORMAL_TASK"},
     ]
-    trace = tl.timeline(events=events, include_telemetry=False)
+    # Task-lane semantics only: exclude the telemetry and
+    # flight-recorder lanes that otherwise merge into the export.
+    trace = tl.timeline(events=events, include_telemetry=False,
+                        include_flight=False)
     by_ph = {ev["ph"]: ev for ev in trace}
     assert set(by_ph) == {"X", "B"}
     assert by_ph["X"]["name"] == "f"
