@@ -99,6 +99,7 @@ def main():
     achieved = flops / dt
     mfu = achieved / peak_flops_per_chip()
     _run_core_bench()
+    _run_serve_stream_bench()
     print(json.dumps({
         "metric": "train_mfu",
         "value": round(mfu, 4),
@@ -121,6 +122,29 @@ def _run_core_bench():
     try:
         subprocess.run(
             [sys.executable, "-m", "ray_tpu.scripts.microbenchmark",
+             "--json", out],
+            timeout=300, stdout=subprocess.DEVNULL,
+            stderr=subprocess.DEVNULL,
+            env={**os.environ, "JAX_PLATFORMS": "cpu"},
+        )
+    except Exception:
+        pass
+
+
+def _run_serve_stream_bench():
+    """Side artifact: serve streaming quality (TTFT, inter-chunk
+    p50/p99, chunks/s at N concurrent streams) written to
+    BENCH_SERVE_STREAM.json — the perf trajectory covers the streaming
+    plane from day one. Never allowed to break the headline metric."""
+    import os
+    import subprocess
+    import sys
+
+    out = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                       "BENCH_SERVE_STREAM.json")
+    try:
+        subprocess.run(
+            [sys.executable, "-m", "ray_tpu.scripts.serve_stream_bench",
              "--json", out],
             timeout=300, stdout=subprocess.DEVNULL,
             stderr=subprocess.DEVNULL,

@@ -352,6 +352,9 @@ _TASK_DEFAULTS = dict(
     num_cpus=1.0, num_tpus=0.0, resources=None, num_returns=1,
     max_retries=3, retry_exceptions=False, name="",
     scheduling_strategy=None, runtime_env=None, memory=None,
+    # Streaming-generator backpressure: max produced-but-unread chunks
+    # before the generator body pauses (0 = unbounded).
+    max_queued_stream_chunks=0,
 )
 
 _ACTOR_DEFAULTS = dict(
@@ -453,6 +456,7 @@ class RemoteFunction:
             retry_exceptions=opts["retry_exceptions"],
             scheduling_strategy=_build_strategy(opts),
             runtime_env=opts["runtime_env"],
+            stream_window=int(opts.get("max_queued_stream_chunks") or 0),
         )
         if n == -1:
             return refs  # an ObjectRefGenerator
@@ -476,13 +480,25 @@ class RemoteFunction:
 
 class ActorMethod:
     def __init__(self, handle: "ActorHandle", method_name: str,
-                 num_returns: int = 1):
+                 num_returns: int = 1,
+                 max_queued_stream_chunks: int = 0):
         self._handle = handle
         self._method_name = method_name
         self._num_returns = num_returns
+        self._max_queued_stream_chunks = max_queued_stream_chunks
 
-    def options(self, num_returns: int = 1, **_ignored) -> "ActorMethod":
-        return ActorMethod(self._handle, self._method_name, num_returns)
+    def options(self, num_returns=None,
+                max_queued_stream_chunks: Optional[int] = None,
+                **_ignored) -> "ActorMethod":
+        # None sentinels preserve the method's current settings, so
+        # .options(num_returns="streaming").options(
+        #     max_queued_stream_chunks=3) composes.
+        return ActorMethod(
+            self._handle, self._method_name,
+            self._num_returns if num_returns is None else num_returns,
+            (self._max_queued_stream_chunks
+             if max_queued_stream_chunks is None
+             else max_queued_stream_chunks))
 
     def bind(self, *args, **kwargs):
         """Build a lazy actor-method DAG node (reference: ray.dag
@@ -493,21 +509,25 @@ class ActorMethod:
                                kwargs)
 
     def remote(self, *args, **kwargs):
-        if self._num_returns == "streaming":
-            raise TypeError(
-                "num_returns='streaming' is not supported on actor "
-                "methods yet; use a streaming task")
         cw = _require_worker()
+        n = self._num_returns
+        if n == "streaming":
+            n = -1  # TaskSpec.STREAMING — the method must return a
+            # generator; validated executor-side (the callable lives in
+            # the actor's process, not here).
         task_args = cw.serialize_args(args, kwargs)
         refs = cw.submit_actor_task(
             self._handle._actor_id,
             self._method_name,
             task_args,
-            num_returns=self._num_returns,
+            num_returns=n,
+            stream_window=int(self._max_queued_stream_chunks or 0),
         )
-        if self._num_returns == 0:
+        if n == -1:
+            return refs  # an ObjectRefGenerator
+        if n == 0:
             return None
-        if self._num_returns == 1:
+        if n == 1:
             return refs[0]
         return refs
 
@@ -609,9 +629,13 @@ class ActorClass:
 
 def _class_is_async(cls) -> bool:
     for name, member in inspect.getmembers(cls):
-        if name.startswith("__"):
+        # __call__ counts: `async def __call__` (the serve token-stream
+        # shape) must put the actor on the async executor or its async
+        # generator would be rejected by the sync streaming lane.
+        if name.startswith("__") and name != "__call__":
             continue
-        if inspect.iscoroutinefunction(member):
+        if (inspect.iscoroutinefunction(member)
+                or inspect.isasyncgenfunction(member)):
             return True
     return False
 

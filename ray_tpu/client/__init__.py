@@ -181,7 +181,10 @@ class ClientWorker:
                     name: str, num_returns: int,
                     resources: Dict[str, float], max_retries: int,
                     retry_exceptions: bool, scheduling_strategy,
-                    runtime_env=None) -> List[ObjectRef]:
+                    runtime_env=None,
+                    stream_window: int = 0) -> List[ObjectRef]:
+        # stream_window accepted for API parity; the proxy rejects
+        # streaming submissions (num_returns == -1) server-side.
         reply = self._call("c_task", {
             "key": function_key, "args": args_blob,
             "opts": cloudpickle.dumps({
@@ -217,7 +220,8 @@ class ClientWorker:
 
     def submit_actor_task(self, actor_id: ActorID, method_name: str,
                           args_blob: bytes, *, num_returns: int,
-                          name: str = "") -> List[ObjectRef]:
+                          name: str = "",
+                          stream_window: int = 0) -> List[ObjectRef]:
         reply = self._call("c_actor_call", {
             "actor_id": actor_id.hex(), "method": method_name,
             "args": args_blob, "num_returns": num_returns,

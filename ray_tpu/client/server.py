@@ -235,6 +235,12 @@ class ClientServer:
         from ray_tpu.core.ids import ActorID
 
         cw = api._require_worker()
+        if payload["num_returns"] == -1:
+            raise NotImplementedError(
+                "streaming actor calls (num_returns='streaming') are "
+                "not supported through the thin client yet; use a "
+                "remote driver (address='host:port') for streaming "
+                "generators")
         args, kwargs = cloudpickle.loads(payload["args"])
         task_args = cw.serialize_args(args, kwargs)
         refs = cw.submit_actor_task(

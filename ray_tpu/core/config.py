@@ -184,6 +184,11 @@ class Config:
     # replica is shed, and how long it stays shed before a probe.
     serve_cb_failure_threshold: int = 3
     serve_cb_reset_timeout_s: float = 5.0
+    # Streaming responses: max wait between consecutive chunks before
+    # the proxy aborts the stream with a terminal error event (a hung
+    # replica mid-stream keeps its connection alive, so only an
+    # inter-chunk deadline catches it).
+    serve_stream_chunk_timeout_s: float = 120.0
 
     # --- logging ---
     log_dir: str = ""

@@ -25,6 +25,7 @@ import logging
 import os
 import threading
 import time
+import weakref
 from collections import OrderedDict, deque
 from dataclasses import dataclass, field
 from typing import Any, Callable, Dict, List, Optional, Tuple
@@ -235,7 +236,9 @@ class ObjectRefGenerator:
     """Iterator over a streaming task's return refs (reference:
     task_manager.h:98 ObjectRefStream / TryReadObjectRefStream). Items
     become ObjectRefs as the executing worker reports them; iteration
-    blocks until the next item or end-of-stream."""
+    blocks until the next item or end-of-stream. Also asynchronously
+    iterable (``async for ref in gen``) — waiters are woken through
+    one-shot callbacks instead of blocking a pool thread per stream."""
 
     def __init__(self, task_id: TaskID, cleanup=None):
         self._task_id = task_id
@@ -248,18 +251,101 @@ class ObjectRefGenerator:
         # the registration must outlive the final task reply because
         # item notifications can still be in flight behind it.
         self._cleanup = cleanup or (lambda: None)
+        # One-shot wakeups for async iterators (invoked on append/finish
+        # from whatever thread produced the event; the registrar wraps
+        # them in call_soon_threadsafe).
+        self._wakeups: List[Any] = []
+        # Consumption hook (backpressure acks): called with the running
+        # read count each time the consumer takes an item. Set by the
+        # CoreWorker when the producer requested flow control.
+        self._on_read = None
+        # Lifecycle observers: fired exactly once with a terminal tag —
+        # "ok" (finished cleanly), "error" (finished with an error), or
+        # "released" (consumer dropped the stream early).
+        self._done_cbs: List[Any] = []
+        self._first_item_cbs: List[Any] = []
+        self._terminal: Optional[str] = None
+        # Set by close(): iteration ends immediately, including for
+        # consumers blocked in __next__/__anext__ on OTHER threads (the
+        # gRPC cancel callback closes from a different thread than the
+        # handler iterating the stream).
+        self._released = False
 
     # -- producer side (CoreWorker) ------------------------------------
-    def _append(self, ref: ObjectRef):
+    def _drain_wakeups_locked(self):
+        wakeups, self._wakeups = self._wakeups, []
+        return wakeups
+
+    def _append(self, ref: ObjectRef) -> bool:
+        """Returns False when the consumer already released the stream
+        (close() raced this chunk's delivery) — the caller must not
+        treat the chunk as delivered; dropping its ref reclaims it
+        through the normal owned-object GC path."""
         with self._cv:
+            if self._released:
+                return False
+            first = not self._items
             self._items.append(ref)
             self._cv.notify_all()
+            wakeups = self._drain_wakeups_locked()
+            first_cbs = list(self._first_item_cbs) if first else []
+            self._first_item_cbs = []
+        for cb in first_cbs:
+            _call_quietly(cb)
+        for cb in wakeups:
+            _call_quietly(cb)
+        return True
 
     def _finish(self, total: int, error: Optional[Exception] = None):
         with self._cv:
             self._total = total
             self._error = error
             self._cv.notify_all()
+            wakeups = self._drain_wakeups_locked()
+        for cb in wakeups:
+            _call_quietly(cb)
+        self._fire_terminal("error" if error is not None else "ok")
+
+    def _fire_terminal(self, tag: str):
+        with self._cv:
+            if self._terminal is not None:
+                return
+            self._terminal = tag
+            cbs, self._done_cbs = self._done_cbs, []
+        for cb in cbs:
+            _call_quietly(cb, tag, self)
+
+    # -- observers ------------------------------------------------------
+    def add_done_callback(self, cb):
+        """``cb(tag, gen)`` fires exactly once when the stream reaches
+        a terminal state: "ok" / "error" (producer finished) or
+        "released" (consumer abandoned it first). The generator is
+        passed as an argument so observers need not capture it —
+        a closure over the gen stored in its own callback list would be
+        a reference cycle keeping abandoned streams alive until the
+        cyclic GC."""
+        with self._cv:
+            if self._terminal is None:
+                self._done_cbs.append(cb)
+                return
+            tag = self._terminal
+        _call_quietly(cb, tag, self)
+
+    def add_first_item_callback(self, cb):
+        """``cb()`` fires when the first chunk lands (TTFT hooks)."""
+        with self._cv:
+            if not self._items:
+                self._first_item_cbs.append(cb)
+                return
+        _call_quietly(cb)
+
+    def error(self) -> Optional[Exception]:
+        with self._cv:
+            return self._error
+
+    def items_produced(self) -> int:
+        with self._cv:
+            return len(self._items)
 
     # -- consumer side --------------------------------------------------
     def __iter__(self):
@@ -271,20 +357,36 @@ class ObjectRefGenerator:
     def next_ready(self, timeout: Optional[float] = None) -> ObjectRef:
         return self._next_internal(timeout=timeout)
 
+    def _take_locked(self) -> Optional[ObjectRef]:
+        """(cv held) Pop the next ready item, or None. Raises at
+        end-of-stream."""
+        if self._released:
+            raise StopIteration
+        if self._read < len(self._items):
+            ref = self._items[self._read]
+            self._read += 1
+            return ref
+        if self._total is not None and self._read >= self._total:
+            self._cleanup()
+            if self._error is not None:
+                raise self._error
+            raise StopIteration
+        return None
+
+    def _took(self):
+        """Post-take consumption hook (ack the producer) — called
+        OUTSIDE the cv so a slow ack can't stall producers appending."""
+        if self._on_read is not None:
+            _call_quietly(self._on_read, self._read)
+
     def _next_internal(self, timeout: Optional[float]) -> ObjectRef:
         deadline = (time.monotonic() + timeout
                     if timeout is not None else None)
         with self._cv:
             while True:
-                if self._read < len(self._items):
-                    ref = self._items[self._read]
-                    self._read += 1
-                    return ref
-                if self._total is not None and self._read >= self._total:
-                    self._cleanup()
-                    if self._error is not None:
-                        raise self._error
-                    raise StopIteration
+                ref = self._take_locked()
+                if ref is not None:
+                    break
                 remaining = None
                 if deadline is not None:
                     remaining = deadline - time.monotonic()
@@ -292,16 +394,62 @@ class ObjectRefGenerator:
                         raise exc.GetTimeoutError(
                             "stream item not ready in time")
                 self._cv.wait(timeout=remaining)
+        self._took()
+        return ref
+
+    def __aiter__(self):
+        return self
+
+    async def __anext__(self) -> ObjectRef:
+        loop = asyncio.get_running_loop()
+        while True:
+            with self._cv:
+                try:
+                    ref = self._take_locked()
+                except StopIteration:
+                    raise StopAsyncIteration
+                if ref is None:
+                    event = asyncio.Event()
+                    self._wakeups.append(
+                        lambda: loop.call_soon_threadsafe(event.set))
+            if ref is not None:
+                self._took()
+                return ref
+            await event.wait()
+
+    def close(self):
+        """Abandon the stream: release owner-side state and cancel the
+        producer if it is still yielding. Consumers blocked in
+        __next__/__anext__ (possibly on other threads) are woken and
+        see end-of-stream."""
+        with self._cv:
+            self._released = True
+            self._cv.notify_all()
+            wakeups = self._drain_wakeups_locked()
+        for cb in wakeups:
+            _call_quietly(cb)
+        self._fire_terminal("released")
+        try:
+            self._cleanup()
+        except Exception:
+            pass
 
     def __del__(self):
         try:
-            self._cleanup()
+            self.close()
         except Exception:
             pass
 
     def completed(self) -> bool:
         with self._cv:
             return self._total is not None and self._read >= self._total
+
+
+def _call_quietly(cb, *args):
+    try:
+        cb(*args)
+    except Exception:
+        logger.debug("stream callback failed", exc_info=True)
 
 
 @dataclass
@@ -390,7 +538,12 @@ class CoreWorker:
         self._task_event_lock = threading.Lock()
         self._event_flush_scheduled = False
         # Streaming-generator tasks: task id -> ObjectRefGenerator.
-        self._streams: Dict[TaskID, "ObjectRefGenerator"] = {}
+        # WEAK values: the registry must not keep an abandoned stream
+        # alive, or the consumer dropping its generator (the documented
+        # cancel-by-abandonment path, __del__ -> close) could never
+        # fire and the producer would stream into the void forever.
+        self._streams: "weakref.WeakValueDictionary[TaskID, ObjectRefGenerator]" = (
+            weakref.WeakValueDictionary())
         # Pushed-but-unreplied tasks: task_id hex -> ("task", spec, lw,
         # key, state, conn) | ("actor", spec, actor_state, conn). Results
         # stream back as task_done notifications (h_task_done); a
@@ -499,22 +652,48 @@ class CoreWorker:
     def _release_stream(self, task_id: TaskID):
         """Consumer dropped or exhausted the generator: deregister, and
         cancel the producer if it is still running so an abandoned
-        stream doesn't keep yielding."""
-        if self._streams.pop(task_id, None) is None:
-            return
+        stream doesn't keep yielding. Normal tasks go through the lease
+        plane's cancel; actor-lane streams notify the actor's executor
+        directly over its connection.
+
+        NB: gate on pending-task state, NOT on the registry entry — the
+        weak _streams entry is already gone when this runs from the
+        generator's own __del__."""
+        self._streams.pop(task_id, None)
         pending = self.pending_tasks.get(task_id)
-        if pending is not None and not pending.cancelled:
+        if pending is None or pending.cancelled:
+            return
+        spec = pending.spec
+        if spec.task_type == TaskType.ACTOR_TASK:
+            def go():
+                pending.cancelled = True
+                state = self.actors.get(spec.actor_id)
+                if (state is not None and state.conn is not None
+                        and not state.conn.closed):
+                    state.conn.notify_forget(
+                        "cancel_task",
+                        {"task_id": spec.task_id.hex(), "force": False})
+
             try:
-                ref = ObjectRef(ObjectID.for_task_return(task_id, 1),
-                                self.address, is_owned=False)
-                self.cancel_task(ref, force=False)
+                self.loop.call_soon_threadsafe(go)
             except Exception:
                 pass
+            return
+        try:
+            ref = ObjectRef(ObjectID.for_task_return(task_id, 1),
+                            self.address, is_owned=False)
+            self.cancel_task(ref, force=False)
+        except Exception:
+            pass
 
-    async def h_stream_item(self, conn, payload):
+    def h_stream_item(self, conn, payload):
         """A streaming task's executor reports one yielded item
         (reference: the streaming-generator return path feeding
-        ObjectRefStream)."""
+        ObjectRefStream). SYNC notification handler deliberately: the
+        final task_done reply is dispatched inline, so item frames must
+        be too — an async handler's queued task would let the finish
+        overtake in-flight items and fire stream-terminal accounting
+        before the last chunks land."""
         task_id = TaskID.from_hex(payload["task_id"])
         gen = self._streams.get(task_id)
         if gen is None:
@@ -526,8 +705,31 @@ class CoreWorker:
                 asyncio.ensure_future(self.head.call(
                     "free_objects", {"object_ids": [object_id.hex()]}))
             return {"ok": False}
+        if payload.get("ack") and gen._on_read is None:
+            # The producer is flow-controlled: ack every consumed item
+            # with the running read count so its credit window reopens.
+            # Rides the item connection back; loop-thread send.
+            task_hex = payload["task_id"]
+
+            def ack(read, conn=conn, task_hex=task_hex):
+                def send():
+                    if not conn.closed:
+                        conn.notify_forget(
+                            "stream_ack",
+                            {"task_id": task_hex, "read": read})
+
+                self.loop.call_soon_threadsafe(send)
+
+            gen._on_read = ack
         object_id = self._ingest_return(payload)
-        gen._append(ObjectRef(object_id, self.address, is_owned=True))
+        ref = ObjectRef(object_id, self.address, is_owned=True)
+        if not gen._append(ref):
+            # close() raced this chunk between the registry lookup and
+            # the append: ownership IS registered, so simply dropping
+            # the ref reclaims the value (including a sealed shm copy)
+            # through the owned-object GC path.
+            del ref
+            return {"ok": False}
         return {"ok": True}
 
     async def start_server(self, extra_handlers: Optional[dict] = None) -> int:
@@ -1238,7 +1440,8 @@ class CoreWorker:
     def submit_task(self, function_key: str, args: List[TaskArg], *,
                     name: str, num_returns: int, resources: Dict[str, float],
                     max_retries: int, retry_exceptions: bool,
-                    scheduling_strategy, runtime_env=None) -> List[ObjectRef]:
+                    scheduling_strategy, runtime_env=None,
+                    stream_window: int = 0) -> List[ObjectRef]:
         self._ensure_sets()
         task_id = TaskID.for_normal_task(self.job_id)
         spec = TaskSpec(
@@ -1255,6 +1458,7 @@ class CoreWorker:
             retry_exceptions=retry_exceptions,
             scheduling_strategy=scheduling_strategy,
             runtime_env=runtime_env,
+            stream_window=stream_window,
         )
         self.pending_tasks[task_id] = PendingTask(
             spec=spec, retries_left=max_retries
@@ -1707,13 +1911,20 @@ class CoreWorker:
         provably_unsent = getattr(error, "sent", True) is False
         likely_unstarted = (not pending.accepted
                             and spec.max_retries != 0)
-        if ((provably_unsent or likely_unstarted)
+        # Streaming tasks: only a provably-unsent push may re-run — once
+        # execution may have started, chunks may have reached the
+        # registered stream and a re-run would replay them (api.py
+        # already forces max_retries=0 for streaming; this guards direct
+        # submit_task callers too).
+        streaming = spec.num_returns == TaskSpec.STREAMING
+        if ((provably_unsent or (likely_unstarted and not streaming))
                 and not pending.cancelled and pending.free_retries > 0):
             pending.free_retries -= 1
             pending.pushed_to = None
             self._submit_on_loop(spec)
             return
-        if pending.retries_left > 0 and not pending.cancelled:
+        if pending.retries_left > 0 and not pending.cancelled \
+                and not streaming:
             pending.retries_left -= 1
             pending.pushed_to = None
             logger.info("retrying task %s after worker failure",
@@ -1936,7 +2147,7 @@ class CoreWorker:
 
     def submit_actor_task(self, actor_id: ActorID, method_name: str,
                           args: List[TaskArg], *, num_returns: int,
-                          name: str = "") -> List[ObjectRef]:
+                          name: str = "", stream_window: int = 0):
         self._ensure_sets()
         state = self.actors.get(actor_id)
         if state is None:
@@ -1958,17 +2169,31 @@ class CoreWorker:
             owner=self.address,
             actor_id=actor_id,
             method_name=method_name,
+            stream_window=stream_window,
         )
+        streaming = num_returns == TaskSpec.STREAMING
         self.pending_tasks[task_id] = PendingTask(
-            spec=spec, retries_left=state.max_task_retries
+            spec=spec,
+            # A streaming method may have delivered chunks before dying;
+            # transparently re-running it would replay them. Mid-stream
+            # failures are terminal (reference: streaming generators are
+            # not retryable mid-stream).
+            retries_left=0 if streaming else state.max_task_retries,
         )
-        refs = [
-            ObjectRef(oid, self.address, is_owned=True)
-            for oid in spec.return_object_ids()
-        ]
-        # Owned from submit — see submit_task for why.
-        for oid in spec.return_object_ids():
-            self.reference_counter.register_owned(oid, False)
+        gen = None
+        if streaming:
+            gen = ObjectRefGenerator(
+                task_id, cleanup=lambda: self._release_stream(task_id))
+            self._streams[task_id] = gen
+            refs = gen
+        else:
+            refs = [
+                ObjectRef(oid, self.address, is_owned=True)
+                for oid in spec.return_object_ids()
+            ]
+            # Owned from submit — see submit_task for why.
+            for oid in spec.return_object_ids():
+                self.reference_counter.register_owned(oid, False)
 
         def go():
             spec.seqno = state.seqno
@@ -2055,6 +2280,17 @@ class CoreWorker:
                                error: Exception):
         pending = self.pending_tasks.get(spec.task_id)
         if pending is None:
+            return
+        if spec.num_returns == TaskSpec.STREAMING:
+            # Chunks may already have reached the consumer — parking or
+            # retrying would replay them. Surface a terminal error on
+            # the stream NOW (the generator raises after the delivered
+            # prefix instead of hanging).
+            self._store_task_error(
+                spec,
+                exc.ActorDiedError(state.actor_id.hex(),
+                                   state.death_cause or str(error)),
+            )
             return
         if state.max_task_retries != 0 and pending.retries_left != 0:
             pending.retries_left -= 1

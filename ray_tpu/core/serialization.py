@@ -278,6 +278,7 @@ def _dump_spec_fast(spec) -> bytes:
             spec.actor_name,
             spec.namespace,
             bool(getattr(spec, "detached", False)),
+            spec.stream_window,
         ]
         return _CTRL_SPEC + msgpack.packb(row, use_bin_type=True)
     except (TypeError, ValueError):
@@ -344,6 +345,7 @@ def _load_spec_fast(data: bytes):
         is_async_actor=row[20],
         actor_name=row[21],
         namespace=row[22],
+        stream_window=row[24] if len(row) > 24 else 0,
     )
     spec.detached = row[23]
     return spec

@@ -106,6 +106,11 @@ class TaskSpec:
     is_async_actor: bool = False
     actor_name: str = ""  # named actor registration
     namespace: str = ""
+    # Streaming-generator flow control: max chunks the executor may have
+    # produced but the consumer not yet read before the generator body
+    # is paused (credit-based; 0 = unbounded). Only meaningful when
+    # num_returns == STREAMING.
+    stream_window: int = 0
 
     def scheduling_key(self) -> tuple:
         """Groups tasks that can share a leased worker (reference:

@@ -50,6 +50,23 @@ _PROFILERS: dict = {}
 from ray_tpu.exceptions import ActorExitSignal  # noqa: E402 — see exceptions.py
 
 
+class _StreamFlow:
+    """Per-stream credit window state (producer side). ``sent`` advances
+    as chunks go out, ``acked`` follows the consumer's read count
+    (``stream_ack`` notifications); the generator body pauses while
+    ``sent - acked >= window``. The threading.Condition serves executor-
+    thread waiters; the asyncio.Event serves loop-side (async actor)
+    waiters — acks arrive on the loop thread and poke both."""
+
+    __slots__ = ("sent", "acked", "cond", "aevent")
+
+    def __init__(self):
+        self.sent = 0
+        self.acked = 0
+        self.cond = threading.Condition()
+        self.aevent: Optional[asyncio.Event] = None
+
+
 class Executor:
     """Runs tasks for this worker process."""
 
@@ -64,6 +81,8 @@ class Executor:
         self._is_async = False
         # task hex -> owner connection (streaming-generator item channel)
         self._stream_conns = {}
+        # task hex -> _StreamFlow (flow-controlled streams only)
+        self._stream_flow = {}
         # task hex -> executing thread ident (for cancellation)
         self._running_threads = {}
         self._cancelled_tasks = set()
@@ -526,6 +545,19 @@ class Executor:
                 else:
                     method = getattr(self.actor_instance,
                                      spec.method_name)
+                    if spec.num_returns == TaskSpec.STREAMING:
+                        # Streaming over the actor RPC lane: the method
+                        # must hand back a generator; each yield ships
+                        # as a stream_item exactly like a streaming
+                        # normal task.
+                        out = method(*args, **kwargs)
+                        if not hasattr(out, "__next__"):
+                            raise TypeError(
+                                f"actor method {spec.method_name!r} "
+                                "called with num_returns='streaming' "
+                                "must return a generator, got "
+                                f"{type(out).__name__}")
+                        return self._stream_items(spec, out)
                     value = method(*args, **kwargs)
             return self._package_returns(spec, value)
         except ActorExitSignal:
@@ -569,55 +601,227 @@ class Executor:
                 value = method(*args, **kwargs)
                 if asyncio.iscoroutine(value):
                     value = await value
+                if spec.num_returns == TaskSpec.STREAMING:
+                    return await self._astream_items(spec, value)
             return self._package_returns(spec, value)
         except BaseException as e:  # noqa: B036
             if isinstance(e, (KeyboardInterrupt, SystemExit, ActorExitSignal)):
                 raise
             return self._package_error(spec, e)
         finally:
+            # Mirror _execute_sync's cleanup: stream cancellation is the
+            # ROUTINE terminal path for serve streams (every client
+            # disconnect), so a leftover entry per cancelled task would
+            # grow this set unboundedly on long-lived async replicas.
+            self._cancelled_tasks.discard(spec.task_id.hex())
             self.cw.set_current_task_id(None)
 
     # ---- return packaging ----
 
-    def _execute_streaming(self, spec: TaskSpec, fn, args, kwargs) -> dict:
-        """Generator task: each yielded value becomes its own return
-        object, reported to the owner over the push connection as it is
-        produced (reference: streaming generator returns,
-        task_manager.h:98). The final reply carries the item count."""
+    # ---- streaming generators ----
+
+    def on_stream_ack(self, payload: dict) -> None:
+        """(loop thread) The consumer read up to ``read`` items of a
+        flow-controlled stream; reopen the producer's credit window."""
+        flow = self._stream_flow.get(payload.get("task_id"))
+        if flow is None:
+            return
+        with flow.cond:
+            flow.acked = max(flow.acked, int(payload.get("read", 0)))
+            flow.cond.notify_all()
+            if flow.aevent is not None:
+                flow.aevent.set()
+
+    def _stream_payload(self, spec: TaskSpec, count: int, value,
+                        ack: bool) -> dict:
+        object_id = ObjectID.for_task_return(spec.task_id, count + 1)
+        obj = serialization.serialize(value)
+        ret = self._store_return(object_id, obj)
+        payload = {"task_id": spec.task_id.hex(), **ret}
+        if ack:
+            # Tells the owner this stream is flow-controlled: every
+            # consumed item must be acked with the read count.
+            payload["ack"] = True
+        return payload
+
+    def _check_stream_cancel(self, spec: TaskSpec):
+        if spec.task_id.hex() in self._cancelled_tasks:
+            raise exc.TaskCancelledError(f"stream {spec.name} cancelled")
+
+    def _wait_for_credit(self, spec: TaskSpec, flow: _StreamFlow,
+                         window: int):
+        """(executor thread) Block while the credit window is closed;
+        polls so a consumer-side cancel still interrupts the wait."""
+        while True:
+            with flow.cond:
+                if flow.sent - flow.acked < window:
+                    return
+                flow.cond.wait(timeout=0.05)
+            self._check_stream_cancel(spec)
+
+    def _stream_error_reply(self, spec: TaskSpec, error: BaseException,
+                            count: int) -> dict:
+        err = serialization.serialize_error(error, task_name=spec.name)
+        return {
+            "returns": [], "is_error": True, "stream_count": count,
+            "error_payload": {
+                "metadata": err.metadata, "inband": err.inband,
+                "buffers": [bytes(memoryview(b)) for b in err.buffers],
+            },
+        }
+
+    def _stream_items(self, spec: TaskSpec, iterator) -> dict:
+        """(executor thread) Drive a sync generator as a stream: each
+        yielded value becomes its own return object, reported to the
+        owner over the push connection as it is produced (reference:
+        streaming generator returns, task_manager.h:98). The final reply
+        carries the item count. ``spec.stream_window > 0`` enables
+        credit-based backpressure: the body pauses once that many chunks
+        are produced-but-unread, so a slow consumer bounds the
+        producer's buffering instead of OOMing it."""
         conn = self._stream_conns.get(spec.task_id.hex())
         if conn is None:
             raise exc.RayTpuError("streaming task has no owner channel")
+        window = max(0, getattr(spec, "stream_window", 0) or 0)
+        flow = None
+        if window:
+            flow = _StreamFlow()
+            self._stream_flow[spec.task_id.hex()] = flow
         count = 0
         try:
-            for value in fn(*args, **kwargs):
-                object_id = ObjectID.for_task_return(spec.task_id,
-                                                     count + 1)
-                obj = serialization.serialize(value)
-                ret = self._store_return(object_id, obj)
-                payload = {"task_id": spec.task_id.hex(), **ret}
+            for value in iterator:
+                payload = self._stream_payload(spec, count, value,
+                                               ack=window > 0)
                 # Ordered delivery: notifications ride the same TCP
                 # stream as the final reply, which is sent only after
                 # this method returns.
                 self.cw.loop_thread.submit(
                     conn.notify("stream_item", payload))
                 count += 1
-                if spec.task_id.hex() in self._cancelled_tasks:
-                    raise exc.TaskCancelledError(
-                        f"stream {spec.name} cancelled")
+                if flow is not None:
+                    with flow.cond:
+                        flow.sent = count
+                    self._wait_for_credit(spec, flow, window)
+                self._check_stream_cancel(spec)
         except BaseException as e:  # noqa: B036
             if isinstance(e, (KeyboardInterrupt, SystemExit,
                               ActorExitSignal)):
                 raise
-            err = serialization.serialize_error(e, task_name=spec.name)
-            return {
-                "returns": [], "is_error": True, "stream_count": count,
-                "error_payload": {
-                    "metadata": err.metadata, "inband": err.inband,
-                    "buffers": [bytes(memoryview(b))
-                                for b in err.buffers],
-                },
-            }
+            self._close_iter_quietly(iterator)
+            return self._stream_error_reply(spec, e, count)
+        finally:
+            if flow is not None:
+                self._stream_flow.pop(spec.task_id.hex(), None)
         return {"returns": [], "is_error": False, "stream_count": count}
+
+    @staticmethod
+    def _close_iter_quietly(iterator):
+        close = getattr(iterator, "close", None)
+        if callable(close):
+            try:
+                close()
+            except Exception:
+                pass
+
+    def _execute_streaming(self, spec: TaskSpec, fn, args, kwargs) -> dict:
+        return self._stream_items(spec, fn(*args, **kwargs))
+
+    async def _await_credit(self, spec: TaskSpec, flow: _StreamFlow,
+                            window: int):
+        """(loop) Async-actor variant of ``_wait_for_credit``; acks
+        arrive on this same loop thread, so the event wake is race-free."""
+        while True:
+            with flow.cond:
+                if flow.sent - flow.acked < window:
+                    return
+                if flow.aevent is None:
+                    flow.aevent = asyncio.Event()
+                flow.aevent.clear()
+                event = flow.aevent
+            self._check_stream_cancel(spec)
+            try:
+                await asyncio.wait_for(event.wait(), timeout=0.1)
+            except asyncio.TimeoutError:
+                pass
+
+    async def _astream_items(self, spec: TaskSpec, source) -> dict:
+        """(loop) Async-actor streaming: the method produced an async
+        generator (or a plain generator — iterated inline). Mirrors
+        ``_stream_items`` including the credit window; cancellation is
+        polled between chunks so a consumer disconnect actually stops
+        the generator body."""
+        conn = self._stream_conns.get(spec.task_id.hex())
+        if conn is None:
+            raise exc.RayTpuError("streaming task has no owner channel")
+        if hasattr(source, "__anext__"):
+            aiter_src = source
+        elif hasattr(source, "__next__"):
+            # A plain generator on an async actor: iterated inline on
+            # the loop (the user chose sync code in an async context).
+            async def _lift(it=source):
+                for v in it:
+                    yield v
+
+            aiter_src = _lift()
+        else:
+            return self._package_error(spec, TypeError(
+                f"method {spec.method_name!r} with "
+                f"num_returns='streaming' must return a generator or "
+                f"async generator, got {type(source).__name__}"))
+        window = max(0, getattr(spec, "stream_window", 0) or 0)
+        flow = None
+        if window:
+            flow = _StreamFlow()
+            self._stream_flow[spec.task_id.hex()] = flow
+        tid_hex = spec.task_id.hex()
+        count = 0
+        try:
+            while True:
+                self._check_stream_cancel(spec)
+                nxt = asyncio.ensure_future(aiter_src.__anext__())
+                while not nxt.done():
+                    await asyncio.wait({nxt}, timeout=0.25)
+                    if tid_hex in self._cancelled_tasks and not nxt.done():
+                        nxt.cancel()
+                        try:
+                            await nxt
+                        except BaseException:  # noqa: B036 — cancel race
+                            pass
+                        raise exc.TaskCancelledError(
+                            f"stream {spec.name} cancelled")
+                try:
+                    value = nxt.result()
+                except StopAsyncIteration:
+                    break
+                payload = self._stream_payload(spec, count, value,
+                                               ack=window > 0)
+                await conn.notify("stream_item", payload)
+                count += 1
+                if flow is not None:
+                    with flow.cond:
+                        flow.sent = count
+                    await self._await_credit(spec, flow, window)
+        except BaseException as e:  # noqa: B036
+            if isinstance(e, (KeyboardInterrupt, SystemExit,
+                              ActorExitSignal)):
+                raise
+            await self._aclose_quietly(aiter_src)
+            return self._stream_error_reply(spec, e, count)
+        finally:
+            if flow is not None:
+                self._stream_flow.pop(tid_hex, None)
+        return {"returns": [], "is_error": False, "stream_count": count}
+
+    @staticmethod
+    async def _aclose_quietly(aiter_src):
+        aclose = getattr(aiter_src, "aclose", None)
+        if aclose is None:
+            Executor._close_iter_quietly(aiter_src)
+            return
+        try:
+            await aclose()
+        except Exception:
+            pass
 
     def _package_returns(self, spec: TaskSpec, value) -> dict:
         n = spec.num_returns
@@ -641,19 +845,12 @@ class Executor:
 
     def _package_error(self, spec: TaskSpec, error: BaseException) -> dict:
         logger.info("task %s failed: %r", spec.name, error)
-        obj = serialization.serialize_error(error, task_name=spec.name)
         if spec.num_returns == TaskSpec.STREAMING:
             # A streaming task that failed before (or outside) its
             # generator body still must close the owner's stream, or
             # iteration would hang forever with the error lost.
-            return {
-                "returns": [], "is_error": True, "stream_count": 0,
-                "error_payload": {
-                    "metadata": obj.metadata, "inband": obj.inband,
-                    "buffers": [bytes(memoryview(b))
-                                for b in obj.buffers],
-                },
-            }
+            return self._stream_error_reply(spec, error, 0)
+        obj = serialization.serialize_error(error, task_name=spec.name)
         returns = []
         for object_id in spec.return_object_ids():
             returns.append(self._store_return(object_id, obj))
@@ -896,6 +1093,11 @@ async def _amain():
         executor.cancel(payload["task_id"], payload.get("force", False))
         return {"ok": True}
 
+    def h_stream_ack(conn, payload):
+        # Sync notification handler (rpc fast path): consumer-side read
+        # acks reopening a flow-controlled stream's credit window.
+        executor.on_stream_ack(payload or {})
+
     async def h_exit_worker(conn, payload):
         exit_event.set()
         return {"ok": True}
@@ -905,6 +1107,7 @@ async def _amain():
         "push_tasks": h_push_tasks,
         "create_actor": h_create_actor,
         "cancel_task": h_cancel_task,
+        "stream_ack": h_stream_ack,
         "exit_worker": h_exit_worker,
     })
 

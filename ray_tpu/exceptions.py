@@ -99,3 +99,12 @@ class ActorExitSignal(BaseException):
     user code don't swallow it. Defined here — not in worker_main — because
     the worker runs as __main__ and would otherwise see two distinct classes.
     """
+
+
+#: Failures that mean the serving PROCESS died or became unreachable —
+#: as opposed to the application code raising. Consumers (serve proxy
+#: retry-before-first-chunk, router stream-abort attribution) use this
+#: to separate "safe to retry / count as replica_death" from user
+#: errors that must never be re-executed.
+ACTOR_SYSTEM_FAILURES = (ActorDiedError, WorkerCrashedError,
+                         ActorUnavailableError, NodeDiedError)

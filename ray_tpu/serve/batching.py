@@ -11,6 +11,7 @@ from __future__ import annotations
 
 import asyncio
 import functools
+import inspect
 from typing import Any, Callable, List, Optional
 
 
@@ -49,6 +50,16 @@ class _BatchQueue:
                 results = self.fn(args)
             if asyncio.iscoroutine(results):
                 results = await results
+            if inspect.isgenerator(results) or inspect.isasyncgen(
+                    results):
+                # Scattering a generator like a list would silently
+                # hand each caller one exhausted-iterator slice.
+                raise TypeError(
+                    f"@serve.batch function "
+                    f"{getattr(self.fn, '__name__', '?')!r} returned a "
+                    "generator; batched streaming is not supported — "
+                    "make the deployment itself a generator and call "
+                    "it with handle.options(stream=True).remote(...)")
             if len(results) != len(args):
                 raise ValueError(
                     f"batch fn returned {len(results)} results for "
@@ -68,6 +79,15 @@ def batch(_fn=None, *, max_batch_size: int = 10,
     a list of requests and returns a list of responses."""
 
     def wrap(fn):
+        if inspect.isgeneratorfunction(fn) or inspect.isasyncgenfunction(fn):
+            # Fail at decoration time: a generator body would be
+            # scattered like a list result and every caller would get
+            # garbage.
+            raise TypeError(
+                f"@serve.batch cannot wrap generator function "
+                f"{getattr(fn, '__name__', '?')!r}; streaming responses "
+                "go through generator deployments + "
+                "handle.options(stream=True) instead")
         queues = {}  # instance id -> _BatchQueue (methods) / None key (fns)
 
         @functools.wraps(fn)

@@ -30,6 +30,12 @@ class AutoscalingConfig:
             raise ValueError("target_ongoing_requests must be > 0")
 
 
+#: Valid values of ``DeploymentConfig.stream_format``: "auto" negotiates
+#: by the request's Accept header (text/event-stream -> SSE, else
+#: chunked); "sse"/"chunked" pin the HTTP framing for every client.
+STREAM_FORMATS = ("auto", "sse", "chunked")
+
+
 @dataclasses.dataclass
 class DeploymentConfig:
     """Reference: serve/config.py:DeploymentConfig."""
@@ -40,6 +46,22 @@ class DeploymentConfig:
     autoscaling_config: Optional[AutoscalingConfig] = None
     graceful_shutdown_timeout_s: float = 10.0
     health_check_period_s: float = 2.0
+    # --- streaming (generator deployments) ---
+    # Per-stream backpressure: max chunks a replica may have produced
+    # but the consumer not yet read before its generator body pauses
+    # (credit-based; 0 = unbounded). Bounds replica-side memory when a
+    # fast TPU replica feeds a slow client.
+    max_queued_stream_chunks: int = 16
+    # HTTP framing for streamed responses (see STREAM_FORMATS).
+    stream_format: str = "auto"
+
+    def __post_init__(self):
+        if self.stream_format not in STREAM_FORMATS:
+            raise ValueError(
+                f"stream_format must be one of {STREAM_FORMATS}, got "
+                f"{self.stream_format!r}")
+        if self.max_queued_stream_chunks < 0:
+            raise ValueError("max_queued_stream_chunks must be >= 0")
 
     def initial_replicas(self) -> int:
         if self.autoscaling_config:

@@ -279,12 +279,19 @@ class ServeController:
             # a starting replica (still importing / warming up jit) would
             # absorb requests its queue can't serve yet.
             ready = sorted(ds.replica_ready & set(ds.replicas))
+            cfg = ds.spec["config"]
             table[key] = {
                 "replica_names": ready or sorted(ds.replicas),
                 "route_prefix": (ds.spec.get("route_prefix")
                                  if ds.spec.get("is_ingress") else None),
                 "app": ds.app_name,
                 "deployment": ds.name,
+                # Streaming plane: proxies pick response framing and the
+                # router picks the backpressure window from here.
+                "stream": bool(ds.spec.get("is_generator")),
+                "stream_format": getattr(cfg, "stream_format", "auto"),
+                "max_queued_stream_chunks": getattr(
+                    cfg, "max_queued_stream_chunks", 16),
             }
         return {"version": self.routing_version, "table": table}
 

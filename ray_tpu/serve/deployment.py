@@ -63,6 +63,8 @@ class Deployment:
                 resources: Optional[Dict[str, float]] = None,
                 placement_strategy: Optional[str] = None,
                 max_replicas_per_node: Optional[int] = None,
+                max_queued_stream_chunks: Optional[int] = None,
+                stream_format: Optional[str] = None,
                 route_prefix: Optional[str] = None) -> "Deployment":
         cfg = DeploymentConfig(
             num_replicas=(num_replicas if num_replicas is not None
@@ -74,6 +76,12 @@ class Deployment:
                          else self.config.user_config),
             autoscaling_config=_coerce_autoscaling(
                 autoscaling_config, self.config.autoscaling_config),
+            max_queued_stream_chunks=(
+                max_queued_stream_chunks
+                if max_queued_stream_chunks is not None
+                else self.config.max_queued_stream_chunks),
+            stream_format=(stream_format if stream_format is not None
+                           else self.config.stream_format),
         )
         rc = ReplicaConfig(
             num_cpus=(num_cpus if num_cpus is not None
@@ -114,6 +122,8 @@ def deployment(func_or_class=None, *, name: Optional[str] = None,
                resources: Optional[Dict[str, float]] = None,
                placement_strategy: str = "SPREAD",
                max_replicas_per_node: Optional[int] = None,
+               max_queued_stream_chunks: int = 16,
+               stream_format: str = "auto",
                route_prefix: Optional[str] = None):
     """@serve.deployment decorator (reference: serve/api.py:deployment)."""
 
@@ -127,6 +137,8 @@ def deployment(func_or_class=None, *, name: Optional[str] = None,
                 user_config=user_config,
                 autoscaling_config=_coerce_autoscaling(
                     autoscaling_config, None),
+                max_queued_stream_chunks=max_queued_stream_chunks,
+                stream_format=stream_format,
             ),
             ReplicaConfig(num_cpus=num_cpus, num_tpus=num_tpus,
                           resources=resources,
@@ -171,5 +183,23 @@ def build_specs(app: Application, app_name: str,
             "replica_config": d.replica_config,
             "route_prefix": route if is_ingress else None,
             "is_ingress": is_ingress,
+            # Generator deployments stream by default through the proxy
+            # (the replica still enforces this at execution time — the
+            # flag only picks the proxy's response mode up front).
+            "is_generator": _callable_is_generator(d.func_or_class),
         })
     return specs, ingress_name
+
+
+def _callable_is_generator(func_or_class) -> bool:
+    """Does this deployment's ``__call__`` produce a stream? (The proxy
+    must choose chunked/SSE framing before the first chunk exists.)"""
+    import inspect
+
+    target = func_or_class
+    if inspect.isclass(func_or_class):
+        target = getattr(func_or_class, "__call__", None)
+        if target is None:
+            return False
+    return (inspect.isgeneratorfunction(target)
+            or inspect.isasyncgenfunction(target))

@@ -15,6 +15,14 @@ with grpcio calls deployments without compiling protos:
     call = ch.unary_unary("/ray_tpu.serve.UserDefinedService/myapp")
     result = pickle.loads(call(pickle.dumps(((arg,), {}))))
 
+Generator deployments get **server streaming** parity through a second
+service name — each response message is one pickled chunk:
+
+    call = ch.unary_stream(
+        "/ray_tpu.serve.UserDefinedStreamingService/myapp")
+    for msg in call(pickle.dumps(((arg,), {}))):
+        chunk = pickle.loads(msg)
+
 Routing reuses the Router (power-of-two-choices replica assignment,
 multiplex-aware) exactly as the HTTP proxy does; the gRPC method name
 selects the deployment by route prefix ("/<name>").
@@ -30,6 +38,7 @@ from typing import Optional
 logger = logging.getLogger(__name__)
 
 SERVICE = "ray_tpu.serve.UserDefinedService"
+STREAM_SERVICE = "ray_tpu.serve.UserDefinedStreamingService"
 
 
 class GrpcProxy:
@@ -71,6 +80,12 @@ class GrpcProxy:
             def service(self, handler_call_details):
                 path = handler_call_details.method
                 prefix = f"/{SERVICE}/"
+                stream_prefix = f"/{STREAM_SERVICE}/"
+                if path.startswith(stream_prefix):
+                    target = path[len(stream_prefix):]
+                    return grpc.unary_stream_rpc_method_handler(
+                        lambda req, ctx: proxy._call_stream(
+                            target, req, ctx))
                 if not path.startswith(prefix):
                     return None
                 target = path[len(prefix):]
@@ -113,15 +128,7 @@ class GrpcProxy:
             context.abort(grpc.StatusCode.INVALID_ARGUMENT,
                           "request must be pickle.dumps((args, kwargs))")
             return b""
-        router = self._get_router()
-        key = router.route_for_prefix(f"/{target}")
-        if key is None:
-            router._refresh(force=True)
-            key = router.route_for_prefix(f"/{target}")
-        if key is None:
-            context.abort(grpc.StatusCode.NOT_FOUND,
-                          f"no deployment routed at /{target}")
-            return b""
+        router, key, _entry = self._route(target, context)
         model_id = ""
         for k, v in (context.invocation_metadata() or ()):
             if k == "serve_multiplexed_model_id":
@@ -139,6 +146,87 @@ class GrpcProxy:
             context.abort(grpc.StatusCode.INTERNAL, str(e))
             return b""
         return pickle.dumps(result)
+
+    def _route(self, target: str, context):
+        """Longest-prefix route for a gRPC method name; aborts NOT_FOUND
+        when nothing is deployed there."""
+        import grpc
+
+        router = self._get_router()
+        key, entry = router.resolve_route(f"/{target}")
+        if key is None:
+            context.abort(grpc.StatusCode.NOT_FOUND,
+                          f"no deployment routed at /{target}")
+        return router, key, entry
+
+    def _call_stream(self, target: str, request: bytes, context):
+        """Server-streaming lane for generator deployments: yields one
+        pickled message per chunk. Mid-stream failures terminate the RPC
+        with INTERNAL carrying the error; calling it on a non-generator
+        deployment is UNIMPLEMENTED."""
+        import grpc
+
+        if not self._authorized(context):
+            context.abort(grpc.StatusCode.UNAUTHENTICATED,
+                          "missing or wrong serve-token metadata")
+            return
+        try:
+            args, kwargs = pickle.loads(request) if request else ((), {})
+        except Exception:
+            context.abort(grpc.StatusCode.INVALID_ARGUMENT,
+                          "request must be pickle.dumps((args, kwargs))")
+            return
+        router, key, entry = self._route(target, context)
+        if not entry.get("stream"):
+            context.abort(
+                grpc.StatusCode.UNIMPLEMENTED,
+                f"deployment at /{target} is not a generator "
+                f"deployment; call the unary "
+                f"/{SERVICE}/{target} method instead")
+            return
+        call_kwargs = dict(kwargs)
+        for k, v in (context.invocation_metadata() or ()):
+            if k == "serve_multiplexed_model_id":
+                call_kwargs["__serve_multiplexed_model_id"] = v
+        import ray_tpu
+
+        try:
+            gen = router.assign(key, "__call__", tuple(args),
+                                call_kwargs, stream=True)
+        except Exception as e:
+            logger.exception("grpc stream assignment failed")
+            context.abort(grpc.StatusCode.INTERNAL, str(e))
+            return
+        # Client cancellation tears the stream down replica-side too.
+        context.add_callback(gen.close)
+        from ray_tpu import exceptions as exc
+        from ray_tpu.core.config import get_config
+
+        # Same inter-chunk deadline as the HTTP proxy: a hung replica
+        # keeps its connection alive, so only a chunk timeout turns
+        # "silent hang" into a terminated RPC.
+        chunk_timeout = get_config().serve_stream_chunk_timeout_s
+        deadline_hit = False
+        try:
+            while True:
+                try:
+                    ref = gen.next_ready(timeout=chunk_timeout)
+                except StopIteration:
+                    break
+                except exc.GetTimeoutError:
+                    gen._release_reason = "deadline"
+                    gen.close()
+                    deadline_hit = True
+                    break  # abort() raises; keep it outside this try
+                yield pickle.dumps(ray_tpu.get(ref, timeout=300))
+        except Exception as e:
+            logger.exception("grpc stream failed mid-stream")
+            gen.close()
+            context.abort(grpc.StatusCode.INTERNAL, str(e))
+        if deadline_hit:
+            context.abort(
+                grpc.StatusCode.DEADLINE_EXCEEDED,
+                f"no chunk within {chunk_timeout:.0f}s (stream deadline)")
 
     def stop(self, grace: Optional[float] = 1.0):
         self._server.stop(grace)

@@ -83,35 +83,143 @@ class DeploymentResponse:
         return self._await_impl().__await__()
 
 
+class DeploymentResponseGenerator:
+    """Streaming result of ``handle.options(stream=True).remote()``
+    (reference: handle.py DeploymentResponseGenerator). Iterable both
+    ways — ``for chunk in gen`` from sync code, ``async for chunk in
+    gen`` from a replica/event loop — yielding the chunk VALUES in
+    order. Dropping or ``cancel()``ing it propagates cancellation to
+    the replica so the generator body actually stops."""
+
+    _UNSET = object()
+
+    def __init__(self, gen=None, gen_future=None):
+        self._gen = gen
+        self._gen_future = gen_future
+        self._cancelled = False
+
+    def _resolve(self, timeout=_UNSET):
+        if self._gen is None:
+            if timeout is DeploymentResponseGenerator._UNSET:
+                from ray_tpu.core.config import get_config
+
+                timeout = get_config().serve_handle_resolve_timeout_s
+            self._gen = self._gen_future.result(timeout)
+            if self._cancelled:
+                self._gen.close()
+        return self._gen
+
+    # -- sync iteration -------------------------------------------------
+    def __iter__(self):
+        return self
+
+    def __next__(self):
+        import ray_tpu
+
+        ref = self._resolve().__next__()
+        return ray_tpu.get(ref)
+
+    def next_ready(self, timeout: Optional[float] = None):
+        """Next chunk, raising GetTimeoutError if none lands in time.
+        ``timeout`` is one overall deadline — the assignment wait, the
+        chunk wait, and the value fetch share it."""
+        import time as _time
+
+        import ray_tpu
+
+        deadline = (_time.monotonic() + timeout
+                    if timeout is not None else None)
+
+        def remaining():
+            if deadline is None:
+                return None
+            return max(0.0, deadline - _time.monotonic())
+
+        from ray_tpu import exceptions as exc
+
+        try:
+            gen = self._resolve(remaining() if timeout is not None
+                                else DeploymentResponseGenerator._UNSET)
+        except concurrent.futures.TimeoutError:
+            raise exc.GetTimeoutError(
+                "stream assignment not ready in time")
+        ref = gen.next_ready(timeout=remaining())
+        return ray_tpu.get(ref, timeout=remaining())
+
+    # -- async iteration ------------------------------------------------
+    def __aiter__(self):
+        return self
+
+    async def __anext__(self):
+        if self._gen is None:
+            self._gen = await asyncio.wrap_future(self._gen_future)
+            if self._cancelled:
+                self._gen.close()
+        ref = await self._gen.__anext__()
+        return await ref
+
+    # -- lifecycle ------------------------------------------------------
+    def cancel(self):
+        """Stop consuming AND stop the replica-side generator. Safe
+        while the assignment is still in flight: the stream is closed
+        the moment it resolves."""
+        self._cancelled = True
+        if self._gen is not None:
+            self._gen.close()
+            return
+        if self._gen_future is not None:
+            def _close_when_ready(fut):
+                if fut.cancelled() or fut.exception() is not None:
+                    return
+                try:
+                    fut.result().close()
+                except Exception:
+                    pass
+
+            self._gen_future.add_done_callback(_close_when_ready)
+
+    close = cancel
+
+    def completed(self) -> bool:
+        return self._gen is not None and self._gen.completed()
+
+
 class DeploymentHandle:
     def __init__(self, app_name: str, deployment_name: str,
                  method_name: str = "__call__",
-                 multiplexed_model_id: str = ""):
+                 multiplexed_model_id: str = "",
+                 stream: bool = False):
         self._app = app_name
         self._deployment = deployment_name
         self._method = method_name
         self._multiplexed_model_id = multiplexed_model_id
+        self._stream = stream
 
     @property
     def deployment_key(self) -> str:
         return f"{self._app}#{self._deployment}"
 
     def options(self, *, method_name: Optional[str] = None,
-                multiplexed_model_id: Optional[str] = None
-                ) -> "DeploymentHandle":
+                multiplexed_model_id: Optional[str] = None,
+                stream: Optional[bool] = None) -> "DeploymentHandle":
         return DeploymentHandle(
             self._app, self._deployment,
             method_name or self._method,
             (multiplexed_model_id if multiplexed_model_id is not None
-             else self._multiplexed_model_id))
+             else self._multiplexed_model_id),
+            self._stream if stream is None else bool(stream))
 
     def __getattr__(self, name: str) -> "DeploymentHandle":
         if name.startswith("_"):
             raise AttributeError(name)
         return DeploymentHandle(self._app, self._deployment, name,
-                                self._multiplexed_model_id)
+                                self._multiplexed_model_id, self._stream)
 
-    def remote(self, *args, **kwargs) -> DeploymentResponse:
+    def remote(self, *args, **kwargs):
+        """Route one call. Returns a DeploymentResponse, or a
+        DeploymentResponseGenerator when the handle was configured with
+        ``options(stream=True)`` (the deployment method must then be a
+        generator / async generator)."""
         args = tuple(
             a._to_object_ref() if isinstance(a, DeploymentResponse) else a
             for a in args)
@@ -128,6 +236,7 @@ class DeploymentHandle:
         from ray_tpu.util import tracing
 
         carrier = tracing.inject_context() if tracing.is_enabled() else None
+        stream = self._stream
         try:
             asyncio.get_running_loop()
             on_loop = True
@@ -137,16 +246,21 @@ class DeploymentHandle:
             fut = _offload.submit(
                 lambda: _get_router().assign(
                     self.deployment_key, self._method, args, kwargs,
-                    trace_carrier=carrier))
+                    trace_carrier=carrier, stream=stream))
+            if stream:
+                return DeploymentResponseGenerator(gen_future=fut)
             return DeploymentResponse(ref_future=fut)
-        ref = _get_router().assign(self.deployment_key, self._method,
-                                   args, kwargs, trace_carrier=carrier)
-        return DeploymentResponse(ref)
+        out = _get_router().assign(self.deployment_key, self._method,
+                                   args, kwargs, trace_carrier=carrier,
+                                   stream=stream)
+        if stream:
+            return DeploymentResponseGenerator(out)
+        return DeploymentResponse(out)
 
     def __reduce__(self):
         return (DeploymentHandle,
                 (self._app, self._deployment, self._method,
-                 self._multiplexed_model_id))
+                 self._multiplexed_model_id, self._stream))
 
     def __repr__(self):
         return (f"DeploymentHandle({self._app}#{self._deployment}"

@@ -19,6 +19,10 @@ logger = logging.getLogger(__name__)
 
 PROXY_NAME = "SERVE_PROXY"
 
+# Sentinel: the stream produced no chunks (StopAsyncIteration before
+# the first item).
+_STREAM_END = object()
+
 
 class Request:
     """Minimal request container handed to ingress callables (reference
@@ -145,45 +149,242 @@ class ProxyActor:
             with tracing.span(f"proxy {request.method} {path}"):
                 carrier = tracing.inject_context()
                 route, resp = await self._dispatch(loop, path, req,
-                                                   model_id, carrier)
+                                                   model_id, carrier,
+                                                   request)
         else:
             route, resp = await self._dispatch(loop, path, req,
-                                               model_id, None)
+                                               model_id, None, request)
         telemetry.observe("ray_tpu_serve_http_latency_seconds",
                           time.perf_counter() - t0, {"route": route})
         telemetry.inc("ray_tpu_serve_http_requests_total", 1,
                       {"route": route, "code": str(resp.status)})
         return resp
 
-    async def _dispatch(self, loop, path, req, model_id, carrier):
-        """Route + await one request; returns (route tag, response)."""
+    async def _dispatch(self, loop, path, req, model_id, carrier,
+                        http_request):
+        """Route + await one request; returns (route tag, response).
+        Generator deployments (routing-table ``stream`` flag) take the
+        streaming path: SSE or chunked transfer, first chunk flushed the
+        moment the replica yields it."""
         from aiohttp import web
 
-        def assign_sync():
-            router = self._get_router()
-            key = router.route_for_prefix(path)
-            if key is None:
-                router._refresh(force=True)
-                key = router.route_for_prefix(path)
-            if key is None:
-                return None, None
-            kwargs = ({"__serve_multiplexed_model_id": model_id}
-                      if model_id else {})
-            return key, router.assign(key, "__call__", (req,), kwargs,
-                                      trace_carrier=carrier)
+        kwargs = ({"__serve_multiplexed_model_id": model_id}
+                  if model_id else {})
+        # One executor hop for the unary hot path: route AND assign in
+        # the same blocking call; only streaming routes come back to the
+        # loop between the two (the stream needs loop-side framing).
+        routed = {}
 
-        key = None
-        try:
-            key, ref = await loop.run_in_executor(None, assign_sync)
+        def route_and_assign():
+            key, entry = self._route_blocking(path)
             if key is None:
-                return "unmatched", web.Response(
-                    status=404, text=f"no route for {path}")
+                return None
+            routed["key"] = key
+            routed["entry"] = entry
+            if entry.get("stream"):
+                return None
+            return self._get_router().assign(
+                key, "__call__", (req,), kwargs, trace_carrier=carrier)
+
+        try:
+            ref = await loop.run_in_executor(None, route_and_assign)
+        except Exception as e:
+            logger.exception("proxy request failed")
+            return routed.get("key", "unmatched"), web.Response(
+                status=500, text=str(e))
+        key = routed.get("key")
+        if key is None:
+            return "unmatched", web.Response(
+                status=404, text=f"no route for {path}")
+        if routed["entry"].get("stream"):
+            return await self._dispatch_stream(
+                loop, path, key, routed["entry"], req, kwargs, carrier,
+                http_request)
+        try:
             result = await ref
         except Exception as e:
             logger.exception("proxy request failed")
-            return key or "unmatched", web.Response(status=500,
-                                                    text=str(e))
+            return key, web.Response(status=500, text=str(e))
         return key, _to_response(result)
+
+    def _route_blocking(self, path):
+        """(executor thread) Longest-prefix route -> (key, entry dict),
+        or (None, None) when nothing matches."""
+        return self._get_router().resolve_route(path)
+
+    async def _dispatch_stream(self, loop, path, key, entry, req,
+                               kwargs, carrier, http_request):
+        """Stream a generator deployment's chunks to the HTTP client.
+
+        Framing: SSE (``text/event-stream``) when the deployment pins
+        ``stream_format="sse"`` or negotiates it via the Accept header,
+        otherwise chunked transfer. Mid-stream replica failure surfaces
+        as a terminal error event (SSE ``event: error`` / a
+        ``[stream-error]`` trailer chunk) — never a silent hang; client
+        disconnect propagates cancellation back to the replica so its
+        generator stops."""
+        from aiohttp import web
+
+        from ray_tpu.core.config import get_config
+
+        def assign_stream():
+            return self._get_router().assign(
+                key, "__call__", (req,), kwargs, trace_carrier=carrier,
+                stream=True)
+
+        def force_refresh():
+            try:
+                self._get_router()._refresh(force=True)
+            except Exception:
+                pass
+
+        chunk_timeout = get_config().serve_stream_chunk_timeout_s
+        # Acquire the stream AND its first chunk before committing HTTP
+        # headers: a failure this early (stale routing table pointing at
+        # a dead replica) is retried against a refreshed table — safe
+        # because nothing was delivered yet — and a terminal failure
+        # becomes an honest 500/504 instead of a 200 with an error
+        # trailer.
+        gen = None
+        first = _STREAM_END
+        last_err: Optional[Exception] = None
+        for attempt in range(3):
+            try:
+                gen = await loop.run_in_executor(None, assign_stream)
+            except Exception as e:
+                logger.exception("proxy stream assignment failed")
+                return key, web.Response(status=500, text=str(e))
+            try:
+                ref = await asyncio.wait_for(gen.__anext__(),
+                                             timeout=chunk_timeout)
+                first = await ref
+                break
+            except asyncio.CancelledError:
+                # Client disconnected while we waited for the first
+                # chunk (pre-headers): the replica-side generator must
+                # not keep producing.
+                gen.close()
+                raise
+            except StopAsyncIteration:
+                first = _STREAM_END
+                break
+            except asyncio.TimeoutError:
+                gen._release_reason = "deadline"
+                gen.close()
+                return key, web.Response(
+                    status=504,
+                    text=f"no first chunk within {chunk_timeout:.0f}s "
+                         "(stream deadline)")
+            except Exception as e:
+                last_err = e
+                gen.close()
+                gen = None
+                if not _is_replica_system_error(e):
+                    # Application error before the first chunk: the
+                    # user generator ran (and may have side-effected) —
+                    # re-executing it on another replica would duplicate
+                    # that work. Fail once, like the unary path.
+                    break
+                # The failure may mean the route itself moved (redeploy
+                # at this prefix): refresh and re-resolve before the
+                # next attempt.
+                await loop.run_in_executor(None, force_refresh)
+                new_key, new_entry = await loop.run_in_executor(
+                    None, self._route_blocking, path)
+                if new_key is None:
+                    return key, web.Response(
+                        status=404, text=f"no route for {path}")
+                key, entry = new_key, new_entry
+                if not entry.get("stream"):
+                    # Replaced by a non-generator deployment mid-retry.
+                    return await self._dispatch(
+                        loop, path, req,
+                        kwargs.get("__serve_multiplexed_model_id", ""),
+                        carrier, http_request)
+        if gen is None:
+            logger.warning("proxy stream failed before first chunk: %s",
+                           last_err)
+            return key, web.Response(status=500, text=str(last_err))
+
+        accept = http_request.headers.get("Accept", "")
+        fmt = entry.get("stream_format", "auto")
+        use_sse = fmt == "sse" or (fmt == "auto"
+                                   and "text/event-stream" in accept)
+        resp = web.StreamResponse(status=200)
+        if use_sse:
+            resp.headers["Content-Type"] = "text/event-stream"
+            resp.headers["Cache-Control"] = "no-cache"
+        else:
+            resp.headers["Content-Type"] = "application/octet-stream"
+        resp.enable_chunked_encoding()
+        try:
+            await resp.prepare(http_request)
+        except Exception:
+            gen.close()
+            return key, resp
+        try:
+            wrote_first = False
+            while True:
+                try:
+                    if not wrote_first:
+                        value = first
+                        wrote_first = True
+                        if value is _STREAM_END:
+                            raise StopAsyncIteration
+                    else:
+                        ref = await asyncio.wait_for(
+                            gen.__anext__(), timeout=chunk_timeout)
+                        value = await ref
+                except StopAsyncIteration:
+                    if use_sse:
+                        await resp.write(b"event: end\ndata:\n\n")
+                    break
+                except asyncio.TimeoutError:
+                    # Hung replica: conn alive, no chunks. Tag the
+                    # release so the router's abort counter says
+                    # "deadline", then tell the client.
+                    gen._release_reason = "deadline"
+                    gen.close()
+                    await self._write_stream_error(
+                        resp, use_sse,
+                        f"no chunk within {chunk_timeout:.0f}s "
+                        "(stream deadline)")
+                    break
+                except Exception as e:
+                    # Mid-stream failure (replica death, generator
+                    # exception): terminal error chunk, not a hang.
+                    gen.close()
+                    await self._write_stream_error(resp, use_sse, str(e))
+                    break
+                try:
+                    await resp.write(_encode_chunk(value, use_sse))
+                except (ConnectionResetError, ConnectionError, OSError):
+                    gen.close()  # client went away -> stop the replica
+                    break
+        except asyncio.CancelledError:
+            # aiohttp cancels the handler on client disconnect; the
+            # replica-side generator must not keep producing.
+            gen.close()
+            raise
+        try:
+            await resp.write_eof()
+        except Exception:
+            pass
+        return key, resp
+
+    @staticmethod
+    async def _write_stream_error(resp, use_sse: bool, message: str):
+        try:
+            if use_sse:
+                data = "".join(f"data: {ln}\n"
+                               for ln in message.split("\n"))
+                await resp.write(
+                    b"event: error\n" + data.encode() + b"\n")
+            else:
+                await resp.write(
+                    f"\n[stream-error] {message}\n".encode())
+        except Exception:
+            pass  # client already gone; the router counted the abort
 
     async def shutdown(self):
         if self._grpc is not None:
@@ -191,6 +392,47 @@ class ProxyActor:
             self._grpc = None
         if self._runner is not None:
             await self._runner.cleanup()
+
+
+def _is_replica_system_error(e: Exception) -> bool:
+    """Did this failure come from the serving system (dead/unreachable
+    replica — safe to retry before any chunk was delivered) rather than
+    from the user generator's own code (never re-executed)?"""
+    from ray_tpu import exceptions as exc
+
+    return isinstance(e, exc.ACTOR_SYSTEM_FAILURES)
+
+
+def _encode_chunk(value: Any, sse: bool) -> bytes:
+    """One stream chunk as wire bytes. Chunked transfer passes bytes
+    through raw (str utf-8, dict/list as JSON lines); SSE frames every
+    chunk as a ``data:`` event. Text values are framed without a
+    bytes round-trip — the token hot path is str/dict chunks."""
+    if isinstance(value, bytes):
+        if not sse:
+            return value
+        try:
+            text = value.decode()
+        except UnicodeDecodeError:
+            # SSE is a text protocol; transcoding arbitrary bytes
+            # would silently corrupt them. Frame non-UTF-8 chunks
+            # honestly as a base64 "binary" event.
+            import base64
+
+            return (b"event: binary\ndata: "
+                    + base64.b64encode(value) + b"\n\n")
+    elif isinstance(value, str):
+        text = value
+    elif isinstance(value, (dict, list)):
+        text = json.dumps(value)
+        if not sse:
+            return (text + "\n").encode()  # JSONL for chunked readers
+    else:
+        text = str(value)
+    if not sse:
+        return text.encode()
+    return ("".join(f"data: {ln}\n" for ln in text.split("\n"))
+            + "\n").encode()
 
 
 def _to_response(result: Any):

@@ -50,8 +50,22 @@ class Replica:
     async def reconfigure(self, user_config) -> None:
         self._reconfigure_sync(user_config)
 
-    async def handle_request(self, method_name: str, args: tuple,
-                             kwargs: dict) -> Any:
+    def _resolve_fn(self, method_name: str):
+        fn = getattr(self.callable, method_name, None)
+        if fn is None and method_name == "__call__":
+            fn = self.callable
+        if fn is None:
+            raise AttributeError(
+                f"{self.deployment_name} has no method "
+                f"{method_name!r}")
+        return fn
+
+    def _request_scope(self, kwargs: dict, label: str):
+        """Shared per-request bookkeeping for the unary AND streaming
+        lanes: pops the hidden serve kwargs, installs tracing + request
+        context, and counts ongoing/served/latency in one place — the
+        two lanes differ only in how they execute the callable. Yields
+        a one-slot dict; set ``scope["status"] = "ok"`` on success."""
         import contextlib
 
         from ray_tpu.serve import context as _ctx
@@ -59,45 +73,90 @@ class Replica:
 
         model_id = kwargs.pop("__serve_multiplexed_model_id", "")
         trace_ctx = kwargs.pop("__serve_trace_ctx", None)
-        # ExitStack so a raising request closes the span with the real
-        # exception info (error status on otel spans).
-        with contextlib.ExitStack() as stack:
-            if trace_ctx is not None:
-                # The carrier's presence proves the driver enabled
-                # tracing (same contract as worker_main's task path).
-                tracing.setup_tracing("ray_tpu.serve.replica")
-                stack.enter_context(
-                    tracing.span(f"replica {self.deployment_name}",
-                                 trace_ctx))
-            _ctx._set_request_context(_ctx.RequestContext(
-                multiplexed_model_id=model_id,
-                deployment=self.deployment_name))
-            self.num_ongoing += 1
-            t0 = time.perf_counter()
-            status = "error"
-            try:
-                fn = getattr(self.callable, method_name, None)
-                if fn is None and method_name == "__call__":
-                    fn = self.callable
-                if fn is None:
-                    raise AttributeError(
-                        f"{self.deployment_name} has no method "
-                        f"{method_name!r}")
-                out = fn(*args, **kwargs)
-                if inspect.isawaitable(out):
-                    out = await out
-                status = "ok"
-                return out
-            finally:
-                self.num_ongoing -= 1
-                self.total_served += 1
-                telemetry.inc("ray_tpu_serve_replica_requests_total", 1,
-                              {"deployment": self.deployment_name,
-                               "status": status})
-                telemetry.observe(
-                    "ray_tpu_serve_replica_latency_seconds",
-                    time.perf_counter() - t0,
-                    {"deployment": self.deployment_name})
+
+        @contextlib.contextmanager
+        def scope_cm():
+            # ExitStack so a raising request closes the span with the
+            # real exception info (error status on otel spans).
+            with contextlib.ExitStack() as stack:
+                if trace_ctx is not None:
+                    # The carrier's presence proves the driver enabled
+                    # tracing (same contract as worker_main's task
+                    # path).
+                    tracing.setup_tracing("ray_tpu.serve.replica")
+                    stack.enter_context(tracing.span(label, trace_ctx))
+                _ctx._set_request_context(_ctx.RequestContext(
+                    multiplexed_model_id=model_id,
+                    deployment=self.deployment_name))
+                self.num_ongoing += 1
+                t0 = time.perf_counter()
+                scope = {"status": "error"}
+                try:
+                    yield scope
+                finally:
+                    self.num_ongoing -= 1
+                    self.total_served += 1
+                    telemetry.inc(
+                        "ray_tpu_serve_replica_requests_total", 1,
+                        {"deployment": self.deployment_name,
+                         "status": scope["status"]})
+                    telemetry.observe(
+                        "ray_tpu_serve_replica_latency_seconds",
+                        time.perf_counter() - t0,
+                        {"deployment": self.deployment_name})
+
+        return scope_cm()
+
+    async def handle_request(self, method_name: str, args: tuple,
+                             kwargs: dict) -> Any:
+        with self._request_scope(
+                kwargs, f"replica {self.deployment_name}") as scope:
+            fn = self._resolve_fn(method_name)
+            out = fn(*args, **kwargs)
+            if inspect.isawaitable(out):
+                out = await out
+            if inspect.isgenerator(out) or inspect.isasyncgen(out):
+                # Materializing a stream into one response would
+                # defeat the generator; point at the streaming API.
+                raise TypeError(
+                    f"{self.deployment_name}.{method_name} returned "
+                    "a generator from a non-streaming call; use "
+                    "handle.options(stream=True).remote(...) (or "
+                    "the HTTP proxy, which streams generator "
+                    "deployments automatically)")
+            scope["status"] = "ok"
+            return out
+
+    async def handle_request_streaming(self, method_name: str,
+                                       args: tuple, kwargs: dict):
+        """Streaming twin of ``handle_request``: an async-generator
+        actor method executed with ``num_returns='streaming'`` — every
+        yielded chunk rides the core stream_item lane to the caller.
+        Sync and async user generators both work; replica metrics count
+        the whole stream as one request."""
+        with self._request_scope(
+                kwargs,
+                f"replica {self.deployment_name} stream") as scope:
+            fn = self._resolve_fn(method_name)
+            out = fn(*args, **kwargs)
+            if inspect.isawaitable(out):
+                out = await out
+            if inspect.isasyncgen(out):
+                async for chunk in out:
+                    yield chunk
+            elif hasattr(out, "__next__"):
+                # Sync generator on the replica loop: yields hand
+                # control back between chunks, so health checks and
+                # concurrent requests still interleave.
+                for chunk in out:
+                    yield chunk
+            else:
+                raise TypeError(
+                    f"{self.deployment_name}.{method_name} was "
+                    "called with stream=True but returned "
+                    f"{type(out).__name__}, not a generator/async "
+                    "generator")
+            scope["status"] = "ok"
 
     async def metrics(self) -> Dict[str, Any]:
         return {

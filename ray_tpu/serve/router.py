@@ -24,6 +24,7 @@ import time
 from typing import Any, Dict, Optional
 
 import ray_tpu
+from ray_tpu import exceptions as exc
 from ray_tpu.core.config import get_config
 from ray_tpu.core.retry import CircuitBreaker, RetryPolicy
 from ray_tpu.util import telemetry, tracing
@@ -33,7 +34,11 @@ class Router:
     def __init__(self, controller_handle, refresh_period_s: float = 1.0):
         self._controller = controller_handle
         self._refresh_period = refresh_period_s
-        self._lock = threading.Lock()
+        # Reentrant: stream done-callbacks can fire from GC
+        # (ObjectRefGenerator.__del__ -> close -> _fire_terminal) on a
+        # thread that is already inside a locked router section; a
+        # plain Lock would self-deadlock there.
+        self._lock = threading.RLock()
         self._version = -1
         self._last_refresh = 0.0
         # deployment key -> list of replica actor names
@@ -91,6 +96,19 @@ class Router:
                     best_key, best_len = key, len(rp)
         return best_key
 
+    def resolve_route(self, path: str):
+        """route_for_prefix + a forced refresh on miss -> (key, entry
+        dict) or (None, None). The shared routing lookup for BOTH
+        ingress proxies (HTTP and gRPC)."""
+        key = self.route_for_prefix(path)
+        if key is None:
+            self._refresh(force=True)
+            key = self.route_for_prefix(path)
+        if key is None:
+            return None, None
+        with self._lock:
+            return key, dict(self._table.get(key) or {})
+
     def _replica_handle(self, name: str):
         h = self._handles.get(name)
         if h is None:
@@ -132,12 +150,17 @@ class Router:
         return name, self._replica_handle(name)
 
     def assign(self, deployment_key: str, method_name: str, args, kwargs,
-               trace_carrier=None):
+               trace_carrier=None, stream: bool = False):
         """Route one request. ``trace_carrier`` parents the router span
         when the caller's span lives on another thread/process (the
         proxy's event loop, a composing replica) — thread-local context
         does not survive the executor hop, so the carrier rides
-        explicitly and continues into the replica via a hidden kwarg."""
+        explicitly and continues into the replica via a hidden kwarg.
+
+        ``stream=True`` routes to the replica's streaming lane instead:
+        the return value is an ObjectRefGenerator of chunk refs, with
+        the deployment's ``max_queued_stream_chunks`` applied as the
+        replica-side backpressure window."""
         if trace_carrier is None and tracing.is_enabled():
             trace_carrier = tracing.inject_context()
         with contextlib.ExitStack() as stack:
@@ -155,16 +178,25 @@ class Router:
             try:
                 return self._assign_policy.execute_sync(
                     lambda: self._assign_once(deployment_key, method_name,
-                                              args, kwargs, t0),
+                                              args, kwargs, t0, stream),
                     label=f"serve assign {deployment_key}")
             except Exception as e:
                 raise RuntimeError(f"could not assign request: {e}")
 
     def _assign_once(self, deployment_key: str, method_name: str,
-                     args, kwargs, t0=None):
+                     args, kwargs, t0=None, stream: bool = False):
         try:
             name, handle = self.pick(deployment_key)
         except RuntimeError:
+            # pick() force-refreshed before raising: a key absent from a
+            # FRESH table is a deleted deployment — fail fast instead of
+            # burning the scale-from-zero wait on a route that will
+            # never come back under this key.
+            with self._lock:
+                known = deployment_key in self._table
+            if not known:
+                raise RuntimeError(
+                    f"deployment {deployment_key} is not deployed")
             # No replicas: report the queued request (scale-from-zero
             # signal) and wait for the autoscaler to bring one up.
             ray_tpu.get(self._controller.report_pending_request.remote(
@@ -187,7 +219,16 @@ class Router:
             self._qlen[name] = self._qlen.get(name, 0) + 1
         self._report_queue_depth(deployment_key)
         try:
-            ref = handle.handle_request.remote(method_name, args, kwargs)
+            if stream:
+                window = int((self._table.get(deployment_key) or {}).get(
+                    "max_queued_stream_chunks", 16))
+                gen = handle.handle_request_streaming.options(
+                    num_returns="streaming",
+                    max_queued_stream_chunks=window,
+                ).remote(method_name, args, kwargs)
+            else:
+                ref = handle.handle_request.remote(method_name, args,
+                                                   kwargs)
         except Exception:
             # Replica died between table refreshes; trip its breaker,
             # drop it and let the policy retry against the rest.
@@ -198,6 +239,9 @@ class Router:
             self._refresh(force=True)
             raise
         self._breaker.record_success(name)
+        if stream:
+            self._attach_stream_completion(name, gen, deployment_key, t0)
+            return gen
         self._attach_completion(name, ref, deployment_key, t0)
         return ref
 
@@ -211,6 +255,70 @@ class Router:
         telemetry.set_gauge("ray_tpu_serve_router_queue_depth", depth,
                             {"deployment": deployment_key,
                              "proc": telemetry.proc_tag()})
+
+    def _attach_stream_completion(self, name: str, gen, deployment_key,
+                                  t0):
+        """Stream-lifecycle accounting: TTFT on the first chunk, queue
+        depth + chunk/abort counters + breaker verdict at terminal.
+        Callbacks fire from the owner loop (producer finish) or the
+        consumer thread (release) — everything here is lock-safe."""
+        from ray_tpu.util import flight_recorder
+
+        flight_recorder.record("serve", "stream_started",
+                               deployment=deployment_key, replica=name)
+
+        def first_chunk():
+            if t0 is not None:
+                telemetry.observe("ray_tpu_serve_stream_ttft_seconds",
+                                  max(0.0, time.time() - t0),
+                                  {"deployment": deployment_key})
+
+        # NB: `done` receives the generator as an argument instead of
+        # closing over `gen` — a gen-capturing closure stored in
+        # gen._done_cbs would be a reference cycle, and abandoned
+        # streams must die by refcount (that drop IS the cancel signal).
+        def done(tag, g):
+            with self._lock:
+                self._qlen[name] = max(0, self._qlen.get(name, 1) - 1)
+            self._report_queue_depth(deployment_key)
+            telemetry.inc("ray_tpu_serve_stream_chunks_total",
+                          g.items_produced(),
+                          {"deployment": deployment_key})
+            if t0 is not None:
+                telemetry.observe("ray_tpu_serve_request_latency_seconds",
+                                  max(0.0, time.time() - t0),
+                                  {"deployment": deployment_key})
+            if tag == "ok":
+                self._breaker.record_success(name)
+                return
+            reason = self._stream_abort_reason(g, tag)
+            telemetry.inc("ray_tpu_serve_stream_aborts_total", 1,
+                          {"deployment": deployment_key,
+                           "reason": reason})
+            flight_recorder.record(
+                "serve", "stream_aborted", severity="warn",
+                deployment=deployment_key, replica=name, reason=reason,
+                chunks=g.items_produced())
+            if reason == "replica_death":
+                # Mid-stream deaths count toward the per-replica
+                # breaker exactly like failed sends.
+                self._breaker.record_failure(name)
+
+        gen.add_first_item_callback(first_chunk)
+        gen.add_done_callback(done)
+
+    @staticmethod
+    def _stream_abort_reason(gen, tag: str) -> str:
+        if tag == "released":
+            # The consumer walked away; whoever released may have
+            # annotated why (the proxy tags chunk-deadline releases).
+            return getattr(gen, "_release_reason", "client_disconnect")
+        err = gen.error()
+        if isinstance(err, exc.ACTOR_SYSTEM_FAILURES):
+            return "replica_death"
+        if isinstance(err, exc.GetTimeoutError):
+            return "deadline"
+        return "app_error"
 
     def _attach_completion(self, name: str, ref, deployment_key=None,
                            t0=None):

@@ -174,6 +174,27 @@ class ActorKiller(_KillerBase):
         return victim["name"]
 
 
+class ReplicaKiller(ActorKiller):
+    """Serve-aware chaos lane: kills live ``SERVE_REPLICA::`` actors,
+    optionally scoped to one deployment — used by the streaming soak to
+    prove a replica death mid-stream surfaces a terminal error chunk to
+    clients (never a hang) and that the router reroutes the next
+    request. Replica names embed ``<app>#<deployment>#g<gen>#<n>``, so
+    ``app``/``deployment`` filters match structurally rather than by
+    raw prefix."""
+
+    def __init__(self, kill_interval_s: float = 1.0, max_kills: int = 3,
+                 app: str = "", deployment: str = "", seed: int = 0,
+                 max_duration_s: Optional[float] = None):
+        prefix = "SERVE_REPLICA::"
+        if app:
+            prefix += f"{app}#"
+            if deployment:
+                prefix += f"{deployment}#"
+        super().__init__(kill_interval_s, max_kills, prefix, seed,
+                         max_duration_s)
+
+
 class TrainWorkerKiller(_KillerBase):
     """Train-aware chaos lane: kills or hangs a random ``TrainWorker``
     gang actor mid-run, exercising the trainer's gang health monitor

@@ -67,8 +67,9 @@ CATALOG: Dict[str, tuple] = {
     # train/backend_executor.py + train/trainer.py
     "train": ("heartbeat_miss", "gang_abort", "gang_restart",
               "elastic_resize"),
-    # serve/router.py
-    "serve": ("replica_shed",),
+    # serve/router.py (streaming lifecycle rides the router — it sees
+    # both the HTTP proxy's streams and driver-side handle streams)
+    "serve": ("replica_shed", "stream_started", "stream_aborted"),
     # the debug plane itself (util/flight_recorder.py)
     "debug": ("postmortem",),
 }

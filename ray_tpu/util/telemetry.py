@@ -139,6 +139,18 @@ CATALOG: Dict[str, tuple] = {
     "ray_tpu_serve_replica_latency_seconds": (
         HISTOGRAM, "Replica-side request execution latency.",
         ("deployment",), SLOW_BOUNDARIES),
+    # --- serve streaming (serve/router.py + serve/proxy.py) ---
+    "ray_tpu_serve_stream_ttft_seconds": (
+        HISTOGRAM, "Time from stream assignment to the first chunk "
+        "(time-to-first-token for LLM serving).",
+        ("deployment",), SLOW_BOUNDARIES),
+    "ray_tpu_serve_stream_chunks_total": (
+        COUNTER, "Chunks produced by streaming deployment responses.",
+        ("deployment",), None),
+    "ray_tpu_serve_stream_aborts_total": (
+        COUNTER, "Streams terminated before a clean finish "
+        "(replica_death / client_disconnect / deadline / app_error).",
+        ("deployment", "reason"), None),
     # --- train (train/session.py) ---
     "ray_tpu_train_reports_total": (
         COUNTER, "train.report() calls across training workers.",

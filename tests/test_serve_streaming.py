@@ -1,0 +1,544 @@
+"""Serve response streaming tests (reference strategy:
+python/ray/serve/tests/test_streaming_response.py + test_generators):
+replica generators -> streaming handles -> SSE/chunked HTTP, with
+backpressure and mid-stream fault semantics."""
+
+import asyncio
+import json
+import time
+import urllib.request
+
+import pytest
+
+import ray_tpu
+from ray_tpu import serve
+
+
+@pytest.fixture(scope="module")
+def serve_cluster():
+    ray_tpu.init(num_cpus=6, num_tpus=0)
+    yield
+    serve.shutdown()
+    ray_tpu.shutdown()
+
+
+@pytest.fixture(autouse=True)
+def _cleanup_apps(serve_cluster):
+    yield
+    leftover = {key.split("#", 1)[0] for key in serve.status()}
+    for app in leftover:
+        serve.delete(app)
+
+
+HTTP_PORT = 8457
+
+
+def _http_stream(path="/", accept=None, port=HTTP_PORT, timeout=60):
+    headers = {"Accept": accept} if accept else {}
+    req = urllib.request.Request(f"http://127.0.0.1:{port}{path}",
+                                 headers=headers)
+    return urllib.request.urlopen(req, timeout=timeout)
+
+
+# ---------------------------------------------------------------------------
+# handle-level streaming
+# ---------------------------------------------------------------------------
+
+
+def test_handle_stream_sync_iteration(serve_cluster):
+    @serve.deployment(num_cpus=0.1)
+    class Gen:
+        def __call__(self, n):
+            for i in range(n):
+                yield {"chunk": i}
+
+    h = serve.run(Gen.bind(), name="hs", proxy=False)
+    out = list(h.options(stream=True).remote(7))
+    assert out == [{"chunk": i} for i in range(7)]
+    serve.delete("hs")
+
+
+def test_handle_stream_async_iteration_and_async_gen(serve_cluster):
+    @serve.deployment(num_cpus=0.1)
+    class AGen:
+        async def __call__(self, n):
+            for i in range(n):
+                await asyncio.sleep(0.005)
+                yield i * 11
+
+    h = serve.run(AGen.bind(), name="ha", proxy=False)
+
+    async def consume():
+        out = []
+        async for chunk in h.options(stream=True).remote(5):
+            out.append(chunk)
+        return out
+
+    assert asyncio.run(consume()) == [0, 11, 22, 33, 44]
+    serve.delete("ha")
+
+
+def test_handle_stream_incremental_delivery(serve_cluster):
+    """First chunk arrives long before the generator finishes."""
+
+    @serve.deployment(num_cpus=0.1)
+    class Slow:
+        async def __call__(self, _):
+            for i in range(4):
+                yield i
+                await asyncio.sleep(0.4)
+
+    h = serve.run(Slow.bind(), name="hslow", proxy=False)
+    gen = h.options(stream=True).remote(None)
+    t0 = time.time()
+    first = next(iter(gen))
+    first_latency = time.time() - t0
+    assert first == 0
+    assert first_latency < 1.2, first_latency
+    assert list(gen) == [1, 2, 3]
+    serve.delete("hslow")
+
+
+def test_stream_non_generator_method_raises(serve_cluster):
+    @serve.deployment(num_cpus=0.1)
+    class Plain:
+        def __call__(self, x):
+            return x
+
+    h = serve.run(Plain.bind(), name="hplain", proxy=False)
+    gen = h.options(stream=True).remote(1)
+    with pytest.raises(Exception, match="generator"):
+        next(iter(gen))
+    serve.delete("hplain")
+
+
+def test_non_stream_call_to_generator_raises_helpfully(serve_cluster):
+    @serve.deployment(num_cpus=0.1)
+    class Gen:
+        def __call__(self, n):
+            yield n
+
+    h = serve.run(Gen.bind(), name="hgen2", proxy=False)
+    with pytest.raises(Exception, match="stream=True"):
+        h.remote(1).result()
+    serve.delete("hgen2")
+
+
+def test_stream_backpressure_caps_replica_queue(serve_cluster):
+    """max_queued_stream_chunks bounds replica-side produced-but-unread
+    chunks: a slow consumer pauses a fast generator instead of letting
+    it buffer the whole stream."""
+
+    @serve.deployment(num_cpus=0.1, num_replicas=1,
+                      max_queued_stream_chunks=4)
+    class Counting:
+        def __init__(self):
+            self.produced = 0
+
+        async def __call__(self, n):
+            for i in range(n):
+                self.produced += 1
+                yield i
+
+        async def produced_count(self):
+            return self.produced
+
+    h = serve.run(Counting.bind(), name="bp", proxy=False)
+    gen = h.options(stream=True).remote(80)
+    it = iter(gen)
+    assert next(it) == 0  # one chunk consumed
+    time.sleep(1.0)  # fast producer would have drained all 80 by now
+    produced = h.options(method_name="produced_count").remote(
+        ).result()
+    # 1 read + window 4 + one mid-flight.
+    assert produced <= 6, f"backpressure did not engage: {produced}"
+    assert [next(it) for _ in range(79)] == list(range(1, 80))
+    serve.delete("bp")
+
+
+def test_stream_consumer_drop_stops_replica_generator(serve_cluster):
+    """Dropping the response generator cancels the replica-side body
+    (router -> core _release_stream -> actor-lane cancel)."""
+
+    @serve.deployment(num_cpus=0.1, max_queued_stream_chunks=8)
+    class Infinite:
+        def __init__(self):
+            self.produced = 0
+
+        async def __call__(self, _):
+            while True:
+                self.produced += 1
+                yield self.produced
+
+        async def produced_count(self):
+            return self.produced
+
+    h = serve.run(Infinite.bind(), name="drop", proxy=False)
+    gen = h.options(stream=True).remote(None)
+    assert next(iter(gen)) == 1
+    gen.cancel()
+    time.sleep(1.0)
+    n1 = h.options(method_name="produced_count").remote().result()
+    time.sleep(0.5)
+    n2 = h.options(method_name="produced_count").remote().result()
+    assert n2 == n1, f"generator kept running after cancel: {n1}->{n2}"
+    serve.delete("drop")
+
+
+# ---------------------------------------------------------------------------
+# HTTP proxy streaming
+# ---------------------------------------------------------------------------
+
+
+def test_http_sse_first_chunk_before_finish_and_in_order(serve_cluster):
+    """Tier-1 e2e: the first SSE chunk of a 100-chunk generator arrives
+    before the generator finishes, and chunks arrive in order."""
+
+    @serve.deployment(num_cpus=0.1)
+    class Tokens:
+        async def __call__(self, request):
+            for i in range(100):
+                yield {"token": i}
+                await asyncio.sleep(0.02)  # whole stream takes ~2s
+
+    serve.run(Tokens.bind(), name="sse", http_port=HTTP_PORT)
+    t0 = time.time()
+    resp = _http_stream(accept="text/event-stream")
+    assert "text/event-stream" in resp.headers.get("Content-Type", "")
+    first = resp.readline().decode()
+    first_latency = time.time() - t0
+    assert first.startswith("data: "), first
+    assert json.loads(first[len("data: "):]) == {"token": 0}
+    assert first_latency < 1.5, (
+        f"first chunk took {first_latency:.2f}s — not streamed")
+    tokens = [json.loads(ln[len(b"data: "):].decode())["token"]
+              for ln in resp.readlines()
+              if ln.startswith(b"data: {")]
+    assert tokens == list(range(1, 100))
+    serve.delete("sse")
+
+
+def test_http_chunked_negotiation_and_format_pin(serve_cluster):
+    @serve.deployment(num_cpus=0.1)
+    class Words:
+        def __call__(self, request):
+            for w in ("alpha", "beta", "gamma"):
+                yield w + " "
+
+    serve.run(Words.bind(), name="chunked", http_port=HTTP_PORT)
+    # No Accept header -> chunked transfer, raw payloads.
+    resp = _http_stream()
+    assert "application/octet-stream" in resp.headers.get(
+        "Content-Type", "")
+    assert resp.read().decode() == "alpha beta gamma "
+    serve.delete("chunked")
+
+    # stream_format="sse" pins SSE even without the Accept header.
+    @serve.deployment(num_cpus=0.1, stream_format="sse")
+    class Pinned:
+        def __call__(self, request):
+            yield "x"
+
+    serve.run(Pinned.bind(), name="pinned", http_port=HTTP_PORT)
+    # The proxy's router refreshes its table on a 1s throttle; right
+    # after a redeploy at the same route it may briefly serve the old
+    # entry — poll past that window.
+    deadline = time.time() + 10
+    ctype, body = "", ""
+    while time.time() < deadline:
+        resp = _http_stream()
+        ctype = resp.headers.get("Content-Type", "")
+        body = resp.read().decode()
+        if "text/event-stream" in ctype:
+            break
+        time.sleep(0.5)
+    assert "text/event-stream" in ctype, ctype
+    assert "data: x" in body and "event: end" in body
+    serve.delete("pinned")
+
+
+def test_http_midstream_app_error_terminal_chunk(serve_cluster):
+    """A generator raising mid-stream yields a terminal error event to
+    the HTTP client instead of a hang or a silent truncation."""
+
+    @serve.deployment(num_cpus=0.1)
+    class Exploding:
+        def __call__(self, request):
+            yield "ok-1"
+            yield "ok-2"
+            raise ValueError("stream exploded mid-flight")
+
+    serve.run(Exploding.bind(), name="boom", http_port=HTTP_PORT)
+    body = _http_stream(accept="text/event-stream").read().decode()
+    assert "data: ok-1" in body and "data: ok-2" in body
+    assert "event: error" in body, body
+    assert "stream exploded mid-flight" in body
+    # Chunked framing carries the documented error trailer.
+    body2 = _http_stream().read().decode()
+    assert "[stream-error]" in body2 and "stream exploded" in body2
+    serve.delete("boom")
+
+
+def test_http_midstream_replica_death_terminal_error(serve_cluster):
+    """Tier-1 e2e: killing the replica mid-stream surfaces a terminal
+    error event (not a hang), and the router reroutes the next request
+    once the controller restores a replica."""
+
+    @serve.deployment(num_cpus=0.1)
+    class Endless:
+        async def __call__(self, request):
+            for i in range(10_000):
+                yield {"token": i}
+                await asyncio.sleep(0.02)
+
+    serve.run(Endless.bind(), name="kill", http_port=HTTP_PORT)
+    resp = _http_stream(accept="text/event-stream", timeout=90)
+    assert resp.readline().startswith(b"data: ")  # stream is live
+
+    # Kill the replica mid-stream.
+    victims = [a for a in ray_tpu.list_named_actors(True)
+               if a["name"].startswith("SERVE_REPLICA::kill#")]
+    assert victims, "no replica found to kill"
+    ray_tpu.kill(ray_tpu.get_actor(
+        victims[0]["name"], victims[0].get("namespace", "")))
+
+    deadline = time.time() + 60
+    saw_error = False
+    while time.time() < deadline:
+        line = resp.readline()
+        if not line:
+            break
+        if line.startswith(b"event: error"):
+            saw_error = True
+            break
+    assert saw_error, "client never saw a terminal error event"
+
+    # The controller replaces the replica; the next request reroutes.
+    deadline = time.time() + 90
+    rerouted = None
+    while time.time() < deadline:
+        try:
+            r = _http_stream(accept="text/event-stream", timeout=30)
+            line = r.readline()
+            if line.startswith(b"data: "):
+                rerouted = line
+                r.close()
+                break
+        except Exception:
+            pass
+        time.sleep(1.0)
+    assert rerouted is not None, "router never recovered a route"
+    serve.delete("kill")
+
+
+# ---------------------------------------------------------------------------
+# gRPC streaming
+# ---------------------------------------------------------------------------
+
+
+def test_grpc_server_streaming_and_unimplemented(serve_cluster):
+    import pickle
+
+    grpc = pytest.importorskip("grpc")
+
+    @serve.deployment(num_cpus=0.1)
+    class GGen:
+        def __call__(self, n):
+            for i in range(n):
+                yield i * 2
+
+    @serve.deployment(num_cpus=0.1, route_prefix="/plain")
+    class GPlain:
+        def __call__(self, x):
+            return x
+
+    serve.run(GGen.bind(), name="ggen", http_port=HTTP_PORT)
+    proxy = ray_tpu.get_actor("SERVE_PROXY")
+    port = ray_tpu.get(proxy.get_grpc_port.remote(), timeout=30)
+    assert port
+    ch = grpc.insecure_channel(f"127.0.0.1:{port}")
+    call = ch.unary_stream(
+        "/ray_tpu.serve.UserDefinedStreamingService/ggen")
+    # The gRPC proxy's router refreshes on the same 1s throttle as the
+    # HTTP side — poll past any stale-table window from earlier tests.
+    chunks, deadline = [], time.time() + 15
+    while time.time() < deadline:
+        try:
+            chunks = [pickle.loads(m)
+                      for m in call(pickle.dumps(((4,), {})),
+                                    timeout=60)]
+            break
+        except grpc.RpcError:
+            time.sleep(0.5)
+    assert chunks == [0, 2, 4, 6]
+    serve.delete("ggen")
+
+    # Streaming service on a non-generator deployment: clear error.
+    serve.run(GPlain.bind(), name="gplain", route_prefix="/gplain",
+              http_port=HTTP_PORT)
+    call = ch.unary_stream(
+        "/ray_tpu.serve.UserDefinedStreamingService/gplain")
+    code, deadline = None, time.time() + 15
+    while time.time() < deadline:
+        with pytest.raises(grpc.RpcError) as err:
+            list(call(pickle.dumps(((1,), {})), timeout=60))
+        code = err.value.code()
+        if code != grpc.StatusCode.NOT_FOUND:  # stale-table window
+            break
+        time.sleep(0.5)
+    assert code in (grpc.StatusCode.UNIMPLEMENTED,
+                    grpc.StatusCode.INTERNAL), code
+    ch.close()
+    serve.delete("gplain")
+
+
+# ---------------------------------------------------------------------------
+# @serve.batch generator guard
+# ---------------------------------------------------------------------------
+
+
+def test_batch_rejects_generator_function_at_decoration():
+    with pytest.raises(TypeError, match="stream"):
+        @serve.batch
+        def gen_batch(requests):
+            yield from requests
+
+
+def test_batch_rejects_generator_return_at_call_time():
+    @serve.batch(max_batch_size=4, batch_wait_timeout_s=0.01)
+    async def bad(requests):
+        return (r for r in requests)  # a generator, not a list
+
+    with pytest.raises(TypeError, match="generator"):
+        asyncio.run(bad(1))
+
+
+# ---------------------------------------------------------------------------
+# streaming observability
+# ---------------------------------------------------------------------------
+
+
+def test_stream_metrics_and_flight_events(serve_cluster):
+    from ray_tpu.util import flight_recorder, telemetry
+
+    @serve.deployment(num_cpus=0.1)
+    class MGen:
+        def __call__(self, n):
+            for i in range(n):
+                yield i
+
+    h = serve.run(MGen.bind(), name="met", proxy=False)
+    assert list(h.options(stream=True).remote(5)) == list(range(5))
+
+    # The driver-side router recorded the stream lifecycle (the done
+    # callback fires on the owner loop; poll out the tiny race with the
+    # consumer's StopIteration).
+    deadline = time.time() + 10
+    chunk_counts = []
+    while time.time() < deadline and not chunk_counts:
+        m = telemetry.metric("ray_tpu_serve_stream_chunks_total")
+        chunk_counts = [v for tags, v in m._values.items()
+                        if ("deployment", "met#MGen") in tags]
+        time.sleep(0.05)
+    assert chunk_counts and chunk_counts[0] >= 5
+    ttft = telemetry.metric("ray_tpu_serve_stream_ttft_seconds")
+    assert any(("deployment", "met#MGen") in tags
+               for tags in ttft._hists), ttft._hists
+
+    events = [e for e in flight_recorder.snapshot()
+              if e["subsystem"] == "serve"
+              and e["event"] == "stream_started"
+              and (e.get("tags") or {}).get("deployment") == "met#MGen"]
+    assert events, "stream_started never recorded"
+
+    # Abort path: a mid-stream app error tags an abort reason.
+    @serve.deployment(num_cpus=0.1)
+    class MBoom:
+        def __call__(self, _):
+            yield 1
+            raise RuntimeError("abort-metric")
+
+    h2 = serve.run(MBoom.bind(), name="metboom", proxy=False)
+    gen = h2.options(stream=True).remote(None)
+    with pytest.raises(Exception, match="abort-metric"):
+        list(gen)
+    deadline = time.time() + 10
+    aborted = []
+    while time.time() < deadline and not aborted:
+        aborts = telemetry.metric("ray_tpu_serve_stream_aborts_total")
+        aborted = [tags for tags in aborts._values
+                   if ("deployment", "metboom#MBoom") in tags
+                   and ("reason", "app_error") in tags]
+        time.sleep(0.05)
+    assert aborted, "stream abort never counted"
+    ev = [e for e in flight_recorder.snapshot()
+          if e["subsystem"] == "serve"
+          and e["event"] == "stream_aborted"]
+    assert ev, "stream_aborted never recorded"
+    serve.delete("met")
+    serve.delete("metboom")
+
+
+# ---------------------------------------------------------------------------
+# chaos soak (slow lane)
+# ---------------------------------------------------------------------------
+
+
+@pytest.mark.slow
+@pytest.mark.chaos
+def test_replica_killer_midstream_soak(serve_cluster):
+    """Slow soak: a ReplicaKiller takes replicas down while clients hold
+    open streams; every interrupted client sees a terminal error (never
+    a hang) and fresh requests keep being served by rerouted/replaced
+    replicas."""
+    from ray_tpu.util.chaos import ReplicaKiller
+
+    @serve.deployment(num_cpus=0.1, num_replicas=2)
+    class SoakGen:
+        async def __call__(self, _):
+            for i in range(5_000):
+                yield i
+                await asyncio.sleep(0.01)
+
+    h = serve.run(SoakGen.bind(), name="soak", proxy=False)
+    killer = (ray_tpu.remote(ReplicaKiller)
+              .options(name="_chaos_replica_killer", num_cpus=0.1)
+              .remote(kill_interval_s=2.0, max_kills=2, app="soak",
+                      deployment="SoakGen", seed=7, max_duration_s=45))
+    run_ref = killer.run.remote()
+
+    outcomes = {"errors": 0, "finished": 0}
+    deadline = time.time() + 60
+    while time.time() < deadline:
+        gen = h.options(stream=True).remote(None)
+        try:
+            n = 0
+            for _ in gen:
+                n += 1
+                if n >= 200:
+                    gen.cancel()
+                    break
+            outcomes["finished"] += 1
+        except Exception:
+            outcomes["errors"] += 1  # terminal error, not a hang
+        kills = ray_tpu.get(killer.get_killed.remote(), timeout=10)
+        if len(kills) >= 2 and outcomes["errors"] >= 1:
+            break
+    kills = ray_tpu.get(run_ref, timeout=90)
+    assert kills >= 1, "killer never struck"
+    assert outcomes["errors"] >= 1, (
+        f"no client observed a mid-stream kill: {outcomes}")
+    # The deployment still serves after the chaos window.
+    deadline = time.time() + 90
+    recovered = False
+    while time.time() < deadline and not recovered:
+        try:
+            gen = h.options(stream=True).remote(None)
+            next(iter(gen))
+            gen.cancel()
+            recovered = True
+        except Exception:
+            time.sleep(1.0)
+    assert recovered, "deployment never recovered after chaos"
+    ray_tpu.kill(killer)
+    serve.delete("soak")

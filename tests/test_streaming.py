@@ -87,15 +87,225 @@ def test_streaming_pre_generator_failure_closes_stream(stream_cluster):
         next(g)  # setup error closes the stream instead of hanging
 
 
-def test_streaming_on_actor_method_raises(stream_cluster):
+def test_streaming_on_sync_actor_method(stream_cluster):
     class A:
-        def gen(self):
-            yield 1
+        def gen(self, n):
+            for i in range(n):
+                yield i * 3
 
     a = ray_tpu.remote(A).options(num_cpus=0.1).remote()
-    with pytest.raises(TypeError, match="streaming"):
-        a.gen.options(num_returns="streaming").remote()
+    g = a.gen.options(num_returns="streaming").remote(4)
+    out = [ray_tpu.get(r, timeout=60) for r in g]
+    assert out == [0, 3, 6, 9]
     ray_tpu.kill(a)
+
+
+def test_streaming_on_async_actor_method(stream_cluster):
+    class A:
+        async def agen(self, n):
+            import asyncio
+
+            for i in range(n):
+                await asyncio.sleep(0.01)
+                yield i + 100
+
+    a = ray_tpu.remote(A).options(num_cpus=0.1).remote()
+    g = a.agen.options(num_returns="streaming").remote(4)
+    out = [ray_tpu.get(r, timeout=60) for r in g]
+    assert out == [100, 101, 102, 103]
+    ray_tpu.kill(a)
+
+
+def test_streaming_actor_method_not_a_generator(stream_cluster):
+    class A:
+        def plain(self):
+            return 42
+
+    a = ray_tpu.remote(A).options(num_cpus=0.1).remote()
+    g = a.plain.options(num_returns="streaming").remote()
+    with pytest.raises(Exception, match="generator"):
+        next(g)
+    ray_tpu.kill(a)
+
+
+def test_streaming_backpressure_bounds_producer(stream_cluster):
+    """max_queued_stream_chunks pauses the generator body once that
+    many chunks are produced-but-unread (credit-based flow control)."""
+
+    class Producer:
+        def __init__(self):
+            self.produced = 0
+
+        async def gen(self, n):
+            for i in range(n):
+                self.produced += 1
+                yield i
+
+        async def count(self):
+            return self.produced
+
+    a = ray_tpu.remote(Producer).options(num_cpus=0.1).remote()
+    g = a.gen.options(num_returns="streaming",
+                      max_queued_stream_chunks=3).remote(60)
+    first = ray_tpu.get(next(g), timeout=60)
+    time.sleep(1.0)
+    produced = ray_tpu.get(a.count.remote(), timeout=60)
+    # 1 read + window of 3 + one chunk mid-flight.
+    assert produced <= 5, produced
+    rest = [ray_tpu.get(r, timeout=60) for r in g]
+    assert [first] + rest == list(range(60))
+    ray_tpu.kill(a)
+
+
+def test_streaming_consumer_drop_cancels_actor_stream(stream_cluster):
+    """Closing the generator propagates cancellation over the actor RPC
+    lane: the replica-side generator actually stops yielding."""
+
+    class Infinite:
+        def __init__(self):
+            self.n = 0
+
+        async def gen(self):
+            while True:
+                self.n += 1
+                yield self.n
+
+        async def count(self):
+            return self.n
+
+    a = ray_tpu.remote(Infinite).options(num_cpus=0.1).remote()
+    g = a.gen.options(num_returns="streaming",
+                      max_queued_stream_chunks=8).remote()
+    ray_tpu.get(next(g), timeout=60)
+    g.close()
+    time.sleep(1.0)
+    n1 = ray_tpu.get(a.count.remote(), timeout=60)
+    time.sleep(0.5)
+    n2 = ray_tpu.get(a.count.remote(), timeout=60)
+    assert n2 == n1, f"stream kept producing after close: {n1} -> {n2}"
+    ray_tpu.kill(a)
+
+
+def test_streaming_async_iteration(stream_cluster):
+    """ObjectRefGenerator is async-iterable (the serve proxy's path)."""
+    import asyncio
+
+    @ray_tpu.remote
+    def gen(n):
+        for i in range(n):
+            yield i * 7
+
+    async def consume():
+        g = gen.options(num_returns="streaming").remote(5)
+        out = []
+        async for ref in g:
+            out.append(ray_tpu.get(ref, timeout=60))
+        return out
+
+    assert asyncio.run(consume()) == [0, 7, 14, 21, 28]
+
+
+def test_streaming_dropped_generator_cancels_producer(stream_cluster):
+    """Dropping the generator WITHOUT close() still cancels the
+    producer: the owner's stream registry holds it weakly, so
+    abandonment triggers __del__ -> close -> cancel."""
+    import gc
+
+    class Infinite:
+        def __init__(self):
+            self.n = 0
+
+        async def gen(self):
+            while True:
+                self.n += 1
+                yield self.n
+
+        async def count(self):
+            return self.n
+
+    a = ray_tpu.remote(Infinite).options(num_cpus=0.1).remote()
+    g = a.gen.options(num_returns="streaming",
+                      max_queued_stream_chunks=8).remote()
+    ray_tpu.get(next(g), timeout=60)
+    del g  # no close(); the drop itself is the cancel signal
+    gc.collect()
+    time.sleep(1.0)
+    n1 = ray_tpu.get(a.count.remote(), timeout=60)
+    time.sleep(0.5)
+    n2 = ray_tpu.get(a.count.remote(), timeout=60)
+    assert n2 == n1, f"producer survived generator drop: {n1} -> {n2}"
+    ray_tpu.kill(a)
+
+
+def test_streaming_close_wakes_blocked_consumer(stream_cluster):
+    """close() from another thread ends iteration for a consumer
+    blocked in __next__ (the gRPC cancel-callback shape) instead of
+    leaving it waiting forever."""
+    import threading
+
+    @ray_tpu.remote
+    def trickle():
+        yield 1
+        time.sleep(30)  # consumer will block waiting for item 2
+        yield 2
+
+    g = trickle.options(num_returns="streaming").remote()
+    ray_tpu.get(next(g), timeout=60)
+    result = {}
+
+    def consume():
+        try:
+            next(g)
+            result["outcome"] = "item"
+        except StopIteration:
+            result["outcome"] = "stopped"
+        except Exception as e:  # noqa: BLE001
+            result["outcome"] = f"error: {e}"
+
+    t = threading.Thread(target=consume)
+    t.start()
+    time.sleep(0.3)  # let the consumer block in __next__
+    g.close()
+    t.join(timeout=10)
+    assert not t.is_alive(), "consumer still blocked after close()"
+    assert result["outcome"] == "stopped", result
+
+
+def test_streaming_iterator_timeout_message(stream_cluster):
+    """next_ready's timeout raises the documented error."""
+
+    @ray_tpu.remote
+    def slow():
+        time.sleep(5)
+        yield 1
+
+    g = slow.options(num_returns="streaming").remote()
+    with pytest.raises(Exception, match="stream item not ready in time"):
+        g.next_ready(timeout=0.2)
+    g.close()
+
+
+def test_streaming_abandoned_stream_releases_queued_items(stream_cluster):
+    """Dropping a generator with queued unread items deregisters the
+    stream; late stream_items for it are refused (no owner-side leak)."""
+    from ray_tpu import api as _api
+
+    @ray_tpu.remote
+    def wide():
+        yield from range(50)
+
+    g = wide.options(num_returns="streaming").remote()
+    ray_tpu.get(next(g), timeout=60)
+    cw = _api._require_worker()
+    task_id = g._task_id
+    assert task_id in cw._streams
+    g.close()
+    assert task_id not in cw._streams
+    # h_stream_item after the drop must not re-register anything.
+    deadline = time.time() + 5
+    while task_id in cw._streams and time.time() < deadline:
+        time.sleep(0.05)
+    assert task_id not in cw._streams
 
 
 def test_streaming_requires_generator(stream_cluster):

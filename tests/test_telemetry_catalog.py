@@ -108,6 +108,25 @@ def test_train_recovery_metrics_in_catalog():
         assert tuple(got_tags) == tag_keys, name
 
 
+def test_serve_stream_metrics_in_catalog():
+    """The serve streaming metrics (TTFT / chunks / aborts) stay
+    declared — proxy+router emit through these names and a
+    rename/removal would silently blind the streaming plane."""
+    expected = {
+        "ray_tpu_serve_stream_ttft_seconds": (
+            telemetry.HISTOGRAM, ("deployment",)),
+        "ray_tpu_serve_stream_chunks_total": (
+            telemetry.COUNTER, ("deployment",)),
+        "ray_tpu_serve_stream_aborts_total": (
+            telemetry.COUNTER, ("deployment", "reason")),
+    }
+    for name, (kind, tag_keys) in expected.items():
+        assert name in telemetry.CATALOG, name
+        got_kind, _desc, got_tags, _bounds = telemetry.CATALOG[name]
+        assert got_kind == kind, name
+        assert tuple(got_tags) == tag_keys, name
+
+
 def test_catalog_metric_roundtrip():
     telemetry.reset_for_testing()
     try:
