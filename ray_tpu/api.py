@@ -350,7 +350,10 @@ def actor_exit():
 
 _TASK_DEFAULTS = dict(
     num_cpus=1.0, num_tpus=0.0, resources=None, num_returns=1,
-    max_retries=3, retry_exceptions=False, name="",
+    # None = resolve from config (task_default_max_retries) at submit
+    # time, so system_config/env overrides reach functions decorated
+    # before init().
+    max_retries=None, retry_exceptions=False, name="",
     scheduling_strategy=None, runtime_env=None, memory=None,
     # Streaming-generator backpressure: max produced-but-unread chunks
     # before the generator body pauses (0 = unbounded).
@@ -358,7 +361,9 @@ _TASK_DEFAULTS = dict(
 )
 
 _ACTOR_DEFAULTS = dict(
-    num_cpus=0.0, num_tpus=0.0, resources=None, max_restarts=0,
+    # max_restarts None = resolve from config
+    # (actor_default_max_restarts) at creation time.
+    num_cpus=0.0, num_tpus=0.0, resources=None, max_restarts=None,
     max_task_retries=0, max_concurrency=None, name="", namespace="",
     lifetime=None, scheduling_strategy=None, runtime_env=None,
     get_if_exists=False, memory=None,
@@ -452,7 +457,10 @@ class RemoteFunction:
             name=opts["name"] or getattr(self._fn, "__name__", "task"),
             num_returns=n,
             resources=_build_resources(opts),
-            max_retries=opts["max_retries"] if n != -1 else 0,
+            max_retries=(0 if n == -1
+                         else opts["max_retries"]
+                         if opts["max_retries"] is not None
+                         else get_config().task_default_max_retries),
             retry_exceptions=opts["retry_exceptions"],
             scheduling_strategy=_build_strategy(opts),
             runtime_env=opts["runtime_env"],
@@ -604,7 +612,9 @@ class ActorClass:
             actor_name=opts.get("name", ""),
             namespace=opts.get("namespace", "") or getattr(cw, "namespace", ""),
             resources=_build_resources(opts),
-            max_restarts=opts["max_restarts"],
+            max_restarts=(opts["max_restarts"]
+                          if opts["max_restarts"] is not None
+                          else get_config().actor_default_max_restarts),
             max_task_retries=opts["max_task_retries"],
             max_concurrency=max_concurrency,
             is_async=is_async,

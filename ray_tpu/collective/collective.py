@@ -23,10 +23,11 @@ from typing import Any, Dict, List, Optional, Sequence
 import numpy as np
 
 from ray_tpu.collective.types import Backend, ReduceOp
+from ray_tpu.util.locks import make_lock as _make_lock
 
 _DEFAULT_GROUP = "default"
 
-_lock = threading.Lock()
+_lock = _make_lock("collective.module._lock")
 _groups: Dict[str, "GroupContext"] = {}
 _store_handle = None
 
@@ -54,34 +55,46 @@ def _get_store():
     with _lock:
         if _store_handle is not None:
             return _store_handle
-        ray_tpu = _api()
-        from ray_tpu.collective.store import (
-            STORE_ACTOR_NAME,
-            STORE_NAMESPACE,
-            CollectiveStore,
-        )
+    # Slow path OUTSIDE the lock: creating + pinging the store actor
+    # can take seconds (name races retry with sleeps), and holding
+    # _lock across it would freeze every other collective call in this
+    # process (lock-discipline: no blocking under a lock). Concurrent
+    # creators converge on one actor via get_if_exists, so the losers
+    # just re-cache the same handle.
+    ray_tpu = _api()
+    from ray_tpu.collective.store import (
+        STORE_ACTOR_NAME,
+        STORE_NAMESPACE,
+        CollectiveStore,
+    )
 
-        last_err = None
-        for _ in range(20):
-            try:
-                handle = (
-                    ray_tpu.remote(CollectiveStore)
-                    .options(name=STORE_ACTOR_NAME,
-                             namespace=STORE_NAMESPACE,
-                             lifetime="detached", get_if_exists=True,
-                             num_cpus=0)
-                    .remote()
-                )
-                ray_tpu.get(handle.ping.remote(), timeout=10)
-                _store_handle = handle
-                return handle
-            except Exception as e:  # lost the name race; retry lookup
-                last_err = e
-                import time
+    last_err = None
+    handle = None
+    for _ in range(20):
+        try:
+            handle = (
+                ray_tpu.remote(CollectiveStore)
+                .options(name=STORE_ACTOR_NAME,
+                         namespace=STORE_NAMESPACE,
+                         lifetime="detached", get_if_exists=True,
+                         num_cpus=0)
+                .remote()
+            )
+            ray_tpu.get(handle.ping.remote(), timeout=10)
+            break
+        except Exception as e:  # lost the name race; retry lookup
+            last_err = e
+            handle = None
+            import time
 
-                time.sleep(0.1)
+            time.sleep(0.1)
+    if handle is None:
         raise RuntimeError(
             f"could not reach collective store actor: {last_err}")
+    with _lock:
+        if _store_handle is None:
+            _store_handle = handle
+        return _store_handle
 
 
 class GroupContext:

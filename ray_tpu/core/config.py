@@ -148,7 +148,9 @@ class Config:
     # span pair per request is too hot for production; turn on to see
     # individual control-plane calls inside a trace.
     trace_rpc: bool = False
-    metrics_report_interval_s: float = 5.0
+    # Throttle window for pushing a process's metrics registry to the
+    # head KV (util/metrics.py _maybe_push).
+    metrics_report_interval_s: float = 2.0
     # Task-event buffer flush (reference: task_event_buffer.h).
     task_events_report_interval_s: float = 1.0
     task_events_max_buffer_size: int = 10_000
@@ -162,6 +164,14 @@ class Config:
     # Events retained per process (a fixed-size ring; older entries are
     # overwritten).
     flight_recorder_capacity: int = 2048
+
+    # --- lockdep witness (util/locks.py) ---
+    # Debug-mode instrumented locks: record cross-thread lock
+    # acquisition order, detect lock-order inversions (ABBA) the first
+    # time a cycle closes. Off in production (make_lock hands out plain
+    # threading locks); the chaos/test lanes turn it on with
+    # RAY_TPU_LOCKDEP=1 before the cluster comes up.
+    lockdep_enabled: bool = False
 
     # --- workers ---
     # Spawn workers by forking a preimported forkserver process instead

@@ -51,6 +51,16 @@ logger = logging.getLogger(__name__)
 IN_PLASMA = b"P"  # metadata marker: value lives in the shm store
 
 
+def _swallow(site: str, error: BaseException, **tags) -> None:
+    """Evidence for intentionally-dropped errors (silent-except audit):
+    the handler stays non-fatal, but the drop rides the flight recorder
+    (guard/swallowed) so ``debug dump`` can explain it later. Lazy
+    import: util package init must not run during core import."""
+    from ray_tpu.util import flight_recorder
+
+    flight_recorder.swallow(site, error, **tags)
+
+
 def make_plasma_marker() -> SerializedObject:
     return SerializedObject(metadata=IN_PLASMA, inband=b"", buffers=[])
 
@@ -94,7 +104,9 @@ class ReferenceCounter:
 
     def __init__(self, core_worker: "CoreWorker"):
         self.cw = core_worker
-        self._lock = threading.Lock()
+        from ray_tpu.util.locks import make_lock
+
+        self._lock = make_lock("core_worker.ReferenceCounter._lock")
         # object hex -> {"local": n, "borrows": n, "owned": bool, "shm": bool}
         self._refs: Dict[str, dict] = {}
         self._disabled = False
@@ -431,13 +443,13 @@ class ObjectRefGenerator:
         self._fire_terminal("released")
         try:
             self._cleanup()
-        except Exception:
-            pass
+        except Exception as e:
+            _swallow("generator.close.cleanup", e)
 
     def __del__(self):
         try:
             self.close()
-        except Exception:
+        except Exception:  # lint: allow-silent(__del__ during interpreter teardown must not raise)
             pass
 
     def completed(self) -> bool:
@@ -535,7 +547,10 @@ class CoreWorker:
         # Task-event buffer: appended from executor threads AND the loop
         # thread; all access goes through the lock.
         self._task_event_buf: List[dict] = []
-        self._task_event_lock = threading.Lock()
+        from ray_tpu.util.locks import make_lock
+
+        self._task_event_lock = make_lock(
+            "core_worker.CoreWorker._task_event_lock")
         self._event_flush_scheduled = False
         # Streaming-generator tasks: task id -> ObjectRefGenerator.
         # WEAK values: the registry must not keep an abandoned stream
@@ -575,15 +590,16 @@ class CoreWorker:
             jitter=0.0)
         # Burst-coalesced submission queue (API thread -> loop).
         self._submit_buf: List[TaskSpec] = []
-        self._submit_lock = threading.Lock()
+        self._submit_lock = make_lock(
+            "core_worker.CoreWorker._submit_lock")
         self._submit_wake_pending = False
         try:
             self.loop.call_soon_threadsafe(
                 lambda: setattr(self, "_loop_thread_ident",
                                 threading.get_ident())
             )
-        except Exception:
-            pass
+        except Exception as e:
+            _swallow("init.loop_ident_probe", e)
         # Set by worker_main for executor duties.
         self.executor = None
 
@@ -676,15 +692,17 @@ class CoreWorker:
 
             try:
                 self.loop.call_soon_threadsafe(go)
-            except Exception:
-                pass
+            except Exception as e:
+                _swallow("stream.release.cancel_notify", e,
+                         task=task_id.hex()[:16])
             return
         try:
             ref = ObjectRef(ObjectID.for_task_return(task_id, 1),
                             self.address, is_owned=False)
             self.cancel_task(ref, force=False)
-        except Exception:
-            pass
+        except Exception as e:
+            _swallow("stream.release.cancel_task", e,
+                     task=task_id.hex()[:16])
 
     def h_stream_item(self, conn, payload):
         """A streaming task's executor reports one yielded item
@@ -1096,8 +1114,9 @@ class CoreWorker:
             try:
                 await self.head.call("object_lost",
                                      {"object_id": object_id.hex()})
-            except Exception:
-                pass
+            except Exception as e:
+                _swallow("recover.object_lost_notify", e,
+                         object=object_id.hex()[:16])
             self._submit_on_loop(spec)
 
             async def wait_reseal(task_id=spec.task_id):
@@ -1239,8 +1258,9 @@ class CoreWorker:
                     self.head.call("free_objects",
                                    {"object_ids": [object_id.hex()]})
                 )
-            except Exception:
-                pass
+            except Exception as e:
+                _swallow("free.head_notify", e,
+                         object=object_id.hex()[:16])
 
     def _notify_owner_ref_removed(self, object_id: ObjectID, owner: Address):
         if self._shutdown:
@@ -1250,13 +1270,15 @@ class CoreWorker:
             try:
                 conn = await self.get_connection(owner.key())
                 await conn.notify("remove_ref", {"object_id": object_id.hex()})
-            except Exception:
-                pass
+            except Exception as e:
+                _swallow("borrow.remove_ref_notify", e,
+                         object=object_id.hex()[:16])
 
         try:
             self.loop_thread.submit(go())
-        except Exception:
-            pass
+        except Exception as e:
+            _swallow("borrow.remove_ref_submit", e,
+                     object=object_id.hex()[:16])
 
     def _notify_owner_add_borrow(self, object_id: ObjectID, owner: Address):
         if self._shutdown:
@@ -1272,13 +1294,15 @@ class CoreWorker:
             try:
                 conn = await self.get_connection(owner.key())
                 await conn.notify("add_borrow", {"object_id": object_id.hex()})
-            except Exception:
-                pass
+            except Exception as e:
+                _swallow("borrow.add_borrow_notify", e,
+                         object=object_id.hex()[:16])
 
         try:
             self.loop_thread.submit(go())
-        except Exception:
-            pass
+        except Exception as e:
+            _swallow("borrow.add_borrow_submit", e,
+                     object=object_id.hex()[:16])
 
     def as_future(self, ref: ObjectRef):
         import concurrent.futures
@@ -1751,7 +1775,8 @@ class CoreWorker:
         self._event_flush_scheduled = True
 
         async def flush_later():
-            await asyncio.sleep(1.0)
+            await asyncio.sleep(
+                self.config.task_events_report_interval_s)
             self._event_flush_scheduled = False
             self._flush_task_events()
 
@@ -1767,8 +1792,8 @@ class CoreWorker:
             try:
                 await self.head.call("report_task_events",
                                      {"events": events})
-            except Exception:
-                pass
+            except Exception as e:
+                _swallow("task_events.flush", e, dropped=len(events))
 
         self.loop.call_soon_threadsafe(
             lambda: asyncio.ensure_future(send()))
@@ -1803,8 +1828,12 @@ class CoreWorker:
                 "lease_id": lw.lease_id,
                 "worker_id": lw.worker_id.hex(),
             })
-        except Exception:
-            pass
+        except Exception as e:
+            # A dropped return leaks the lease until the head reaps the
+            # worker — exactly the kind of slow leak the recorder must
+            # witness.
+            _swallow("lease.return_worker", e,
+                     worker=lw.worker_id.hex()[:16])
 
     def _record_lineage(self, spec: TaskSpec, reply: dict):
         """Retain the creating-task spec of plasma-sealed returns so a
@@ -1865,8 +1894,10 @@ class CoreWorker:
                             err = serialization.deserialize_no_raise(
                                 ep["metadata"], ep["inband"],
                                 ep.get("buffers", []))[0]
-                        except Exception:
-                            pass
+                        except Exception as e:
+                            # Fall back to the generic stream error.
+                            _swallow("stream.error_payload_decode", e,
+                                     task=spec.task_id.hex()[:16])
                 gen._finish(total=reply["stream_count"], error=err)
 
     def _fail_spec_locally(self, spec: TaskSpec, error: Exception):
@@ -2129,8 +2160,8 @@ class CoreWorker:
                    and not self._shutdown):
                 try:
                     await self._refresh_actor_info(state.actor_id)
-                except Exception:
-                    pass  # head briefly unreachable; keep polling
+                except Exception:  # lint: allow-silent(head briefly unreachable; 0.5s poll loop retries and recording every miss would spam the ring)
+                    pass
                 if not state.queue:
                     return
                 await asyncio.sleep(0.5)

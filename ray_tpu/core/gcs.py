@@ -45,6 +45,15 @@ from ray_tpu.core.task_spec import ActorInfo, Bundle, NodeInfo, PlacementGroupIn
 logger = logging.getLogger(__name__)
 
 
+def _swallow(site: str, error: BaseException, **tags) -> None:
+    """Evidence for intentionally-dropped errors (silent-except audit):
+    ride the flight recorder (guard/swallowed) so the head's ``debug
+    dump`` can explain them later."""
+    from ray_tpu.util import flight_recorder
+
+    flight_recorder.swallow(site, error, **tags)
+
+
 class HeadService:
     def __init__(self, config: Config, shm_store: ShmStore, session_dir: str,
                  host: str = "127.0.0.1", storage=None):
@@ -399,8 +408,8 @@ class HeadService:
                 if snap:
                     self.kv.setdefault("metrics", {})[b"metrics:head"] = (
                         json.dumps(snap).encode())
-            except Exception:
-                pass
+            except Exception as e:
+                _swallow("gcs.metrics_snapshot", e)
 
     _last_debug_dump = 0.0
 
@@ -720,8 +729,9 @@ class HeadService:
                 try:
                     await agent.notify("free_objects",
                                        {"object_ids": [hex_id]})
-                except Exception:
-                    pass
+                except Exception as e:
+                    _swallow("gcs.lost_object_free", e,
+                             object=hex_id[:16])
         return {"ok": True}
 
     async def h_object_location_added(self, conn, payload):
@@ -1163,8 +1173,11 @@ class HeadService:
             if handle and handle.connection and not handle.connection.closed:
                 try:
                     await handle.connection.notify("exit_worker", {})
-                except Exception:
-                    pass
+                except Exception as e:
+                    # The hard kill below still lands; record the soft
+                    # path's failure.
+                    _swallow("gcs.kill_actor_exit_notify", e,
+                             worker=worker_id.hex()[:16])
             # Ensure the process dies even if it ignores the notify.
             await asyncio.sleep(0)
             if handle:
@@ -1263,8 +1276,8 @@ class HeadService:
             try:
                 peer.notify_forget("pubsub",
                                    {"channel": channel, "data": data})
-            except Exception:
-                pass
+            except Exception as e:
+                _swallow("gcs.pubsub_publish", e, channel=channel)
 
     # ------------------------------------------------------------------
     # object directory
@@ -1342,8 +1355,8 @@ class HeadService:
         for agent, hex_ids in remote_by_agent.items():
             try:
                 await agent.notify("free_objects", {"object_ids": hex_ids})
-            except Exception:
-                pass  # agent death cleans its whole store anyway
+            except Exception:  # lint: allow-silent(agent death cleans its whole store anyway)
+                pass
         return {"ok": True}
 
     async def h_pin_object(self, conn, payload):

@@ -45,7 +45,10 @@ class GcsStorage:
     def __init__(self, path: str):
         os.makedirs(os.path.dirname(path), exist_ok=True)
         self.path = path
-        self._lock = threading.Lock()  # guards _db across threads
+        from ray_tpu.util.locks import make_lock
+
+        self._lock = make_lock(  # guards _db across threads
+            "gcs_storage.GcsStorage._lock")
         self._db = sqlite3.connect(path, check_same_thread=False)
         self._db.execute("PRAGMA journal_mode=WAL")
         self._db.execute("PRAGMA synchronous=NORMAL")
@@ -55,6 +58,7 @@ class GcsStorage:
                 "(k TEXT PRIMARY KEY, v BLOB)")
         self._db.commit()
         self._queue: "queue.Queue" = queue.Queue()
+        self._closed = False
         self._writer = threading.Thread(
             target=self._writer_loop, name="gcs-storage", daemon=True)
         self._writer.start()
@@ -71,7 +75,15 @@ class GcsStorage:
 
     def _writer_loop(self):
         while True:
-            op = self._queue.get()
+            try:
+                # Bounded get (lock-discipline audit): if the close()
+                # sentinel is ever lost, the Empty branch notices the
+                # closed flag instead of hanging this thread forever.
+                op = self._queue.get(timeout=1.0)
+            except queue.Empty:
+                if self._closed:
+                    return
+                continue
             if op is None:
                 # Balance the join() accounting or a later flush() blocks
                 # forever on the never-finished sentinel.
@@ -119,6 +131,7 @@ class GcsStorage:
 
     def close(self) -> None:
         self.flush()
+        self._closed = True
         self._queue.put(None)
         self._writer.join(timeout=5)
         with self._lock:

@@ -43,6 +43,15 @@ from ray_tpu.core.ids import ObjectID, WorkerID
 logger = logging.getLogger(__name__)
 
 
+def _swallow(site: str, error: BaseException, **tags) -> None:
+    """Evidence for intentionally-dropped errors (silent-except audit):
+    ride the flight recorder (guard/swallowed) so ``debug dump`` on
+    this agent can explain them later."""
+    from ray_tpu.util import flight_recorder
+
+    flight_recorder.swallow(site, error, **tags)
+
+
 class NodeAgent:
     def __init__(self, head_host: str, head_port: int,
                  resources: Dict[str, float], host: str = "127.0.0.1",
@@ -227,7 +236,7 @@ class NodeAgent:
         if proc is not None and proc.poll() is None:
             try:
                 proc.kill()
-            except Exception:
+            except Exception:  # lint: allow-silent(best-effort kill; the worker is already exiting)
                 pass
         return {"ok": True}
 
@@ -375,8 +384,12 @@ class NodeAgent:
                                 {"worker_id": wid}),
                             timeout_per_attempt=10.0,
                             label="worker_exited_early")
-                    except Exception:
-                        pass
+                    except Exception as e:
+                        # The head now learns of the exit only from the
+                        # worker's connection close — slower backoff
+                        # bookkeeping, worth a recorded trace.
+                        _swallow("agent.worker_exited_early", e,
+                                 worker=worker_id[:16])
             # Stream new worker output to subscribed drivers
             # (reference: log_monitor.py publishing to GCS pubsub).
             entries = tailer.poll()
@@ -387,8 +400,9 @@ class NodeAgent:
                         "data": {"node": self.node_id_hex or "",
                                  "entries": entries},
                     })
-                except Exception:
-                    pass
+                except Exception as e:
+                    _swallow("agent.worker_log_publish", e,
+                             dropped=len(entries))
             if monitor is not None:
                 try:
                     killed = monitor.maybe_kill()
@@ -405,8 +419,9 @@ class NodeAgent:
                                 {"worker_id": killed, "reason": reason}),
                             timeout_per_attempt=10.0,
                             label="report_oom_kill")
-                    except Exception:
-                        pass
+                    except Exception as e:
+                        _swallow("agent.report_oom_kill", e,
+                                 worker=str(killed)[:16])
             await asyncio.sleep(0.5)
 
     _last_oom_reason: Optional[str] = None
@@ -422,7 +437,7 @@ class NodeAgent:
         if proc is not None and proc.poll() is None:
             try:
                 proc.kill()
-            except Exception:
+            except Exception:  # lint: allow-silent(best-effort OOM kill; reap loop reports the exit either way)
                 pass
 
     async def run_forever(self):
@@ -434,7 +449,7 @@ class NodeAgent:
             if proc.poll() is None:
                 try:
                     proc.kill()
-                except Exception:
+                except Exception:  # lint: allow-silent(best-effort kill during agent shutdown)
                     pass
         self._procs.clear()
 

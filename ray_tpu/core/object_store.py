@@ -135,7 +135,9 @@ class ShmStore:
         # via RAY_TPU_OBJECT_SPILLING_DIR, exported by the head node).
         self.spill_threshold = spill_threshold
         self._used = 0
-        self._lock = threading.Lock()
+        from ray_tpu.util.locks import make_lock
+
+        self._lock = make_lock("object_store.ShmStore._lock")
         # object hex -> (size, sealed, pinned_count); LRU order = insertion /
         # last-touch order.
         self._entries: "OrderedDict[str, dict]" = OrderedDict()

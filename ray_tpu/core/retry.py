@@ -326,7 +326,9 @@ class CircuitBreaker:
         self.failure_threshold = max(1, failure_threshold)
         self.reset_timeout_s = reset_timeout_s
         self._clock = clock
-        self._lock = threading.Lock()
+        from ray_tpu.util.locks import make_lock
+
+        self._lock = make_lock("retry.CircuitBreaker._lock")
         # key -> [consecutive_failures, open_until (0 when closed)]
         self._entries: Dict[str, list] = {}
 

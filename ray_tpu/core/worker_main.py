@@ -162,9 +162,19 @@ class Executor:
             prof = cProfile.Profile()
             prof.enable()
             _PROFILERS[f"{prof_path}.{os.getpid()}.sync"] = prof
+        import queue as _queue
+
         q = self._sync_queue
         while True:
-            item = q.get()
+            try:
+                # Bounded get (lock-discipline audit): a lost shutdown
+                # sentinel or a queue swapped mid-block must not strand
+                # this thread forever — the Empty branch re-checks.
+                item = q.get(timeout=1.0)
+            except _queue.Empty:
+                if q is not self._sync_queue:
+                    return
+                continue
             if item is None or q is not self._sync_queue:
                 return
             spec, fut = item
@@ -790,6 +800,7 @@ class Executor:
                         raise exc.TaskCancelledError(
                             f"stream {spec.name} cancelled")
                 try:
+                    # lint: allow-blocking(asyncio Task.result() after the done()-loop above — never blocks)
                     value = nxt.result()
                 except StopAsyncIteration:
                     break

@@ -263,6 +263,20 @@ def cmd_microbenchmark(args):
     microbenchmark.main()
 
 
+def cmd_lint(args):
+    """Static-analysis suite (tools/analysis): no cluster needed."""
+    from ray_tpu.tools.analysis import runner
+
+    argv = list(args.lint_args)
+    if args.as_json:
+        argv.append("--json")
+    if args.update_baseline:
+        argv.append("--update-baseline")
+    if args.baseline:
+        argv += ["--baseline", args.baseline]
+    sys.exit(runner.main(argv))
+
+
 def main(argv=None):
     parser = argparse.ArgumentParser(
         prog="ray-tpu", description="ray_tpu cluster CLI")
@@ -326,6 +340,20 @@ def main(argv=None):
 
     p = sub.add_parser("microbenchmark", help="run the perf suite")
     p.set_defaults(fn=cmd_microbenchmark)
+
+    p = sub.add_parser(
+        "lint", help="concurrency/static-analysis suite "
+        "(lock discipline, async hygiene, silent catches, config flags) "
+        "against the ratchet baseline")
+    p.add_argument("--json", action="store_true", dest="as_json",
+                   help="machine-readable output")
+    p.add_argument("--update-baseline", action="store_true",
+                   help="bank fixed violations / re-pin the baseline")
+    p.add_argument("--baseline", default=None,
+                   help="baseline path ('none' disables)")
+    p.add_argument("lint_args", nargs="*",
+                   help="optional file paths relative to the package")
+    p.set_defaults(fn=cmd_lint)
 
     args = parser.parse_args(argv)
     args.fn(args)

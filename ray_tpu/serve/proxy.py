@@ -235,8 +235,11 @@ class ProxyActor:
         def force_refresh():
             try:
                 self._get_router()._refresh(force=True)
-            except Exception:
-                pass
+            except Exception as e:
+                from ray_tpu.util import flight_recorder
+
+                # The retry proceeds against the stale table.
+                flight_recorder.swallow("proxy.stream_table_refresh", e)
 
         chunk_timeout = get_config().serve_stream_chunk_timeout_s
         # Acquire the stream AND its first chunk before committing HTTP
@@ -368,7 +371,7 @@ class ProxyActor:
             raise
         try:
             await resp.write_eof()
-        except Exception:
+        except Exception:  # lint: allow-silent(client already disconnected; stream fully delivered)
             pass
         return key, resp
 
@@ -383,8 +386,8 @@ class ProxyActor:
             else:
                 await resp.write(
                     f"\n[stream-error] {message}\n".encode())
-        except Exception:
-            pass  # client already gone; the router counted the abort
+        except Exception:  # lint: allow-silent(client already gone; the router counted the abort)
+            pass
 
     async def shutdown(self):
         if self._grpc is not None:

@@ -38,7 +38,9 @@ class Router:
         # (ObjectRefGenerator.__del__ -> close -> _fire_terminal) on a
         # thread that is already inside a locked router section; a
         # plain Lock would self-deadlock there.
-        self._lock = threading.RLock()
+        from ray_tpu.util.locks import make_lock
+
+        self._lock = make_lock("serve.Router._lock", reentrant=True)
         self._version = -1
         self._last_refresh = 0.0
         # deployment key -> list of replica actor names

@@ -1,0 +1,3 @@
+"""Developer-facing tooling that ships inside the package (static
+analysis, maintenance scripts). Nothing here is imported by the
+runtime's hot paths."""

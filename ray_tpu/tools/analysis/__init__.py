@@ -1,0 +1,19 @@
+"""``ray_tpu lint`` — the concurrency lint plane's static half.
+
+Four repo-native AST checkers (lock discipline, async hygiene,
+swallowed-exception audit, config-flag lint) with a ratcheted violation
+baseline; the dynamic half is the lockdep witness in
+``ray_tpu/util/locks.py`` and the TSan lane in ``cpp/tpustore``.
+
+Entry points::
+
+    ray_tpu lint [--json] [--update-baseline] [paths...]
+    python -m ray_tpu.tools.analysis.runner
+    tests/test_lint.py   (tier-1 ratchet gate)
+"""
+
+from ray_tpu.tools.analysis.common import (  # noqa: F401
+    PRAGMA_NAMES,
+    Violation,
+    collect_pragmas,
+)

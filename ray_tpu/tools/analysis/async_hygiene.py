@@ -1,0 +1,85 @@
+"""Checker 2 — async hygiene.
+
+A blocking call inside an ``async def`` body stalls the whole event
+loop, not just one request: the Serve proxy/router/replica planes and
+the core RPC pump each multiplex hundreds of requests per loop, so one
+``time.sleep`` or timeout-less ``fut.result()`` inside a handler is a
+cluster-visible latency cliff (reference: Ray Serve forbids the same —
+its replicas run user code off-loop for exactly this reason).
+
+Flags direct, non-awaited blocking calls (`time.sleep`,
+``subprocess.run``-family, timeout-less queue ``get`` / future
+``result`` / ``communicate`` / ``wait`` / zero-arg ``join``) in the
+body of every ``async def``. Nested synchronous ``def``s reset the
+scope — they execute wherever they are *called* (often a thread-pool
+executor), which is the sanctioned escape hatch.
+
+Detail key: ``blocking-in-async: <call>``; pragma:
+``# lint: allow-blocking(<reason>)``.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import List
+
+from ray_tpu.tools.analysis.common import (
+    ContextVisitor,
+    Violation,
+    classify_blocking_call,
+    collect_awaited_calls,
+    suppressed,
+)
+
+CHECK = "async-hygiene"
+
+
+class _Visitor(ContextVisitor):
+    def __init__(self, path: str, pragmas, awaited):
+        super().__init__()
+        self.path = path
+        self.pragmas = pragmas
+        self.awaited = awaited
+        self.violations: List[Violation] = []
+        self._async_depth = 0
+
+    def visit_AsyncFunctionDef(self, node: ast.AsyncFunctionDef) -> None:
+        self._async_depth += 1
+        try:
+            super().visit_AsyncFunctionDef(node)
+        finally:
+            self._async_depth -= 1
+
+    def visit_FunctionDef(self, node: ast.FunctionDef) -> None:
+        # A sync def nested in an async def runs at its call site (an
+        # executor, a callback) — out of scope here.
+        depth, self._async_depth = self._async_depth, 0
+        try:
+            super().visit_FunctionDef(node)
+        finally:
+            self._async_depth = depth
+
+    def visit_Lambda(self, node: ast.Lambda) -> None:
+        depth, self._async_depth = self._async_depth, 0
+        try:
+            self.generic_visit(node)
+        finally:
+            self._async_depth = depth
+
+    def visit_Call(self, node: ast.Call) -> None:
+        if self._async_depth > 0:
+            detail = classify_blocking_call(node, self.awaited)
+            if detail is not None and not suppressed(
+                    self.pragmas, "blocking", node.lineno, node.lineno - 1):
+                self.violations.append(Violation(
+                    check=CHECK, path=self.path, line=node.lineno,
+                    context=self.context,
+                    detail=f"blocking-in-async: {detail}"))
+        self.generic_visit(node)
+
+
+def check_module(path: str, tree: ast.AST, source: str,
+                 pragmas) -> List[Violation]:
+    v = _Visitor(path, pragmas, collect_awaited_calls(tree))
+    v.visit(tree)
+    return v.violations

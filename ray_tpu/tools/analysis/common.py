@@ -1,0 +1,204 @@
+"""Shared plumbing for the ``ray_tpu lint`` AST analyzers.
+
+The suite (reference: Ray's ``ci/lint`` + ``bazel --config=tsan``
+discipline, arxiv 1712.05889 §6) is repo-native: each checker knows
+this codebase's concurrency invariants instead of generic style rules.
+This module holds what every checker shares:
+
+- :class:`Violation` — one finding, with a **line-stable identity key**
+  ``check::path::context::detail`` (no line number) so the ratchet
+  baseline survives unrelated edits that shift line numbers; the line
+  is carried for humans only.
+- the pragma grammar ``# lint: allow-<name>(<reason>)`` — a suppression
+  must name the check family *and* give a non-empty reason; a reasonless
+  pragma is ignored (the site stays flagged), so "why is this OK" is
+  always in the diff.
+- blocking-call classification shared by the lock-discipline and
+  async-hygiene checkers.
+"""
+
+from __future__ import annotations
+
+import ast
+import re
+from dataclasses import dataclass, field
+from typing import Dict, List, Optional, Set
+
+#: pragma names -> the checks they suppress (see each checker module).
+PRAGMA_NAMES = ("silent", "blocking", "lock-order", "config")
+
+_PRAGMA_RE = re.compile(
+    r"#\s*lint:\s*allow-(?P<name>[a-z-]+)\(\s*(?P<reason>[^)]*?)\s*\)")
+
+
+@dataclass(frozen=True)
+class Violation:
+    check: str      # checker id, e.g. "lock-discipline"
+    path: str       # posix path relative to the scan root
+    line: int       # 1-based; informational only, not part of identity
+    context: str    # enclosing Class.method qualname or "<module>"
+    detail: str     # stable description, e.g. "blocking-under-lock: time.sleep"
+
+    @property
+    def key(self) -> str:
+        """Identity used by the ratchet baseline: everything except the
+        line number, so touching unrelated code in a pinned file does
+        not churn the baseline."""
+        return "::".join((self.check, self.path, self.context, self.detail))
+
+    def render(self) -> str:
+        return f"{self.path}:{self.line}: [{self.check}] {self.context}: {self.detail}"
+
+    def to_dict(self) -> dict:
+        return {"check": self.check, "path": self.path, "line": self.line,
+                "context": self.context, "detail": self.detail,
+                "key": self.key}
+
+
+def collect_pragmas(source: str) -> Dict[int, Dict[str, str]]:
+    """``{line: {pragma-name: reason}}`` for every well-formed
+    ``# lint: allow-<name>(<reason>)`` in ``source``. Pragmas with an
+    empty reason or an unknown name are dropped — the site stays
+    flagged rather than silently suppressed by a typo."""
+    out: Dict[int, Dict[str, str]] = {}
+    for lineno, text in enumerate(source.splitlines(), start=1):
+        for m in _PRAGMA_RE.finditer(text):
+            name, reason = m.group("name"), m.group("reason")
+            if name in PRAGMA_NAMES and reason:
+                out.setdefault(lineno, {})[name] = reason
+    return out
+
+
+def suppressed(pragmas: Dict[int, Dict[str, str]], name: str,
+               *lines: int) -> bool:
+    """True when any of ``lines`` (a violation's own line, the line
+    above it, a handler's body line, ...) carries an ``allow-<name>``
+    pragma with a reason."""
+    return any(name in pragmas.get(ln, ()) for ln in lines)
+
+
+# ---------------------------------------------------------------------------
+# AST helpers
+# ---------------------------------------------------------------------------
+
+def dotted_name(node: ast.AST) -> Optional[str]:
+    """``a.b.c`` for a Name/Attribute chain, else None."""
+    parts: List[str] = []
+    while isinstance(node, ast.Attribute):
+        parts.append(node.attr)
+        node = node.value
+    if isinstance(node, ast.Name):
+        parts.append(node.id)
+        return ".".join(reversed(parts))
+    return None
+
+
+class ContextVisitor(ast.NodeVisitor):
+    """NodeVisitor that maintains ``self.context`` — the enclosing
+    ``Class.method`` qualname (or ``"<module>"``) — while walking."""
+
+    def __init__(self) -> None:
+        self._ctx: List[str] = []
+
+    @property
+    def context(self) -> str:
+        return ".".join(self._ctx) if self._ctx else "<module>"
+
+    def _push_visit(self, node: ast.AST, name: str) -> None:
+        self._ctx.append(name)
+        try:
+            self.generic_visit(node)
+        finally:
+            self._ctx.pop()
+
+    def visit_ClassDef(self, node: ast.ClassDef) -> None:
+        self._push_visit(node, node.name)
+
+    def visit_FunctionDef(self, node: ast.FunctionDef) -> None:
+        self._push_visit(node, node.name)
+
+    def visit_AsyncFunctionDef(self, node: ast.AsyncFunctionDef) -> None:
+        self._push_visit(node, node.name)
+
+
+def _has_timeout(call: ast.Call) -> bool:
+    """A positional arg or a ``timeout=`` kwarg counts as bounded."""
+    if call.args:
+        return True
+    return any(kw.arg and "timeout" in kw.arg for kw in call.keywords)
+
+
+def _queue_like(name: Optional[str]) -> bool:
+    if not name:
+        return False
+    last = name.rsplit(".", 1)[-1].lower().strip("_")
+    return last in ("q", "inq", "outq") or "queue" in last
+
+
+#: subprocess entry points that block until the child exits (Popen
+#: itself returns immediately and is classified by what follows it).
+_SUBPROCESS_BLOCKING = {"run", "call", "check_call", "check_output"}
+
+
+def classify_blocking_call(node: ast.Call,
+                           awaited: Set[int]) -> Optional[str]:
+    """Stable detail string when ``node`` is a call that can block the
+    calling thread indefinitely, else None.
+
+    ``awaited`` holds ``id()`` of Call nodes that are directly awaited —
+    ``await q.get()`` is the asyncio (non-thread-blocking) form and is
+    never flagged here.
+    """
+    if id(node) in awaited:
+        return None
+    func = node.func
+    dotted = dotted_name(func)
+    if dotted == "time.sleep":
+        return "time.sleep"
+    if dotted and dotted.startswith("subprocess."):
+        tail = dotted.rsplit(".", 1)[-1]
+        if tail in _SUBPROCESS_BLOCKING:
+            return dotted
+    if isinstance(func, ast.Attribute):
+        recv = dotted_name(func.value)
+        if func.attr == "get" and not node.args and not _has_timeout(node):
+            # dict.get / ContextVar.get take or need no timeout; only a
+            # queue-shaped receiver is an unbounded blocking get.
+            if _queue_like(recv):
+                return f"{recv}.get() without timeout"
+        if func.attr == "result" and not _has_timeout(node):
+            return (f"{recv or '<expr>'}.result() without timeout")
+        if func.attr in ("communicate", "wait") and not _has_timeout(node):
+            # subprocess.Popen.communicate/wait, threading.Event.wait.
+            # str has neither method; asyncio's awaitable .wait() forms
+            # are filtered by `awaited` above.
+            return f"{recv or '<expr>'}.{func.attr}() without timeout"
+        if func.attr == "join" and not node.args and not _has_timeout(node):
+            # Zero-arg join is Thread/Process join (str.join takes an
+            # iterable), unbounded without a timeout.
+            return f"{recv or '<expr>'}.join() without timeout"
+    return None
+
+
+#: asyncio combinators whose call arguments are coroutines/awaitables —
+#: ``asyncio.wait_for(q.get(), t)`` schedules q.get() cooperatively.
+_ASYNC_WRAPPERS = {"wait_for", "gather", "wait", "shield", "create_task",
+                   "ensure_future", "run_coroutine_threadsafe"}
+
+
+def collect_awaited_calls(tree: ast.AST) -> Set[int]:
+    """``id()`` of every Call node that is the direct operand of an
+    ``await`` or an argument to an asyncio combinator
+    (``wait_for``/``gather``/``create_task``/...) — those run
+    cooperatively, not thread-blocking."""
+    out: Set[int] = set()
+    for node in ast.walk(tree):
+        if isinstance(node, ast.Await) and isinstance(node.value, ast.Call):
+            out.add(id(node.value))
+        elif isinstance(node, ast.Call):
+            name = dotted_name(node.func)
+            if name and name.rsplit(".", 1)[-1] in _ASYNC_WRAPPERS:
+                for arg in node.args:
+                    if isinstance(arg, ast.Call):
+                        out.add(id(arg))
+    return out

@@ -1,0 +1,169 @@
+"""Checker 4 — config-flag lint.
+
+The reference keeps its 219-entry ``RAY_CONFIG`` table honest with an
+X-macro: a flag cannot be read without being declared, and an
+undeclared read is a compile error (ray_config_def.h). Python gives us
+neither, so this checker closes both directions over
+``core/config.py``'s ``Config`` dataclass:
+
+1. **Undeclared read** — ``get_config().foo`` (or ``cfg.foo`` where
+   ``cfg`` was provably bound from ``get_config()`` in the same scope,
+   or a parameter annotated ``Config``) for a ``foo`` that is not a
+   declared field. At runtime this raises ``AttributeError`` only on
+   the code path that reads it — i.e. in production, at 3am. Detail:
+   ``undeclared-config-read: <attr>``; pragma:
+   ``# lint: allow-config(<reason>)``.
+
+2. **Unread field** — a declared field no code reads is either dead
+   (delete it) or a flag someone *believes* is wired in but isn't,
+   which is worse. Read collection is deliberately liberal (any
+   attribute read whose name matches a declared field, anywhere) so
+   this direction has no false positives from aliasing through helper
+   parameters. Reported at the field's declaration line in config.py;
+   detail: ``unread-config-field: <name>``.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Dict, List, Optional, Set, Tuple
+
+from ray_tpu.tools.analysis.common import (
+    ContextVisitor,
+    Violation,
+    dotted_name,
+    suppressed,
+)
+
+CHECK = "config-flag"
+
+#: non-field attributes that are legal on a Config instance.
+_CONFIG_METHODS = {"apply_system_config"}
+
+
+def declared_fields(config_source: str) -> Dict[str, int]:
+    """``{field name: declaration line}`` from the ``Config`` dataclass."""
+    tree = ast.parse(config_source)
+    for node in ast.walk(tree):
+        if isinstance(node, ast.ClassDef) and node.name == "Config":
+            return {
+                stmt.target.id: stmt.lineno
+                for stmt in node.body
+                if isinstance(stmt, ast.AnnAssign)
+                and isinstance(stmt.target, ast.Name)
+            }
+    return {}
+
+
+def _is_get_config_call(node: ast.AST) -> bool:
+    if not isinstance(node, ast.Call):
+        return False
+    name = dotted_name(node.func)
+    return bool(name) and name.rsplit(".", 1)[-1] == "get_config"
+
+
+def _is_config_annotation(ann: Optional[ast.AST]) -> bool:
+    if ann is None:
+        return False
+    name = dotted_name(ann)
+    if name and name.rsplit(".", 1)[-1] == "Config":
+        return True
+    # "Config" as a string / Optional[Config] forward reference.
+    return isinstance(ann, ast.Constant) and ann.value == "Config"
+
+
+class _Visitor(ContextVisitor):
+    def __init__(self, path: str, pragmas, fields: Set[str]):
+        super().__init__()
+        self.path = path
+        self.pragmas = pragmas
+        self.fields = fields
+        self.violations: List[Violation] = []
+        self.reads: Set[str] = set()
+        # Stack of per-scope sets of names provably bound to the global
+        # Config (assigned from get_config() or annotated Config).
+        self._scopes: List[Set[str]] = [set()]
+
+    # -- scope handling --------------------------------------------------
+
+    def _function_scope(self, node) -> None:
+        scope: Set[str] = set()
+        args = node.args
+        for arg in (list(args.posonlyargs) + list(args.args)
+                    + list(args.kwonlyargs)):
+            if _is_config_annotation(arg.annotation):
+                scope.add(arg.arg)
+        self._scopes.append(scope)
+        try:
+            self._push_visit(node, node.name)
+        finally:
+            self._scopes.pop()
+
+    def visit_FunctionDef(self, node: ast.FunctionDef) -> None:
+        self._function_scope(node)
+
+    def visit_AsyncFunctionDef(self, node: ast.AsyncFunctionDef) -> None:
+        self._function_scope(node)
+
+    def visit_Assign(self, node: ast.Assign) -> None:
+        if _is_get_config_call(node.value):
+            for target in node.targets:
+                name = dotted_name(target)
+                if name:
+                    self._scopes[-1].add(name)
+        self.generic_visit(node)
+
+    def visit_AnnAssign(self, node: ast.AnnAssign) -> None:
+        name = dotted_name(node.target)
+        if name and (_is_get_config_call(node.value)
+                     or _is_config_annotation(node.annotation)):
+            self._scopes[-1].add(name)
+        self.generic_visit(node)
+
+    def _is_config_expr(self, node: ast.AST) -> bool:
+        if _is_get_config_call(node):
+            return True
+        name = dotted_name(node)
+        return bool(name) and any(name in s for s in self._scopes)
+
+    # -- reads -----------------------------------------------------------
+
+    def visit_Attribute(self, node: ast.Attribute) -> None:
+        if isinstance(node.ctx, ast.Load):
+            if node.attr in self.fields:
+                self.reads.add(node.attr)
+            elif (not node.attr.startswith("_")
+                    and node.attr not in _CONFIG_METHODS
+                    and self._is_config_expr(node.value)
+                    and not suppressed(self.pragmas, "config",
+                                       node.lineno, node.lineno - 1)):
+                self.violations.append(Violation(
+                    check=CHECK, path=self.path, line=node.lineno,
+                    context=self.context,
+                    detail=f"undeclared-config-read: {node.attr}"))
+        self.generic_visit(node)
+
+
+def check_module(path: str, tree: ast.AST, source: str, pragmas,
+                 fields: Dict[str, int]
+                 ) -> Tuple[List[Violation], Set[str]]:
+    """Per-module pass: undeclared-read violations plus the set of
+    field names this module reads (for the suite-wide unread pass)."""
+    v = _Visitor(path, pragmas, set(fields))
+    v.visit(tree)
+    return v.violations, v.reads
+
+
+def find_unread(fields: Dict[str, int], reads: Set[str],
+                config_path: str, pragmas_for_config: dict
+                ) -> List[Violation]:
+    out: List[Violation] = []
+    for name, line in sorted(fields.items()):
+        if name in reads:
+            continue
+        if suppressed(pragmas_for_config, "config", line, line - 1):
+            continue
+        out.append(Violation(
+            check=CHECK, path=config_path, line=line, context="Config",
+            detail=f"unread-config-field: {name}"))
+    return out

@@ -1,0 +1,198 @@
+"""Checker 1 — lock discipline.
+
+Two invariants, both learned the hard way by every threaded runtime
+(reference: Ray's C++ core runs whole-program TSan; the kernel runs
+lockdep):
+
+1. **No unbounded blocking while a lock is held.** A ``time.sleep``,
+   ``subprocess.run``, timeout-less ``queue.get()`` / ``fut.result()``
+   / ``proc.wait()`` inside a ``with <lock>:`` body turns every other
+   thread that wants that lock into a hostage of the slow operation —
+   and into a deadlock if the blocked-on work itself needs the lock.
+   Detail key: ``blocking-under-lock: <call> [holding <lock>]``;
+   pragma: ``# lint: allow-blocking(<reason>)``.
+
+2. **Consistent lock acquisition order.** Every syntactic nesting
+   ``with A: ... with B:`` contributes an edge A→B to a global
+   acquired-while-holding graph; a cycle (including the trivial
+   ``with A: ... with A:`` self-deadlock on a non-reentrant lock) is an
+   ABBA inversion waiting for the right interleaving. Detail key:
+   ``lock-order-cycle: A -> B -> A``; pragma:
+   ``# lint: allow-lock-order(<reason>)`` on the edge site that closes
+   the cycle.
+
+Lock identification is syntactic: a ``with``/``async with`` context
+expression whose dotted name contains ``lock`` (``self._lock``,
+``_submit_lock``, ``member_lock`` ...). That convention holds across
+this codebase and is cheap to keep true. Lock *identity* for the order
+graph is ``<path>::<Class>.<dotted>`` so same-named attributes on
+different classes stay distinct; the runtime witness
+(``util/locks.py``) covers the orders static nesting can't see.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Dict, List, Optional, Set, Tuple
+
+from ray_tpu.tools.analysis.common import (
+    ContextVisitor,
+    Violation,
+    classify_blocking_call,
+    collect_awaited_calls,
+    dotted_name,
+    suppressed,
+)
+
+CHECK = "lock-discipline"
+
+
+def _lock_expr(item: ast.withitem) -> Optional[str]:
+    name = dotted_name(item.context_expr)
+    if name and "lock" in name.lower():
+        return name
+    return None
+
+
+class _Visitor(ContextVisitor):
+    def __init__(self, path: str, pragmas, awaited: Set[int]):
+        super().__init__()
+        self.path = path
+        self.pragmas = pragmas
+        self.awaited = awaited
+        self.violations: List[Violation] = []
+        # (holder, acquired) -> (line, context) of the first witness.
+        self.edges: Dict[Tuple[str, str], Tuple[int, str]] = {}
+        self._held: List[Tuple[str, str]] = []  # (dotted, qualified id)
+        self._class: List[str] = []
+
+    def visit_ClassDef(self, node: ast.ClassDef) -> None:
+        self._class.append(node.name)
+        try:
+            super().visit_ClassDef(node)
+        finally:
+            self._class.pop()
+
+    def _lock_id(self, dotted: str) -> str:
+        owner = self._class[-1] if self._class else "<module>"
+        return f"{self.path}::{owner}.{dotted}"
+
+    def _visit_with(self, node) -> None:
+        acquired: List[Tuple[str, str]] = []
+        for item in node.items:
+            dotted = _lock_expr(item)
+            if dotted is None:
+                continue
+            lock_id = self._lock_id(dotted)
+            for _, held_id in self._held:
+                self.edges.setdefault(
+                    (held_id, lock_id), (node.lineno, self.context))
+            acquired.append((dotted, lock_id))
+        self._held.extend(acquired)
+        try:
+            self.generic_visit(node)
+        finally:
+            if acquired:
+                del self._held[-len(acquired):]
+
+    visit_With = _visit_with
+    visit_AsyncWith = _visit_with
+
+    def visit_FunctionDef(self, node: ast.FunctionDef) -> None:
+        # A nested def's body runs at call time, not while the lock is
+        # syntactically held here.
+        held, self._held = self._held, []
+        try:
+            super().visit_FunctionDef(node)
+        finally:
+            self._held = held
+
+    def visit_AsyncFunctionDef(self, node: ast.AsyncFunctionDef) -> None:
+        held, self._held = self._held, []
+        try:
+            super().visit_AsyncFunctionDef(node)
+        finally:
+            self._held = held
+
+    def visit_Lambda(self, node: ast.Lambda) -> None:
+        held, self._held = self._held, []
+        try:
+            self.generic_visit(node)
+        finally:
+            self._held = held
+
+    def visit_Call(self, node: ast.Call) -> None:
+        if self._held:
+            detail = classify_blocking_call(node, self.awaited)
+            if detail is not None and not suppressed(
+                    self.pragmas, "blocking", node.lineno, node.lineno - 1):
+                holder = self._held[-1][0]
+                self.violations.append(Violation(
+                    check=CHECK, path=self.path, line=node.lineno,
+                    context=self.context,
+                    detail=f"blocking-under-lock: {detail} "
+                           f"[holding {holder}]"))
+        self.generic_visit(node)
+
+
+def check_module(path: str, tree: ast.AST, source: str, pragmas
+                 ) -> Tuple[List[Violation],
+                            Dict[Tuple[str, str], Tuple[str, int, str]]]:
+    """Per-module pass: blocking-under-lock violations plus this
+    module's lock-order edges ``{(holder, acquired): (path, line,
+    context)}`` for the suite-wide cycle pass."""
+    v = _Visitor(path, pragmas, collect_awaited_calls(tree))
+    v.visit(tree)
+    edges = {pair: (path, line, ctx)
+             for pair, (line, ctx) in v.edges.items()}
+    return v.violations, edges
+
+
+def find_cycles(edges: Dict[Tuple[str, str], Tuple[str, int, str]],
+                pragmas_by_path: Dict[str, dict]) -> List[Violation]:
+    """Cycle detection over the merged acquired-while-holding graph.
+    Each cycle is reported once, at the witness site of its
+    lexicographically-smallest edge, with a canonicalized detail key so
+    the report is stable run-to-run."""
+    graph: Dict[str, Set[str]] = {}
+    for holder, acquired in edges:
+        graph.setdefault(holder, set()).add(acquired)
+
+    cycles: Set[Tuple[str, ...]] = set()
+
+    def _walk(node: str, stack: List[str], on_stack: Set[str],
+              done: Set[str]) -> None:
+        on_stack.add(node)
+        stack.append(node)
+        for nxt in sorted(graph.get(node, ())):
+            if nxt in on_stack:
+                cyc = stack[stack.index(nxt):]
+                pivot = cyc.index(min(cyc))
+                cycles.add(tuple(cyc[pivot:] + cyc[:pivot]))
+            elif nxt not in done:
+                _walk(nxt, stack, on_stack, done)
+        stack.pop()
+        on_stack.discard(node)
+        done.add(node)
+
+    visited: Set[str] = set()
+    for root in sorted(graph):
+        if root not in visited:
+            _walk(root, [], set(), visited)
+
+    def _short(lock_id: str) -> str:
+        return lock_id.split("::", 1)[-1]
+
+    out: List[Violation] = []
+    for cyc in sorted(cycles):
+        ring = list(cyc) + [cyc[0]]
+        cycle_edges = sorted(zip(ring, ring[1:]))
+        path, line, ctx = edges[cycle_edges[0]]
+        if suppressed(pragmas_by_path.get(path, {}), "lock-order",
+                      line, line - 1):
+            continue
+        out.append(Violation(
+            check=CHECK, path=path, line=line, context=ctx,
+            detail="lock-order-cycle: "
+                   + " -> ".join(_short(l) for l in ring)))
+    return out

@@ -1,0 +1,264 @@
+"""The ``ray_tpu lint`` driver: walk the package, run the four
+checkers, compare against the ratchet baseline.
+
+Ratchet semantics (reference: the "burn-down file" pattern used by
+large TSan/clang-tidy rollouts): ``baseline.json`` pins every
+*pre-existing* violation by its line-stable key. A run fails when
+
+- a violation appears whose key is not in the baseline (or whose count
+  at that key grew) — **new debt is rejected**, or
+- a baseline entry no longer fires — the fix must be banked with
+  ``ray_tpu lint --update-baseline`` so the pin can't quietly regress
+  back; **the baseline only shrinks**.
+
+``--json`` emits the machine form for CI; exit code 0 means clean
+modulo baseline AND no stale pins.
+"""
+
+from __future__ import annotations
+
+import ast
+import json
+import os
+import sys
+from collections import Counter
+from typing import Dict, Iterable, List, Optional, Tuple
+
+from ray_tpu.tools.analysis import (
+    async_hygiene,
+    config_flags,
+    lock_discipline,
+    silent_except,
+)
+from ray_tpu.tools.analysis.common import Violation, collect_pragmas
+
+CHECKS = (lock_discipline.CHECK, async_hygiene.CHECK,
+          silent_except.CHECK, config_flags.CHECK)
+
+_SKIP_DIRS = {"__pycache__", ".git", "build"}
+
+
+def package_root() -> str:
+    """The ``ray_tpu`` package directory (default scan root)."""
+    import ray_tpu
+
+    return os.path.dirname(os.path.abspath(ray_tpu.__file__))
+
+
+def default_baseline_path() -> str:
+    return os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                        "baseline.json")
+
+
+def iter_sources(root: str, paths: Optional[Iterable[str]] = None
+                 ) -> Iterable[Tuple[str, str]]:
+    """Yield ``(relative posix path, source)`` for every ``*.py`` under
+    ``root`` (or just ``paths``, given relative to ``root``)."""
+    if paths:
+        files = [os.path.join(root, p) for p in paths]
+    else:
+        files = []
+        for dirpath, dirnames, filenames in os.walk(root):
+            dirnames[:] = sorted(d for d in dirnames
+                                 if d not in _SKIP_DIRS)
+            files.extend(os.path.join(dirpath, f)
+                         for f in sorted(filenames) if f.endswith(".py"))
+    for path in files:
+        rel = os.path.relpath(path, root).replace(os.sep, "/")
+        try:
+            with open(path, encoding="utf-8") as f:
+                yield rel, f.read()
+        except OSError as e:
+            print(f"lint: cannot read {rel}: {e}", file=sys.stderr)
+
+
+def run_lint(root: Optional[str] = None,
+             paths: Optional[Iterable[str]] = None,
+             config_source: Optional[str] = None) -> List[Violation]:
+    """Run all four checkers; returns violations sorted by
+    (path, line). ``config_source`` overrides the ``Config`` dataclass
+    source for the config-flag checker (tests inject fixtures)."""
+    root = root or package_root()
+    config_rel = "core/config.py"
+    if config_source is None:
+        config_path = os.path.join(root, config_rel)
+        if os.path.exists(config_path):
+            with open(config_path, encoding="utf-8") as f:
+                config_source = f.read()
+        else:
+            config_source = ""
+    fields = config_flags.declared_fields(config_source)
+
+    violations: List[Violation] = []
+    all_edges: Dict[Tuple[str, str], Tuple[str, int, str]] = {}
+    all_reads = set()
+    pragmas_by_path: Dict[str, dict] = {}
+    config_pragmas: dict = {}
+
+    for rel, source in iter_sources(root, paths):
+        try:
+            tree = ast.parse(source)
+        except SyntaxError as e:
+            violations.append(Violation(
+                check="parse", path=rel, line=e.lineno or 0,
+                context="<module>", detail=f"syntax-error: {e.msg}"))
+            continue
+        pragmas = collect_pragmas(source)
+        pragmas_by_path[rel] = pragmas
+        if rel == config_rel:
+            config_pragmas = pragmas
+
+        lock_v, edges = lock_discipline.check_module(
+            rel, tree, source, pragmas)
+        violations.extend(lock_v)
+        for pair, site in edges.items():
+            all_edges.setdefault(pair, site)
+
+        violations.extend(async_hygiene.check_module(
+            rel, tree, source, pragmas))
+        violations.extend(silent_except.check_module(
+            rel, tree, source, pragmas))
+        if fields:
+            cfg_v, reads = config_flags.check_module(
+                rel, tree, source, pragmas, fields)
+            violations.extend(cfg_v)
+            all_reads.update(reads)
+
+    violations.extend(lock_discipline.find_cycles(
+        all_edges, pragmas_by_path))
+    if fields:
+        violations.extend(config_flags.find_unread(
+            fields, all_reads, config_rel,
+            config_pragmas or collect_pragmas(config_source)))
+    return sorted(violations, key=lambda v: (v.path, v.line, v.check,
+                                             v.detail))
+
+
+# ---------------------------------------------------------------------------
+# ratchet baseline
+# ---------------------------------------------------------------------------
+
+def load_baseline(path: str) -> Dict[str, dict]:
+    """``{key: {"count": n, "lines": [...]}}`` or empty when absent."""
+    try:
+        with open(path, encoding="utf-8") as f:
+            data = json.load(f)
+    except FileNotFoundError:
+        return {}
+    if data.get("version") != 1:
+        raise ValueError(f"unsupported baseline version in {path}: "
+                         f"{data.get('version')!r}")
+    return data.get("entries", {})
+
+
+def write_baseline(violations: List[Violation], path: str) -> dict:
+    entries: Dict[str, dict] = {}
+    for v in violations:
+        row = entries.setdefault(v.key, {"count": 0, "lines": []})
+        row["count"] += 1
+        row["lines"].append(v.line)
+    payload = {
+        "version": 1,
+        "tool": "ray_tpu lint --update-baseline",
+        "total": len(violations),
+        "entries": {k: entries[k] for k in sorted(entries)},
+    }
+    with open(path, "w", encoding="utf-8") as f:
+        json.dump(payload, f, indent=1, sort_keys=True)
+        f.write("\n")
+    return payload
+
+
+def compare(violations: List[Violation], baseline: Dict[str, dict]
+            ) -> Tuple[List[Violation], List[str]]:
+    """``(new, stale)``: violations beyond the baseline's pinned count
+    per key, and baseline keys whose pinned count exceeds what still
+    fires (fixed debt that must be banked with --update-baseline)."""
+    observed = Counter(v.key for v in violations)
+    new: List[Violation] = []
+    budget = {k: row.get("count", 0) for k, row in baseline.items()}
+    for v in violations:
+        if budget.get(v.key, 0) > 0:
+            budget[v.key] -= 1
+        else:
+            new.append(v)
+    stale = sorted(k for k, row in baseline.items()
+                   if observed.get(k, 0) < row.get("count", 0))
+    return new, stale
+
+
+# ---------------------------------------------------------------------------
+# CLI
+# ---------------------------------------------------------------------------
+
+def main(argv: Optional[List[str]] = None) -> int:
+    import argparse
+
+    p = argparse.ArgumentParser(
+        prog="ray_tpu lint",
+        description="repo-native concurrency/static analysis suite")
+    p.add_argument("paths", nargs="*",
+                   help="files relative to the package root "
+                        "(default: the whole ray_tpu package)")
+    p.add_argument("--root", default=None,
+                   help="scan root (default: the installed ray_tpu "
+                        "package directory)")
+    p.add_argument("--json", action="store_true", dest="as_json",
+                   help="machine-readable output")
+    p.add_argument("--baseline", default=None,
+                   help="ratchet baseline path (default: "
+                        "tools/analysis/baseline.json); 'none' disables")
+    p.add_argument("--update-baseline", action="store_true",
+                   help="rewrite the baseline from this run's findings")
+    args = p.parse_args(argv)
+
+    violations = run_lint(root=args.root, paths=args.paths or None)
+
+    baseline_path = args.baseline or default_baseline_path()
+    use_baseline = baseline_path != "none" and not args.paths
+    if args.update_baseline:
+        if args.paths:
+            # A partial scan would overwrite the whole baseline with
+            # just these files' findings, silently unpinning the rest.
+            print("lint: --update-baseline requires a full scan "
+                  "(drop the path arguments)", file=sys.stderr)
+            return 2
+        if baseline_path == "none":
+            print("lint: --update-baseline conflicts with "
+                  "--baseline none", file=sys.stderr)
+            return 2
+        payload = write_baseline(violations, baseline_path)
+        print(f"baseline updated: {payload['total']} violations across "
+              f"{len(payload['entries'])} keys -> {baseline_path}")
+        return 0
+
+    baseline = load_baseline(baseline_path) if use_baseline else {}
+    new, stale = compare(violations, baseline)
+
+    if args.as_json:
+        print(json.dumps({
+            "total": len(violations),
+            "baselined": len(violations) - len(new),
+            "new": [v.to_dict() for v in new],
+            "stale_baseline_keys": stale,
+            "violations": [v.to_dict() for v in violations],
+            "ok": not new and not stale,
+        }, indent=1))
+        return 0 if not new and not stale else 1
+
+    by_check = Counter(v.check for v in violations)
+    for v in new:
+        print(v.render())
+    summary = ", ".join(f"{c}: {by_check.get(c, 0)}" for c in CHECKS)
+    print(f"lint: {len(violations)} total ({summary}); "
+          f"{len(violations) - len(new)} baselined, {len(new)} new")
+    if stale:
+        print("lint: stale baseline entries (the debt was paid — bank "
+              "it with `ray_tpu lint --update-baseline`):")
+        for key in stale:
+            print(f"  {key}")
+    return 0 if not new and not stale else 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())

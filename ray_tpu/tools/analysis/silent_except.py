@@ -1,0 +1,71 @@
+"""Checker 3 — swallowed-exception audit.
+
+``except Exception: pass`` is how a distributed runtime loses its
+evidence: the flight recorder (PR 4) can only explain a stall from the
+events the code bothered to record, and a silent catch is an event
+that never happened. The audit's contract: every handler whose body is
+*only* ``pass`` (or ``...``) must either grow a real action — record
+to the flight recorder (``guard/swallowed``), log, re-raise — or carry
+an explicit ``# lint: allow-silent(<reason>)`` pragma stating why
+dropping the error is correct (e.g. best-effort kill of an already-
+exiting process).
+
+Detail key: ``silent-except`` (+ the guarded exception type when it is
+a simple name, so two handlers in one function stay distinct only if
+they guard different types); pragma on the ``except`` line, the line
+above it, or the ``pass`` line itself.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import List
+
+from ray_tpu.tools.analysis.common import (
+    ContextVisitor,
+    Violation,
+    dotted_name,
+    suppressed,
+)
+
+CHECK = "silent-except"
+
+
+def _body_is_silent(body: List[ast.stmt]) -> bool:
+    for stmt in body:
+        if isinstance(stmt, ast.Pass):
+            continue
+        if (isinstance(stmt, ast.Expr)
+                and isinstance(stmt.value, ast.Constant)
+                and stmt.value.value is Ellipsis):
+            continue
+        return False
+    return True
+
+
+class _Visitor(ContextVisitor):
+    def __init__(self, path: str, pragmas):
+        super().__init__()
+        self.path = path
+        self.pragmas = pragmas
+        self.violations: List[Violation] = []
+
+    def visit_ExceptHandler(self, node: ast.ExceptHandler) -> None:
+        if _body_is_silent(node.body):
+            lines = [node.lineno, node.lineno - 1]
+            if node.body:
+                lines.append(node.body[0].lineno)
+            if not suppressed(self.pragmas, "silent", *lines):
+                guarded = dotted_name(node.type) if node.type else "bare"
+                self.violations.append(Violation(
+                    check=CHECK, path=self.path, line=node.lineno,
+                    context=self.context,
+                    detail=f"silent-except: {guarded or 'bare'}"))
+        self.generic_visit(node)
+
+
+def check_module(path: str, tree: ast.AST, source: str,
+                 pragmas) -> List[Violation]:
+    v = _Visitor(path, pragmas)
+    v.visit(tree)
+    return v.violations

@@ -72,6 +72,13 @@ CATALOG: Dict[str, tuple] = {
     "serve": ("replica_shed", "stream_started", "stream_aborted"),
     # the debug plane itself (util/flight_recorder.py)
     "debug": ("postmortem",),
+    # swallowed-exception audit (tools/analysis silent-except checker):
+    # sites converted from `except Exception: pass` record the error
+    # they drop here, so "nothing happened" still leaves evidence.
+    "guard": ("swallowed",),
+    # util/locks.py lockdep witness: a lock-order inversion was
+    # detected at acquire time (before the deadlock interleaving).
+    "lockdep": ("inversion",),
 }
 
 _DEFAULT_CAPACITY = 2048
@@ -136,6 +143,16 @@ def record(subsystem: str, event: str, severity: str = INFO,
     if ring is None:
         ring = _get_ring()
     ring.append((time.time(), subsystem, event, severity, tags or None))
+
+
+def swallow(site: str, error: BaseException,
+            severity: str = WARN, **tags: Any) -> None:
+    """Record an intentionally-swallowed exception — the silent-except
+    audit's sanctioned alternative to ``except Exception: pass``. The
+    handler stays non-fatal, but the drop leaves evidence the debug
+    plane can replay (``guard/swallowed`` with the site and error)."""
+    record("guard", "swallowed", severity=severity, site=site,
+           error=f"{type(error).__name__}: {error}"[:240], **tags)
 
 
 def snapshot(limit: Optional[int] = None) -> List[dict]:
@@ -269,7 +286,7 @@ def install_crash_handler() -> None:
     def on_crash(exc_type, exc, tb):
         try:
             flush_postmortem(f"{exc_type.__name__}: {exc}")
-        except Exception:
+        except Exception:  # lint: allow-silent(crash handler must never crash harder)
             pass
         prev_sys(exc_type, exc, tb)
 
@@ -284,7 +301,7 @@ def install_crash_handler() -> None:
                 flush_postmortem(
                     f"{args.exc_type.__name__}: {args.exc_value} "
                     f"(thread {getattr(args.thread, 'name', '?')})")
-            except Exception:
+            except Exception:  # lint: allow-silent(crash handler must never crash harder)
                 pass
         prev_thread(args)
 

@@ -1,0 +1,265 @@
+"""Runtime lockdep witness — the dynamic half of the concurrency lint
+plane (the static half is ``ray_tpu/tools/analysis``).
+
+Reference: the Linux kernel's lockdep validator and TSan's deadlock
+detector — record the *order* in which each thread acquires named
+locks, build the global acquired-while-holding graph, and report a
+lock-order inversion the first time a cycle closes, i.e. **before** the
+actual ABBA interleaving deadlocks a soak run.
+
+Production cost is zero: ``make_lock(name)`` returns a plain
+``threading.Lock``/``RLock`` unless the witness is enabled
+(``RAY_TPU_LOCKDEP=1`` / config ``lockdep_enabled``, turned on by the
+chaos/test lanes). When enabled, each acquisition does one thread-local
+list walk plus a reachability probe over the (tiny) lock graph under a
+single meta-lock; edges are deduplicated so the steady-state cost after
+warm-up is a set lookup.
+
+On detection: the cycle is recorded to the flight recorder
+(``lockdep/inversion``) with both witness stacks, logged at ERROR, and
+— in strict mode (``RAY_TPU_LOCKDEP_STRICT=1``, default in unit tests)
+— raised as :class:`LockOrderInversion` so the test run fails at the
+first bad ordering rather than at the eventual deadlock.
+"""
+
+from __future__ import annotations
+
+import logging
+import os
+import threading
+import traceback
+from typing import Dict, List, Optional, Set, Tuple
+
+logger = logging.getLogger(__name__)
+
+__all__ = [
+    "LockOrderInversion",
+    "make_lock",
+    "witness_enabled",
+    "witness_graph",
+    "reset_witness_for_testing",
+]
+
+
+class LockOrderInversion(RuntimeError):
+    """Raised (strict mode) when acquiring a lock would close a cycle
+    in the global acquired-while-holding graph."""
+
+
+def witness_enabled() -> bool:
+    """Whether new locks should be witness-instrumented. Checked once
+    per ``make_lock`` call — existing locks keep whatever mode they
+    were created with (the chaos/test lanes set the env var before the
+    cluster comes up)."""
+    raw = os.environ.get("RAY_TPU_LOCKDEP")
+    if raw is not None:
+        return raw.lower() not in ("0", "false", "no", "")
+    try:
+        from ray_tpu.core.config import get_config
+
+        return bool(get_config().lockdep_enabled)
+    except Exception:  # lint: allow-silent(config import cycle during bootstrap)
+        return False
+
+
+def _strict() -> bool:
+    """Default is record-only: enabling the witness alone must never
+    turn a survivable ordering bug into a crash. Tests and race-hunt
+    lanes opt into raising with RAY_TPU_LOCKDEP_STRICT=1."""
+    return os.environ.get("RAY_TPU_LOCKDEP_STRICT", "0").lower() in (
+        "1", "true", "yes")
+
+
+# ---------------------------------------------------------------------------
+# the witness graph
+# ---------------------------------------------------------------------------
+
+# Edge A -> B means "some thread acquired B while holding A". A cycle
+# means two threads can interleave into a deadlock. All three
+# structures are guarded by _meta (never held while a witnessed lock's
+# underlying primitive is being acquired — the probe runs before the
+# blocking acquire).
+_meta = threading.Lock()
+_edges: Dict[str, Set[str]] = {}
+_edge_stacks: Dict[Tuple[str, str], str] = {}
+_reported: Set[Tuple[str, str]] = set()
+_held = threading.local()
+
+
+def _held_stack() -> List["WitnessLock"]:
+    stack = getattr(_held, "stack", None)
+    if stack is None:
+        stack = []
+        _held.stack = stack
+    return stack
+
+
+def _reachable(src: str, dst: str) -> Optional[List[str]]:
+    """Path src -> ... -> dst over _edges (caller holds _meta), or None."""
+    seen = {src}
+    trail = [(src, [src])]
+    while trail:
+        node, path = trail.pop()
+        if node == dst:
+            return path
+        for nxt in _edges.get(node, ()):
+            if nxt not in seen:
+                seen.add(nxt)
+                trail.append((nxt, path + [nxt]))
+    return None
+
+
+def witness_graph() -> Dict[str, List[str]]:
+    """Snapshot of the acquired-while-holding graph (for debug dumps
+    and tests)."""
+    with _meta:
+        return {a: sorted(bs) for a, bs in _edges.items()}
+
+
+def reset_witness_for_testing() -> None:
+    with _meta:
+        _edges.clear()
+        _edge_stacks.clear()
+        _reported.clear()
+    _held.stack = []
+
+
+def _record_inversion(holding: str, acquiring: str, cycle: List[str],
+                      prior_stack: str) -> None:
+    here = "".join(traceback.format_stack(limit=12))
+    pair = (holding, acquiring)
+    with _meta:
+        if pair in _reported:
+            fresh = False
+        else:
+            _reported.add(pair)
+            fresh = True
+    if fresh:
+        try:
+            from ray_tpu.util import flight_recorder
+
+            flight_recorder.record(
+                "lockdep", "inversion", severity=flight_recorder.ERROR,
+                holding=holding, acquiring=acquiring,
+                cycle=" -> ".join(cycle + [cycle[0]]))
+        except Exception:  # lint: allow-silent(witness must not crash the runtime)
+            pass
+        logger.error(
+            "lock-order inversion: acquiring %r while holding %r closes "
+            "cycle %s\nprior order witnessed at:\n%s\nthis order at:\n%s",
+            acquiring, holding, " -> ".join(cycle + [cycle[0]]),
+            prior_stack, here)
+
+
+class WitnessLock:
+    """A named lock that reports lock-order inversions at acquire time.
+
+    Wraps a ``threading.Lock`` or ``RLock``; supports the context-
+    manager protocol and explicit ``acquire``/``release``. Reentrant
+    re-acquisition of an RLock does not add graph edges (it is not an
+    ordering event)."""
+
+    __slots__ = ("name", "_inner", "_reentrant")
+
+    def __init__(self, name: str, reentrant: bool = False):
+        self.name = name
+        self._reentrant = reentrant
+        self._inner = threading.RLock() if reentrant else threading.Lock()
+
+    # -- witness core ---------------------------------------------------
+
+    def _check_order(self) -> None:
+        stack = _held_stack()
+        if not stack:
+            return
+        if any(held is self for held in stack):
+            if self._reentrant:
+                return
+            # Re-acquiring a non-reentrant lock is a CERTAIN
+            # self-deadlock — the inner acquire below would block on
+            # ourselves forever. Always raise (even in record-only
+            # mode): a witnessed exception beats a silent hang.
+            try:
+                from ray_tpu.util import flight_recorder
+
+                flight_recorder.record(
+                    "lockdep", "inversion",
+                    severity=flight_recorder.ERROR,
+                    holding=self.name, acquiring=self.name,
+                    cycle=f"{self.name} -> {self.name}")
+            except Exception:  # lint: allow-silent(witness must not crash the runtime)
+                pass
+            raise LockOrderInversion(
+                f"re-acquiring non-reentrant lock {self.name!r} in the "
+                f"same thread — certain self-deadlock")
+        holder = stack[-1]
+        with _meta:
+            already = self.name in _edges.get(holder.name, ())
+            if not already:
+                # Adding holder->self: a cycle exists iff self already
+                # reaches holder.
+                cycle = _reachable(self.name, holder.name)
+                _edges.setdefault(holder.name, set()).add(self.name)
+                _edge_stacks[(holder.name, self.name)] = "".join(
+                    traceback.format_stack(limit=12))
+            else:
+                cycle = None
+            prior = _edge_stacks.get((self.name, holder.name), "")
+        if cycle is not None:
+            _record_inversion(holder.name, self.name,
+                              [holder.name] + cycle[:-1], prior)
+            if _strict():
+                raise LockOrderInversion(
+                    f"acquiring {self.name!r} while holding "
+                    f"{holder.name!r} inverts the witnessed order "
+                    f"{' -> '.join(cycle)}")
+
+    # -- lock protocol ---------------------------------------------------
+
+    def acquire(self, blocking: bool = True, timeout: float = -1) -> bool:
+        if blocking:
+            # Trylocks are exempt (as in kernel lockdep): a
+            # non-blocking acquire can never deadlock, and a failed
+            # one must not leave a phantom edge in the order graph.
+            self._check_order()
+        got = self._inner.acquire(blocking, timeout)
+        if got:
+            _held_stack().append(self)
+        return got
+
+    def release(self) -> None:
+        stack = _held_stack()
+        # Out-of-order release is legal for threading.Lock; drop the
+        # newest matching entry.
+        for i in range(len(stack) - 1, -1, -1):
+            if stack[i] is self:
+                del stack[i]
+                break
+        self._inner.release()
+
+    def __enter__(self):
+        self.acquire()
+        return self
+
+    def __exit__(self, *exc):
+        self.release()
+        return False
+
+    def locked(self) -> bool:
+        inner_locked = getattr(self._inner, "locked", None)
+        return inner_locked() if inner_locked is not None else False
+
+    def __repr__(self):
+        return f"<WitnessLock {self.name!r}>"
+
+
+def make_lock(name: str, reentrant: bool = False):
+    """Factory used by the threaded subsystems (core_worker, router,
+    object_store, retry, ...): a plain ``threading.Lock``/``RLock`` in
+    production, a :class:`WitnessLock` when the lockdep lane is on. The
+    ``name`` should be stable and globally unique-ish
+    (``"module.Class.attr"``) — it is the node identity in the order
+    graph."""
+    if witness_enabled():
+        return WitnessLock(name, reentrant=reentrant)
+    return threading.RLock() if reentrant else threading.Lock()

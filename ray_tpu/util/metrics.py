@@ -19,7 +19,17 @@ from typing import Callable, Dict, List, Optional, Sequence, Tuple
 _registry_lock = threading.Lock()
 _registry: Dict[str, "Metric"] = {}
 _last_push = 0.0
-_PUSH_INTERVAL_S = 2.0
+_PUSH_INTERVAL_S = 2.0  # fallback when config is unavailable
+
+
+def _push_interval() -> float:
+    """Config-driven throttle (metrics_report_interval_s)."""
+    try:
+        from ray_tpu.core.config import get_config
+
+        return float(get_config().metrics_report_interval_s)
+    except Exception:  # metrics must work before config bootstraps
+        return _PUSH_INTERVAL_S
 # Called with the core worker after each metrics push; the telemetry
 # module's timeline-event push rides the same throttle window.
 _push_hooks: List[Callable] = []
@@ -226,8 +236,9 @@ def _maybe_push(force: bool = False, idle_skip: bool = False):
     """Throttled push of this process's registry to the head KV."""
     global _last_push, _last_app_blob
     now = time.time()
-    if not force and now - _last_push < _PUSH_INTERVAL_S:
-        _schedule_trailing_flush(_PUSH_INTERVAL_S - (now - _last_push))
+    interval = _push_interval()
+    if not force and now - _last_push < interval:
+        _schedule_trailing_flush(interval - (now - _last_push))
         return
     try:
         from ray_tpu.core.object_ref import get_core_worker

@@ -51,6 +51,16 @@ jax.config.update("jax_compilation_cache_dir", _cache_dir)
 jax.config.update("jax_persistent_cache_min_compile_time_secs", 0.0)
 jax.config.update("jax_persistent_cache_min_entry_size_bytes", -1)
 
+# Concurrency lint plane: the whole suite (including the chaos lanes)
+# runs with witness-instrumented locks (util/locks.py) so cross-thread
+# lock-order inversions are detected at acquire time. Non-strict —
+# an inversion is recorded to the flight recorder (lockdep/inversion)
+# and logged at ERROR instead of raised — so a real finding surfaces in
+# logs/debug dumps without flaking unrelated tests. The witness unit
+# tests opt back into strict mode explicitly.
+os.environ.setdefault("RAY_TPU_LOCKDEP", "1")
+os.environ.setdefault("RAY_TPU_LOCKDEP_STRICT", "0")
+
 import pytest  # noqa: E402
 
 

@@ -1,0 +1,216 @@
+"""Lockdep witness (ray_tpu/util/locks.py): ABBA inversion detection,
+strict vs recording mode, reentrant locks, and the make_lock production
+fast path."""
+
+import threading
+
+import pytest
+
+from ray_tpu.util import locks
+from ray_tpu.util import flight_recorder as fr
+
+
+@pytest.fixture(autouse=True)
+def _fresh_witness(monkeypatch):
+    monkeypatch.setenv("RAY_TPU_LOCKDEP", "1")
+    monkeypatch.setenv("RAY_TPU_LOCKDEP_STRICT", "1")
+    locks.reset_witness_for_testing()
+    yield
+    locks.reset_witness_for_testing()
+
+
+def test_make_lock_plain_when_disabled(monkeypatch):
+    monkeypatch.setenv("RAY_TPU_LOCKDEP", "0")
+    lock = locks.make_lock("x")
+    assert not isinstance(lock, locks.WitnessLock)
+    with lock:
+        pass
+
+
+def test_make_lock_witness_when_enabled():
+    lock = locks.make_lock("x")
+    assert isinstance(lock, locks.WitnessLock)
+
+
+def test_consistent_order_is_clean():
+    a = locks.WitnessLock("A")
+    b = locks.WitnessLock("B")
+    for _ in range(3):
+        with a:
+            with b:
+                pass
+    assert locks.witness_graph() == {"A": ["B"]}
+
+
+def test_abba_inversion_raises_in_strict_mode():
+    a = locks.WitnessLock("A")
+    b = locks.WitnessLock("B")
+    with a:
+        with b:
+            pass
+    with b:
+        with pytest.raises(locks.LockOrderInversion) as ei:
+            with a:
+                pass
+    assert "A" in str(ei.value) and "B" in str(ei.value)
+
+
+def test_abba_inversion_across_threads():
+    a = locks.WitnessLock("A")
+    b = locks.WitnessLock("B")
+    with a:
+        with b:
+            pass
+
+    caught = []
+
+    def other():
+        try:
+            with b:
+                with a:
+                    pass
+        except locks.LockOrderInversion as e:
+            caught.append(e)
+
+    t = threading.Thread(target=other)
+    t.start()
+    t.join(timeout=10)
+    assert len(caught) == 1
+
+
+def test_three_lock_cycle_detected():
+    a, b, c = (locks.WitnessLock(n) for n in "ABC")
+    with a:
+        with b:
+            pass
+    with b:
+        with c:
+            pass
+    with c:
+        with pytest.raises(locks.LockOrderInversion):
+            with a:
+                pass
+
+
+def test_nonstrict_records_instead_of_raising(monkeypatch):
+    monkeypatch.setenv("RAY_TPU_LOCKDEP_STRICT", "0")
+    fr.reset_for_testing(capacity=32)
+    a = locks.WitnessLock("A")
+    b = locks.WitnessLock("B")
+    with a:
+        with b:
+            pass
+    with b:
+        with a:  # recorded, not raised
+            pass
+    events = [e for e in fr.snapshot() if e["event"] == "inversion"]
+    assert len(events) == 1
+    assert events[0]["severity"] == "error"
+    tags = events[0]["tags"]
+    assert tags["holding"] == "B" and tags["acquiring"] == "A"
+    assert "A" in tags["cycle"] and "B" in tags["cycle"]
+    fr.reset_for_testing()
+
+
+def test_inversion_reported_once_per_pair(monkeypatch):
+    monkeypatch.setenv("RAY_TPU_LOCKDEP_STRICT", "0")
+    fr.reset_for_testing(capacity=32)
+    a = locks.WitnessLock("A")
+    b = locks.WitnessLock("B")
+    with a:
+        with b:
+            pass
+    for _ in range(5):
+        with b:
+            with a:
+                pass
+    events = [e for e in fr.snapshot() if e["event"] == "inversion"]
+    assert len(events) == 1
+    fr.reset_for_testing()
+
+
+def test_self_deadlock_raises_even_in_record_only_mode(monkeypatch):
+    # Re-acquiring a non-reentrant lock in the same thread would block
+    # on ourselves forever — the witness raises instead of hanging,
+    # regardless of strict mode.
+    monkeypatch.setenv("RAY_TPU_LOCKDEP_STRICT", "0")
+    a = locks.WitnessLock("A")
+    with a:
+        with pytest.raises(locks.LockOrderInversion,
+                           match="self-deadlock"):
+            a.acquire()
+
+
+def test_record_only_is_the_default(monkeypatch):
+    # Enabling the witness alone must never crash the runtime: with
+    # STRICT unset, an inversion is recorded, not raised.
+    monkeypatch.delenv("RAY_TPU_LOCKDEP_STRICT", raising=False)
+    fr.reset_for_testing(capacity=32)
+    a = locks.WitnessLock("A")
+    b = locks.WitnessLock("B")
+    with a:
+        with b:
+            pass
+    with b:
+        with a:
+            pass
+    assert [e for e in fr.snapshot() if e["event"] == "inversion"]
+    fr.reset_for_testing()
+
+
+def test_trylock_skips_order_check():
+    # A non-blocking acquire can never deadlock (kernel-lockdep rule):
+    # even an order that would invert is permitted and adds no edge.
+    a = locks.WitnessLock("A")
+    b = locks.WitnessLock("B")
+    with a:
+        with b:
+            pass
+    with b:
+        assert a.acquire(blocking=False)
+        a.release()
+    assert "A" not in locks.witness_graph().get("B", [])
+
+
+def test_reentrant_lock_no_self_edge():
+    r = locks.WitnessLock("R", reentrant=True)
+    with r:
+        with r:  # legal re-entry, not an ordering event
+            pass
+    assert locks.witness_graph() == {}
+
+
+def test_explicit_acquire_release_and_out_of_order_release():
+    a = locks.WitnessLock("A")
+    b = locks.WitnessLock("B")
+    a.acquire()
+    b.acquire()
+    a.release()  # out-of-order release is legal
+    b.release()
+    assert locks.witness_graph() == {"A": ["B"]}
+    # Held-stack is clean: acquiring in the other order now closes the
+    # cycle (B held, A wanted).
+    b.acquire()
+    with pytest.raises(locks.LockOrderInversion):
+        a.acquire()
+    b.release()
+
+
+def test_trylock_failure_does_not_track_as_held():
+    a = locks.WitnessLock("A")
+    b = locks.WitnessLock("B")
+    assert a.acquire()
+
+    def other():
+        # Failed try-acquire must not leave A on this thread's held
+        # stack — otherwise the b acquisition would add a phantom
+        # A->B edge.
+        assert a.acquire(blocking=False) is False
+        with b:
+            pass
+
+    t = threading.Thread(target=other)
+    t.start()
+    t.join(timeout=10)
+    a.release()
+    assert "B" not in locks.witness_graph().get("A", [])

@@ -1,20 +1,57 @@
 """ThreadSanitizer gate for the native arena (reference: bazel
---config=tsan on the C++ core). Compile+run costs ~1 min, so it only
-runs when RAY_TPU_TSAN=1 (CI race-hunt lane); the script is also
-directly runnable: bash cpp/tpustore/tsan_check.sh."""
+--config=tsan on the C++ core). Compile+run costs ~1 min, so the
+stress itself only runs when RAY_TPU_TSAN=1 (CI race-hunt lane); the
+script is also directly runnable: bash cpp/tpustore/tsan_check.sh.
 
+The committed artifact (TSAN_r<NN>.json) is schema-checked in tier-1
+so a stale or hand-mangled JSON can't green the lane silently."""
+
+import glob
+import json
 import os
+import re
 import subprocess
 
 import pytest
+
+_REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+#: every key the artifact must carry, with a validity predicate.
+_ARTIFACT_SCHEMA = {
+    "lane": lambda v: isinstance(v, str) and "tsan_check.sh" in v,
+    "stress": lambda v: isinstance(v, str) and "fsanitize=thread" in v,
+    "result": lambda v: v == "OK",
+    "races_found": lambda v: v == 0,
+    "run_date": lambda v: isinstance(v, str)
+    and re.fullmatch(r"\d{4}-\d{2}-\d{2}", v) is not None,
+}
+
+
+def _latest_artifact() -> str:
+    paths = sorted(glob.glob(os.path.join(_REPO, "TSAN_r*.json")))
+    assert paths, "no TSAN_r*.json artifact committed"
+    return paths[-1]
+
+
+def test_tsan_artifact_schema():
+    """Tier-1: the newest committed TSan artifact parses and proves a
+    clean run — every schema key present and valid."""
+    path = _latest_artifact()
+    with open(path) as f:
+        data = json.load(f)
+    for key, ok in _ARTIFACT_SCHEMA.items():
+        assert key in data, f"{os.path.basename(path)} missing {key!r}"
+        assert ok(data[key]), (
+            f"{os.path.basename(path)}: bad {key!r}: {data[key]!r}")
+    extra = set(data) - set(_ARTIFACT_SCHEMA)
+    assert not extra, f"unknown artifact keys (update the schema): {extra}"
 
 
 @pytest.mark.skipif(os.environ.get("RAY_TPU_TSAN") != "1",
                     reason="set RAY_TPU_TSAN=1 to run the TSan stress")
 def test_native_store_under_tsan():
-    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
     out = subprocess.run(
-        ["bash", os.path.join(repo, "cpp", "tpustore", "tsan_check.sh")],
+        ["bash", os.path.join(_REPO, "cpp", "tpustore", "tsan_check.sh")],
         capture_output=True, text=True, timeout=600)
     assert out.returncode == 0, out.stdout[-2000:] + out.stderr[-2000:]
     assert "OK" in out.stdout
