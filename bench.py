@@ -154,5 +154,34 @@ def _run_serve_stream_bench():
         pass
 
 
+def _run_transfer_device_bench():
+    """`bench.py transfer-device`: the device-plane transfer lane —
+    1 GiB sharded jax.Array, shared-device zero-copy get + cross-process
+    per-shard pull, vs the r05 host-bounce baseline. Writes
+    BENCH_TRANSFER_r06.json."""
+    import os
+    import subprocess
+    import sys
+
+    here = os.path.dirname(os.path.abspath(__file__))
+    out = os.path.join(here, "BENCH_TRANSFER_r06.json")
+    baseline = os.path.join(here, "BENCH_TRANSFER_r05.json")
+    env = {**os.environ, "JAX_PLATFORMS": "cpu"}
+    flags = env.get("XLA_FLAGS", "")
+    if "xla_force_host_platform_device_count" not in flags:
+        env["XLA_FLAGS"] = (
+            flags + " --xla_force_host_platform_device_count=8").strip()
+    subprocess.run(
+        [sys.executable, "-m", "ray_tpu.scripts.device_transfer_bench",
+         "--out", out, "--baseline", baseline],
+        timeout=1200, check=True, env=env,
+    )
+
+
 if __name__ == "__main__":
-    main()
+    import sys
+
+    if len(sys.argv) > 1 and sys.argv[1] == "transfer-device":
+        _run_transfer_device_bench()
+    else:
+        main()

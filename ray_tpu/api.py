@@ -312,14 +312,22 @@ def put(value: Any) -> ObjectRef:
 
 
 def get(refs: Union[ObjectRef, Sequence[ObjectRef]],
-        timeout: Optional[float] = None):
+        timeout: Optional[float] = None, donate: bool = False):
+    """Resolve refs to values.
+
+    ``donate=True`` applies to device-plane objects (sharded jax.Arrays
+    put through the device-native object plane) pulled from another
+    process: once the transfer lands, the serving holder's device
+    buffers are released — the get is a move of HBM, not a copy. It is
+    a no-op for host-path objects and for same-process (zero-copy)
+    hits."""
     cw = _require_worker()
     single = isinstance(refs, ObjectRef)
     ref_list = [refs] if single else list(refs)
     for r in ref_list:
         if not isinstance(r, ObjectRef):
             raise TypeError(f"get() expects ObjectRef, got {type(r)}")
-    values = cw.get(ref_list, timeout)
+    values = cw.get(ref_list, timeout, donate=donate)
     return values[0] if single else values
 
 

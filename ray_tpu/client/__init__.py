@@ -237,7 +237,11 @@ class ClientWorker:
         return self._mk_ref(reply["ref"])
 
     def get(self, refs: List[ObjectRef],
-            timeout: Optional[float] = None) -> List[Any]:
+            timeout: Optional[float] = None,
+            donate: bool = False) -> List[Any]:
+        # ``donate`` is a device-plane transfer optimization; values
+        # reach a client as pickled host data, so there is no holder-
+        # side buffer to release — accepted for API parity, ignored.
         reply = self._call(
             "c_get", {"ids": [r.hex() for r in refs],
                       "timeout": timeout},

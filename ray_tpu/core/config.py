@@ -139,6 +139,20 @@ class Config:
     # instead of surfacing ObjectLostError).
     object_pull_max_attempts: int = 3
 
+    # --- device-native object plane (core/device_objects.py) ---
+    # Store qualifying jax.Array leaves of put() values as per-shard
+    # device buffers + a sharding descriptor instead of a pickled host
+    # blob; get() returns them by reference in the producing process and
+    # reassembles via per-shard pulls elsewhere. Off restores the
+    # host-numpy path everywhere.
+    device_object_plane_enabled: bool = True
+    # Arrays below this stay on the host path (tiny scalars aren't worth
+    # descriptor + manifest traffic).
+    device_object_min_bytes: int = 1024
+    # Shards pulled concurrently per get(): bounds host staging memory
+    # at concurrency x shard size, never the whole array.
+    device_shard_pull_concurrency: int = 4
+
     # --- metrics / tracing ---
     # Built-in ray_tpu_* metrics plane (util/telemetry.py). On by
     # default: instruments RPC, retry, scheduler, object, GCS, Serve and

@@ -124,7 +124,8 @@ class ReferenceCounter:
 
     def _entry(self, hex_id: str) -> dict:
         return self._refs.setdefault(
-            hex_id, {"local": 0, "borrows": 0, "owned": False, "shm": False}
+            hex_id, {"local": 0, "borrows": 0, "owned": False,
+                     "shm": False, "device": False}
         )
 
     def _drain_deferred(self):
@@ -138,7 +139,8 @@ class ReferenceCounter:
                 return
             self._remove_local_ref_now(hex_id, object_id, owner)
 
-    def register_owned(self, object_id: ObjectID, in_shm: bool):
+    def register_owned(self, object_id: ObjectID, in_shm: bool,
+                       device: bool = False):
         if self._disabled:
             return
         self._drain_deferred()
@@ -146,6 +148,7 @@ class ReferenceCounter:
             entry = self._entry(object_id.hex())
             entry["owned"] = True
             entry["shm"] = in_shm
+            entry["device"] = device
 
     def add_local_ref(self, ref: ObjectRef):
         if self._disabled:
@@ -173,13 +176,16 @@ class ReferenceCounter:
             entry["local"] -= 1
             if entry["local"] <= 0 and entry["borrows"] <= 0:
                 if entry["owned"]:
-                    to_free = (object_id, entry["shm"])
+                    to_free = (object_id, entry["shm"],
+                               entry.get("device", False))
                 elif owner is not None:
                     notify_owner = owner
                 self._refs.pop(hex_id, None)
         if to_free is not None:
-            self.cw._free_owned_object(to_free[0], to_free[1])
+            self.cw._free_owned_object(to_free[0], to_free[1],
+                                       device=to_free[2])
         elif notify_owner is not None:
+            self.cw._release_borrowed_device_copy(object_id)
             self.cw._notify_owner_ref_removed(object_id, notify_owner)
 
     def on_ref_serialized(self, ref: ObjectRef):
@@ -218,10 +224,12 @@ class ReferenceCounter:
                 return
             entry["borrows"] -= 1
             if entry["local"] <= 0 and entry["borrows"] <= 0 and entry["owned"]:
-                to_free = (object_id, entry["shm"])
+                to_free = (object_id, entry["shm"],
+                           entry.get("device", False))
                 self._refs.pop(object_id.hex(), None)
         if to_free is not None:
-            self.cw._free_owned_object(to_free[0], to_free[1])
+            self.cw._free_owned_object(to_free[0], to_free[1],
+                                       device=to_free[2])
 
     def num_tracked(self) -> int:
         with self._lock:
@@ -618,6 +626,8 @@ class CoreWorker:
             "task_done": self.h_task_done,
             "ping": self.h_ping,
             "debug_dump": self.h_debug_dump,
+            "fetch_device_shard": self.h_fetch_device_shard,
+            "donate_device_shards": self.h_donate_device_shards,
         }
 
     async def h_debug_dump(self, conn, payload):
@@ -818,11 +828,88 @@ class CoreWorker:
     def put(self, value: Any) -> ObjectRef:
         object_id = ObjectID.for_put(self.current_task_id(),
                                      self._put_counter.next())
-        obj = serialization.serialize(value)
+        obj = self._serialize_for_put(object_id, value)
         self.put_serialized(object_id, obj)
         return ObjectRef(object_id, self.address, is_owned=True)
 
+    def _serialize_for_put(self, object_id: ObjectID,
+                           value: Any) -> SerializedObject:
+        """Serialize a put value, routing qualifying jax.Array leaves
+        through the device plane (per-shard device buffers + a tiny
+        placeholder envelope) instead of the host-numpy bounce."""
+        from ray_tpu.core import device_objects
+
+        if not device_objects.plane_enabled(self.config):
+            return serialization.serialize(value)
+        exported: dict = {}
+
+        def exporter(v):
+            try:
+                mapped, count, descs = device_objects.export_value(
+                    object_id, v, self.config)
+            except Exception as e:
+                _swallow("device_objects.export", e,
+                         object=object_id.hex()[:16])
+                return v, 0
+            if count:
+                exported["descs"] = descs
+            return mapped, count
+
+        try:
+            obj = serialization.serialize(value, device_exporter=exporter)
+        except BaseException:
+            # Serialization of the non-device remainder failed after the
+            # export already registered shards: don't leak the entry.
+            if exported:
+                device_objects.drop(object_id.hex())
+            raise
+        if obj.metadata == serialization.DEVICE:
+            self._register_device_manifest(object_id, obj,
+                                           exported["descs"])
+        return obj
+
+    def _register_device_manifest(self, object_id: ObjectID,
+                                  obj: SerializedObject,
+                                  descs: List[dict]) -> None:
+        """Record the sharding manifest in the head's owner table (next
+        to the location entry) and start serving shards. Small envelopes
+        are mirrored so holders can serve the object after this owner
+        dies (replica cold-start-from-peer)."""
+        from ray_tpu.core import device_objects
+
+        total_bytes = sum(int(d.get("nbytes", 0)) for d in descs)
+        envelope = None
+        if obj.total_size() <= device_objects.MANIFEST_ENVELOPE_CAP:
+            envelope = [obj.metadata, obj.inband,
+                        [bytes(memoryview(b)) for b in obj.buffers]]
+        fut = self.loop_thread.submit(
+            self.head.call("device_object_put", {
+                "object_id": object_id.hex(),
+                "manifest": descs,
+                "holder": list(self._device_holder_address()),
+                "envelope": envelope,
+                "total_bytes": total_bytes,
+            }))
+
+        def _observe(f, hex_id=object_id.hex()):
+            # A lost registration makes the put silently unfetchable
+            # cross-process ("no registered holders") — leave evidence
+            # tying that symptom to its cause.
+            err = f.exception()
+            if err is not None:
+                _swallow("device.manifest_register", err,
+                         object=hex_id[:16])
+
+        fut.add_done_callback(_observe)
+
+    def _device_holder_address(self) -> Tuple[str, int, int]:
+        """(host, worker rpc port, data-plane port) other processes use
+        to pull shards from this one."""
+        data_port = object_transfer.ensure_data_server()
+        return (self.address.host, self.address.port, data_port)
+
     def put_serialized(self, object_id: ObjectID, obj: SerializedObject):
+        device = obj.metadata == serialization.DEVICE
         in_shm = (obj.total_size() > self.config.max_direct_call_object_size
                   and not getattr(self, "no_node_store", False))
         if in_shm:
@@ -835,7 +922,8 @@ class CoreWorker:
             )
         else:
             self.memory_store.put(object_id, obj)
-        self.reference_counter.register_owned(object_id, in_shm)
+        self.reference_counter.register_owned(object_id, in_shm,
+                                              device=device)
 
     def _seal_to_shm(self, object_id: ObjectID, obj: SerializedObject) -> int:
         size = object_store.node_store_write(object_id, obj)
@@ -855,14 +943,16 @@ class CoreWorker:
                 f"actor method?). Use `await ref` / the async API instead."
             )
 
-    def get(self, refs: List[ObjectRef], timeout: Optional[float] = None
-            ) -> List[Any]:
+    def get(self, refs: List[ObjectRef], timeout: Optional[float] = None,
+            donate: bool = False) -> List[Any]:
         self._check_not_on_loop("get()")
-        fut = self.loop_thread.submit(self._get_all_async(refs, timeout))
+        fut = self.loop_thread.submit(
+            self._get_all_async(refs, timeout, donate=donate))
         return fut.result()
 
     async def _get_all_async(self, refs: List[ObjectRef],
-                             timeout: Optional[float]) -> List[Any]:
+                             timeout: Optional[float],
+                             donate: bool = False) -> List[Any]:
         """Batched get with a single awaitable for every owned-local
         pending ref: per-ref ``gather`` + ``wait_for`` costs an asyncio
         Task and a timer handle per object — at tiny-object rates that
@@ -929,14 +1019,34 @@ class CoreWorker:
                 *(self._open_shm(refs[i].id, timeout) for i in plasma))
             for i, obj in zip(plasma, opened):
                 objs[i] = obj
+        device = [i for i, obj in enumerate(objs)
+                  if obj.metadata == serialization.DEVICE]
+        if device:
+            resolved = await asyncio.gather(
+                *(self._resolve_device_object(refs[i], objs[i],
+                                              donate=donate)
+                  for i in device))
+            out = [None] * len(objs)
+            dset = set(device)
+            for i, value in zip(device, resolved):
+                out[i] = value
+            for i, obj in enumerate(objs):
+                if i not in dset:
+                    out[i] = serialization.deserialize(
+                        obj.metadata, obj.inband, obj.buffers)
+            return out
         return [
             serialization.deserialize(obj.metadata, obj.inband,
                                       obj.buffers)
             for obj in objs
         ]
 
-    async def get_async(self, ref: ObjectRef, timeout: Optional[float] = None):
+    async def get_async(self, ref: ObjectRef, timeout: Optional[float] = None,
+                        donate: bool = False):
         obj = await self._resolve_object(ref, timeout)
+        if obj.metadata == serialization.DEVICE:
+            return await self._resolve_device_object(ref, obj,
+                                                     donate=donate)
         return serialization.deserialize(obj.metadata, obj.inband, obj.buffers)
 
     async def _resolve_object(self, ref: ObjectRef,
@@ -1007,6 +1117,13 @@ class CoreWorker:
                 timeout=timeout,
             )
         except (rpc.ConnectionLost, rpc.RpcError, OSError) as e:
+            # Device-plane objects outlive their owner: the envelope +
+            # sharding manifest are mirrored in the head's owner table,
+            # and any registered holder can serve the shards (replica
+            # cold-start-from-peer).
+            fallback = await self._device_envelope_from_head(ref.id)
+            if fallback is not None:
+                return fallback
             raise exc.ObjectLostError(ref.hex()) from e
         if reply.get("in_plasma"):
             return make_plasma_marker()
@@ -1047,6 +1164,288 @@ class CoreWorker:
         if obj is None:
             raise exc.ObjectLostError(object_id.hex())
         return obj
+
+    # ------------------------------------------------------------------
+    # device-native object plane (core/device_objects.py)
+    # ------------------------------------------------------------------
+
+    async def _device_envelope_from_head(self, object_id: ObjectID
+                                         ) -> Optional[SerializedObject]:
+        """The mirrored envelope from the head's owner table (owner-death
+        fallback). None when the object isn't a device-plane object or
+        the envelope was too large to mirror."""
+        try:
+            reply = await self.head.call(
+                "locate_device_object", {"object_id": object_id.hex()})
+        except Exception:
+            return None
+        envelope = reply.get("envelope") if reply.get("found") else None
+        if envelope is None:
+            return None
+        metadata, inband, buffers = envelope
+        return SerializedObject(metadata=bytes(metadata),
+                                inband=bytes(inband),
+                                buffers=list(buffers or []))
+
+    async def _resolve_device_object(self, ref: ObjectRef,
+                                     obj: SerializedObject,
+                                     donate: bool = False) -> Any:
+        """Materialize a DEVICE envelope: placeholders become arrays —
+        by reference when this process already holds them, otherwise via
+        per-shard pulls from any registered holder."""
+        from ray_tpu.core import device_objects
+
+        value = serialization.deserialize(serialization.NORMAL,
+                                          obj.inband, obj.buffers)
+        leaf_refs = device_objects.collect_leaf_refs(value)
+        resolved: Dict[Tuple[str, int], Any] = {}
+        missing = []
+        for lr in leaf_refs:
+            arr = device_objects.local_array(lr.obj_hex, lr.leaf)
+            if arr is not None:
+                resolved[(lr.obj_hex, lr.leaf)] = arr
+            else:
+                missing.append(lr)
+        if missing:
+            # The owner registers the manifest asynchronously at put
+            # time; a consumer racing that registration (publish →
+            # immediate fetch) sees an empty holder list for a few ms —
+            # retry briefly before declaring the object lost.
+            holders = await self._device_holders(ref.id)
+            for delay in self._probe_retry.backoff_series(3):
+                if holders:
+                    break
+                if delay:
+                    await asyncio.sleep(delay)
+                holders = await self._device_holders(ref.id)
+            if not holders:
+                raise exc.ObjectLostError(
+                    f"device object {ref.hex()}: no registered holders")
+            sources = set()
+            sem = asyncio.Semaphore(
+                max(1, self.config.device_shard_pull_concurrency))
+            # Leaves pull concurrently — a weights pytree of many
+            # small-shard leaves would otherwise serialize on one
+            # transfer at a time; the shared semaphore still bounds
+            # total staging.
+            pulled = await asyncio.gather(
+                *(self._pull_device_leaf(ref.id, lr, holders, sem)
+                  for lr in missing))
+            servable = 0
+            for lr, (arr, source) in zip(missing, pulled):
+                sources.add(source)
+                resolved[(lr.obj_hex, lr.leaf)] = arr
+                servable += device_objects.register_assembled(
+                    ref.id, lr.leaf, lr.desc, arr)
+            if servable:
+                # Become a holder: peers (e.g. the next cold-starting
+                # replica) can now pull from this process. A consumer
+                # that fell back to single-device assembly has no
+                # shards matching the recorded layout — listing it
+                # would only burn peers' pull sweeps.
+                asyncio.ensure_future(self.head.call(
+                    "device_location_added", {
+                        "object_id": ref.id.hex(),
+                        "holder": list(self._device_holder_address()),
+                    }))
+            else:
+                device_objects.drop(ref.id.hex())
+            if donate:
+                for src in sources:
+                    await self._donate_source_shards(ref.id, src)
+        return device_objects.substitute(value, resolved)
+
+    async def _device_holders(self, object_id: ObjectID) -> List[tuple]:
+        try:
+            reply = await self.head.call(
+                "locate_device_object", {"object_id": object_id.hex()})
+        except Exception:
+            return []
+        if not reply.get("found"):
+            return []
+        me = tuple(self._device_holder_address())
+        return [tuple(h) for h in reply.get("holders", [])
+                if tuple(h) != me]
+
+    async def _pull_device_leaf(self, object_id: ObjectID, leaf_ref,
+                                holders: List[tuple],
+                                sem: asyncio.Semaphore,
+                                preferred: Optional[tuple] = None):
+        """Pull one leaf's shards (bounded concurrency, resumable range
+        reads with chunked-rpc fallback) and assemble the array against
+        the recorded sharding. Returns (array, holder that served it)."""
+        from ray_tpu.core import device_objects
+        from ray_tpu.util import flight_recorder, telemetry
+
+        desc = leaf_ref.desc
+        ordered = ([preferred] if preferred in holders else []) + [
+            h for h in holders if h != preferred]
+        last_error: Optional[Exception] = None
+        loop = asyncio.get_running_loop()
+        for holder in ordered:
+            assembler = device_objects.LeafAssembler(desc)
+            # Shared with the data-plane threads: a failed sibling sets
+            # "stop" so blocked recv loops bail at their next check
+            # instead of riding out the socket timeout.
+            state = {"stop": False}
+            try:
+                async def pull_one(meta, holder=holder,
+                                   assembler=assembler, state=state):
+                    async with sem:
+                        t0 = time.perf_counter()
+                        buf = device_objects.StagingBuffer(meta["nbytes"])
+                        absorbed = False
+                        try:
+                            sid = device_objects.shard_id(
+                                object_id.binary(), leaf_ref.leaf,
+                                meta["key"])
+                            await self._pull_shard(holder, sid,
+                                                   buf.view(), state)
+                            # Land on device NOW and release the host
+                            # staging before the next shard claims a
+                            # buffer: peak host memory stays at
+                            # concurrency × shard size. On XLA:CPU the
+                            # landing may absorb the buffer zero-copy —
+                            # then it belongs to the array, not the pool.
+                            absorbed = await loop.run_in_executor(
+                                None, assembler.land, meta["key"],
+                                buf.array)
+                        finally:
+                            if absorbed:
+                                buf.forfeit()
+                            else:
+                                buf.release()
+                        elapsed = time.perf_counter() - t0
+                        telemetry.observe(
+                            "ray_tpu_object_shard_pull_seconds",
+                            elapsed, {"status": "ok"})
+                        telemetry.inc(
+                            "ray_tpu_object_shard_pull_bytes_total",
+                            meta["nbytes"])
+                        flight_recorder.record(
+                            "object", "shard_pulled",
+                            object=object_id.hex()[:16],
+                            leaf=leaf_ref.leaf, shard=meta["key"],
+                            bytes=meta["nbytes"],
+                            dur_s=round(elapsed, 4))
+
+                tasks = [asyncio.ensure_future(pull_one(meta))
+                         for meta in desc["shards"]]
+                try:
+                    await asyncio.gather(*tasks)
+                except BaseException:
+                    # One shard failed: siblings still in flight for
+                    # THIS holder would otherwise keep the shared
+                    # semaphore slots (and their sockets) busy for the
+                    # retry against the next holder. Cancel and drain.
+                    state["stop"] = True
+                    for t in tasks:
+                        t.cancel()
+                    await asyncio.gather(*tasks, return_exceptions=True)
+                    raise
+                arr = await loop.run_in_executor(None,
+                                                 assembler.finalize)
+                return arr, holder
+            except asyncio.CancelledError:
+                raise
+            except Exception as e:
+                last_error = e
+                telemetry.observe("ray_tpu_object_shard_pull_seconds",
+                                  0.0, {"status": "error"})
+                logger.info("device shard pull from %s failed: %s",
+                            holder, e)
+        raise exc.ObjectLostError(
+            f"device object {object_id.hex()}: every holder failed "
+            f"({last_error})")
+
+    async def _pull_shard(self, holder: tuple, shard_id_bytes: bytes,
+                          dest: memoryview,
+                          state: Optional[dict] = None) -> None:
+        """One shard from one holder: bulk data plane first (resumable
+        range reads, two kernel copies), chunked rpc on the worker
+        connection as the fallback. ``state["stop"]`` aborts the
+        data-plane recv loop between reads (sibling-failure cleanup)."""
+        host, port, data_port = holder
+        if data_port:
+            loop = asyncio.get_running_loop()
+            try:
+                await loop.run_in_executor(
+                    None, object_transfer.pull_shard_into,
+                    (host, data_port), shard_id_bytes, dest, state)
+                return
+            except object_transfer._PullAborted:
+                raise
+            except OSError as e:
+                logger.info("shard data-plane pull from %s:%s failed "
+                            "(%s); falling back to chunked rpc",
+                            host, data_port, e)
+        conn = await self.get_connection((host, port))
+        total = dest.nbytes
+        offset = 0
+        while offset < total:
+            ln = min(object_transfer.SHARD_CHUNK_BYTES, total - offset)
+            reply = await conn.call("fetch_device_shard", {
+                "shard_id": bytes(shard_id_bytes).hex(),
+                "offset": offset, "length": ln,
+            })
+            if not reply.get("found"):
+                raise object_transfer._PullAborted(
+                    "holder no longer serves the shard")
+            chunk = reply.get("__attachment__", b"")
+            if len(chunk) != ln:
+                raise object_transfer._PullAborted("truncated shard chunk")
+            dest[offset:offset + ln] = chunk
+            offset += ln
+
+    async def _donate_source_shards(self, object_id: ObjectID,
+                                    source: tuple) -> None:
+        """donate=True epilogue: the consumer has the shards; tell the
+        serving holder to release its device buffers (an HBM move, not a
+        copy)."""
+        host, port, _data_port = source
+        try:
+            conn = await self.get_connection((host, port))
+            await conn.call("donate_device_shards",
+                            {"object_id": object_id.hex()})
+        except Exception as e:
+            _swallow("device.donate_notify", e,
+                     object=object_id.hex()[:16])
+
+    async def h_fetch_device_shard(self, conn, payload):
+        """Chunked-rpc shard serving (fallback when a puller can't reach
+        the bulk data plane). Offset-based, so interrupted pulls resume."""
+        from ray_tpu.core import device_objects
+
+        view = device_objects.shard_view(
+            bytes.fromhex(payload["shard_id"]))
+        if view is None:
+            return {"found": False}
+        off = int(payload["offset"])
+        ln = int(payload["length"])
+        return rpc.WithAttachment(
+            {"found": True, "total": view.nbytes}, view[off:off + ln])
+
+    async def h_donate_device_shards(self, conn, payload):
+        """A consumer finished a donate=True transfer: release this
+        process's device buffers for the object and retract the holder
+        listing."""
+        from ray_tpu.core import device_objects
+        from ray_tpu.util import flight_recorder
+
+        hex_id = payload["object_id"]
+        released = device_objects.drop(hex_id, donated=True)
+        if released:
+            flight_recorder.record("object", "shard_donated",
+                                   object=hex_id[:16], bytes=released)
+            try:
+                await self.head.call("device_location_removed", {
+                    "object_id": hex_id,
+                    "holder": list(self._device_holder_address()),
+                })
+            except Exception as e:
+                _swallow("device.donate_location_removed", e,
+                         object=hex_id[:16])
+        return {"ok": True, "released": released}
 
     async def _recover_object(self, object_id: ObjectID,
                               timeout: Optional[float]
@@ -1237,17 +1636,37 @@ class CoreWorker:
         return ready_sorted, not_ready + extra
 
     def free(self, refs: List[ObjectRef]):
+        from ray_tpu.core import device_objects
+
         hex_ids = [r.hex() for r in refs]
         for ref in refs:
             self.memory_store.delete(ref.id)
             self._drop_lineage(ref.id)
+            device_objects.drop(ref.hex())
         self.loop_thread.submit(
             self.head.call("free_objects", {"object_ids": hex_ids})
         )
 
-    def _free_owned_object(self, object_id: ObjectID, in_shm: bool):
+    def _free_owned_object(self, object_id: ObjectID, in_shm: bool,
+                           device: bool = False):
         self.memory_store.delete(object_id)
         self._drop_lineage(object_id)
+        if device:
+            from ray_tpu.core import device_objects
+
+            device_objects.drop(object_id.hex())
+            if not self._shutdown and not in_shm:
+                # Device envelopes live in the memory store, so the shm
+                # free below won't fire — still tell the head to drop
+                # the manifest (and any stale holder entries with it).
+                try:
+                    self.loop_thread.submit(
+                        self.head.call("free_objects",
+                                       {"object_ids": [object_id.hex()]})
+                    )
+                except Exception as e:
+                    _swallow("free.device_manifest_notify", e,
+                             object=object_id.hex()[:16])
         if in_shm and not self._shutdown:
             from ray_tpu.util import flight_recorder
 
@@ -1261,6 +1680,27 @@ class CoreWorker:
             except Exception as e:
                 _swallow("free.head_notify", e,
                          object=object_id.hex()[:16])
+
+    def _release_borrowed_device_copy(self, object_id: ObjectID):
+        """Final local release of a borrowed ref: if this process
+        assembled a device copy (it was serving it to peers), drop the
+        registry entry and retract the holder listing."""
+        from ray_tpu.core import device_objects
+
+        if not device_objects.holds(object_id.hex()):
+            return
+        device_objects.drop(object_id.hex())
+        if self._shutdown:
+            return
+        try:
+            self.loop_thread.submit(
+                self.head.call("device_location_removed", {
+                    "object_id": object_id.hex(),
+                    "holder": list(self._device_holder_address()),
+                }))
+        except Exception as e:
+            _swallow("device.location_removed_notify", e,
+                     object=object_id.hex()[:16])
 
     def _notify_owner_ref_removed(self, object_id: ObjectID, owner: Address):
         if self._shutdown:

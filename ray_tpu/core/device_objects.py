@@ -1,0 +1,746 @@
+"""Device-native object plane: sharded ``jax.Array``s without host bounces.
+
+Reference gap (SURVEY §7.3 hard-part #3, ROADMAP open item #1): the host
+object plane converts every ``jax.Array`` to numpy before pickling
+(core/serialization.py), so a sharded model's weights round-trip host RAM
+on every handoff.  This module keeps device arrays ON DEVICE:
+
+- ``put`` detects qualifying ``jax.Array`` leaves (fully-addressable
+  ``NamedSharding``/``SingleDeviceSharding``), registers their per-shard
+  device buffers in a process-local registry, and serializes only a tiny
+  envelope containing ``DeviceLeafRef`` placeholders plus a sharding
+  descriptor (mesh axes/shape, partition spec, dtype/shape, per-shard
+  layout — the pjit/GSPMD model of arxiv 2204.06514 made these first-class
+  metadata, so they can be stored and re-materialized).
+- ``get`` in the producing process returns the original array BY
+  REFERENCE — zero copies of any kind.
+- ``get`` in another process pulls shard-by-shard from any registered
+  holder (resumable range reads over the bulk data plane, chunked-RPC
+  fallback) and lands each shard through ``jax.device_put`` against the
+  recorded sharding: host staging is bounded by a few shards, never the
+  whole array.  Consumers register as holders, so a cold-starting Serve
+  replica pulls weights from the nearest peer replica instead of the
+  original producer (weight delivery at serve scale — arxiv 2605.25645
+  measures exactly this cold-start cost).
+- ``donate=True`` on transfer deletes the source holder's device buffers
+  once the consumer has them — a move, not a copy, of HBM.
+
+Everything degrades to the host path: non-jax values, exotic shardings,
+or a disabled plane (``device_object_plane_enabled=False``) use the
+numpy route unchanged.  Under ``JAX_PLATFORMS=cpu`` the same per-shard
+protocol runs against CPU devices, which is what tier-1 exercises.
+"""
+
+from __future__ import annotations
+
+import hashlib
+import threading
+from dataclasses import dataclass, field
+from typing import Any, Callable, Dict, List, Optional, Tuple
+
+from ray_tpu.core.ids import OBJECT_ID_SIZE, ObjectID
+
+# Descriptor kinds.
+KIND_NAMED = "named"
+KIND_SINGLE = "single"
+
+#: Envelopes below this are mirrored into the head's owner table next to
+#: the location entry, so holders can serve the object after the owner
+#: dies (replica cold-start-from-peer). Larger envelopes (device arrays
+#: mixed with big host data) stay owner-only.
+MANIFEST_ENVELOPE_CAP = 4 << 20
+
+
+class DeviceLeafRef:
+    """Placeholder pickled into the envelope where a device array was.
+
+    Carries everything a consumer needs to rebuild the leaf: the owning
+    object id, the leaf's position, and the full sharding descriptor —
+    so resolution never depends on reaching the producer for metadata.
+    """
+
+    __slots__ = ("obj_hex", "leaf", "desc")
+
+    def __init__(self, obj_hex: str, leaf: int, desc: dict):
+        self.obj_hex = obj_hex
+        self.leaf = leaf
+        self.desc = desc
+
+    def __reduce__(self):
+        return (DeviceLeafRef, (self.obj_hex, self.leaf, self.desc))
+
+    def __repr__(self):
+        return (f"DeviceLeafRef({self.obj_hex[:12]}…/{self.leaf}, "
+                f"{self.desc.get('kind')}, shape="
+                f"{tuple(self.desc.get('global_shape', ()))})")
+
+
+@dataclass
+class _LeafEntry:
+    desc: dict
+    # The producer keeps the whole array for the zero-copy same-process
+    # path; assembled borrower copies keep theirs for peer serving.
+    array: Any = None
+    # shard key -> single-device jax.Array (one per UNIQUE data piece;
+    # replicated shards share a key).
+    shards: Dict[int, Any] = field(default_factory=dict)
+    nbytes: int = 0
+
+
+@dataclass
+class _ObjectEntry:
+    leaves: Dict[int, _LeafEntry] = field(default_factory=dict)
+    owned: bool = False
+    donated: bool = False
+
+
+def _make_lock(name: str):
+    from ray_tpu.util.locks import make_lock
+
+    return make_lock(name)
+
+
+_registry_lock = _make_lock("device_objects._registry_lock")
+_registry: Dict[str, _ObjectEntry] = {}
+# shard id (binary) -> (object hex, leaf, shard key): the serving index
+# the data plane and the fetch_device_shard handler look through.
+_shard_index: Dict[bytes, Tuple[str, int, int]] = {}
+
+# High-water mark of host bytes staged for shard transfer in this
+# process — the "no whole-array host buffer" property is asserted
+# against this in tests (peak stays ~shard-sized, not array-sized).
+_staging_lock = threading.Lock()
+_staging_now = 0
+_staging_peak = 0
+
+
+def _note_staging(delta: int) -> None:
+    global _staging_now, _staging_peak
+    with _staging_lock:
+        _staging_now = max(0, _staging_now + delta)
+        if _staging_now > _staging_peak:
+            _staging_peak = _staging_now
+
+
+def peak_staging_bytes() -> int:
+    with _staging_lock:
+        return _staging_peak
+
+
+def reset_for_testing() -> None:
+    global _staging_now, _staging_peak, _pool_bytes
+    with _registry_lock:
+        _registry.clear()
+        _shard_index.clear()
+    with _staging_lock:
+        _staging_now = 0
+        _staging_peak = 0
+    with _pool_lock:
+        _pool.clear()
+        _pool_bytes = 0
+
+
+def plane_enabled(config=None) -> bool:
+    if config is None:
+        from ray_tpu.core.config import get_config
+
+        config = get_config()
+    if not config.device_object_plane_enabled:
+        return False
+    try:
+        import jax  # noqa: F401
+    except ImportError:
+        return False
+    return True
+
+
+# ---------------------------------------------------------------------------
+# descriptors
+# ---------------------------------------------------------------------------
+
+
+def _norm_index(index, shape) -> List[List[int]]:
+    """A shard's global index (tuple of slices) as [[start, stop], ...]."""
+    out = []
+    for sl, dim in zip(index, shape):
+        start = 0 if sl.start is None else int(sl.start)
+        stop = dim if sl.stop is None else int(sl.stop)
+        out.append([start, stop])
+    # 0-d arrays: index is (), keep it [].
+    return out
+
+
+def _describe(arr) -> Optional[dict]:
+    """Sharding descriptor for a qualifying array, else None (host path)."""
+    import jax
+    from jax.sharding import NamedSharding, SingleDeviceSharding
+
+    if getattr(arr, "is_deleted", lambda: False)():
+        return None
+    if not arr.is_fully_addressable:
+        return None
+    sharding = arr.sharding
+    desc: dict = {
+        "global_shape": [int(d) for d in arr.shape],
+        "dtype": str(arr.dtype),
+        "nbytes": int(arr.nbytes),
+    }
+    if isinstance(sharding, SingleDeviceSharding):
+        device = next(iter(sharding.device_set))
+        desc["kind"] = KIND_SINGLE
+        desc["device_id"] = int(device.id)
+        shards = [{"key": 0,
+                   "index": _norm_index((slice(None),) * arr.ndim,
+                                        arr.shape),
+                   "shape": [int(d) for d in arr.shape],
+                   "nbytes": int(arr.nbytes)}]
+    elif isinstance(sharding, NamedSharding):
+        mesh = sharding.mesh
+        desc["kind"] = KIND_NAMED
+        desc["mesh_axes"] = [str(a) for a in mesh.axis_names]
+        desc["mesh_shape"] = [int(mesh.shape[a]) for a in mesh.axis_names]
+        desc["device_ids"] = [int(d.id)
+                              for d in mesh.devices.flat]
+        desc["spec"] = _encode_spec(sharding.spec)
+        # One entry per UNIQUE data piece: replicated shards share the
+        # piece and transfer once per consumer.
+        by_index: Dict[tuple, dict] = {}
+        for shard in arr.addressable_shards:
+            norm = _norm_index(shard.index, arr.shape)
+            tkey = tuple(tuple(p) for p in norm)
+            if tkey in by_index:
+                continue
+            data = shard.data
+            by_index[tkey] = {
+                "key": len(by_index),
+                "index": norm,
+                "shape": [int(d) for d in data.shape],
+                "nbytes": int(data.nbytes),
+            }
+        shards = sorted(by_index.values(), key=lambda s: s["key"])
+    else:
+        return None  # Positional/GSPMD/pmap shardings: host path
+    desc["shards"] = shards
+    return desc
+
+
+def _encode_spec(spec) -> list:
+    """PartitionSpec -> msgpack-able nested list (None | str | [str...])."""
+    out = []
+    for part in tuple(spec):
+        if part is None:
+            out.append(None)
+        elif isinstance(part, (tuple, list)):
+            out.append([str(p) for p in part])
+        else:
+            out.append(str(part))
+    return out
+
+
+def _decode_spec(encoded):
+    from jax.sharding import PartitionSpec as P
+
+    parts = []
+    for part in encoded:
+        if part is None:
+            parts.append(None)
+        elif isinstance(part, (tuple, list)):
+            parts.append(tuple(part))
+        else:
+            parts.append(part)
+    return P(*parts)
+
+
+def build_sharding(desc: dict):
+    """Rebuild (sharding, device->shard-key map) from a descriptor on
+    THIS process's devices. Raises if the local topology can't host the
+    mesh (caller falls back to single-device assembly)."""
+    import numpy as np
+
+    import jax
+    from jax.sharding import Mesh, NamedSharding, SingleDeviceSharding
+
+    if desc["kind"] == KIND_SINGLE:
+        by_id = {d.id: d for d in jax.devices()}
+        device = by_id.get(desc.get("device_id"), jax.devices()[0])
+        sharding = SingleDeviceSharding(device)
+        return sharding, {device: 0}
+    n = 1
+    for dim in desc["mesh_shape"]:
+        n *= dim
+    local = jax.devices()
+    if len(local) < n:
+        raise ValueError(
+            f"mesh of {n} devices does not fit {len(local)} local devices")
+    # Prefer id-identical devices (same-topology consumer); fall back to
+    # the first n local devices in order.
+    by_id = {d.id: d for d in local}
+    wanted = desc.get("device_ids") or []
+    if len(wanted) == n and all(i in by_id for i in wanted):
+        devs = [by_id[i] for i in wanted]
+    else:
+        devs = list(local[:n])
+    mesh = Mesh(np.array(devs).reshape(desc["mesh_shape"]),
+                tuple(desc["mesh_axes"]))
+    sharding = NamedSharding(mesh, _decode_spec(desc["spec"]))
+    shape = tuple(desc["global_shape"])
+    key_by_index = {
+        tuple(tuple(p) for p in s["index"]): s["key"]
+        for s in desc["shards"]}
+    device_keys = {}
+    for device, index in sharding.addressable_devices_indices_map(
+            shape).items():
+        tkey = tuple(tuple(p) for p in _norm_index(index, shape))
+        if tkey not in key_by_index:
+            raise ValueError("local sharding layout disagrees with the "
+                             "recorded shard set")
+        device_keys[device] = key_by_index[tkey]
+    return sharding, device_keys
+
+
+def _np_dtype(name: str):
+    import numpy as np
+
+    try:
+        return np.dtype(name)
+    except TypeError:
+        import ml_dtypes  # registers bfloat16/float8 etc.  # noqa: F401
+
+        return np.dtype(name)
+
+
+# ---------------------------------------------------------------------------
+# export (producer side of put)
+# ---------------------------------------------------------------------------
+
+
+def shard_id(object_binary: bytes, leaf: int, key: int) -> bytes:
+    """Stable pseudo-ObjectID for one shard of one leaf: lets shards ride
+    the existing range-read data plane unchanged."""
+    h = hashlib.sha1(
+        b"devshard:" + object_binary + leaf.to_bytes(4, "little")
+        + key.to_bytes(4, "little")).digest()
+    return h[:OBJECT_ID_SIZE]
+
+
+def min_export_bytes(config=None) -> int:
+    if config is None:
+        from ray_tpu.core.config import get_config
+
+        config = get_config()
+    return int(config.device_object_min_bytes)
+
+
+def export_value(object_id: ObjectID, value: Any,
+                 config=None) -> Tuple[Any, int, List[dict]]:
+    """Walk ``value``; move qualifying device arrays into the registry.
+
+    Returns (mapped value with DeviceLeafRef placeholders, number of
+    leaves exported, leaf descriptors in leaf order)."""
+    import jax
+
+    from ray_tpu.core import serialization
+
+    threshold = min_export_bytes(config)
+    hex_id = object_id.hex()
+    binary = object_id.binary()
+    state = {"leaf": 0}
+    entry = _ObjectEntry(owned=True)
+    descs: List[dict] = []
+
+    def leaf_fn(x):
+        if not isinstance(x, jax.Array):
+            return serialization.UNCHANGED
+        if x.nbytes < threshold:
+            return serialization.UNCHANGED  # host path maps it later
+        desc = _describe(x)
+        if desc is None:
+            return serialization.UNCHANGED
+        leaf = state["leaf"]
+        state["leaf"] += 1
+        shards_by_key: Dict[int, Any] = {}
+        by_index = {tuple(tuple(p) for p in s["index"]): s["key"]
+                    for s in desc["shards"]}
+        if desc["kind"] == KIND_SINGLE:
+            shards_by_key[0] = x
+        else:
+            for shard in x.addressable_shards:
+                tkey = tuple(tuple(p) for p in
+                             _norm_index(shard.index, x.shape))
+                key = by_index[tkey]
+                if key not in shards_by_key:
+                    shards_by_key[key] = shard.data
+        entry.leaves[leaf] = _LeafEntry(
+            desc=desc, array=x, shards=shards_by_key,
+            nbytes=int(desc["nbytes"]))
+        descs.append(desc)
+        return DeviceLeafRef(hex_id, leaf, desc)
+
+    mapped = serialization.map_tree(value, leaf_fn)
+    count = state["leaf"]
+    if count:
+        with _registry_lock:
+            _registry[hex_id] = entry
+            for leaf, le in entry.leaves.items():
+                for key in le.shards:
+                    _shard_index[shard_id(binary, leaf, key)] = (
+                        hex_id, leaf, key)
+        _report_device_bytes()
+    return mapped, count, descs
+
+
+def register_assembled(object_id: ObjectID, leaf: int, desc: dict,
+                       array: Any) -> int:
+    """A consumer finished assembling a leaf: become a holder so peers
+    can pull from this process (replica cold-start-from-peer path).
+    Returns the number of recorded-layout shards this process can now
+    serve — 0 when the array was assembled via the single-device
+    fallback (its shards don't match the descriptor, so advertising
+    this process as a holder would be a lie)."""
+    import jax
+
+    hex_id = object_id.hex()
+    binary = object_id.binary()
+    shards_by_key: Dict[int, Any] = {}
+    if desc["kind"] == KIND_SINGLE:
+        shards_by_key[0] = array
+    else:
+        by_index = {tuple(tuple(p) for p in s["index"]): s["key"]
+                    for s in desc["shards"]}
+        for shard in array.addressable_shards:
+            tkey = tuple(tuple(p) for p in
+                         _norm_index(shard.index, array.shape))
+            key = by_index.get(tkey)
+            if key is not None and key not in shards_by_key:
+                shards_by_key[key] = shard.data
+    with _registry_lock:
+        entry = _registry.setdefault(hex_id, _ObjectEntry(owned=False))
+        entry.leaves[leaf] = _LeafEntry(
+            desc=desc, array=array, shards=shards_by_key,
+            nbytes=int(desc["nbytes"]))
+        for key in shards_by_key:
+            _shard_index[shard_id(binary, leaf, key)] = (hex_id, leaf, key)
+    _report_device_bytes()
+    return len(shards_by_key)
+
+
+def local_array(obj_hex: str, leaf: int):
+    """Zero-copy hit: the original (or previously assembled) array, by
+    reference. None when this process holds no copy."""
+    with _registry_lock:
+        entry = _registry.get(obj_hex)
+        if entry is None or entry.donated:
+            return None
+        le = entry.leaves.get(leaf)
+    if le is None or le.array is None:
+        return None
+    if getattr(le.array, "is_deleted", lambda: False)():
+        return None
+    return le.array
+
+
+def holds(obj_hex: str) -> bool:
+    with _registry_lock:
+        entry = _registry.get(obj_hex)
+        return entry is not None and not entry.donated
+
+
+def drop(obj_hex: str, donated: bool = False) -> int:
+    """Forget this process's copy (free / borrower release / donation).
+    Returns the device bytes released."""
+    with _registry_lock:
+        entry = _registry.pop(obj_hex, None)
+        if entry is None:
+            return 0
+        stale = [sid for sid, loc in _shard_index.items()
+                 if loc[0] == obj_hex]
+        for sid in stale:
+            del _shard_index[sid]
+    released = 0
+    for le in entry.leaves.values():
+        released += le.nbytes
+        if donated and le.array is not None:
+            try:
+                le.array.delete()
+            except Exception:  # lint: allow-silent(buffer already freed by jax)
+                pass
+        le.array = None
+        le.shards.clear()
+    _report_device_bytes()
+    return released
+
+
+def device_bytes() -> int:
+    with _registry_lock:
+        return sum(le.nbytes for entry in _registry.values()
+                   for le in entry.leaves.values())
+
+
+def _report_device_bytes() -> None:
+    from ray_tpu.util import telemetry
+
+    telemetry.set_gauge("ray_tpu_object_device_bytes", device_bytes(),
+                        {"proc": telemetry.proc_tag()})
+
+
+# ---------------------------------------------------------------------------
+# serving shards (holder side)
+# ---------------------------------------------------------------------------
+
+
+def shard_view(shard_id_bytes: bytes):
+    """Host view of one registered shard's bytes, or None. On CPU
+    backends this is a zero-copy view of the device buffer; on real
+    accelerators it stages exactly one shard to host."""
+    with _registry_lock:
+        loc = _shard_index.get(bytes(shard_id_bytes))
+        if loc is None:
+            return None
+        entry = _registry.get(loc[0])
+        if entry is None:
+            return None
+        le = entry.leaves.get(loc[1])
+        if le is None:
+            return None
+        data = le.shards.get(loc[2])
+    if data is None:
+        return None
+    return _host_view(data)
+
+
+def _host_view(shard_data):
+    """memoryview('B') over a shard's host bytes."""
+    import numpy as np
+
+    arr = np.asarray(shard_data)
+    if not arr.flags["C_CONTIGUOUS"]:
+        arr = np.ascontiguousarray(arr)
+    # Custom dtypes (bfloat16 & friends) don't export a buffer format;
+    # a uint8 view always does.
+    return memoryview(arr.view(np.uint8).reshape(-1))
+
+
+# ---------------------------------------------------------------------------
+# assembly (consumer side of get)
+# ---------------------------------------------------------------------------
+
+
+def collect_leaf_refs(value: Any) -> List[DeviceLeafRef]:
+    from ray_tpu.core import serialization
+
+    found: List[DeviceLeafRef] = []
+
+    def leaf_fn(x):
+        if isinstance(x, DeviceLeafRef):
+            found.append(x)
+            return x
+        return serialization.UNCHANGED
+
+    serialization.map_tree(value, leaf_fn)
+    return found
+
+
+def substitute(value: Any, resolved: Dict[Tuple[str, int], Any]) -> Any:
+    from ray_tpu.core import serialization
+
+    def leaf_fn(x):
+        if isinstance(x, DeviceLeafRef):
+            return resolved[(x.obj_hex, x.leaf)]
+        return serialization.UNCHANGED
+
+    return serialization.map_tree(value, leaf_fn)
+
+
+def _shard_np(desc: dict, key: int, buf):
+    import numpy as np
+
+    meta = next(s for s in desc["shards"] if s["key"] == key)
+    arr = np.frombuffer(buf, dtype=np.uint8)[:meta["nbytes"]]
+    return arr.view(_np_dtype(desc["dtype"])).reshape(
+        tuple(meta["shape"]))
+
+
+class LeafAssembler:
+    """Incremental consumer-side assembly: each pulled shard lands on
+    its device (``jax.device_put``) the moment it arrives, and its host
+    staging buffer is released before the next shard needs one — peak
+    host memory is pull-concurrency × shard size, never the array.
+
+    ``land()`` runs on executor threads (possibly several at once);
+    ``finalize()`` stitches the landed single-device arrays into the
+    recorded sharding."""
+
+    def __init__(self, desc: dict):
+        self.desc = desc
+        self._lock = _make_lock("device_objects.LeafAssembler._lock")
+        self._arrays: List[Tuple[Any, Any]] = []  # (device, shard arr)
+        self._partial = None
+        self.fallback = False
+        try:
+            self.sharding, self._device_keys = build_sharding(desc)
+            self._devices_by_key: Dict[int, list] = {}
+            for device, key in self._device_keys.items():
+                self._devices_by_key.setdefault(key, []).append(device)
+        except Exception:
+            # Local topology can't host the mesh: stitch on the default
+            # device one shard at a time. Still no whole-array HOST
+            # buffer — the partial lives on device.
+            self.fallback = True
+
+    @staticmethod
+    def _land_piece(shard_np, device=None):
+        """device_put one shard; returns (piece, absorbed_staging).
+
+        XLA:CPU's device_put takes the ZERO-COPY path for aligned host
+        arrays — the returned jax.Array then WRAPS the staging memory.
+        That is the ideal landing (zero copies), but the staging buffer
+        must not go back to the pool while the array lives: the caller
+        forfeits it to the array when ``absorbed`` is True.
+        block_until_ready covers async dispatch (on accelerators the
+        host→HBM DMA may still be reading the staging buffer when
+        device_put returns)."""
+        import numpy as np
+
+        import jax
+
+        piece = jax.device_put(shard_np, device)
+        jax.block_until_ready(piece)
+        absorbed = False
+        if jax.default_backend() == "cpu":
+            try:
+                absorbed = np.shares_memory(np.asarray(piece), shard_np)
+            except Exception:
+                absorbed = True  # can't prove otherwise: keep it safe
+        return piece, absorbed
+
+    def land(self, key: int, buf) -> bool:
+        """Land one pulled shard on its device(s). Returns True when
+        the staging buffer was absorbed as the device storage (caller
+        must forfeit it instead of pooling it)."""
+        import jax
+
+        shard_np = _shard_np(self.desc, key, buf)
+        if self.fallback:
+            import jax.numpy as jnp
+
+            meta = next(s for s in self.desc["shards"]
+                        if s["key"] == key)
+            piece, _absorbed = self._land_piece(shard_np)
+            with self._lock:
+                if self._partial is None:
+                    self._partial = jnp.zeros(
+                        tuple(self.desc["global_shape"]),
+                        _np_dtype(self.desc["dtype"]))
+                idx = tuple(slice(lo, hi) for lo, hi in meta["index"])
+                self._partial = self._partial.at[idx].set(piece)
+                # The stitch READS piece; only after it completes may
+                # the staging buffer be reused (piece dies with this
+                # frame, releasing any absorbed buffer).
+                jax.block_until_ready(self._partial)
+            return False
+        absorbed = False
+        landed = []
+        for d in self._devices_by_key.get(key, []):
+            piece, piece_absorbed = self._land_piece(shard_np, d)
+            absorbed = absorbed or piece_absorbed
+            landed.append((d, piece))
+        with self._lock:
+            self._arrays.extend(landed)
+        return absorbed
+
+    def finalize(self):
+        import jax
+
+        if self.fallback:
+            return self._partial
+        if self.desc["kind"] == KIND_SINGLE:
+            return self._arrays[0][1]
+        return jax.make_array_from_single_device_arrays(
+            tuple(self.desc["global_shape"]), self.sharding,
+            [a for _, a in self._arrays])
+
+
+def assemble_leaf(desc: dict, shard_bytes: Dict[int, Any]):
+    """Rebuild one leaf from fully-staged shard bytes (unit tests and
+    same-host fast paths; the streaming consumer uses LeafAssembler)."""
+    assembler = LeafAssembler(desc)
+    for key, buf in shard_bytes.items():
+        assembler.land(key, buf)
+    return assembler.finalize()
+
+
+def sharding_matches(array, desc: dict) -> bool:
+    """Does a live array's sharding match its descriptor? (test helper
+    and publish-time sanity check)"""
+    try:
+        fresh = _describe(array)
+    except Exception:
+        return False
+    if fresh is None:
+        return False
+    return (fresh["kind"] == desc["kind"]
+            and fresh["global_shape"] == desc["global_shape"]
+            and fresh["dtype"] == desc["dtype"]
+            and fresh.get("spec") == desc.get("spec")
+            and fresh.get("mesh_axes") == desc.get("mesh_axes")
+            and [s["index"] for s in fresh["shards"]]
+            == [s["index"] for s in desc["shards"]])
+
+
+# ---------------------------------------------------------------------------
+# staging buffers (bounded host memory during pulls)
+# ---------------------------------------------------------------------------
+
+
+#: Released staging buffers are pooled (per exact size) up to this many
+#: bytes: on lazy-memory microVM hosts a FRESH buffer page-faults at
+#: ~25µs/page (the 0.18 GiB/s first-touch floor in BENCH_TRANSFER_r05),
+#: so steady-state pulls must land in already-faulted pages.
+STAGING_POOL_CAP = 768 << 20
+
+_pool_lock = threading.Lock()
+_pool: Dict[int, List[Any]] = {}
+_pool_bytes = 0
+
+
+class StagingBuffer:
+    """One shard's host landing area; accounts the staging high-water
+    mark so 'no whole-array host buffer' is a checkable property.
+    Backed by a bounded free-list so steady-state pulls recycle
+    already-faulted pages instead of paying the page-supply floor."""
+
+    def __init__(self, nbytes: int):
+        global _pool_bytes
+        self.nbytes = nbytes
+        self.array = None
+        with _pool_lock:
+            free = _pool.get(nbytes)
+            if free:
+                self.array = free.pop()
+                _pool_bytes -= nbytes
+        if self.array is None:
+            import numpy as np
+
+            self.array = np.empty(nbytes, dtype=np.uint8)
+        _note_staging(nbytes)
+
+    def view(self) -> memoryview:
+        return memoryview(self.array)
+
+    def release(self) -> None:
+        global _pool_bytes
+        _note_staging(-self.nbytes)
+        arr, self.array = self.array, None
+        if arr is None:
+            return
+        with _pool_lock:
+            if _pool_bytes + self.nbytes <= STAGING_POOL_CAP:
+                _pool.setdefault(self.nbytes, []).append(arr)
+                _pool_bytes += self.nbytes
+
+    def forfeit(self) -> None:
+        """The buffer was absorbed as a device array's storage
+        (XLA:CPU zero-copy device_put): stop accounting it as staging
+        and NEVER pool it — the array owns it now."""
+        _note_staging(-self.nbytes)
+        self.array = None

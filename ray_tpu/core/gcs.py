@@ -83,6 +83,13 @@ class HeadService:
         # hex id -> node ids holding a copy (reference:
         # ownership_based_object_directory.h location sets)
         self.object_locations: Dict[str, Set[NodeID]] = {}
+        # Device-native object plane: hex id -> {"manifest": [leaf
+        # descriptor dicts], "holders": {(host, port, data_port)},
+        # "envelope": (metadata, inband, buffers) | None,
+        # "total_bytes": int}. The sharding descriptor lives HERE, next
+        # to the location entry, so consumers can rebuild the array and
+        # pull from any surviving holder after the owner dies.
+        self.device_objects: Dict[str, dict] = {}
         # agent connections for remote nodes: node_id -> rpc.Connection
         self._node_agents: Dict[NodeID, object] = {}
         # Nodes whose agent health channel dropped, waiting out the
@@ -529,6 +536,10 @@ class HeadService:
             "object_sealed": self.h_object_sealed,
             "wait_object": self.h_wait_object,
             "free_objects": self.h_free_objects,
+            "device_object_put": self.h_device_object_put,
+            "locate_device_object": self.h_locate_device_object,
+            "device_location_added": self.h_device_location_added,
+            "device_location_removed": self.h_device_location_removed,
             "pin_object": self.h_pin_object,
             "unpin_object": self.h_unpin_object,
             "create_pg": self.h_create_pg,
@@ -722,6 +733,7 @@ class HeadService:
         primary that would otherwise leak until node death."""
         hex_id = payload["object_id"]
         self.sealed_objects.pop(hex_id, None)
+        self.device_objects.pop(hex_id, None)
         self.shm.delete(ObjectID.from_hex(hex_id))
         for node_id in self.object_locations.pop(hex_id, set()):
             agent = self._node_agents.get(node_id)
@@ -809,6 +821,15 @@ class HeadService:
         wid = handle.worker_id.hex()
         self.kv.get("metrics", {}).pop(f"metrics:{wid}".encode(), None)
         self.kv.get("timeline", {}).pop(f"timeline:{wid}".encode(), None)
+        # Retract the dead process's device-plane holder listings so
+        # consumers don't burn a pull sweep on a vanished peer; the
+        # manifest itself survives as long as any holder (or mirrored
+        # envelope) does.
+        if handle.address is not None:
+            dead = tuple(handle.address)
+            for entry in self.device_objects.values():
+                entry["holders"] = {h for h in entry["holders"]
+                                    if tuple(h[:2]) != dead}
         if handle.lease_id:
             self.scheduler.release_lease(handle.lease_id)
         # Actor death?
@@ -1347,6 +1368,7 @@ class HeadService:
         remote_by_agent: Dict[object, List[str]] = {}
         for hex_id in payload["object_ids"]:
             self.sealed_objects.pop(hex_id, None)
+            self.device_objects.pop(hex_id, None)
             self.shm.delete(ObjectID.from_hex(hex_id))
             for node_id in self.object_locations.pop(hex_id, set()):
                 agent = self._node_agents.get(node_id)
@@ -1357,6 +1379,50 @@ class HeadService:
                 await agent.notify("free_objects", {"object_ids": hex_ids})
             except Exception:  # lint: allow-silent(agent death cleans its whole store anyway)
                 pass
+        return {"ok": True}
+
+    # ---- device-native object plane (core/device_objects.py) ----
+
+    async def h_device_object_put(self, conn, payload):
+        """Owner registered a device-plane object: record the sharding
+        manifest + envelope next to the location entry, with the owner
+        as the first holder."""
+        hex_id = payload["object_id"]
+        holder = tuple(payload["holder"])
+        envelope = payload.get("envelope")
+        self.device_objects[hex_id] = {
+            "manifest": payload.get("manifest") or [],
+            "holders": {holder},
+            "envelope": (tuple(envelope) if envelope is not None
+                         else None),
+            "total_bytes": int(payload.get("total_bytes") or 0),
+        }
+        return {"ok": True}
+
+    async def h_locate_device_object(self, conn, payload):
+        entry = self.device_objects.get(payload["object_id"])
+        if entry is None:
+            return {"found": False}
+        envelope = entry["envelope"]
+        return {
+            "found": True,
+            "holders": [list(h) for h in entry["holders"]],
+            "manifest": entry["manifest"],
+            "total_bytes": entry["total_bytes"],
+            "envelope": (list(envelope) if envelope is not None
+                         else None),
+        }
+
+    async def h_device_location_added(self, conn, payload):
+        entry = self.device_objects.get(payload["object_id"])
+        if entry is not None:
+            entry["holders"].add(tuple(payload["holder"]))
+        return {"ok": True}
+
+    async def h_device_location_removed(self, conn, payload):
+        entry = self.device_objects.get(payload["object_id"])
+        if entry is not None:
+            entry["holders"].discard(tuple(payload["holder"]))
         return {"ok": True}
 
     async def h_pin_object(self, conn, payload):

@@ -112,6 +112,16 @@ class DataPlaneServer:
                     view = object_store.node_store_read_packed(object_id)
                 except Exception:
                     view = None
+                if view is None:
+                    # Device-plane shard ids ride the same range-read
+                    # protocol: the holder serves a host view of one
+                    # shard (core/device_objects.py registry).
+                    try:
+                        from ray_tpu.core import device_objects
+
+                        view = device_objects.shard_view(bytes(raw_id))
+                    except Exception:
+                        view = None
                 if view is None or offset > len(view):
                     conn.sendall((_DATA_MISSING).to_bytes(8, "little"))
                     continue
@@ -614,6 +624,71 @@ class ObjectPuller:
 
 class _PullAborted(Exception):
     """The holder's copy disappeared or shrank mid-pull."""
+
+
+# ---------------------------------------------------------------------------
+# device-plane per-shard pulls (core/device_objects.py consumers)
+# ---------------------------------------------------------------------------
+
+#: Chunk size for the rpc fallback of shard pulls (same sizing rationale
+#: as CHUNK_BYTES: amortize framing, don't head-of-line-block control).
+SHARD_CHUNK_BYTES = CHUNK_BYTES
+
+
+def pull_shard_into(address: Tuple[str, int], shard_id_bytes: bytes,
+                    dest: memoryview, state: Optional[dict] = None,
+                    max_resumes: int = 3) -> None:
+    """(worker thread) Resumable range-read of one device shard over the
+    bulk data plane, straight into ``dest``.
+
+    Bytes that already landed are never re-transferred: a mid-stream
+    connection drop resumes at the received offset with a fresh range
+    request, up to ``max_resumes`` times. Raises _PullAborted when the
+    holder no longer serves the shard; OSError bubbles a dead data port
+    so the caller can fall back to chunked rpc."""
+    total = dest.nbytes
+    got = 0
+    resumes = 0
+    while got < total:
+        if state is not None and state.get("stop"):
+            raise _PullAborted("shard pull cancelled")
+        conn = _borrow_data_conn(address)
+        clean = False
+        try:
+            conn.sendall(
+                _DATA_REQ.pack(len(shard_id_bytes), got, total - got)
+                + shard_id_bytes)
+            head = _recv_exactly(conn, 8)
+            if head is None:
+                raise OSError("data plane connection closed")
+            avail = int.from_bytes(head, "little")
+            if avail == _DATA_MISSING:
+                raise _PullAborted("holder no longer serves the shard")
+            if avail != total - got:
+                raise _PullAborted(
+                    f"holder served {avail} of {total - got} shard bytes")
+            while got < total:
+                if state is not None and state.get("stop"):
+                    raise _PullAborted("shard pull cancelled")
+                r = conn.recv_into(dest[got:],
+                                   min(total - got, _RECV_CAP))
+                if r == 0:
+                    raise OSError("data plane EOF mid-shard")
+                got += r
+            clean = True
+        except OSError:
+            resumes += 1
+            if resumes > max_resumes:
+                raise
+            # Resume from `got`: the landed prefix stays.
+        finally:
+            if clean:
+                _return_data_conn(address, conn)
+            else:
+                try:
+                    conn.close()
+                except OSError:  # lint: allow-silent(close of an already-failed data conn)
+                    pass
 
 
 class _sem_guard:

@@ -13,10 +13,11 @@ Error objects are tagged in metadata so that ``get`` re-raises them.
 
 from __future__ import annotations
 
+import dataclasses
 import pickle
 import traceback
 from dataclasses import dataclass, field
-from typing import Any, List, Optional, Sequence
+from typing import Any, Callable, List, Optional, Sequence
 
 import cloudpickle
 
@@ -26,6 +27,13 @@ from ray_tpu import exceptions as exc
 NORMAL = b"N"
 ERROR = b"E"
 ACTOR_HANDLE = b"A"
+# The inband stream contains DeviceLeafRef placeholders for jax.Array
+# leaves whose shards live in the device plane (core/device_objects.py);
+# get() resolves them (zero-copy locally, per-shard pulls remotely).
+DEVICE = b"D"
+
+#: map_tree leaf-callback sentinel: "not a leaf I handle, recurse".
+UNCHANGED = object()
 
 
 @dataclass
@@ -62,13 +70,23 @@ class _OutOfBandPickler(cloudpickle.CloudPickler):
     """Cloudpickle with protocol-5 buffer_callback and jax.Array reduction."""
 
 
-def serialize(value: Any) -> SerializedObject:
+def serialize(value: Any,
+              device_exporter: Optional[Callable] = None
+              ) -> SerializedObject:
     buffers: List[pickle.PickleBuffer] = []
 
     def buffer_callback(buf: pickle.PickleBuffer) -> bool:
         buffers.append(buf)
         return False  # out-of-band
 
+    metadata = NORMAL
+    if device_exporter is not None:
+        # Device plane first: qualifying jax.Array leaves become
+        # DeviceLeafRef placeholders (their shards stay on device);
+        # whatever it declines falls through to the host mapping below.
+        value, exported = device_exporter(value)
+        if exported:
+            metadata = DEVICE
     value = _map_jax_arrays(value)
     # The C pickler is ~7x cheaper than cloudpickle for plain data (the
     # overwhelmingly common case for args/returns); cloudpickle is only
@@ -91,32 +109,80 @@ def serialize(value: Any) -> SerializedObject:
         inband = cloudpickle.dumps(value, protocol=5,
                                    buffer_callback=buffer_callback)
     return SerializedObject(
-        metadata=NORMAL,
+        metadata=metadata,
         inband=inband,
         buffers=[b.raw() for b in buffers],
     )
 
 
-def _map_jax_arrays(value):
-    """Shallowly convert jax arrays (incl. inside tuples/lists/dicts) to numpy.
+def map_tree(value: Any, leaf_fn: Callable[[Any], Any]) -> Any:
+    """Structure-preserving map over the common container types.
 
-    Deep structures are handled by pickle itself calling __reduce__ on
-    jax.Array, which jax supports (it pickles via numpy); this fast path
-    avoids an extra copy for the common flat cases.
+    ``leaf_fn(x)`` returns a replacement, or the ``UNCHANGED`` sentinel
+    to recurse into ``x``. Namedtuples and dataclasses keep their
+    container TYPE (a plain ``tuple(...)`` rebuild would silently
+    collapse a namedtuple — consumers indexing by field name would
+    break); unchanged subtrees are returned identically (no pointless
+    container churn). Unknown container types are left to pickle, which
+    handles arbitrary nesting via __reduce__."""
+    mapped = leaf_fn(value)
+    if mapped is not UNCHANGED:
+        return mapped
+    if isinstance(value, tuple):
+        parts = [map_tree(v, leaf_fn) for v in value]
+        if all(a is b for a, b in zip(parts, value)):
+            return value
+        if hasattr(value, "_fields"):  # namedtuple: preserve the type
+            return type(value)(*parts)
+        return tuple(parts)
+    if isinstance(value, list):
+        parts = [map_tree(v, leaf_fn) for v in value]
+        if all(a is b for a, b in zip(parts, value)):
+            return value
+        return parts
+    if isinstance(value, dict):
+        parts = {k: map_tree(v, leaf_fn) for k, v in value.items()}
+        if all(parts[k] is value[k] for k in value):
+            return value
+        return parts
+    if dataclasses.is_dataclass(value) and not isinstance(value, type):
+        changes = {}
+        for f in dataclasses.fields(value):
+            try:
+                old = getattr(value, f.name)
+            except AttributeError:
+                continue
+            new = map_tree(old, leaf_fn)
+            if new is not old:
+                changes[f.name] = new
+        if not changes:
+            return value
+        try:
+            return dataclasses.replace(value, **changes)
+        except (TypeError, ValueError):
+            return value  # init=False / custom __init__: leave to pickle
+    return value
+
+
+def _map_jax_arrays(value):
+    """Convert jax arrays (incl. inside tuples/lists/dicts/namedtuples/
+    dataclasses) to numpy, preserving container types.
+
+    Deep/unknown structures are handled by pickle itself calling
+    __reduce__ on jax.Array, which jax supports (it pickles via numpy);
+    this fast path avoids an extra copy for the common flat cases.
     """
     try:
         import jax
     except ImportError:
         return value
-    if isinstance(value, jax.Array):
-        return _to_host(value)
-    if isinstance(value, tuple):
-        return tuple(_map_jax_arrays(v) for v in value)
-    if isinstance(value, list):
-        return [_map_jax_arrays(v) for v in value]
-    if isinstance(value, dict):
-        return {k: _map_jax_arrays(v) for k, v in value.items()}
-    return value
+
+    def leaf_fn(x):
+        if isinstance(x, jax.Array):
+            return _to_host(x)
+        return UNCHANGED
+
+    return map_tree(value, leaf_fn)
 
 
 def serialize_error(err: BaseException, task_name: str = "") -> SerializedObject:

@@ -1,0 +1,169 @@
+"""Device-plane transfer bench: 1 GiB sharded jax.Array put/get.
+
+Two lanes, both against the host-bounce baseline (BENCH_TRANSFER_r05:
+every jax.Array put round-tripped host numpy + pickle + shm):
+
+- shared-device get: producer and consumer share devices (same
+  process) — the device plane returns the array BY REFERENCE. This is
+  the ``train → serve`` colocated handoff; throughput is bounded only
+  by bookkeeping, and host RSS delta is ~0.
+- device→device pull: a separate process gets the same 1 GiB array via
+  the per-shard protocol (resumable data-plane range reads +
+  ``jax.device_put`` landings). Host staging is bounded by
+  concurrency × shard size — never the whole array — reported as the
+  staging high-water mark next to the raw MB/s.
+
+Writes BENCH_TRANSFER JSON with both lanes plus the r05 baseline
+numbers for the trajectory table.
+"""
+
+from __future__ import annotations
+
+import json
+import time
+
+
+def _anon_rss_kib() -> int:
+    with open("/proc/self/status") as f:
+        for line in f:
+            if line.startswith("RssAnon"):
+                return int(line.split()[1])
+    return 0
+
+
+def main(size_gib: float = 1.0, out: str | None = None,
+         baseline: str | None = None):
+    import numpy as np
+
+    import jax
+    import jax.numpy as jnp
+    from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+    import ray_tpu
+
+    n_dev = len(jax.devices())
+    ray_tpu.init(num_cpus=4, num_tpus=0,
+                 object_store_memory=int(1.5 * (1 << 30)))
+    try:
+        rows = int(size_gib * (1 << 30) // (4 * 1024))
+        rows -= rows % n_dev
+        mesh = Mesh(np.array(jax.devices()).reshape(n_dev), ("data",))
+        sharding = NamedSharding(mesh, P("data"))
+        key = jax.random.PRNGKey(0)
+        arr = jax.device_put(
+            jax.random.uniform(key, (rows, 1024), jnp.float32), sharding)
+        jax.block_until_ready(arr)
+        gib = arr.nbytes / (1 << 30)
+
+        # --- lane 1: shared-device (same-process) zero-copy get ---
+        rss0 = _anon_rss_kib()
+        t0 = time.perf_counter()
+        ref = ray_tpu.put(arr)
+        put_s = time.perf_counter() - t0
+        t0 = time.perf_counter()
+        got = ray_tpu.get(ref)
+        get_s = time.perf_counter() - t0
+        rss1 = _anon_rss_kib()
+        assert got is arr, "shared-device get must return by reference"
+
+        # --- lane 2: device→device per-shard pull (separate process) ---
+        @ray_tpu.remote
+        class Puller:
+            def pull(self, refs):
+                import jax as _jax
+
+                import ray_tpu as _rt
+                from ray_tpu.core import device_objects
+
+                rssa = _anon_rss_kib()
+                t = time.perf_counter()
+                value = _rt.get(refs[0], timeout=900)
+                _jax.block_until_ready(value)
+                dt = time.perf_counter() - t
+                return {
+                    "seconds": dt,
+                    "gib": value.nbytes / (1 << 30),
+                    "num_shards": len(value.sharding.device_set),
+                    "staging_peak_mib":
+                        device_objects.peak_staging_bytes() / (1 << 20),
+                    # On CPU backends the assembled "device" buffers are
+                    # host RAM, so subtract them to isolate the
+                    # plane's own host cost.
+                    "anon_rss_delta_mib":
+                        (_anon_rss_kib() - rssa) / 1024
+                        - value.nbytes / (1 << 20),
+                    "checksum": float(value[0, 0]),
+                }
+
+            def drop_local(self, refs):
+                """Forget the local device copy and cached envelope so
+                the next get re-pulls — into recycled pages (the
+                steady-state a serving fleet lives in; cold pulls are
+                bounded by this infra's ~0.18 GiB/s page-supply floor,
+                see BENCH_TRANSFER_r05 first_touch_floor_gibps)."""
+                from ray_tpu import api
+                from ray_tpu.core import device_objects
+
+                cw = api._require_worker()
+                device_objects.drop(refs[0].hex())
+                cw.memory_store.delete(refs[0].id)
+                return True
+
+        puller = Puller.remote()
+        cold = ray_tpu.get(puller.pull.remote([ref]), timeout=900)
+        assert cold["checksum"] == float(arr[0, 0])
+        ray_tpu.get(puller.drop_local.remote([ref]), timeout=60)
+        pulled = ray_tpu.get(puller.pull.remote([ref]), timeout=900)
+        assert pulled["checksum"] == float(arr[0, 0])
+
+        base = {}
+        if baseline:
+            try:
+                with open(baseline) as f:
+                    base = json.load(f)
+            except OSError:
+                base = {}
+        host_gibps = float(base.get("loopback_pull_gibps") or 0.0)
+        shared_gibps = gib / max(get_s, 1e-9)
+        pull_gibps = pulled["gib"] / pulled["seconds"]
+        result = {
+            "object_gib": round(gib, 2),
+            "num_shards": n_dev,
+            "device_put_seconds": round(put_s, 4),
+            "device_get_shared_gibps": round(shared_gibps, 1),
+            "device_get_shared_rss_delta_mib": round(
+                (rss1 - rss0) / 1024, 1),
+            "device_pull_gibps": round(pull_gibps, 2),
+            "device_pull_cold_gibps": round(
+                cold["gib"] / cold["seconds"], 2),
+            "device_pull_staging_peak_mib": round(
+                pulled["staging_peak_mib"], 1),
+            "device_pull_anon_rss_delta_mib": round(
+                pulled["anon_rss_delta_mib"], 1),
+            "host_path_r05_gibps": host_gibps,
+            "host_path_r05_cold_gibps": float(
+                base.get("loopback_pull_cold_gibps") or 0.0),
+            "vs_host_path_shared": (
+                round(shared_gibps / host_gibps, 1) if host_gibps else None),
+            "vs_host_path_pull": (
+                round(pull_gibps / host_gibps, 2) if host_gibps else None),
+        }
+        print(json.dumps(result))
+        if out:
+            with open(out, "w") as f:
+                json.dump(result, f, indent=1)
+                f.write("\n")
+        return result
+    finally:
+        ray_tpu.shutdown()
+
+
+if __name__ == "__main__":
+    import argparse
+
+    p = argparse.ArgumentParser()
+    p.add_argument("--size-gib", type=float, default=1.0)
+    p.add_argument("--out", default=None)
+    p.add_argument("--baseline", default=None)
+    a = p.parse_args()
+    main(a.size_gib, a.out, a.baseline)

@@ -25,6 +25,12 @@ from ray_tpu.serve.handle import (
 )
 from ray_tpu.serve.multiplex import get_multiplexed_model_id, multiplexed
 from ray_tpu.serve.proxy import Request
+from ray_tpu.serve.weights import (
+    fetch_weights,
+    publish_weights,
+    unpublish,
+    weights_version,
+)
 
 __all__ = [
     "Application",
@@ -40,10 +46,14 @@ __all__ = [
     "deployment",
     "get_app_handle",
     "get_deployment_handle",
+    "fetch_weights",
     "get_multiplexed_model_id",
     "multiplexed",
+    "publish_weights",
     "run",
     "shutdown",
     "start",
     "status",
+    "unpublish",
+    "weights_version",
 ]

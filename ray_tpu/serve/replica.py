@@ -19,25 +19,48 @@ from typing import Any, Dict, Optional
 class Replica:
     def __init__(self, serialized_callable, init_args, init_kwargs,
                  user_config, deployment_name: str, replica_id: str):
-        from ray_tpu.core import serialization as _ser
-
-        cls_or_fn = _ser.loads_control(serialized_callable)
         self.deployment_name = deployment_name
         self.replica_id = replica_id
         self.num_ongoing = 0
         self.total_served = 0
         self._started = time.time()
+        self._serialized_callable = serialized_callable
+        self._init_args = init_args
+        self._init_kwargs = init_kwargs
+        self._user_config = user_config
+        self.callable = None
+        # User __init__ is cold-start code — checkpoint reads, blocking
+        # weight fetches (serve.fetch_weights pulling sharded arrays
+        # through the device object plane), warmup jit — so it must NOT
+        # run on this actor's event loop: a blocking ray_tpu.get() there
+        # would deadlock the worker. Construction runs on the executor;
+        # requests and health checks gate on the future (the controller
+        # counts the replica ready only once check_health passes).
+        self._built = asyncio.get_event_loop().run_in_executor(
+            None, self._build)
+
+    def _build(self):
+        from ray_tpu.core import serialization as _ser
+
+        cls_or_fn = _ser.loads_control(self._serialized_callable)
         if inspect.isclass(cls_or_fn):
-            self.callable = cls_or_fn(*init_args, **(init_kwargs or {}))
+            callable_ = cls_or_fn(*self._init_args,
+                                  **(self._init_kwargs or {}))
         else:
-            if init_args or init_kwargs:
+            if self._init_args or self._init_kwargs:
                 raise TypeError("function deployments take no init args")
-            self.callable = cls_or_fn
-        if user_config is not None:
-            self._reconfigure_sync(user_config)
-        warmup = getattr(self.callable, "warmup", None)
+            callable_ = cls_or_fn
+        self.callable = callable_
+        if self._user_config is not None:
+            self._reconfigure_sync(self._user_config)
+        warmup = getattr(callable_, "warmup", None)
         if callable(warmup):
             warmup()
+
+    async def _ensure_built(self):
+        # Shield: a cancelled request must not cancel construction for
+        # every later request. Raises the user __init__ error, if any.
+        await asyncio.shield(self._built)
 
     def _reconfigure_sync(self, user_config):
         fn = getattr(self.callable, "reconfigure", None)
@@ -48,6 +71,7 @@ class Replica:
         fn(user_config)
 
     async def reconfigure(self, user_config) -> None:
+        await self._ensure_built()
         self._reconfigure_sync(user_config)
 
     def _resolve_fn(self, method_name: str):
@@ -109,6 +133,7 @@ class Replica:
 
     async def handle_request(self, method_name: str, args: tuple,
                              kwargs: dict) -> Any:
+        await self._ensure_built()
         with self._request_scope(
                 kwargs, f"replica {self.deployment_name}") as scope:
             fn = self._resolve_fn(method_name)
@@ -134,6 +159,7 @@ class Replica:
         yielded chunk rides the core stream_item lane to the caller.
         Sync and async user generators both work; replica metrics count
         the whole stream as one request."""
+        await self._ensure_built()
         with self._request_scope(
                 kwargs,
                 f"replica {self.deployment_name} stream") as scope:
@@ -167,6 +193,12 @@ class Replica:
         }
 
     async def check_health(self) -> bool:
+        # Still constructing: not ready yet (the controller's startup
+        # grace covers cold starts). A failed construction re-raises the
+        # user error here so the probe surfaces it.
+        if not self._built.done():
+            return False
+        await self._ensure_built()
         fn = getattr(self.callable, "check_health", None)
         if callable(fn):
             out = fn()
@@ -181,6 +213,10 @@ class Replica:
         otherwise never run."""
         while self.num_ongoing > 0:
             await asyncio.sleep(0.02)
+        try:
+            await self._ensure_built()
+        except Exception:
+            return  # construction failed: nothing to clean up
         fn = getattr(self.callable, "__del__", None)
         if callable(fn):
             try:

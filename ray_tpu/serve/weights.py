@@ -1,0 +1,187 @@
+"""Zero-copy weight delivery: ``train → serve`` handoff at serve scale.
+
+The dominant cost of scaling out an LLM deployment is getting the
+weights onto the new replica (TPU serving studies measure cold-start /
+weight-delivery time as a first-order serving cost — arxiv 2605.25645).
+This module turns weight handoff into a device-plane publish:
+
+- ``publish_weights(name, pytree)`` — a trainer (a gang worker between
+  steps, or the driver after ``fit()``) puts the sharded pytree through
+  the device-native object plane (``core/device_objects.py``): weights
+  stay as per-shard device buffers; only a descriptor envelope is
+  serialized. The ref is recorded under ``name`` in the head KV with a
+  monotonically increasing version.
+- ``fetch_weights(name)`` — a Serve replica resolves the latest ref in
+  its ``__init__``: same-process hits are returned by reference,
+  remote hits are per-shard pulls from the NEAREST holder — and since
+  every consumer registers as a holder, the second replica of a
+  deployment cold-starts from the first replica (or any trainer) rather
+  than re-reading a checkpoint or hammering the original producer.
+
+The driver keeps the published ref alive in the KV entry itself: the
+pickled ref carries a borrow on the owner, so publish-then-exit-scope
+does not free the weights under the replicas.
+"""
+
+from __future__ import annotations
+
+import pickle
+from typing import Any, Optional, Tuple
+
+_KV_NS = "serve_weights"
+
+# name -> (ref, version) of the newest fetch in THIS process. Holding
+# the ref keeps the process's device-plane registry copy alive (a
+# borrower stops serving shards when its last ref dies), so a replica
+# that fetched weights keeps serving peers for as long as it serves the
+# model — exactly the cold-start-from-peer window. Replaced (and the old
+# version's borrow released) when a newer version is fetched.
+_held: dict = {}
+
+
+def _worker():
+    from ray_tpu.api import _require_worker
+
+    return _require_worker()
+
+
+def publish_weights(name: str, pytree: Any) -> Tuple[Any, int]:
+    """Publish a (sharded) weight pytree under ``name``.
+
+    Returns (ObjectRef, version). Re-publishing the same name bumps the
+    version; fetchers always resolve the newest. The previous version's
+    ref is dropped from the KV, so its device buffers are reclaimed once
+    the last replica still holding it releases its borrow.
+
+    Publishes of one ``name`` must come from a single process at a time
+    (the normal topology: rank 0 of the gang, or the driver) — the
+    version bump and the superseded version's pin release are a
+    read-modify-write on the KV entry, not an atomic swap, so
+    concurrent republishers can double-release the old pin and lose a
+    version."""
+    import ray_tpu
+
+    cw = _worker()
+    ref = ray_tpu.put(pytree)
+    key = f"weights:{name}".encode()
+    reply = cw.loop_thread.run(cw.head.call("kv_get",
+                                            {"ns": _KV_NS, "key": key}))
+    version = 0
+    blob = reply.get("value")
+    if blob:
+        try:
+            old = pickle.loads(bytes(blob))
+            version = old["version"]
+        except Exception:
+            old = None
+        if old is not None:
+            # Release the superseded version's borrow pin — otherwise
+            # every re-publish would leak the previous weights for the
+            # owner's lifetime (see unpublish for the accounting).
+            prev = old["ref"]
+            owner = prev.owner_address
+            if owner is None or owner.key() == cw.address.key():
+                cw.reference_counter.on_borrow_removed(prev.id)
+    version += 1
+    cw.loop_thread.run(cw.head.call("kv_put", {
+        "ns": _KV_NS, "key": key,
+        "value": pickle.dumps({"version": version, "ref": ref},
+                              protocol=5),
+        "overwrite": True,
+    }))
+    # Version mirrored under its own key so weights_version() polls are
+    # one tiny kv_get — no ref deserialization, no borrow churn on the
+    # owner.
+    cw.loop_thread.run(cw.head.call("kv_put", {
+        "ns": _KV_NS, "key": f"weights_ver:{name}".encode(),
+        "value": str(version).encode(), "overwrite": True,
+    }))
+    return ref, version
+
+
+def fetch_weights(name: str, timeout: Optional[float] = 120.0,
+                  donate: bool = False) -> Any:
+    """Resolve the latest published weights for ``name``.
+
+    Device-plane semantics apply: the producing process gets its own
+    arrays back by reference; other processes pull per-shard from the
+    nearest registered holder and become holders themselves (so later
+    replicas pull from peers). ``donate=True`` releases the serving
+    holder's buffers after the transfer."""
+    entry = published_ref(name)
+    if entry is None:
+        raise KeyError(f"no published weights under {name!r}")
+    ref, version = entry
+    import ray_tpu
+
+    value = ray_tpu.get(ref, timeout=timeout, donate=donate)
+    _held[name] = (ref, version)
+    return value
+
+
+def published_ref(name: str) -> Optional[Tuple[Any, int]]:
+    """(ref, version) of the latest publish, or None.
+
+    Borrow accounting: the publish-time pickle counted ONE borrow on the
+    owner, but the KV blob is deserialized once per fetcher — each of
+    which will send a matching remove_ref when its ref dies. Every load
+    beyond the one that unpublish() consumes must therefore add its own
+    borrow, or the N-th fetch would drive the owner's count negative and
+    free the weights under live replicas."""
+    cw = _worker()
+    key = f"weights:{name}".encode()
+    reply = cw.loop_thread.run(cw.head.call("kv_get",
+                                            {"ns": _KV_NS, "key": key}))
+    blob = reply.get("value")
+    if not blob:
+        return None
+    entry = pickle.loads(bytes(blob))
+    ref = entry["ref"]
+    owner = ref.owner_address
+    if owner is not None and owner.key() != cw.address.key():
+        cw.reference_counter.on_ref_serialized(ref)
+    return ref, entry["version"]
+
+
+def weights_version(name: str) -> int:
+    """Latest published version (0 = never published). One small
+    kv_get — no ref materialization or refcount traffic — so a replica
+    health loop can poll it to decide when to re-fetch."""
+    cw = _worker()
+    reply = cw.loop_thread.run(cw.head.call("kv_get", {
+        "ns": _KV_NS, "key": f"weights_ver:{name}".encode()}))
+    blob = reply.get("value")
+    if not blob:
+        return 0
+    try:
+        return int(bytes(blob).decode())
+    except ValueError:
+        return 0
+
+
+def unpublish(name: str) -> None:
+    """Drop the KV entry and release the publish-time borrow pin (the
+    registry copies held by replicas drain via their own refcounts)."""
+    cw = _worker()
+    key = f"weights:{name}".encode()
+    reply = cw.loop_thread.run(cw.head.call("kv_get",
+                                            {"ns": _KV_NS, "key": key}))
+    cw.loop_thread.run(cw.head.call("kv_del",
+                                    {"ns": _KV_NS, "key": key}))
+    cw.loop_thread.run(cw.head.call("kv_del", {
+        "ns": _KV_NS, "key": f"weights_ver:{name}".encode()}))
+    blob = reply.get("value")
+    if not blob:
+        return
+    try:
+        entry = pickle.loads(bytes(blob))
+    except Exception:
+        return
+    ref = entry["ref"]
+    owner = ref.owner_address
+    if owner is None or owner.key() == cw.address.key():
+        # Owner-side unpublish: this load only touched the local count;
+        # cancel the publish-time borrow explicitly.
+        cw.reference_counter.on_borrow_removed(ref.id)
+    # Remote unpublish: this loaded ref's destruction sends the
+    # remove_ref that cancels the publish-time borrow.

@@ -55,7 +55,7 @@ CATALOG: Dict[str, tuple] = {
     # object lifecycle (core/object_store.py, core/object_transfer.py,
     # core/core_worker.py)
     "object": ("sealed", "spilled", "restored", "pulled", "freed",
-               "lost", "recovered"),
+               "lost", "recovered", "shard_pulled", "shard_donated"),
     # core/rpc.py + core/retry.py
     "rpc": ("fault_injected", "conn_lost", "retry",
             "deadline_exhausted", "breaker_open", "breaker_closed"),

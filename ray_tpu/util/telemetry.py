@@ -110,6 +110,18 @@ CATALOG: Dict[str, tuple] = {
     "ray_tpu_object_pull_seconds": (
         HISTOGRAM, "Latency of object pull sweeps across holders.",
         ("status",), SLOW_BOUNDARIES),
+    # --- device-native object plane (core/device_objects.py) ---
+    "ray_tpu_object_device_bytes": (
+        GAUGE, "Device-resident bytes registered in this process's "
+        "shard registry (exported puts + assembled borrows).",
+        ("proc",), None),
+    "ray_tpu_object_shard_pull_seconds": (
+        HISTOGRAM, "Per-shard pull latency (device object plane), by "
+        "transport path and outcome.",
+        ("status",), SLOW_BOUNDARIES),
+    "ray_tpu_object_shard_pull_bytes_total": (
+        COUNTER, "Bytes landed by per-shard device-plane pulls.",
+        (), None),
     # --- gcs (core/gcs.py) ---
     "ray_tpu_gcs_nodes": (
         GAUGE, "Cluster nodes by state (SUSPECT = death-grace window).",
