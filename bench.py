@@ -154,6 +154,28 @@ def _run_serve_stream_bench():
         pass
 
 
+def _run_serve_cb_bench():
+    """`bench.py serve-cb`: the continuous-batching load lane — 1k+
+    concurrent SSE streams through the HTTP proxy against an engine
+    deployment (p50/p99 TTFT, inter-chunk latency, chunks/s, shed
+    rate). Writes BENCH_SERVE_CB.json."""
+    import os
+    import subprocess
+    import sys
+
+    out = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                       "BENCH_SERVE_CB.json")
+    subprocess.run(
+        [sys.executable, "-m", "ray_tpu.scripts.serve_cb_bench",
+         "--json", out],
+        timeout=1200, check=True,
+        # Echoing 1k streams' proxy access logs to the driver would
+        # dominate the measurement.
+        env={**os.environ, "JAX_PLATFORMS": "cpu",
+             "RAY_TPU_LOG_TO_DRIVER": "0"},
+    )
+
+
 def _run_transfer_device_bench():
     """`bench.py transfer-device`: the device-plane transfer lane —
     1 GiB sharded jax.Array, shared-device zero-copy get + cross-process
@@ -183,5 +205,7 @@ if __name__ == "__main__":
 
     if len(sys.argv) > 1 and sys.argv[1] == "transfer-device":
         _run_transfer_device_bench()
+    elif len(sys.argv) > 1 and sys.argv[1] == "serve-cb":
+        _run_serve_cb_bench()
     else:
         main()

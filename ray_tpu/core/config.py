@@ -213,6 +213,11 @@ class Config:
     # replica mid-stream keeps its connection alive, so only an
     # inter-chunk deadline catches it).
     serve_stream_chunk_timeout_s: float = 120.0
+    # Request-body cap for the HTTP proxy. Bodies (including chunked /
+    # streamed uploads — long prompts) are accumulated incrementally
+    # and rejected with an honest 413 the moment they cross this bound,
+    # so an oversized upload can never balloon proxy memory.
+    serve_max_request_body_bytes: int = 64 * 1024 * 1024
 
     # --- logging ---
     log_dir: str = ""

@@ -1,0 +1,50 @@
+"""Version-portable ``shard_map`` / ambient-mesh helpers.
+
+``shard_map`` graduated from ``jax.experimental.shard_map`` (where the
+replication-check kwarg is ``check_rep``) to ``jax.shard_map`` (where it
+was renamed ``check_vma``); likewise ``jax.set_mesh`` /
+``jax.sharding.get_abstract_mesh`` replaced the legacy ``with mesh:``
+resource-env context. The container pins whichever jax the image bakes
+in, so resolve the callables at import time.
+"""
+
+from __future__ import annotations
+
+import jax
+
+try:
+    _shard_map = jax.shard_map
+    _CHECK_KWARG = "check_vma"
+except AttributeError:
+    from jax.experimental.shard_map import shard_map as _shard_map
+    _CHECK_KWARG = "check_rep"
+
+
+def shard_map(f=None, *, mesh, in_specs, out_specs, check_vma=True):
+    kwargs = {"mesh": mesh, "in_specs": in_specs, "out_specs": out_specs,
+              _CHECK_KWARG: check_vma}
+    if f is None:
+        return lambda fn: _shard_map(fn, **kwargs)
+    return _shard_map(f, **kwargs)
+
+
+try:
+    set_mesh = jax.set_mesh
+except AttributeError:
+    def set_mesh(mesh):
+        # Legacy jax: a Mesh is its own context manager (resource env),
+        # and bare-PartitionSpec sharding constraints resolve against it.
+        return mesh
+
+
+try:
+    get_abstract_mesh = jax.sharding.get_abstract_mesh
+except AttributeError:
+    from jax._src.mesh import thread_resources as _thread_resources
+
+    def get_abstract_mesh():
+        # Legacy jax: the ambient mesh entered via ``with mesh:``.
+        # Returns an empty Mesh (``.empty`` True) when none is active,
+        # matching the modern API's contract closely enough for axis
+        # checks.
+        return _thread_resources.env.physical_mesh

@@ -22,6 +22,8 @@ import jax.numpy as jnp
 from flax import linen as nn
 from jax.sharding import PartitionSpec as P
 
+from ray_tpu.parallel import _jax_compat
+
 
 def _dispatch_tensors(router_probs, expert_idx, num_experts: int,
                       capacity: int, position_offset=None):
@@ -133,7 +135,7 @@ def _constrain(x, spec: P):
     wanted = {a for a in jax.tree.leaves(tuple(spec)) if a is not None}
     if not wanted:
         return x
-    mesh = jax.sharding.get_abstract_mesh()
+    mesh = _jax_compat.get_abstract_mesh()
     if mesh is None or mesh.empty:
         return x
     missing = wanted - set(mesh.axis_names or ())

@@ -21,6 +21,8 @@ import jax
 import jax.numpy as jnp
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
+from ray_tpu.parallel._jax_compat import shard_map
+
 
 def stack_stage_params(param_trees):
     """Stack per-stage param pytrees into [S, ...] leaves (shard the
@@ -78,7 +80,7 @@ def make_pipeline(stage_fn: Callable[[Any, jnp.ndarray], jnp.ndarray],
         return jax.lax.psum(outputs * mask, axis_name)
 
     # P(axis_name) applies as a prefix spec to every param leaf.
-    sharded = jax.shard_map(
+    sharded = shard_map(
         per_device, mesh=mesh,
         in_specs=(P(axis_name), P()),
         out_specs=P(),

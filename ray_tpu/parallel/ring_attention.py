@@ -27,6 +27,8 @@ import jax
 import jax.numpy as jnp
 from jax.sharding import Mesh, PartitionSpec as P
 
+from ray_tpu.parallel._jax_compat import shard_map
+
 _NEG_INF = -1e30
 
 
@@ -124,7 +126,7 @@ def make_sequence_parallel_attention(mesh: Mesh, kind: str = "ring",
     fn = ring_attention if kind == "ring" else ulysses_attention
 
     @functools.partial(
-        jax.shard_map, mesh=mesh, in_specs=(spec, spec, spec),
+        shard_map, mesh=mesh, in_specs=(spec, spec, spec),
         out_specs=spec, check_vma=False,
     )
     def sp_attention(q, k, v):

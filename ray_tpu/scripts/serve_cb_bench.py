@@ -1,0 +1,160 @@
+"""Serve continuous-batching load bench: N-thousand concurrent streams
+through the HTTP proxy against an engine deployment.
+
+The serving-quality numbers that matter for LLM token streaming at load
+(reference: TTFT / inter-token latency under concurrency in the TPU
+serving comparison literature): p50/p99 TTFT, inter-chunk latency,
+aggregate chunks/s, and the shed rate (requests rejected honestly by
+the engine's bounded admission queue or failed outright). Unlike the
+``serve-stream`` lane (8 handle-level streams), this drives the FULL
+ingress path — aiohttp client -> proxy SSE -> router -> replica engine
+-> per-sequence stream lanes — at 1k+ concurrent streams.
+
+Writes ``BENCH_SERVE_CB.json`` via ``--json``; importable (``run``).
+"""
+
+from __future__ import annotations
+
+import asyncio
+import statistics
+import time
+from typing import Dict, List
+
+from ray_tpu.scripts.serve_stream_bench import _percentile
+
+
+def _raise_nofile_limit(n: int) -> None:
+    """1k+ concurrent sockets needs headroom over the common 1024
+    soft cap; raise toward the hard limit, never above it."""
+    import resource
+
+    soft, hard = resource.getrlimit(resource.RLIMIT_NOFILE)
+    want = min(hard, max(soft, n))
+    if want > soft:
+        resource.setrlimit(resource.RLIMIT_NOFILE, (want, hard))
+
+
+def run(num_streams: int = 1000, chunks_per_stream: int = 16,
+        num_replicas: int = 2, max_batch_size: int = 128,
+        http_port: int = 8463, init: bool = True) -> Dict[str, float]:
+    import ray_tpu
+    from ray_tpu import serve
+
+    _raise_nofile_limit(num_streams * 2 + 256)
+    if init and not ray_tpu.is_initialized():
+        ray_tpu.init(num_cpus=8, num_tpus=0)
+
+    @serve.deployment(
+        num_cpus=0.5, num_replicas=num_replicas,
+        max_queued_stream_chunks=32,
+        engine=serve.EngineConfig(
+            max_batch_size=max_batch_size,
+            max_queued=max(256, 2 * num_streams // num_replicas)),
+    )
+    class TokenGen:
+        async def __call__(self, request):
+            for i in range(chunks_per_stream):
+                await asyncio.sleep(0.002)  # model decode iteration
+                yield {"t": i}
+
+    serve.run(TokenGen.bind(), name="cb_bench", http_port=http_port)
+
+    url = f"http://127.0.0.1:{http_port}/"
+    results = {"ttfts": [], "gaps": [], "chunks": 0, "shed": 0,
+               "ok": 0}
+
+    import aiohttp
+
+    stream_timeout = aiohttp.ClientTimeout(total=600, sock_read=180)
+
+    async def one_stream(session):
+        t0 = time.perf_counter()
+        last = None
+        n = 0
+        try:
+            async with session.get(
+                    url, headers={"Accept": "text/event-stream"},
+                    timeout=stream_timeout) as resp:
+                if resp.status != 200:
+                    results["shed"] += 1
+                    return
+                async for line in resp.content:
+                    if not line.startswith(b"data: {"):
+                        continue
+                    now = time.perf_counter()
+                    if last is None:
+                        results["ttfts"].append(now - t0)
+                    else:
+                        results["gaps"].append(now - last)
+                    last = now
+                    n += 1
+            results["chunks"] += n
+            results["ok"] += 1
+        except Exception:
+            results["shed"] += 1
+
+    async def drive():
+        conn = aiohttp.TCPConnector(limit=num_streams + 16)
+        async with aiohttp.ClientSession(connector=conn) as session:
+            # Warm the route + replicas before the measured burst.
+            await one_stream(session)
+            for key in ("ttfts", "gaps"):
+                results[key].clear()
+            results.update(chunks=0, shed=0, ok=0)
+            t0 = time.perf_counter()
+            await asyncio.gather(*[one_stream(session)
+                                   for _ in range(num_streams)])
+            return time.perf_counter() - t0
+
+    elapsed = asyncio.run(drive())
+
+    ttfts = sorted(results["ttfts"])
+    gaps = sorted(results["gaps"])
+    out = {
+        "concurrent_streams": float(num_streams),
+        "chunks_per_stream": float(chunks_per_stream),
+        "replicas": float(num_replicas),
+        "engine_max_batch_size": float(max_batch_size),
+        "completed_streams": float(results["ok"]),
+        "shed_rate": results["shed"] / max(1, num_streams),
+        "ttft_p50_ms": (statistics.median(ttfts) * 1e3
+                        if ttfts else 0.0),
+        "ttft_p99_ms": _percentile(ttfts, 0.99) * 1e3,
+        "inter_chunk_p50_ms": (statistics.median(gaps) * 1e3
+                               if gaps else 0.0),
+        "inter_chunk_p99_ms": _percentile(gaps, 0.99) * 1e3,
+        "chunks_per_second": results["chunks"] / elapsed if elapsed
+        else 0.0,
+        "wall_s": elapsed,
+    }
+    for name, value in out.items():
+        print(f"{name}: {value:,.3f}")
+    serve.delete("cb_bench")
+    return out
+
+
+def main():
+    import argparse
+
+    p = argparse.ArgumentParser()
+    p.add_argument("--json", default=None)
+    p.add_argument("--streams", type=int, default=1000)
+    p.add_argument("--chunks", type=int, default=16)
+    p.add_argument("--replicas", type=int, default=2)
+    p.add_argument("--batch", type=int, default=128)
+    args = p.parse_args()
+    results = run(num_streams=args.streams,
+                  chunks_per_stream=args.chunks,
+                  num_replicas=args.replicas,
+                  max_batch_size=args.batch)
+    if args.json:
+        import json
+
+        with open(args.json, "w") as f:
+            json.dump({k: round(v, 3) for k, v in results.items()}, f,
+                      indent=1)
+            f.write("\n")
+
+
+if __name__ == "__main__":
+    main()

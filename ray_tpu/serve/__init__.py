@@ -18,6 +18,7 @@ from ray_tpu.serve.api import (
 from ray_tpu.serve.batching import batch
 from ray_tpu.serve.config import AutoscalingConfig, DeploymentConfig
 from ray_tpu.serve.deployment import Application, Deployment, deployment
+from ray_tpu.serve.engine import EngineConfig, EngineOverloadedError, Finished
 from ray_tpu.serve.handle import (
     DeploymentHandle,
     DeploymentResponse,
@@ -40,6 +41,9 @@ __all__ = [
     "DeploymentHandle",
     "DeploymentResponse",
     "DeploymentResponseGenerator",
+    "EngineConfig",
+    "EngineOverloadedError",
+    "Finished",
     "Request",
     "batch",
     "delete",

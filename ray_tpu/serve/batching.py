@@ -12,7 +12,10 @@ from __future__ import annotations
 import asyncio
 import functools
 import inspect
+import time
 from typing import Any, Callable, List, Optional
+
+from ray_tpu.util import telemetry
 
 
 class _BatchQueue:
@@ -20,14 +23,25 @@ class _BatchQueue:
         self.fn = fn
         self.max_batch_size = max_batch_size
         self.timeout = batch_wait_timeout_s
-        self.queue: List[tuple] = []  # (single_arg, future)
+        self.queue: List[tuple] = []  # (single_arg, future, enqueued_at)
         self._flusher: Optional[asyncio.Task] = None
+        self._bg_flushes: set = set()  # keep refs: loop holds tasks weakly
 
     async def submit(self, instance, arg) -> Any:
         fut = asyncio.get_event_loop().create_future()
-        self.queue.append((arg, fut))
-        if len(self.queue) >= self.max_batch_size:
-            await self._flush(instance)
+        self.queue.append((arg, fut, time.monotonic()))
+        if len(self.queue) == self.max_batch_size:
+            # Exactly-at-crossing (appends are one at a time, so every
+            # crossing hits equality): one flush task per full batch,
+            # not one per over-cap submit. Detached, NOT awaited inline
+            # on this caller's task: the batch fn serves every parked
+            # peer, so one client's cancellation mid-execution must only
+            # drop that client's slot — not abort the shared computation
+            # for the rest.
+            t = asyncio.get_event_loop().create_task(
+                self._flush(instance))
+            self._bg_flushes.add(t)
+            t.add_done_callback(self._bg_flushes.discard)
         elif self._flusher is None or self._flusher.done():
             self._flusher = asyncio.get_event_loop().create_task(
                 self._delayed_flush(instance))
@@ -38,11 +52,41 @@ class _BatchQueue:
         await self._flush(instance)
 
     async def _flush(self, instance):
-        if not self.queue:
+        # Drain in max_batch_size slices: a same-tick burst can append
+        # many entries before this task runs, and the batch fn's
+        # contract (XLA executables compiled/padded for <= max) must
+        # hold regardless of arrival pattern.
+        try:
+            while self.queue:
+                await self._flush_one(instance)
+        except asyncio.CancelledError:
+            # Torn down mid-drain (loop shutdown): fail what's still
+            # parked — unresolved futures would hang their callers.
+            for _, f, _enq in self.queue:
+                if not f.done():
+                    f.set_exception(
+                        RuntimeError("batch flush task cancelled"))
+            self.queue = []
+            raise
+
+    async def _flush_one(self, instance):
+        batch = self.queue[:self.max_batch_size]
+        self.queue = self.queue[self.max_batch_size:]
+        now = time.monotonic()
+        args: List[Any] = []
+        futs: List[asyncio.Future] = []
+        for a, f, enqueued in batch:
+            # A caller cancelled while parked (client disconnected, task
+            # torn down) is dropped HERE: executing its slot would spend
+            # a batch position computing for a dead client.
+            if f.cancelled():
+                continue
+            telemetry.observe("ray_tpu_serve_batch_queue_wait_seconds",
+                              now - enqueued)
+            args.append(a)
+            futs.append(f)
+        if not args:
             return
-        batch, self.queue = self.queue, []
-        args = [a for a, _ in batch]
-        futs = [f for _, f in batch]
         try:
             if instance is not None:
                 results = self.fn(instance, args)
@@ -67,10 +111,21 @@ class _BatchQueue:
             for f, r in zip(futs, results):
                 if not f.done():
                     f.set_result(r)
-        except Exception as e:
+        except BaseException as e:
+            # BaseException on purpose: the batch already left
+            # self.queue, so ANY abort of this flush task — including a
+            # CancelledError raised by the batch fn or loop teardown —
+            # must resolve the parked futures or their callers hang
+            # forever. (Flushes run on detached/timer tasks, never a
+            # caller's task, so caller cancellation cannot land here.)
             for f in futs:
                 if not f.done():
-                    f.set_exception(e)
+                    f.set_exception(
+                        RuntimeError("batch flush aborted: "
+                                     f"{e!r}")
+                        if isinstance(e, asyncio.CancelledError) else e)
+            if isinstance(e, asyncio.CancelledError):
+                raise
 
 
 def batch(_fn=None, *, max_batch_size: int = 10,

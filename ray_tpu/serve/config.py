@@ -10,11 +10,18 @@ from __future__ import annotations
 import dataclasses
 from typing import Any, Dict, Optional
 
+from ray_tpu.serve.engine.config import EngineConfig
+
 
 @dataclasses.dataclass
 class AutoscalingConfig:
     """Reference: serve/config.py:AutoscalingConfig — replica count
-    tracks avg ongoing requests per replica around a target."""
+    tracks avg ongoing requests per replica around a target. The
+    streaming/engine signals close the loop for LLM serving: routers
+    report observed TTFT with their routing-table refresh, replicas
+    report engine batch occupancy and admission queue depth, and the
+    controller scales up on a sustained breach of ``target_ttft_s`` /
+    ``target_queue_depth`` and down on idle engine occupancy."""
 
     min_replicas: int = 1
     max_replicas: int = 1
@@ -22,12 +29,34 @@ class AutoscalingConfig:
     upscale_delay_s: float = 3.0
     downscale_delay_s: float = 10.0
     look_back_period_s: float = 5.0
+    # --- streaming / continuous-batching signals ---
+    # Scale up when the look-back-window average TTFT (router-observed
+    # serve_stream_ttft_seconds) stays above this for upscale_delay_s.
+    target_ttft_s: Optional[float] = None
+    # Scale up when the mean engine admission-queue depth per replica
+    # stays above this for upscale_delay_s. Engine deployments never
+    # upscale on num_ongoing (long-lived streams pin it), so when
+    # neither target_ttft_s nor target_queue_depth is set the
+    # controller defaults this to 0.0 for them: sustained queueing
+    # scales up.
+    target_queue_depth: Optional[float] = None
+    # Engine deployments scale DOWN (to min_replicas) when batch
+    # occupancy / max_batch_size stays at or below this fraction with an
+    # empty admission queue for downscale_delay_s.
+    downscale_occupancy: float = 0.1
 
     def __post_init__(self):
         if self.min_replicas < 0 or self.max_replicas < self.min_replicas:
             raise ValueError("need 0 <= min_replicas <= max_replicas")
         if self.target_ongoing_requests <= 0:
             raise ValueError("target_ongoing_requests must be > 0")
+        if self.target_ttft_s is not None and self.target_ttft_s <= 0:
+            raise ValueError("target_ttft_s must be > 0")
+        if (self.target_queue_depth is not None
+                and self.target_queue_depth < 0):
+            raise ValueError("target_queue_depth must be >= 0")
+        if not 0 <= self.downscale_occupancy < 1:
+            raise ValueError("downscale_occupancy must be in [0, 1)")
 
 
 #: Valid values of ``DeploymentConfig.stream_format``: "auto" negotiates
@@ -54,6 +83,11 @@ class DeploymentConfig:
     max_queued_stream_chunks: int = 16
     # HTTP framing for streamed responses (see STREAM_FORMATS).
     stream_format: str = "auto"
+    # Opt into the iteration-level continuous-batching engine
+    # (serve/engine/): requests share a per-replica decode loop that
+    # admits new arrivals between iterations instead of per-request
+    # generator bodies. None = classic per-request execution.
+    engine: Optional[EngineConfig] = None
 
     def __post_init__(self):
         if self.stream_format not in STREAM_FORMATS:

@@ -47,6 +47,17 @@ class DeploymentState:
         self._metrics: Dict[str, dict] = {}
         self._last_scale_up = 0.0
         self._last_scale_down = 0.0
+        # Router-reported stream TTFT samples: (ts, ttft_sum, count)
+        # batches piggybacked on routing-snapshot refreshes, pruned to
+        # the autoscaler's look-back window.
+        self._stream_stats: List[tuple] = []
+        # When the current TTFT/queue-depth breach started (None = no
+        # active breach) — upscales require the breach to be SUSTAINED
+        # for upscale_delay_s, not a single slow sample.
+        self._breach_since: Optional[float] = None
+        self._idle_since: Optional[float] = None
+        # router id -> last applied cumulative (ttft_sum, ttft_count)
+        self._router_cum: Dict[str, tuple] = {}
 
     def key(self) -> str:
         return f"{self.app_name}#{self.name}"
@@ -66,6 +77,15 @@ class ServeController:
         self.apps: Dict[str, List[str]] = {}  # app -> deployment keys
         self.deployments: Dict[str, DeploymentState] = {}
         self.routing_version = 0
+        # Fresh per controller process (NOT checkpointed): routers tag
+        # TTFT reports with the last instance id they synced with, so a
+        # report whose cumulative totals predate a controller restart is
+        # consumed as baseline instead of replayed into the look-back
+        # window (recovery reuses deployment generations, so the gen tag
+        # alone cannot tell "new router" from "new controller").
+        import uuid
+
+        self.instance_id = uuid.uuid4().hex
         self._shutdown = False
         # Serializes check-then-act replica creation: creation awaits
         # off-loop (get_if_exists name lookup), so two interleaved
@@ -272,7 +292,90 @@ class ServeController:
         return self.http_port
 
     # -- routing table ---------------------------------------------------
-    async def get_routing_snapshot(self) -> Dict[str, Any]:
+    async def get_routing_snapshot(self, stats: Optional[dict] = None
+                                   ) -> Dict[str, Any]:
+        if stats:
+            # Routers batch their locally-observed stream TTFT samples
+            # onto the refresh they were already making — the
+            # autoscaling signal rides an existing control call instead
+            # of a per-request RPC. Totals are cumulative per router:
+            # only the delta since that router's last applied report is
+            # appended, so a refresh whose reply was lost after we
+            # processed it cannot double-count when retried.
+            now = time.time()
+            rid = stats.pop("_router", None)
+            same_controller = (stats.pop("_controller", None)
+                               == self.instance_id)
+            for key, s in stats.items():
+                ds = self.deployments.get(key)
+                if ds is None:
+                    continue
+                cum_sum = float(s.get("ttft_sum", 0.0))
+                cum_count = int(s.get("ttft_count", 0))
+                rep_gen = s.get("gen")
+                if rep_gen is not None and rep_gen != ds.generation:
+                    # Samples accrued against a previous generation of
+                    # this deployment (the router hasn't refreshed past
+                    # a redeploy yet): not this deployment's signal.
+                    continue
+                if rid is None:
+                    d_sum, d_count = cum_sum, cum_count
+                elif rid in ds._router_cum:
+                    prev_sum, prev_count = ds._router_cum[rid]
+                    d_sum = cum_sum - prev_sum
+                    d_count = cum_count - prev_count
+                    if d_count < 0 or d_sum < 0:
+                        # A router's totals are monotonic within one
+                        # DeploymentState lifetime, so a negative delta
+                        # means this report is STALE (two router threads
+                        # can snapshot totals and land out of order).
+                        # Drop it and keep the newer stored baseline —
+                        # applying the full cumulative total here would
+                        # replay the router's entire history into the
+                        # look-back window, and regressing the baseline
+                        # would double-count the gap on the next report.
+                        continue
+                elif (rep_gen is not None and same_controller
+                        and s.get("first")):
+                    # Genuinely-first report from this router: tagged
+                    # with OUR generation, OUR controller instance, and
+                    # the router's own "never applied before" marker —
+                    # the router resets its accumulator when a
+                    # deployment's generation changes, so the full
+                    # total belongs to this deployment. (Treating it as
+                    # baseline would permanently drop any burst fully
+                    # contained in one refresh interval.)
+                    d_sum, d_count = cum_sum, cum_count
+                else:
+                    # Unknown router whose totals we can't date: a
+                    # gen-less legacy report, one tagged with a previous
+                    # controller instance (we restarted and recovery
+                    # reused the generation), or a router we evicted
+                    # from the bounded _router_cum map (first=False) —
+                    # its cumulative history may span hours. Baseline
+                    # only: applying the full total would replay that
+                    # history into the look-back window and fake an
+                    # instant breach.
+                    d_sum, d_count = 0.0, 0
+                if rid is not None:
+                    # Delete-then-insert keeps the dict ordered by most
+                    # recent report, so the cap evicts the router that
+                    # has gone quietest — not a live long-lived one
+                    # (whose eviction would replay its whole cumulative
+                    # history as one giant delta).
+                    ds._router_cum.pop(rid, None)
+                    ds._router_cum[rid] = (cum_sum, cum_count)
+                    if len(ds._router_cum) > 256:
+                        # Dead routers' ids; two floats each, capped.
+                        ds._router_cum.pop(next(iter(ds._router_cum)))
+                if d_count > 0:
+                    ds._stream_stats.append((now, d_sum, d_count))
+                    # _autoscale prunes by look-back window, but only
+                    # for deployments WITH an autoscaling config —
+                    # bound the list here too so a long-lived streaming
+                    # deployment without one can't grow it forever.
+                    if len(ds._stream_stats) > 1024:
+                        del ds._stream_stats[:-1024]
         table = {}
         for key, ds in self.deployments.items():
             # Route only to replicas that have answered a health check —
@@ -286,6 +389,10 @@ class ServeController:
                                  if ds.spec.get("is_ingress") else None),
                 "app": ds.app_name,
                 "deployment": ds.name,
+                # Routers tag TTFT reports with this and reset their
+                # accumulators when it changes, so first reports and
+                # redeploys are disambiguated (see stats handling above).
+                "gen": ds.generation,
                 # Streaming plane: proxies pick response framing and the
                 # router picks the backpressure window from here.
                 "stream": bool(ds.spec.get("is_generator")),
@@ -293,7 +400,8 @@ class ServeController:
                 "max_queued_stream_chunks": getattr(
                     cfg, "max_queued_stream_chunks", 16),
             }
-        return {"version": self.routing_version, "table": table}
+        return {"version": self.routing_version, "table": table,
+                "controller": self.instance_id}
 
     # -- reconciliation --------------------------------------------------
     async def _reconcile_loop(self):
@@ -364,13 +472,22 @@ class ServeController:
                 opts["get_if_exists"] = True
                 spec = ds.spec
 
-                def create(opts=opts, spec=spec, rid=rid):
+                def create(opts=opts, spec=spec, rid=rid,
+                           dkey=ds.key()):
+                    # App-qualified "app#name", matching the router's
+                    # TTFT metrics and the controller's autoscale
+                    # events, so one deployment carries ONE tag value
+                    # across the whole telemetry plane (and same-named
+                    # deployments in two apps never merge series).
                     return ray_tpu.remote(Replica).options(**opts).remote(
                         spec["serialized_callable"],
                         spec.get("init_args", ()),
                         spec.get("init_kwargs", {}),
                         spec["config"].user_config,
-                        spec["name"], rid,
+                        dkey, rid,
+                        # getattr: app checkpoints written before the
+                        # engine existed unpickle without the field.
+                        getattr(spec["config"], "engine", None),
                     )
 
                 actor = await asyncio.get_event_loop().run_in_executor(
@@ -471,8 +588,12 @@ class ServeController:
     async def _graceful_stop(self, actor, ds: DeploymentState):
         try:
             timeout = ds.spec["config"].graceful_shutdown_timeout_s
+            # Give the replica most of the budget for its engine drain,
+            # keeping a margin for the terminal-fail + user cleanup
+            # hook to still run inside OUR wait_for.
+            drain = max(1.0, timeout - 2.0)
             await asyncio.wait_for(
-                _aref(actor.prepare_shutdown.remote()), timeout)
+                _aref(actor.prepare_shutdown.remote(drain)), timeout)
         except Exception:
             pass
         await _kill_async(actor)
@@ -493,6 +614,31 @@ class ServeController:
         if ds is not None:
             ds.pending_requests += 1
 
+    def _set_target(self, ds: DeploymentState, new_target: int,
+                    direction: str, reason: str, now: float) -> None:
+        """The one place replica targets change from autoscaling: every
+        decision is observable (counter tagged direction/reason + a
+        ``serve/autoscale`` flight-recorder event)."""
+        old = ds.target_replicas
+        if new_target == old:
+            return
+        ds.target_replicas = new_target
+        if direction == "up":
+            ds._last_scale_up = now
+        else:
+            ds._last_scale_down = now
+        from ray_tpu.util import flight_recorder, telemetry
+
+        telemetry.inc("ray_tpu_serve_autoscale_decisions_total", 1,
+                      {"deployment": ds.key(), "direction": direction,
+                       "reason": reason})
+        flight_recorder.record(
+            "serve", "autoscale", deployment=ds.key(),
+            direction=direction, reason=reason,
+            from_replicas=old, to_replicas=new_target)
+        logger.info("autoscale %s %s: %d -> %d (%s)", ds.key(),
+                    direction, old, new_target, reason)
+
     async def _autoscale(self):
         now = time.time()
         for key, ds in self.deployments.items():
@@ -502,39 +648,124 @@ class ServeController:
             if not ds.replicas:
                 # Scale from zero on queued-request reports.
                 if ds.pending_requests > 0 and ds.target_replicas < 1:
-                    ds.target_replicas = max(1, cfg.min_replicas)
-                    ds._last_scale_up = now
+                    self._set_target(ds, max(1, cfg.min_replicas),
+                                     "up", "pending_requests", now)
                 ds.pending_requests = 0
                 continue
             ds.pending_requests = 0
 
             async def grab(actor):
                 try:
-                    m = await asyncio.wait_for(
+                    return await asyncio.wait_for(
                         _aref(actor.metrics.remote()), 2.0)
-                    return m["num_ongoing"]
                 except Exception:
                     return None
 
             results = await asyncio.gather(
                 *[grab(a) for a in ds.replicas.values()])
-            ongoing = [r for r in results if r is not None]
-            if not ongoing:
+            metrics = [m for m in results if m is not None]
+            if not metrics:
                 continue
-            total = sum(ongoing)
+            total = sum(m.get("num_ongoing", 0) for m in metrics)
             desired = max(
                 cfg.min_replicas,
                 min(cfg.max_replicas,
                     -(-total // int(max(1, cfg.target_ongoing_requests)))))
-            if desired > ds.target_replicas:
+
+            # --- streaming / engine signals ---------------------------
+            engine_ms = [m["engine"] for m in metrics if m.get("engine")]
+            # Keyed on CONFIG, not reported metrics: replicas build
+            # their engine lazily on the first streamed request, so
+            # right after a rolling replace no replica reports engine
+            # stats — falling through to the ongoing-based branch then
+            # would read num_ongoing=0 as an instant downscale with no
+            # sustained-idle requirement. (getattr: app checkpoints
+            # written before the engine existed unpickle without it.)
+            is_engine = getattr(
+                ds.spec["config"], "engine", None) is not None
+            look_back = getattr(cfg, "look_back_period_s", 5.0)
+            ds._stream_stats = [(t, s, c) for (t, s, c)
+                                in ds._stream_stats
+                                if now - t <= look_back]
+            tcount = sum(c for _, _, c in ds._stream_stats)
+            ttft_avg = (sum(s for _, s, _ in ds._stream_stats) / tcount
+                        if tcount else None)
+            queue_depth = sum(m.get("queue_depth", 0) for m in engine_ms)
+            occupancy = sum(m.get("occupancy", 0) for m in engine_ms)
+            batch_capacity = sum(m.get("max_batch_size", 0)
+                                 for m in engine_ms)
+
+            breach = None
+            target_ttft = getattr(cfg, "target_ttft_s", None)
+            target_qd = getattr(cfg, "target_queue_depth", None)
+            if is_engine and target_ttft is None and target_qd is None:
+                # Engine deployments never upscale on num_ongoing (it
+                # is pinned by long-lived streams), so an
+                # AutoscalingConfig without explicit targets would
+                # silently become downscale-only. Default: sustained
+                # admission queueing (batch full, requests waiting) is
+                # the upscale signal.
+                target_qd = 0.0
+            if (target_ttft is not None and ttft_avg is not None
+                    and ttft_avg > target_ttft):
+                breach = "ttft"
+            elif (target_qd is not None and engine_ms
+                    and queue_depth / len(engine_ms) > target_qd):
+                breach = "queue_depth"
+
+            if breach is not None:
+                ds._idle_since = None
+                if ds._breach_since is None:
+                    ds._breach_since = now
+                sustained = (now - ds._breach_since
+                             >= cfg.upscale_delay_s)
+                if (sustained
+                        and ds.target_replicas < cfg.max_replicas
+                        and now - ds._last_scale_up
+                        >= cfg.upscale_delay_s):
+                    self._set_target(ds, ds.target_replicas + 1,
+                                     "up", breach, now)
+                # A breach (even not yet sustained) vetoes downscaling.
+                continue
+            ds._breach_since = None
+
+            if is_engine:
+                # Engine deployments scale UP only on the TTFT /
+                # queue-depth breach above and DOWN only on idle
+                # occupancy: stream counts sit in num_ongoing for their
+                # whole lifetime, so the ongoing-based desired count
+                # would misread long-lived healthy streams as demand
+                # for more replicas and a full decode batch as idle
+                # capacity. (With no engine stats reported yet —
+                # lazily-built engines after a replace — occupancy and
+                # queue depth read 0, which is at worst a SUSTAINED-idle
+                # downscale, never an instant ongoing-based one.)
+                occ_frac = occupancy / max(1, batch_capacity)
+                idle = (occ_frac
+                        <= getattr(cfg, "downscale_occupancy", 0.1)
+                        and queue_depth == 0)
+                if not idle:
+                    ds._idle_since = None
+                else:
+                    # Idleness must be SUSTAINED for downscale_delay_s —
+                    # one instantaneous empty sample between bursts must
+                    # not drop a replica and pay the cold-start twice.
+                    if ds._idle_since is None:
+                        ds._idle_since = now
+                    if (now - ds._idle_since >= cfg.downscale_delay_s
+                            and ds.target_replicas > cfg.min_replicas
+                            and now - ds._last_scale_down
+                            >= cfg.downscale_delay_s):
+                        self._set_target(ds, ds.target_replicas - 1,
+                                         "down", "idle", now)
+            elif desired > ds.target_replicas:
                 if now - ds._last_scale_up >= cfg.upscale_delay_s:
-                    ds.target_replicas = desired
-                    ds._last_scale_up = now
+                    self._set_target(ds, desired, "up", "ongoing", now)
             elif desired < ds.target_replicas:
                 if now - ds._last_scale_down >= cfg.downscale_delay_s:
-                    ds.target_replicas = max(desired,
-                                             ds.target_replicas - 1)
-                    ds._last_scale_down = now
+                    self._set_target(ds, max(desired,
+                                             ds.target_replicas - 1),
+                                     "down", "ongoing", now)
 
     STARTUP_GRACE_S = 120.0
     CONSECUTIVE_FAILURES_TO_KILL = 3  # reference: replica killed after 3

@@ -17,6 +17,7 @@ from ray_tpu.serve.config import (
     DeploymentConfig,
     ReplicaConfig,
 )
+from ray_tpu.serve.engine.config import EngineConfig
 
 
 class Application:
@@ -65,6 +66,7 @@ class Deployment:
                 max_replicas_per_node: Optional[int] = None,
                 max_queued_stream_chunks: Optional[int] = None,
                 stream_format: Optional[str] = None,
+                engine: Optional[Union[EngineConfig, dict]] = None,
                 route_prefix: Optional[str] = None) -> "Deployment":
         cfg = DeploymentConfig(
             num_replicas=(num_replicas if num_replicas is not None
@@ -82,6 +84,8 @@ class Deployment:
                 else self.config.max_queued_stream_chunks),
             stream_format=(stream_format if stream_format is not None
                            else self.config.stream_format),
+            engine=_coerce_engine(
+                engine if engine is not None else self.config.engine),
         )
         rc = ReplicaConfig(
             num_cpus=(num_cpus if num_cpus is not None
@@ -114,6 +118,12 @@ def _coerce_autoscaling(value, default):
     return value
 
 
+def _coerce_engine(value):
+    if isinstance(value, dict):
+        return EngineConfig(**value)
+    return value
+
+
 def deployment(func_or_class=None, *, name: Optional[str] = None,
                num_replicas: int = 1, max_ongoing_requests: int = 100,
                user_config: Optional[dict] = None,
@@ -124,6 +134,7 @@ def deployment(func_or_class=None, *, name: Optional[str] = None,
                max_replicas_per_node: Optional[int] = None,
                max_queued_stream_chunks: int = 16,
                stream_format: str = "auto",
+               engine: Optional[Union[EngineConfig, dict]] = None,
                route_prefix: Optional[str] = None):
     """@serve.deployment decorator (reference: serve/api.py:deployment)."""
 
@@ -139,6 +150,7 @@ def deployment(func_or_class=None, *, name: Optional[str] = None,
                     autoscaling_config, None),
                 max_queued_stream_chunks=max_queued_stream_chunks,
                 stream_format=stream_format,
+                engine=_coerce_engine(engine),
             ),
             ReplicaConfig(num_cpus=num_cpus, num_tpus=num_tpus,
                           resources=resources,
@@ -174,6 +186,15 @@ def build_specs(app: Application, app_name: str,
         route = d.route_prefix
         if is_ingress and route is None:
             route = default_route_prefix
+        if (d.config.engine is not None
+                and not _callable_is_generator(d.func_or_class)
+                and not _has_engine_contract(d.func_or_class)):
+            raise TypeError(
+                f"deployment '{name}': engine=EngineConfig(...) needs "
+                "a generator/async-generator __call__ or the "
+                "prefill/decode_step contract — rejecting at deploy "
+                "time (every request would fail at first traffic "
+                "otherwise)")
         specs.append({
             "name": name,
             "serialized_callable": _ser.dumps_control(d.func_or_class),
@@ -186,9 +207,19 @@ def build_specs(app: Application, app_name: str,
             # Generator deployments stream by default through the proxy
             # (the replica still enforces this at execution time — the
             # flag only picks the proxy's response mode up front).
-            "is_generator": _callable_is_generator(d.func_or_class),
+            # Engine deployments always stream: the continuous-batching
+            # loop emits per-sequence chunks even when the user supplies
+            # the prefill/decode contract instead of a generator.
+            "is_generator": (_callable_is_generator(d.func_or_class)
+                             or d.config.engine is not None),
         })
     return specs, ingress_name
+
+
+def _has_engine_contract(func_or_class) -> bool:
+    from ray_tpu.serve.engine.core import has_engine_contract
+
+    return has_engine_contract(func_or_class)
 
 
 def _callable_is_generator(func_or_class) -> bool:

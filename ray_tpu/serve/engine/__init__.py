@@ -1,0 +1,21 @@
+"""ray_tpu.serve.engine — iteration-level continuous batching for
+generator deployments (see ``core.py`` for the engine loop and
+``config.py`` for the knobs)."""
+
+from ray_tpu.serve.engine.config import EngineConfig
+from ray_tpu.serve.engine.core import (
+    ContinuousBatchingEngine,
+    EngineOverloadedError,
+    EngineRequest,
+    Finished,
+    SequenceState,
+)
+
+__all__ = [
+    "ContinuousBatchingEngine",
+    "EngineConfig",
+    "EngineOverloadedError",
+    "EngineRequest",
+    "Finished",
+    "SequenceState",
+]

@@ -1,0 +1,73 @@
+"""EngineConfig: the continuous-batching engine's per-deployment knobs.
+
+A plain dataclass (like serve/config.py's schemas) so it pickles through
+the controller's app checkpoint and the replica actor's creation args.
+Kept dependency-free: serve/config.py imports this module, so it must
+not import anything from ``ray_tpu.serve``.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+
+
+@dataclasses.dataclass
+class EngineConfig:
+    """Opt a deployment into iteration-level continuous batching
+    (``@serve.deployment(engine=EngineConfig(...))``).
+
+    The engine admits newly-arrived requests into the running batch
+    *between decode iterations* — there is no flush window, so a request
+    arriving mid-decode waits a few iterations for its first token, not
+    the residual decode time of the in-flight batch.
+    """
+
+    #: Max sequences decoded together in one iteration. New requests are
+    #: admitted whenever the batch is below this, even mid-decode.
+    max_batch_size: int = 8
+    #: Admission queue bound. A request arriving while ``max_queued``
+    #: requests are already parked is shed with an honest
+    #: ``EngineOverloadedError`` instead of growing an unbounded queue.
+    max_queued: int = 128
+    #: Per-sequence emission credit: chunks a sequence may have emitted
+    #: but its consumer not yet taken before the engine pauses THAT
+    #: sequence (the rest of the batch keeps decoding). Resumed the
+    #: moment the consumer drains below the window. Pausing requires the
+    #: engine to be able to skip the sequence — auto-wrapped generators
+    #: and contract ``decode_step(batch_state, active_seq_ids)`` both
+    #: can; a contract ``decode_step(batch_state)`` that ignores
+    #: ``active_seq_ids`` keeps producing for paused sequences, so the
+    #: engine buffers up to 4x this window and then evicts the stalled
+    #: sequence with a terminal error rather than grow the buffer until
+    #: the replica OOMs.
+    max_buffered_chunks_per_seq: int = 8
+    #: Sleep applied when a decode iteration makes no progress (a
+    #: contract-mode ``decode_step`` returning nothing) so a stalled
+    #: model can't hot-spin the replica's event loop.
+    empty_step_sleep_s: float = 0.002
+    #: Bound on one decode iteration's awaits (per-sequence async
+    #: generator advance; contract-mode prefill/decode_step call). A
+    #: sequence or batch step exceeding it is failed terminally instead
+    #: of wedging the whole engine — without this, one generator
+    #: awaiting a hung upstream freezes every other sequence AND
+    #: admission, while check_health keeps passing. 0 disables. A
+    #: blocked *sync* generator cannot be interrupted (its executor
+    #: thread is stuck in user code) and is not covered. A timed-out
+    #: *sync* contract hook stops the WHOLE engine (terminal errors on
+    #: every sequence, replica reported unhealthy and replaced): its
+    #: executor thread is still running user code, and issuing another
+    #: prefill/decode_step would race two threads over the same batch
+    #: state.
+    decode_iteration_timeout_s: float = 60.0
+
+    def __post_init__(self):
+        if self.max_batch_size < 1:
+            raise ValueError("max_batch_size must be >= 1")
+        if self.max_queued < 1:
+            raise ValueError("max_queued must be >= 1")
+        if self.max_buffered_chunks_per_seq < 1:
+            raise ValueError("max_buffered_chunks_per_seq must be >= 1")
+        if self.empty_step_sleep_s < 0:
+            raise ValueError("empty_step_sleep_s must be >= 0")
+        if self.decode_iteration_timeout_s < 0:
+            raise ValueError("decode_iteration_timeout_s must be >= 0")

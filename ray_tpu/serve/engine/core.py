@@ -1,0 +1,674 @@
+"""Iteration-level continuous-batching engine (Orca-style).
+
+Reference: the iteration-level scheduling idea from Orca (OSDI '22) as
+deployed by vLLM/TGI-class servers — the unit of scheduling is ONE
+decode iteration, not one request. ``@serve.batch`` collects requests
+for a flush window and then runs the whole batch to completion; a
+request that arrives one tick after the flush waits for the entire
+batch to drain. This engine instead keeps a per-replica decode loop
+running and admits newly-arrived requests into the live batch *between
+iterations*, so TTFT under load is bounded by a few decode iterations.
+
+Two user contracts, detected at engine construction:
+
+- **prefill/decode contract** — the deployment callable provides
+  ``prefill(batch_state, requests)`` (admit new requests, returns the
+  updated batch state) and ``decode_step(batch_state)`` (one iteration;
+  returns ``{seq_id: chunk}``, finishing a sequence by returning a
+  ``Finished(value)``). An optional ``evict(batch_state, seq_ids)``
+  hook is called when sequences leave the batch (finished or
+  cancelled) so KV-cache-style slots can be reclaimed. ``decode_step``
+  may also accept ``(batch_state, active_seq_ids)`` to see which
+  sequences are currently unpaused.
+- **auto-wrap** — any generator / async-generator deployment: the
+  engine drives one generator per request, advancing every active
+  sequence one item per iteration (sync generators advance in a single
+  executor hop per iteration so the replica event loop never blocks).
+
+Sequence lifecycle: submitted -> queued (admission queue, bounded by
+``max_queued`` with an honest shed) -> admitted (``engine/admitted``
+flight event, queue wait observed) -> decoding -> evicted
+(``engine/evicted``: finished, cancelled by client disconnect, or
+errored). Per-sequence emission is credit-bounded: a slow consumer
+pauses ITS sequence (excluded from the next iterations), never the
+whole batch.
+
+All engine state is mutated on the replica's event loop only — no
+locks. Blocking user code (sync prefill/decode/generators) runs in the
+loop's default executor.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import dataclasses
+import inspect
+import time
+from typing import Any, Dict, List, Optional
+
+from ray_tpu.serve.engine.config import EngineConfig
+
+#: Internal terminal marker on a sequence's output queue.
+_DONE = object()
+
+
+class Finished:
+    """Contract-mode sentinel: ``decode_step`` returns ``Finished()``
+    (or ``Finished(final_chunk)``) for a sequence that just completed;
+    a non-None value is emitted as the sequence's last chunk."""
+
+    __slots__ = ("value",)
+
+    def __init__(self, value: Any = None):
+        self.value = value
+
+
+class EngineOverloadedError(RuntimeError):
+    """Admission queue at ``max_queued``: the request was shed, not
+    parked — the honest backpressure signal the autoscaler and clients
+    both see."""
+
+
+@dataclasses.dataclass
+class EngineRequest:
+    """One admitted request as handed to contract-mode ``prefill``."""
+
+    seq_id: int
+    args: tuple
+    kwargs: dict
+
+
+class SequenceState:
+    """Per-request decode state tracked by the engine."""
+
+    __slots__ = ("seq_id", "args", "kwargs", "enqueued_at", "admitted_at",
+                 "first_chunk_at", "chunks_emitted", "finished",
+                 "cancelled", "error", "paused", "out_q", "gen",
+                 "gen_is_async")
+
+    def __init__(self, seq_id: int, args: tuple, kwargs: dict):
+        self.seq_id = seq_id
+        self.args = args
+        self.kwargs = kwargs
+        self.enqueued_at = time.time()
+        self.admitted_at: Optional[float] = None
+        self.first_chunk_at: Optional[float] = None
+        self.chunks_emitted = 0
+        self.finished = False
+        self.cancelled = False
+        self.error: Optional[BaseException] = None
+        self.paused = False
+        # Unbounded queue + explicit credit check in _emit: terminal
+        # markers must always land even when the consumer is stalled.
+        self.out_q: asyncio.Queue = asyncio.Queue()
+        self.gen = None            # auto-wrap mode only
+        self.gen_is_async = False
+
+
+def has_engine_contract(callable_: Any) -> bool:
+    """Single source of truth for contract-mode detection — used by the
+    engine itself AND build_specs' deploy-time gate, so the two cannot
+    diverge."""
+    return (callable(getattr(callable_, "prefill", None))
+            and callable(getattr(callable_, "decode_step", None)))
+
+
+class ContinuousBatchingEngine:
+    """One engine per replica, running as a task on the replica's event
+    loop. ``submit()`` parks a request; the loop admits, decodes, and
+    fans each iteration's outputs into per-sequence queues that
+    ``stream()`` drains into the core streaming lane."""
+
+    def __init__(self, callable_: Any, cfg: EngineConfig,
+                 deployment_name: str):
+        self.cfg = cfg
+        self._deployment = deployment_name
+        self._callable = callable_
+        if has_engine_contract(callable_):
+            prefill = callable_.prefill
+            decode = callable_.decode_step
+            self._mode = "contract"
+            self._prefill_fn = prefill
+            self._decode_fn = decode
+            self._evict_fn = getattr(callable_, "evict", None)
+            params = [
+                p for p in inspect.signature(decode).parameters.values()
+                if p.kind in (p.POSITIONAL_ONLY, p.POSITIONAL_OR_KEYWORD)]
+            self._decode_wants_active = len(params) >= 2
+        else:
+            if not callable(callable_):
+                raise TypeError(
+                    f"{deployment_name}: engine deployments need either "
+                    "prefill()/decode_step() methods or a generator "
+                    "__call__")
+            self._mode = "auto"
+            self._prefill_fn = self._decode_fn = self._evict_fn = None
+            self._decode_wants_active = False
+            self._target = callable_
+        self._batch_state: Any = None
+        self._batch: Dict[int, SequenceState] = {}
+        self._admission: asyncio.Queue = asyncio.Queue(
+            maxsize=cfg.max_queued)
+        self._work = asyncio.Event()
+        self._seq_counter = 0
+        # Sequences popped from the admission queue but not yet landed
+        # in _batch (the await inside _prefill can be cancelled by
+        # shutdown); _fail_all covers them so no consumer ever hangs.
+        self._admitting: List[SequenceState] = []
+        self._stopped = False
+        #: True only when the loop died on a bug (not a clean
+        #: shutdown) — Replica.check_health reports unhealthy then.
+        self.failed = False
+        self._draining = False
+        # Count of parked-and-cancelled sequences so the per-iteration
+        # purge is O(1) when there is nothing to drop.
+        self._cancelled_parked = 0
+        self.total_admitted = 0
+        self.total_evicted = 0
+        # Count of SYNC contract hooks currently executing on an
+        # executor thread, incremented/decremented INSIDE the thread:
+        # wait_for cancels only the awaiting coroutine (and marks the
+        # wrapped future done) while the thread keeps running user
+        # code, so the future's state can't be trusted — see
+        # _sync_call_abandoned.
+        self._sync_running = 0
+        self._task = asyncio.get_event_loop().create_task(self._run())
+
+    # -- request surface (replica event loop) ---------------------------
+
+    def submit(self, args: tuple, kwargs: dict) -> SequenceState:
+        """Park one request on the admission queue; sheds with
+        ``EngineOverloadedError`` when ``max_queued`` are already
+        parked."""
+        if self._stopped or self._draining:
+            raise RuntimeError(
+                f"{self._deployment}: engine is shut down")
+        self._seq_counter += 1
+        seq = SequenceState(self._seq_counter, args, kwargs)
+        try:
+            self._admission.put_nowait(seq)
+        except asyncio.QueueFull:
+            # Cancelled-while-parked entries must not hold slots
+            # against live requests while the batch is full.
+            self._purge_cancelled_parked()
+            try:
+                self._admission.put_nowait(seq)
+            except asyncio.QueueFull:
+                raise EngineOverloadedError(
+                    f"{self._deployment}: engine admission queue full "
+                    f"(max_queued={self.cfg.max_queued}); request shed")
+        self._update_gauges()
+        self._work.set()
+        return seq
+
+    async def stream(self, seq: SequenceState):
+        """Async generator over one sequence's chunks. Draining below
+        the per-sequence window resumes a paused sequence; the caller
+        is responsible for ``cancel(seq)`` on early exit."""
+        window = self.cfg.max_buffered_chunks_per_seq
+        while True:
+            item = await seq.out_q.get()
+            if seq.paused and seq.out_q.qsize() < window:
+                seq.paused = False
+                self._work.set()
+            if item is _DONE:
+                if seq.error is not None:
+                    raise seq.error
+                return
+            yield item
+
+    def cancel(self, seq: SequenceState) -> None:
+        """Mark a sequence cancelled (client walked away). Evicted from
+        the running batch before the next decode iteration; dropped at
+        admission time if still parked in the queue."""
+        if seq.finished or seq.cancelled:
+            return
+        seq.cancelled = True
+        if seq.admitted_at is None:
+            self._cancelled_parked += 1
+        self._work.set()
+
+    def stats(self) -> Dict[str, Any]:
+        """Autoscaling signals, polled by the controller through
+        ``Replica.metrics()``."""
+        return {
+            "occupancy": len(self._batch),
+            "queue_depth": self._admission.qsize(),
+            "max_batch_size": self.cfg.max_batch_size,
+            "total_admitted": self.total_admitted,
+            "total_evicted": self.total_evicted,
+        }
+
+    def begin_drain(self) -> None:
+        """Stop admitting NEW requests (submits shed fast) while
+        in-flight and already-parked sequences run to completion —
+        a routine scale-down or redeploy must not error live streams.
+        Pair with ``shutdown()`` to fail whatever is left."""
+        self._draining = True
+        self._work.set()
+
+    @property
+    def idle(self) -> bool:
+        return (not self._batch and not self._admitting
+                and self._admission.empty())
+
+    async def shutdown(self) -> None:
+        self._stopped = True
+        self._work.set()
+        self._task.cancel()
+        try:
+            await self._task
+        except (asyncio.CancelledError, Exception):  # lint: allow-silent(engine task teardown; sequences are failed terminally below)
+            pass
+        self._fail_all(
+            RuntimeError(f"{self._deployment}: engine shut down"),
+            "shutdown")
+
+    def _fail_all(self, err: BaseException, reason: str) -> None:
+        """Fail every sequence the engine knows about — in-limbo
+        (drained but not yet prefilled), batched, and still parked —
+        terminally. Terminal errors, never a hang."""
+        for seq in self._admitting:
+            self._finish_seq(seq, error=err, reason=reason)
+        self._admitting = []
+        for seq in list(self._batch.values()):
+            self._finish_seq(seq, error=err, reason=reason)
+        while True:
+            try:
+                seq = self._admission.get_nowait()
+            except asyncio.QueueEmpty:
+                break
+            self._finish_seq(seq, error=err, reason=reason)
+        self._update_gauges()
+
+    # -- engine loop -----------------------------------------------------
+
+    async def _run(self):
+        try:
+            while not self._stopped:
+                self._work.clear()
+                newly = self._drain_admission()
+                if newly:
+                    self._admitting = newly
+                    await self._prefill(newly)
+                    self._admitting = []
+                self._purge_cancelled_parked()
+                await self._evict_cancelled()
+                active = [s for s in self._batch.values()
+                          if not s.paused and not s.finished]
+                self._update_gauges()
+                if not active:
+                    # Everything finished, paused, or empty: sleep until
+                    # a submit / consumer drain / cancel wakes the loop.
+                    await self._work.wait()
+                    continue
+                await self._decode(active)
+        except asyncio.CancelledError:
+            raise
+        except Exception as e:
+            # An engine bug must surface as terminal errors on every
+            # waiting consumer — never a silent hang. The engine stays
+            # stopped; new submits fail fast.
+            self._stopped = True
+            self.failed = True
+            self._fail_all(
+                RuntimeError(
+                    f"{self._deployment}: engine loop failed: {e!r}"),
+                "error")
+            raise
+
+    def _purge_cancelled_parked(self) -> None:
+        """Drop cancelled entries still parked in the admission queue so
+        they stop counting toward ``max_queued`` / the queue-depth gauge
+        even while the batch is full (``_drain_admission`` can't pop
+        then). Runs entirely on the event loop, so the drain/re-put is
+        not interleaved with submits."""
+        if not self._cancelled_parked:
+            return  # O(1) on the hot path when nothing was cancelled
+        keep: List[SequenceState] = []
+        purged = False
+        while True:
+            try:
+                seq = self._admission.get_nowait()
+            except asyncio.QueueEmpty:
+                break
+            if seq.cancelled:
+                self._finish_seq(seq, reason="cancelled")
+                purged = True
+            else:
+                keep.append(seq)
+        for seq in keep:
+            self._admission.put_nowait(seq)
+        self._cancelled_parked = 0
+        if purged:
+            self._update_gauges()
+
+    def _drain_admission(self) -> List[SequenceState]:
+        """Admit parked requests up to the free batch capacity.
+        Requests cancelled while parked are dropped HERE — never
+        decoded for a dead client."""
+        out: List[SequenceState] = []
+        while len(self._batch) + len(out) < self.cfg.max_batch_size:
+            try:
+                seq = self._admission.get_nowait()
+            except asyncio.QueueEmpty:
+                break
+            if seq.cancelled:
+                self._cancelled_parked = max(
+                    0, self._cancelled_parked - 1)
+                self._finish_seq(seq, reason="cancelled")
+                continue
+            out.append(seq)
+        return out
+
+    async def _prefill(self, newly: List[SequenceState]):
+        from ray_tpu.util import flight_recorder, telemetry
+
+        now = time.time()
+        for seq in newly:
+            seq.admitted_at = now
+            self.total_admitted += 1
+            telemetry.observe(
+                "ray_tpu_serve_engine_queue_wait_seconds",
+                max(0.0, now - seq.enqueued_at),
+                {"deployment": self._deployment})
+            flight_recorder.record(
+                "engine", "admitted", deployment=self._deployment,
+                seq=seq.seq_id,
+                queue_wait_ms=round((now - seq.enqueued_at) * 1e3, 3),
+                batch=len(self._batch))
+        if self._mode == "contract":
+            reqs = [EngineRequest(s.seq_id, s.args, s.kwargs)
+                    for s in newly]
+            try:
+                self._batch_state = await self._bounded(self._call_user(
+                    self._prefill_fn, self._batch_state, reqs))
+            except Exception as e:
+                if self._sync_call_abandoned():
+                    raise self._wedged_error(e) from e
+                for seq in newly:
+                    self._finish_seq(seq, error=e, reason="error")
+                if self._evict_fn is not None:
+                    # A partially-run prefill may have allocated
+                    # batch_state slots (KV cache) for the new seq_ids
+                    # before failing; route them through the user's
+                    # evict hook so repeated prefill failures cannot
+                    # leak batch capacity.
+                    await self._call_evict([s.seq_id for s in newly])
+                return
+            for seq in newly:
+                self._batch[seq.seq_id] = seq
+            return
+        for seq in newly:
+            try:
+                gen = self._target(*seq.args, **seq.kwargs)
+                if inspect.isawaitable(gen):
+                    gen = await self._bounded(gen)
+                if inspect.isasyncgen(gen):
+                    seq.gen, seq.gen_is_async = gen, True
+                elif hasattr(gen, "__next__"):
+                    seq.gen, seq.gen_is_async = gen, False
+                else:
+                    raise TypeError(
+                        f"{self._deployment}: engine deployment "
+                        "callable returned "
+                        f"{type(gen).__name__}, not a generator/async "
+                        "generator (add prefill/decode_step for the "
+                        "batched contract)")
+            except Exception as e:
+                self._finish_seq(seq, error=e, reason="error")
+                continue
+            self._batch[seq.seq_id] = seq
+
+    async def _evict_cancelled(self):
+        cancelled = [s for s in self._batch.values()
+                     if s.cancelled and not s.finished]
+        if not cancelled:
+            return
+        for seq in cancelled:
+            if seq.gen is not None:
+                try:
+                    if seq.gen_is_async:
+                        # Bounded: a finally-block awaiting a hung
+                        # upstream must not wedge the engine loop.
+                        await self._bounded(seq.gen.aclose())
+                    else:
+                        seq.gen.close()
+                except Exception:  # lint: allow-silent(user generator cleanup on a cancelled sequence; the sequence is already terminal)
+                    pass
+            self._finish_seq(seq, reason="cancelled")
+        if self._mode == "contract" and self._evict_fn is not None:
+            await self._call_evict([s.seq_id for s in cancelled])
+
+    async def _call_evict(self, seq_ids: List[int]):
+        try:
+            out = await self._bounded(self._call_user(
+                self._evict_fn, self._batch_state, seq_ids))
+            if out is not None:
+                self._batch_state = out
+        except Exception as e:
+            if self._sync_call_abandoned():
+                raise self._wedged_error(e) from e
+            from ray_tpu.util import flight_recorder
+
+            flight_recorder.swallow("serve.engine_evict_hook", e)
+
+    async def _decode(self, active: List[SequenceState]):
+        if self._mode == "contract":
+            await self._decode_contract(active)
+        else:
+            await self._decode_auto(active)
+
+    async def _decode_contract(self, active: List[SequenceState]):
+        try:
+            if self._decode_wants_active:
+                out = await self._bounded(self._call_user(
+                    self._decode_fn, self._batch_state,
+                    [s.seq_id for s in active]))
+            else:
+                out = await self._bounded(self._call_user(
+                    self._decode_fn, self._batch_state))
+            # Normalize inside the try: a malformed return value is a
+            # user error like a raising decode_step — it must not
+            # escape to the loop's crash handler and brick the engine.
+            if out is not None and not hasattr(out, "items"):
+                raise TypeError(
+                    f"{self._deployment}: decode_step must return a "
+                    "mapping of seq_id -> chunk (or Finished), got "
+                    f"{type(out).__name__}")
+            items = list(out.items()) if out else []
+        except Exception as e:
+            if self._sync_call_abandoned():
+                raise self._wedged_error(e) from e
+            # A failing decode_step poisons the whole batch state: fail
+            # every in-flight sequence terminally (honest errors beat a
+            # wedged batch) and start fresh for future admissions.
+            for seq in list(self._batch.values()):
+                self._finish_seq(seq, error=e, reason="error")
+            self._batch_state = None
+            return
+        finished_ids: List[int] = []
+        progressed = False
+        for sid, chunk in items:
+            seq = self._batch.get(sid)
+            if seq is None or seq.finished:
+                continue
+            progressed = True
+            if isinstance(chunk, Finished):
+                if chunk.value is not None:
+                    self._emit(seq, chunk.value)
+                finished_ids.append(sid)
+                self._finish_seq(seq)
+            else:
+                self._emit(seq, chunk)
+                if seq.finished:
+                    # _emit hard-capped a stalled consumer: route the
+                    # eviction through the user's evict hook too, so
+                    # its batch_state slot (KV cache) is reclaimed and
+                    # decode_step stops computing for a dead seq_id.
+                    finished_ids.append(sid)
+        if finished_ids and self._evict_fn is not None:
+            await self._call_evict(finished_ids)
+        if not progressed:
+            await asyncio.sleep(self.cfg.empty_step_sleep_s)
+
+    async def _decode_auto(self, active: List[SequenceState]):
+        sync_seqs = [s for s in active if not s.gen_is_async]
+        async_seqs = [s for s in active if s.gen_is_async]
+        # Overlap the sync-generator executor hop with the async
+        # advances: a mixed batch's iteration latency is the max of the
+        # two, not the sum.
+        groups = []
+        if sync_seqs:
+            loop = asyncio.get_event_loop()
+            groups.append(loop.run_in_executor(
+                None, _advance_sync, sync_seqs))
+        if async_seqs:
+            async def advance_bounded(s):
+                try:
+                    return await self._bounded(_advance_async(s))
+                except asyncio.TimeoutError:
+                    return (s, "error", RuntimeError(
+                        f"{self._deployment}: seq {s.seq_id} decode "
+                        "iteration exceeded "
+                        f"{self.cfg.decode_iteration_timeout_s}s "
+                        "(decode_iteration_timeout_s); evicted so the "
+                        "rest of the batch keeps decoding"))
+
+            groups.append(asyncio.gather(
+                *[advance_bounded(s) for s in async_seqs]))
+        results: List[tuple] = []
+        for group in await asyncio.gather(*groups):
+            results.extend(group)
+        for seq, kind, val in results:
+            if kind == "chunk":
+                self._emit(seq, val)
+            elif kind == "done":
+                self._finish_seq(seq)
+            else:
+                self._finish_seq(seq, error=val, reason="error")
+
+    # -- helpers ---------------------------------------------------------
+
+    async def _bounded(self, awaitable):
+        """Apply ``decode_iteration_timeout_s`` to one engine await so a
+        hung user coroutine fails terminally instead of wedging the
+        batch and admission forever."""
+        t = self.cfg.decode_iteration_timeout_s
+        if not t:
+            return await awaitable
+        return await asyncio.wait_for(awaitable, t)
+
+    async def _call_user(self, fn, *args):
+        """Run one user hook without ever blocking the replica event
+        loop: coroutine functions are awaited in place, sync functions
+        (jit'd model steps, KV-cache bookkeeping) hop to the default
+        executor."""
+        if inspect.iscoroutinefunction(fn):
+            return await fn(*args)
+        loop = asyncio.get_event_loop()
+
+        def _invoke():
+            self._sync_running += 1
+            try:
+                return fn(*args)
+            finally:
+                self._sync_running -= 1
+
+        out = await loop.run_in_executor(None, _invoke)
+        if inspect.isawaitable(out):
+            out = await out
+        return out
+
+    def _sync_call_abandoned(self) -> bool:
+        """True when a timed-out SYNC user hook's executor thread is
+        still running user code. Issuing another user call then would
+        race two unsynchronized threads over the same user object /
+        batch state — the engine must stop instead (terminal errors on
+        every sequence; check_health turns unhealthy so the controller
+        replaces the replica). Only meaningful from the engine loop's
+        exception paths, where any legitimate call has already
+        completed."""
+        return self._sync_running > 0
+
+    def _wedged_error(self, e: BaseException) -> RuntimeError:
+        return RuntimeError(
+            f"{self._deployment}: a sync prefill/decode_step/evict "
+            "exceeded decode_iteration_timeout_s but its executor "
+            "thread is still running user code; stopping the engine "
+            f"rather than racing a second call against it ({e!r})")
+
+    def _emit(self, seq: SequenceState, chunk: Any):
+        if seq.first_chunk_at is None:
+            seq.first_chunk_at = time.time()
+        seq.chunks_emitted += 1
+        seq.out_q.put_nowait(chunk)
+        qsize = seq.out_q.qsize()
+        if qsize >= self.cfg.max_buffered_chunks_per_seq:
+            # Credit exhausted: pause THIS sequence's decoding until its
+            # consumer drains below the window — the batch keeps going.
+            seq.paused = True
+        if qsize >= 4 * self.cfg.max_buffered_chunks_per_seq:
+            # A paused sequence can still be produced for when the
+            # contract's decode_step doesn't accept active_seq_ids (the
+            # engine can't stop production for one sequence then). Cap
+            # the buffer honestly rather than let one stalled consumer
+            # grow out_q until the replica OOMs.
+            self._finish_seq(seq, error=RuntimeError(
+                f"{self._deployment}: seq {seq.seq_id} evicted — "
+                f"consumer stalled with {qsize} chunks buffered (window "
+                f"{self.cfg.max_buffered_chunks_per_seq}); accept "
+                "active_seq_ids in decode_step to pause slow sequences "
+                "instead"), reason="backpressure")
+
+    def _finish_seq(self, seq: SequenceState,
+                    error: Optional[BaseException] = None,
+                    reason: str = "finished"):
+        if seq.finished:
+            return
+        from ray_tpu.util import flight_recorder
+
+        seq.finished = True
+        seq.error = error
+        self._batch.pop(seq.seq_id, None)
+        self.total_evicted += 1
+        seq.out_q.put_nowait(_DONE)
+        flight_recorder.record(
+            "engine", "evicted",
+            severity=("info" if reason == "finished" else "warn"),
+            deployment=self._deployment, seq=seq.seq_id, reason=reason,
+            chunks=seq.chunks_emitted)
+
+    def _update_gauges(self):
+        from ray_tpu.util import telemetry
+
+        tags = {"deployment": self._deployment,
+                "proc": telemetry.proc_tag()}
+        telemetry.set_gauge("ray_tpu_serve_engine_batch_occupancy",
+                            len(self._batch), tags)
+        telemetry.set_gauge("ray_tpu_serve_engine_queue_depth",
+                            self._admission.qsize(), tags)
+
+
+def _advance_sync(seqs: List[SequenceState]) -> List[tuple]:
+    """(executor thread) Advance each sync generator one item.
+    StopIteration must not cross the executor boundary — it is folded
+    into the result tuples here."""
+    out = []
+    for s in seqs:
+        try:
+            out.append((s, "chunk", next(s.gen)))
+        except StopIteration:
+            out.append((s, "done", None))
+        except Exception as e:  # noqa: BLE001 — becomes the seq's terminal error
+            out.append((s, "error", e))
+    return out
+
+
+async def _advance_async(s: SequenceState) -> tuple:
+    try:
+        return (s, "chunk", await s.gen.__anext__())
+    except StopAsyncIteration:
+        return (s, "done", None)
+    except Exception as e:  # noqa: BLE001 — becomes the seq's terminal error
+        return (s, "error", e)

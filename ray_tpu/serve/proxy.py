@@ -56,10 +56,13 @@ class ProxyActor:
     reference — one per cluster here (single-host head runtime)."""
 
     def __init__(self, host: str = "127.0.0.1", port: int = 8000):
+        from ray_tpu.core.config import get_config
+
         self.host = host
         self.port = port
         self._runner = None
         self._router = None
+        self._max_body = get_config().serve_max_request_body_bytes
         self._started = asyncio.get_event_loop().create_task(self._start())
         # gRPC ingress next to HTTP (reference: proxy.py:542 gRPCProxy);
         # it runs its own thread pool, so the actor's event loop never
@@ -130,7 +133,21 @@ class ProxyActor:
 
             return web.json_response(
                 await loop.run_in_executor(None, routes_sync))
-        body = await request.read()
+        # Stream the request body in (long prompts arrive as chunked
+        # uploads): accumulate bounded by serve_max_request_body_bytes
+        # and reject with an honest 413 the moment the bound is crossed
+        # — request.read() would buffer the whole body first and only
+        # then let us look at its size.
+        body = await self._read_body_bounded(request)
+        if body is None:
+            from ray_tpu.util import telemetry
+
+            telemetry.inc("ray_tpu_serve_http_requests_total", 1,
+                          {"route": "body_limit", "code": "413"})
+            return web.Response(
+                status=413,
+                text=f"request body exceeds "
+                     f"serve_max_request_body_bytes={self._max_body}")
         req = Request(request.method, path, dict(request.query),
                       dict(request.headers), body)
 
@@ -159,6 +176,23 @@ class ProxyActor:
         telemetry.inc("ray_tpu_serve_http_requests_total", 1,
                       {"route": route, "code": str(resp.status)})
         return resp
+
+    async def _read_body_bounded(self, request) -> Optional[bytes]:
+        """Incrementally accumulate the request body (fixed-length OR
+        chunked transfer), returning None once it exceeds the
+        configured cap — the connection stops reading right there
+        instead of swallowing the rest of an oversized upload."""
+        declared = request.content_length
+        if declared is not None and declared > self._max_body:
+            return None
+        buf = bytearray()
+        while True:
+            chunk = await request.content.readany()
+            if not chunk:
+                return bytes(buf)
+            buf.extend(chunk)
+            if len(buf) > self._max_body:
+                return None
 
     async def _dispatch(self, loop, path, req, model_id, carrier,
                         http_request):

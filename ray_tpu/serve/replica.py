@@ -18,7 +18,8 @@ from typing import Any, Dict, Optional
 
 class Replica:
     def __init__(self, serialized_callable, init_args, init_kwargs,
-                 user_config, deployment_name: str, replica_id: str):
+                 user_config, deployment_name: str, replica_id: str,
+                 engine_config=None):
         self.deployment_name = deployment_name
         self.replica_id = replica_id
         self.num_ongoing = 0
@@ -29,6 +30,12 @@ class Replica:
         self._init_kwargs = init_kwargs
         self._user_config = user_config
         self.callable = None
+        # Continuous-batching engine (serve/engine/): constructed
+        # lazily on the event loop once the callable exists — streams
+        # then share the per-replica decode loop instead of running one
+        # generator body per request.
+        self._engine_cfg = engine_config
+        self._engine = None
         # User __init__ is cold-start code — checkpoint reads, blocking
         # weight fetches (serve.fetch_weights pulling sharded arrays
         # through the device object plane), warmup jit — so it must NOT
@@ -131,9 +138,24 @@ class Replica:
 
         return scope_cm()
 
+    def _ensure_engine(self):
+        if self._engine is None:
+            from ray_tpu.serve.engine import ContinuousBatchingEngine
+
+            self._engine = ContinuousBatchingEngine(
+                self.callable, self._engine_cfg, self.deployment_name)
+        return self._engine
+
     async def handle_request(self, method_name: str, args: tuple,
                              kwargs: dict) -> Any:
         await self._ensure_built()
+        if self._engine_cfg is not None and method_name == "__call__":
+            raise TypeError(
+                f"{self.deployment_name} runs the continuous-batching "
+                "engine; __call__ is streaming-only — use "
+                "handle.options(stream=True).remote(...) (or the HTTP "
+                "proxy, which streams engine deployments "
+                "automatically)")
         with self._request_scope(
                 kwargs, f"replica {self.deployment_name}") as scope:
             fn = self._resolve_fn(method_name)
@@ -160,6 +182,26 @@ class Replica:
         Sync and async user generators both work; replica metrics count
         the whole stream as one request."""
         await self._ensure_built()
+        if self._engine_cfg is not None and method_name == "__call__":
+            # Engine lane: the request joins the replica-wide decode
+            # loop; chunks still ride the same per-request core stream
+            # lane as classic generators (credit-based backpressure on
+            # the consumer side pauses only this sequence upstream).
+            with self._request_scope(
+                    kwargs,
+                    f"replica {self.deployment_name} engine") as scope:
+                engine = self._ensure_engine()
+                seq = engine.submit(args, kwargs)
+                try:
+                    async for chunk in engine.stream(seq):
+                        yield chunk
+                finally:
+                    # Covers client disconnect / cancellation: the core
+                    # lane cancels this async generator, which must
+                    # evict the sequence from the running batch.
+                    engine.cancel(seq)
+                scope["status"] = "ok"
+            return
         with self._request_scope(
                 kwargs,
                 f"replica {self.deployment_name} stream") as scope:
@@ -185,12 +227,17 @@ class Replica:
             scope["status"] = "ok"
 
     async def metrics(self) -> Dict[str, Any]:
-        return {
+        out = {
             "replica_id": self.replica_id,
             "num_ongoing": self.num_ongoing,
             "total_served": self.total_served,
             "uptime_s": time.time() - self._started,
         }
+        if self._engine is not None:
+            # Autoscaling signals: batch occupancy + admission queue
+            # depth feed the controller's scale decisions.
+            out["engine"] = self._engine.stats()
+        return out
 
     async def check_health(self) -> bool:
         # Still constructing: not ready yet (the controller's startup
@@ -199,6 +246,11 @@ class Replica:
         if not self._built.done():
             return False
         await self._ensure_built()
+        # An engine whose loop died on a bug fails every request fast —
+        # report unhealthy so the controller's restart machinery
+        # replaces this replica instead of routing to a green corpse.
+        if self._engine is not None and self._engine.failed:
+            return False
         fn = getattr(self.callable, "check_health", None)
         if callable(fn):
             out = fn()
@@ -207,10 +259,24 @@ class Replica:
             return bool(out) if out is not None else True
         return True
 
-    async def prepare_shutdown(self) -> None:
+    async def prepare_shutdown(self, drain_timeout_s: float = 8.0
+                               ) -> None:
         """Drain ongoing requests, then run the user cleanup hook — the
         worker process is force-killed afterwards, so finalizers would
         otherwise never run."""
+        if self._engine is not None:
+            # Drain first: a routine autoscale-down or redeploy must
+            # not error live client streams. New submits shed fast,
+            # in-flight sequences finish within the budget (the
+            # controller bounds this whole call with
+            # graceful_shutdown_timeout_s), then leftovers — e.g.
+            # endless streams — fail terminally (an error chunk, never
+            # a hang).
+            self._engine.begin_drain()
+            deadline = time.time() + max(0.0, drain_timeout_s)
+            while not self._engine.idle and time.time() < deadline:
+                await asyncio.sleep(0.02)
+            await self._engine.shutdown()
         while self.num_ongoing > 0:
             await asyncio.sleep(0.02)
         try:

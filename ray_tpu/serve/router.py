@@ -43,6 +43,33 @@ class Router:
         self._lock = make_lock("serve.Router._lock", reentrant=True)
         self._version = -1
         self._last_refresh = 0.0
+        # Locally-observed stream TTFT samples (deployment key ->
+        # [sum_seconds, count]), reported CUMULATIVELY (never cleared)
+        # with the next routing-snapshot refresh — the autoscaler's
+        # TTFT signal. Cumulative totals + the router id make the
+        # piggyback idempotent: the controller appends only the delta
+        # since this router's last applied report, so a reply lost
+        # after the controller processed it can neither drop nor
+        # double-count samples.
+        import uuid
+
+        self._router_id = uuid.uuid4().hex
+        self._ttft_acc: Dict[str, list] = {}
+        # deployment key -> generation the accumulator belongs to; reset
+        # on redeploy so old-generation samples never pollute the new
+        # deployment's autoscaling signal.
+        self._ttft_gen: Dict[str, Any] = {}
+        # Last controller instance id seen; echoed on reports so a
+        # restarted controller treats our pre-restart cumulative totals
+        # as baseline instead of replaying them as fresh samples.
+        self._ctrl_instance: Optional[str] = None
+        # Deployment keys whose totals this controller instance has
+        # already applied a report for. First reports carry first=True,
+        # which is the ONLY case the controller may apply the full
+        # cumulative total — a router evicted from the controller's
+        # bounded per-router baseline map reports first=False and is
+        # re-baselined instead of replaying its history.
+        self._reported_keys: set = set()
         # deployment key -> list of replica actor names
         self._table: Dict[str, dict] = {}
         self._handles: Dict[str, Any] = {}  # replica name -> actor handle
@@ -61,15 +88,44 @@ class Router:
             failure_threshold=cfg.serve_cb_failure_threshold,
             reset_timeout_s=cfg.serve_cb_reset_timeout_s)
 
+    def _note_ttft(self, deployment_key: str, ttft_s: float) -> None:
+        with self._lock:
+            acc = self._ttft_acc.setdefault(deployment_key, [0.0, 0])
+            acc[0] += ttft_s
+            acc[1] += 1
+
     def _refresh(self, force: bool = False):
         now = time.time()
         if not force and now - self._last_refresh < self._refresh_period:
             return
+        with self._lock:
+            stats = {k: {"ttft_sum": v[0], "ttft_count": v[1],
+                         "gen": self._ttft_gen.get(k),
+                         "first": k not in self._reported_keys}
+                     for k, v in self._ttft_acc.items() if v[1]}
+        reported_keys = set(stats)
+        if stats:
+            stats["_router"] = self._router_id
+            stats["_controller"] = self._ctrl_instance
+        # A failed refresh loses nothing: the totals are cumulative, so
+        # the next successful one carries every sample accrued since
+        # the controller's last applied report.
         snap = ray_tpu.get(
-            self._controller.get_routing_snapshot.remote(),
+            self._controller.get_routing_snapshot.remote(stats or None),
             timeout=self._control_timeout)
         with self._lock:
             self._last_refresh = now
+            # Read OUTSIDE the version check: a recovered controller can
+            # come back at the same routing version.
+            new_ctrl = snap.get("controller")
+            if new_ctrl != self._ctrl_instance:
+                # New controller instance: only the keys in THIS report
+                # have a baseline there (applied via the stale-nonce
+                # path); everything else is first again.
+                self._reported_keys = reported_keys
+            else:
+                self._reported_keys |= reported_keys
+            self._ctrl_instance = new_ctrl
             if snap["version"] != self._version:
                 self._version = snap["version"]
                 self._table = snap["table"]
@@ -83,6 +139,29 @@ class Router:
                                  if n in live}
                 self._qlen = {n: q for n, q in self._qlen.items()
                               if n in live}
+                # The cumulative TTFT accumulator is never drained —
+                # drop deleted deployments' keys so it tracks the
+                # routing table instead of growing forever, and reset
+                # it on a generation change (redeploy): the controller
+                # applies a first report tagged with the current
+                # generation in FULL, so the totals must contain only
+                # this generation's samples.
+                for k, entry in self._table.items():
+                    g = entry.get("gen")
+                    if self._ttft_gen.get(k) != g:
+                        self._ttft_gen[k] = g
+                        self._ttft_acc.pop(k, None)
+                        # The redeployed DeploymentState starts with an
+                        # empty baseline map: our next report for this
+                        # key is a FIRST report again, or the controller
+                        # would baseline away the first post-redeploy
+                        # refresh interval of samples.
+                        self._reported_keys.discard(k)
+                self._ttft_acc = {k: v for k, v in self._ttft_acc.items()
+                                  if k in self._table}
+                self._ttft_gen = {k: v for k, v in self._ttft_gen.items()
+                                  if k in self._table}
+                self._reported_keys &= set(self._table)
 
     def route_for_prefix(self, path: str) -> Optional[str]:
         """Longest-prefix route match (proxy use)."""
@@ -271,9 +350,12 @@ class Router:
 
         def first_chunk():
             if t0 is not None:
+                ttft = max(0.0, time.time() - t0)
                 telemetry.observe("ray_tpu_serve_stream_ttft_seconds",
-                                  max(0.0, time.time() - t0),
-                                  {"deployment": deployment_key})
+                                  ttft, {"deployment": deployment_key})
+                # Feed the autoscaler: batched to the controller with
+                # the next routing refresh.
+                self._note_ttft(deployment_key, ttft)
 
         # NB: `done` receives the generator as an argument instead of
         # closing over `gen` — a gen-capturing closure stored in

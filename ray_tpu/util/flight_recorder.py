@@ -68,8 +68,15 @@ CATALOG: Dict[str, tuple] = {
     "train": ("heartbeat_miss", "gang_abort", "gang_restart",
               "elastic_resize"),
     # serve/router.py (streaming lifecycle rides the router — it sees
-    # both the HTTP proxy's streams and driver-side handle streams)
-    "serve": ("replica_shed", "stream_started", "stream_aborted"),
+    # both the HTTP proxy's streams and driver-side handle streams);
+    # "autoscale" is recorded by the controller on every replica-target
+    # change (direction/reason/from/to in the tags).
+    "serve": ("replica_shed", "stream_started", "stream_aborted",
+              "autoscale"),
+    # serve/engine/core.py continuous-batching lifecycle: a sequence is
+    # admitted into the running batch between decode iterations and
+    # evicted when it finishes, errors, or its client disconnects.
+    "engine": ("admitted", "evicted"),
     # the debug plane itself (util/flight_recorder.py)
     "debug": ("postmortem",),
     # swallowed-exception audit (tools/analysis silent-except checker):

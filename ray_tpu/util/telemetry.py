@@ -163,6 +163,31 @@ CATALOG: Dict[str, tuple] = {
         COUNTER, "Streams terminated before a clean finish "
         "(replica_death / client_disconnect / deadline / app_error).",
         ("deployment", "reason"), None),
+    # --- serve continuous-batching engine (serve/engine/core.py) ---
+    # Per-replica gauges ("proc" keeps each replica process's series
+    # distinct through the last-write-wins gauge merge).
+    "ray_tpu_serve_engine_batch_occupancy": (
+        GAUGE, "Sequences currently decoding in a replica's "
+        "continuous-batching engine.",
+        ("deployment", "proc"), None),
+    "ray_tpu_serve_engine_queue_depth": (
+        GAUGE, "Requests parked in a replica engine's admission queue.",
+        ("deployment", "proc"), None),
+    "ray_tpu_serve_engine_queue_wait_seconds": (
+        HISTOGRAM, "Admission-queue wait (submit to batch admission) "
+        "of engine requests.",
+        ("deployment",), SLOW_BOUNDARIES),
+    # --- serve autoscaling (serve/controller.py) ---
+    "ray_tpu_serve_autoscale_decisions_total": (
+        COUNTER, "Replica-target changes made by the deployment "
+        "autoscaler (direction up/down; reason ttft / queue_depth / "
+        "ongoing / idle / pending_requests).",
+        ("deployment", "direction", "reason"), None),
+    # --- serve batching (serve/batching.py) ---
+    "ray_tpu_serve_batch_queue_wait_seconds": (
+        HISTOGRAM, "Time @serve.batch requests spend parked before "
+        "their batch flushes.",
+        (), LATENCY_BOUNDARIES),
     # --- train (train/session.py) ---
     "ray_tpu_train_reports_total": (
         COUNTER, "train.report() calls across training workers.",

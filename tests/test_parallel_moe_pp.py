@@ -7,6 +7,7 @@ import numpy as np
 import pytest
 from jax.sharding import NamedSharding, PartitionSpec as P
 
+from ray_tpu.parallel._jax_compat import set_mesh
 from ray_tpu.parallel import (
     MeshConfig,
     MoELayer,
@@ -81,7 +82,7 @@ def test_moe_sharded_matches_unsharded():
     x = jax.random.normal(jax.random.PRNGKey(0), (4, 16, 16))
     params = layer_ref.init(jax.random.PRNGKey(1), x)
     ref = layer_ref.apply(params, x)
-    with jax.set_mesh(mesh):
+    with set_mesh(mesh):
         sh = jax.jit(lambda p, a: layer_sh.apply(p, a))(params, x)
     np.testing.assert_allclose(np.asarray(ref), np.asarray(sh),
                                rtol=2e-4, atol=2e-4)
@@ -105,7 +106,7 @@ def test_pipeline_matches_sequential():
     pipelined = make_pipeline(_mlp_stage, mesh,
                               num_microbatches=n_micro,
                               axis_name="stage")
-    with jax.set_mesh(mesh):
+    with set_mesh(mesh):
         out = jax.jit(pipelined)(stacked, x)
 
     expect = x
@@ -137,7 +138,7 @@ def test_pipeline_grads_flow():
             h = _mlp_stage(p, h)
         return jnp.mean(h ** 2)
 
-    with jax.set_mesh(mesh):
+    with set_mesh(mesh):
         g = jax.jit(jax.grad(loss))(stacked)
     g_ref = jax.grad(ref_loss)(stage_params)
     for s in range(n_stages):

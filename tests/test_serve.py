@@ -162,6 +162,80 @@ def test_batching(serve_cluster):
     serve.delete("batched")
 
 
+def test_batch_drops_cancelled_requests_at_flush():
+    """A request cancelled while parked in the batch queue is dropped
+    at flush time — never executed for a dead client — and queue wait
+    is observed in serve_batch_queue_wait_seconds."""
+    from ray_tpu.util import telemetry
+
+    telemetry.reset_for_testing()
+    executed = []
+
+    @serve.batch(max_batch_size=10, batch_wait_timeout_s=0.2)
+    async def fn(xs):
+        executed.extend(xs)
+        return [x * 2 for x in xs]
+
+    async def main():
+        t1 = asyncio.ensure_future(fn(1))
+        t2 = asyncio.ensure_future(fn(2))
+        await asyncio.sleep(0.05)  # both parked, flush pending
+        t1.cancel()
+        assert await t2 == 4
+        with pytest.raises(asyncio.CancelledError):
+            await t1
+
+    try:
+        asyncio.run(main())
+        assert executed == [2], executed
+        m = telemetry.metric("ray_tpu_serve_batch_queue_wait_seconds")
+        # Only the surviving request's wait is observed.
+        assert sum(h[-1] for h in m._hists.values()) == 1, m._hists
+    finally:
+        telemetry.reset_for_testing()
+
+
+def test_batch_all_cancelled_skips_execution():
+    executed = []
+
+    @serve.batch(max_batch_size=10, batch_wait_timeout_s=0.1)
+    async def fn(xs):
+        executed.extend(xs)
+        return xs
+
+    async def main():
+        tasks = [asyncio.ensure_future(fn(i)) for i in range(3)]
+        await asyncio.sleep(0.02)
+        for t in tasks:
+            t.cancel()
+        await asyncio.sleep(0.3)  # flush timer fires on an empty batch
+
+    asyncio.run(main())
+    assert executed == [], "batch ran for exclusively dead clients"
+
+
+def test_batch_never_exceeds_max_batch_size():
+    """A same-tick burst larger than max_batch_size must reach the batch
+    fn in <= max_batch_size slices — XLA executables are compiled/padded
+    for the declared max, so the bound is a hard contract."""
+    sizes = []
+
+    @serve.batch(max_batch_size=4, batch_wait_timeout_s=0.05)
+    async def fn(xs):
+        sizes.append(len(xs))
+        return [x + 1 for x in xs]
+
+    async def main():
+        # All 20 submits land in one event-loop tick, before any
+        # detached flush task gets to run.
+        return await asyncio.gather(*[fn(i) for i in range(20)])
+
+    results = asyncio.run(main())
+    assert results == [i + 1 for i in range(20)]
+    assert sum(sizes) == 20
+    assert max(sizes) <= 4, sizes
+
+
 def test_autoscaling_up(serve_cluster):
     @serve.deployment(
         autoscaling_config=serve.AutoscalingConfig(

@@ -185,6 +185,41 @@ def test_stream_consumer_drop_stops_replica_generator(serve_cluster):
     serve.delete("drop")
 
 
+def test_streaming_composition_two_stage_pipeline(serve_cluster):
+    """A replica consumes ANOTHER deployment's stream inside its own
+    generator loop (draft -> refine) without deadlocking its event
+    loop: the handle's stream assignment offloads to the executor and
+    the chunk iteration is natively async."""
+
+    @serve.deployment(num_cpus=0.1)
+    class Draft:
+        async def __call__(self, n):
+            for i in range(n):
+                await asyncio.sleep(0.005)
+                yield i
+
+    @serve.deployment(num_cpus=0.1)
+    class Refine:
+        def __init__(self, draft):
+            self.draft = draft
+
+        async def __call__(self, n):
+            async for tok in self.draft.options(stream=True).remote(n):
+                yield tok * 10
+
+    h = serve.run(Refine.bind(Draft.bind()), name="pipe", proxy=False)
+    # Incremental: the first refined chunk must arrive while the draft
+    # stage is still producing, proving chunks flow stage-to-stage
+    # instead of being buffered per stage.
+    gen = h.options(stream=True).remote(40)
+    t0 = time.time()
+    it = iter(gen)
+    assert next(it) == 0
+    assert time.time() - t0 < 1.5
+    assert list(it) == [i * 10 for i in range(1, 40)]
+    serve.delete("pipe")
+
+
 # ---------------------------------------------------------------------------
 # HTTP proxy streaming
 # ---------------------------------------------------------------------------

@@ -127,6 +127,30 @@ def test_serve_stream_metrics_in_catalog():
         assert tuple(got_tags) == tag_keys, name
 
 
+def test_serve_engine_and_autoscale_metrics_in_catalog():
+    """The continuous-batching engine + autoscaling metrics stay
+    declared — the engine loop, the controller's scale decisions, and
+    @serve.batch's queue-wait all emit through these names and a
+    rename/removal would silently blind the serving plane."""
+    expected = {
+        "ray_tpu_serve_engine_batch_occupancy": (
+            telemetry.GAUGE, ("deployment", "proc")),
+        "ray_tpu_serve_engine_queue_depth": (
+            telemetry.GAUGE, ("deployment", "proc")),
+        "ray_tpu_serve_engine_queue_wait_seconds": (
+            telemetry.HISTOGRAM, ("deployment",)),
+        "ray_tpu_serve_autoscale_decisions_total": (
+            telemetry.COUNTER, ("deployment", "direction", "reason")),
+        "ray_tpu_serve_batch_queue_wait_seconds": (
+            telemetry.HISTOGRAM, ()),
+    }
+    for name, (kind, tag_keys) in expected.items():
+        assert name in telemetry.CATALOG, name
+        got_kind, _desc, got_tags, _bounds = telemetry.CATALOG[name]
+        assert got_kind == kind, name
+        assert tuple(got_tags) == tag_keys, name
+
+
 def test_catalog_metric_roundtrip():
     telemetry.reset_for_testing()
     try:
