@@ -99,6 +99,12 @@ def init(address: Optional[str] = None,
             worker = _connect_remote_driver(address, config, namespace)
             _global_worker = worker
             _start_log_streaming(worker, config)
+            # Attached drivers honor profiler_continuous_enabled too —
+            # the flag must not be silently ignored off the local-start
+            # path.
+            from ray_tpu.util import profiler
+
+            profiler.maybe_start_continuous()
             return get_runtime_context()
 
         node_resources = detect_node_resources(num_cpus, num_tpus, resources)
@@ -106,6 +112,12 @@ def init(address: Optional[str] = None,
         worker = _connect_driver(node, config, namespace)
         _global_node = node
         _global_worker = worker
+        # Live profiling plane: continuous sampler for the head+driver
+        # process when configured on (workers start theirs in
+        # worker_main; the config rides to them via the env override).
+        from ray_tpu.util import profiler
+
+        profiler.maybe_start_continuous()
         _write_cluster_address(f"127.0.0.1:{node.port}")
         _start_log_streaming(worker, config)
         return get_runtime_context()

@@ -179,6 +179,22 @@ class Config:
     # overwritten).
     flight_recorder_capacity: int = 2048
 
+    # --- live profiling plane (util/profiler.py) ---
+    # Always-on low-Hz background sampler: folded-stack snapshots into
+    # <session>/profile/, a profile:<pid> timeline lane, and the
+    # overhead gauge. Off by default — the on-demand `ray_tpu profile`
+    # surface needs no standing cost; turn this on for soak triage.
+    profiler_continuous_enabled: bool = False
+    # Sampling rate of the continuous mode (the on-demand rate is a CLI
+    # flag). 10 Hz keeps measured overhead well under the bound below.
+    profiler_continuous_hz: float = 10.0
+    # How often the continuous sampler rewrites its snapshot file and
+    # publishes its timeline window.
+    profiler_snapshot_interval_s: float = 5.0
+    # Measured-overhead self-check: when sampling time / wall time
+    # crosses this, the continuous sampler halves its rate.
+    profiler_max_overhead_ratio: float = 0.02
+
     # --- lockdep witness (util/locks.py) ---
     # Debug-mode instrumented locks: record cross-thread lock
     # acquisition order, detect lock-order inversions (ABBA) the first

@@ -626,6 +626,7 @@ class CoreWorker:
             "task_done": self.h_task_done,
             "ping": self.h_ping,
             "debug_dump": self.h_debug_dump,
+            "profile_capture": self.h_profile_capture,
             "fetch_device_shard": self.h_fetch_device_shard,
             "donate_device_shards": self.h_donate_device_shards,
         }
@@ -650,6 +651,23 @@ class CoreWorker:
         if payload.get("include_events", True):
             out["events"] = flight_recorder.snapshot(
                 limit=payload.get("event_limit"))
+        return out
+
+    async def h_profile_capture(self, conn, payload):
+        """Live profiling plane (reference: the reporter agent's py-spy
+        capture): sample this process's threads for a bounded window and
+        return folded stacks with task attribution. The sampling loop
+        blocks, so it runs on the executor pool — the event loop keeps
+        serving (heartbeats, acks, the task being profiled)."""
+        payload = payload or {}
+        from ray_tpu.util import profiler
+
+        duration = float(payload.get("duration_s", 5.0))
+        hz = float(payload.get("hz", 100.0))
+        out = await asyncio.get_running_loop().run_in_executor(
+            None, lambda: profiler.capture(duration, hz))
+        out.update(worker_id=self.worker_id.hex(), mode=self.mode,
+                   node_id=self.node_id_hex)
         return out
 
     def h_task_accepted(self, conn, payload):

@@ -563,6 +563,7 @@ class HeadService:
             "autoscaler_status": self.h_autoscaler_status,
             "debug_dump_cluster": self.h_debug_dump_cluster,
             "debug_sched_state": self.h_debug_sched_state,
+            "profile_capture_cluster": self.h_profile_capture_cluster,
             # Serve the head-host node store for cross-node pulls.
             **object_transfer.serve_handlers(),
         }
@@ -821,6 +822,9 @@ class HeadService:
         wid = handle.worker_id.hex()
         self.kv.get("metrics", {}).pop(f"metrics:{wid}".encode(), None)
         self.kv.get("timeline", {}).pop(f"timeline:{wid}".encode(), None)
+        # The "flightring" namespace deliberately survives: a shipped
+        # ring tail is exactly the evidence a SIGKILL'd worker left
+        # behind, and debug_dump_cluster merges it for dead processes.
         # Retract the dead process's device-plane holder listings so
         # consumers don't burn a pull sweep on a vanished peer; the
         # manifest itself survives as long as any holder (or mirrored
@@ -1234,7 +1238,7 @@ class HeadService:
     #: process re-pushes within seconds, a restarted head must not
     #: resurrect dead workers' gauges, and the 2s push cadence must not
     #: pay the sqlite fsync path.
-    EPHEMERAL_KV_NS = ("metrics", "timeline")
+    EPHEMERAL_KV_NS = ("metrics", "timeline", "flightring")
 
     async def h_kv_put(self, conn, payload):
         ns_name = payload.get("ns", "")
@@ -1696,7 +1700,192 @@ class HeadService:
         if req["include_events"]:
             head_entry["events"] = flight_recorder.snapshot(
                 limit=req["event_limit"])
-        return {"entries": [head_entry] + entries, "ts": time.time()}
+        entries = [head_entry] + entries
+        if req["include_events"]:
+            entries.extend(self._shipped_ring_entries(entries))
+        return {"entries": entries, "ts": time.time()}
+
+    #: Shipped-ring retention: enough to cover any realistic postmortem
+    #: window without letting worker churn grow the head (one ~256-event
+    #: blob per error-recording worker) or bury fresh evidence in a dump
+    #: under weeks of cleanly-exited processes' stale rings.
+    FLIGHTRING_MAX_ENTRIES = 64
+    FLIGHTRING_MAX_AGE_S = 6 * 3600.0
+
+    def _prune_flightring(self) -> None:
+        ns = self.kv.get("flightring")
+        if not ns:
+            return
+        now = time.time()
+        rows = []
+        for key, blob in list(ns.items()):
+            try:
+                ts = float(json.loads(bytes(blob).decode())
+                           .get("ts") or 0.0)
+            except (ValueError, TypeError):
+                ts = 0.0
+            rows.append((key, ts))
+        rows.sort(key=lambda kv: kv[1])
+        drop = len(rows) - self.FLIGHTRING_MAX_ENTRIES
+        for key, ts in rows:
+            if drop > 0 or now - ts > self.FLIGHTRING_MAX_AGE_S:
+                ns.pop(key, None)
+                drop -= 1
+
+    def _shipped_ring_entries(self, live_entries) -> list:
+        """Shipped flight-recorder ring tails (KV ns "flightring") for
+        processes the fan-out could NOT reach — a SIGKILL'd worker's
+        last error-severity window survives here. Processes that
+        answered live supersede their shipped (older) copy; stale
+        entries age out (_prune_flightring) so churn can't bury the
+        ring that matters."""
+        self._prune_flightring()
+        reached = {e.get("source") for e in live_entries
+                   if not e.get("error")}
+        # Live drivers ship rings too (same error-event trigger) but
+        # are not fan-out targets — they splice themselves into dumps
+        # client-side. Their shipped copy must not masquerade as a
+        # dead worker's.
+        live_driver_wids = {job.get("worker_id")
+                            for job in self.jobs.values()
+                            if job.get("state") == "RUNNING"}
+        out = []
+        for key, blob in list(self.kv.get("flightring", {}).items()):
+            try:
+                wid = bytes(key).decode().split(":", 1)[1]
+            except (IndexError, UnicodeDecodeError):
+                continue
+            if f"worker:{wid}" in reached or wid in live_driver_wids:
+                continue
+            try:
+                data = json.loads(bytes(blob).decode())
+            except ValueError:
+                continue
+            out.append({
+                "source": f"shipped:worker:{wid}",
+                "worker_id": wid,
+                "shipped": True,
+                "pid": data.get("pid"),
+                "node_id": data.get("node_id"),
+                "ts": data.get("ts"),
+                "events": data.get("events", []),
+                "stacks": {},
+            })
+        return out
+
+    async def h_profile_capture_cluster(self, conn, payload):
+        """Fan the ``profile_capture`` sampling window out — to one
+        worker (``kind=worker``), the worker running a task
+        (``kind=task``, resolved through the task-event store), an
+        actor's worker (``kind=actor``), or every reachable process
+        plus this head itself (``kind=all``). Unreachable peers come
+        back as error entries, mirroring debug_dump_cluster."""
+        payload = payload or {}
+        kind = payload.get("kind", "all")
+        if kind not in ("worker", "task", "actor", "all"):
+            # Reject, don't default: a typo'd kind from the unvalidated
+            # HTTP surface must not fan a sampling window out to every
+            # process.
+            return {"entries": [], "error":
+                    f"unknown kind {kind!r} (worker|task|actor|all)"}
+        ident = (payload.get("id") or "").lower()
+        req = {
+            "duration_s": float(payload.get("duration_s", 5.0)),
+            "hz": float(payload.get("hz", 100.0)),
+        }
+        timeout = req["duration_s"] + float(
+            payload.get("timeout_s", 10.0))
+
+        def live_workers(prefix=None):
+            found = []
+            for h in self.pool.workers.values():
+                c = h.connection
+                if c is None or getattr(c, "closed", False):
+                    continue
+                if prefix and not h.worker_id.hex().startswith(prefix):
+                    continue
+                found.append((f"worker:{h.worker_id.hex()}",
+                              h.node_id.hex(), c))
+            return found
+
+        targets = []
+        if kind == "worker":
+            if not ident:
+                return {"entries": [], "error": "worker id required"}
+            targets = live_workers(ident)
+            if not targets:
+                return {"entries": [], "error":
+                        f"no live worker with id prefix {ident!r}"}
+        elif kind == "actor":
+            if not ident:
+                return {"entries": [], "error": "actor id required"}
+            wid = None
+            for actor_id, info in self.actors.items():
+                if (actor_id.hex().startswith(ident)
+                        and info.address is not None):
+                    wid = info.address.worker_id_hex
+                    break
+            if wid is None:
+                return {"entries": [], "error":
+                        f"no live actor with id prefix {ident!r}"}
+            targets = live_workers(wid)
+            if not targets:
+                return {"entries": [], "error":
+                        f"actor {ident[:16]}'s worker {wid[:12]} is "
+                        "not reachable"}
+        elif kind == "task":
+            if not ident:
+                return {"entries": [], "error": "task id required"}
+            wid = None
+            state = None
+            for ev in reversed(self.task_events):
+                if (ev.get("task_id", "").startswith(ident)
+                        and ev.get("worker_id")):
+                    wid, state = ev["worker_id"], ev.get("state")
+                    break
+            if wid is None:
+                return {"entries": [], "error":
+                        f"no task event with id prefix {ident!r} names "
+                        "a worker (wrong id, or events rotated out)"}
+            targets = live_workers(wid)
+            if not targets:
+                return {"entries": [], "error":
+                        f"task {ident[:16]}'s worker {wid[:12]} "
+                        f"(last state {state}) is not reachable"}
+        else:  # all
+            targets = live_workers()
+            for node_id, agent in self._node_agents.items():
+                if not getattr(agent, "closed", False):
+                    targets.append((f"agent:{node_id.hex()}",
+                                    node_id.hex(), agent))
+
+        async def one(source, node_hex, c):
+            try:
+                rep = await c.call("profile_capture", req,
+                                   timeout=timeout)
+                rep["source"] = source
+                rep.setdefault("node_id", node_hex)
+                return rep
+            except Exception as e:  # noqa: BLE001 — capture must survive peers
+                return {"source": source, "node_id": node_hex,
+                        "error": f"{type(e).__name__}: {e}"}
+
+        gathered = asyncio.gather(*(one(*t) for t in targets))
+        if kind == "all":
+            from ray_tpu.util import profiler
+
+            head_cap, entries = await asyncio.gather(
+                asyncio.get_running_loop().run_in_executor(
+                    None, lambda: profiler.capture(**req)),
+                gathered)
+            head_cap["source"] = "head"
+            head_cap["node_id"] = (self.default_node_id.hex()
+                                   if hasattr(self, "default_node_id")
+                                   else None)
+            entries = [head_cap] + list(entries)
+        else:
+            entries = list(await gathered)
+        return {"entries": entries, "ts": time.time(), **req}
 
     async def h_debug_sched_state(self, conn, payload):
         """The scheduler's live waiting state, for the `why` explainer:

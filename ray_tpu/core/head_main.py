@@ -63,6 +63,11 @@ def main():
                     host=args.host, port=args.port)
     print(f"ray_tpu head listening on {args.host}:{node.port} "
           f"(session {node.session_dir})", flush=True)
+    # Live profiling plane: a standalone head samples itself too when
+    # the continuous mode is configured on.
+    from ray_tpu.util import profiler
+
+    profiler.maybe_start_continuous()
 
     client_srv = None
     if args.client_server_port is not None:

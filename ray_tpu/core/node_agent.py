@@ -108,6 +108,7 @@ class NodeAgent:
             "pull_object": self.h_pull_object,
             "shutdown_node": self.h_shutdown_node,
             "debug_dump": self.h_debug_dump,
+            "profile_capture": self.h_profile_capture,
             **object_transfer.serve_handlers(),
         }
 
@@ -128,6 +129,19 @@ class NodeAgent:
         if payload.get("include_events", True):
             out["events"] = flight_recorder.snapshot(
                 limit=payload.get("event_limit"))
+        return out
+
+    async def h_profile_capture(self, conn, payload):
+        """The agent's slice of the live profiling plane: sample its
+        own threads (pull pump, log tailer, health channel) off-loop."""
+        payload = payload or {}
+        from ray_tpu.util import profiler
+
+        duration = float(payload.get("duration_s", 5.0))
+        hz = float(payload.get("hz", 100.0))
+        out = await asyncio.get_running_loop().run_in_executor(
+            None, lambda: profiler.capture(duration, hz))
+        out.update(mode="agent", node_id=self.node_id_hex)
         return out
 
     async def h_pull_object(self, conn, payload):
@@ -510,9 +524,10 @@ def main():
     p.add_argument("--resources", default=None,
                    help='extra custom resources as JSON, e.g. \'{"hostB":1}\'')
     args = p.parse_args()
-    from ray_tpu.util import flight_recorder
+    from ray_tpu.util import flight_recorder, profiler
 
     flight_recorder.install_crash_handler()
+    profiler.maybe_start_continuous()
     try:
         code = asyncio.run(_amain(args))
     except KeyboardInterrupt:

@@ -516,13 +516,19 @@ class ClusterScheduler:
         for lease in self.pending:
             if lease.future.done():
                 continue  # cancelled
+            # PG-scheduled leases tag their placement group so the
+            # `why placement-group` explainer can find this evidence
+            # by id, not by substring luck.
+            pg_hex = getattr(lease.spec.scheduling_strategy,
+                             "placement_group_id_hex", None)
             try:
                 picked = self._pick_node(lease)
             except ValueError as e:
                 flight_recorder.record(
                     "sched", "lease_infeasible", severity="error",
                     task=lease.spec.task_id.hex()[:16],
-                    name=lease.spec.name, reason=str(e))
+                    name=lease.spec.name, reason=str(e),
+                    pg=pg_hex[:16] if pg_hex else "")
                 lease.future.set_exception(e)
                 continue
             if picked is None:
@@ -533,7 +539,8 @@ class ClusterScheduler:
                     flight_recorder.record(
                         "sched", "lease_wait", severity="warn",
                         task=lease.spec.task_id.hex()[:16],
-                        name=lease.spec.name, reason=lease.wait_reason)
+                        name=lease.spec.name, reason=lease.wait_reason,
+                        pg=pg_hex[:16] if pg_hex else "")
                 remaining.append(lease)
                 continue
             node, pg_id, bundle_index = picked

@@ -483,6 +483,13 @@ class Executor:
         self.cw.set_current_task_id(tid)
         self._running_threads[tid.hex()] = threading.get_ident()
         self.cw.record_task_event(spec, "RUNNING")
+        # Live profiling plane: publish what this thread is executing so
+        # sampled stacks are bucketed per task (util/profiler.py).
+        from ray_tpu.util import profiler as _profiler
+
+        _prof_token = _profiler.push_thread_context(
+            task=tid.hex()[:16], name=spec.name or tid.hex()[:8],
+            actor=spec.actor_id.hex()[:12] if spec.actor_id else "")
         undo_env = lambda: None  # noqa: E731
         try:
             if tid.hex() in self._cancelled_tasks:
@@ -584,6 +591,7 @@ class Executor:
             # a reused worker doesn't leak one task's env into the next.
             if spec.task_type == TaskType.NORMAL_TASK:
                 undo_env()
+            _profiler.pop_thread_context(_prof_token)
             self._running_threads.pop(tid.hex(), None)
             self._cancelled_tasks.discard(tid.hex())
             self.cw.set_current_task_id(None)
@@ -592,6 +600,17 @@ class Executor:
         """Async-actor path: methods may be coroutines."""
         self.cw.set_current_task_id(spec.task_id)
         self.cw.record_task_event(spec, "RUNNING")
+        # Token-based context (not LIFO): interleaved coroutines share
+        # this loop thread, so each removes exactly its own entry. A
+        # sampled loop-thread stack attributes to the most recently
+        # entered task — approximate under concurrency, exact when one
+        # method (a jit warmup, a blocking build) pins the loop.
+        from ray_tpu.util import profiler as _profiler
+
+        _prof_token = _profiler.push_thread_context(
+            task=spec.task_id.hex()[:16],
+            name=spec.name or spec.task_id.hex()[:8],
+            actor=spec.actor_id.hex()[:12] if spec.actor_id else "")
         try:
             args, kwargs = await asyncio.get_running_loop().run_in_executor(
                 None, lambda: self._resolve_args(spec)
@@ -623,6 +642,7 @@ class Executor:
             # ROUTINE terminal path for serve streams (every client
             # disconnect), so a leftover entry per cancelled task would
             # grow this set unboundedly on long-lived async replicas.
+            _profiler.pop_thread_context(_prof_token)
             self._cancelled_tasks.discard(spec.task_id.hex())
             self.cw.set_current_task_id(None)
 
@@ -1170,9 +1190,17 @@ def main():
     from ray_tpu.util import flight_recorder
 
     flight_recorder.install_crash_handler()
-    # On-demand worker profiling (reference: profile_manager.py's
-    # py-spy hooks): RAY_TPU_WORKER_PROFILE=<path> dumps cProfile
-    # stats for the event loop (and .sync for the executor thread).
+    # Live profiling plane: the always-on low-Hz sampler when
+    # profiler_continuous_enabled is set (on-demand captures need no
+    # standing thread — they are served by the profile_capture RPC).
+    from ray_tpu.util import profiler as _profiler
+
+    _profiler.maybe_start_continuous()
+    # DEPRECATED startup-only cProfile hook: RAY_TPU_WORKER_PROFILE
+    # predates the live profiling plane (`ray_tpu profile ...` /
+    # profile_capture RPC) and only covers process lifetime with
+    # cProfile's tracing overhead. Kept for raw callgrind-style stats;
+    # prefer the sampler for everything else.
     prof_path = os.environ.get("RAY_TPU_WORKER_PROFILE")
     if prof_path:
         import cProfile

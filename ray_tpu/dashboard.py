@@ -40,6 +40,8 @@ class DashboardActor:
         app.router.add_get("/api/autoscaler", self._autoscaler)
         app.router.add_get("/debug", self._debug)
         app.router.add_get("/api/debug", self._debug)
+        app.router.add_get("/profile", self._profile)
+        app.router.add_get("/api/profile", self._profile)
         app.router.add_get("/metrics", self._metrics)
         app.router.add_get("/healthz", self._healthz)
         self._runner = web.AppRunner(app)
@@ -134,6 +136,49 @@ class DashboardActor:
             return out
 
         return await self._json(produce)
+
+    async def _profile(self, request):
+        """On-demand cluster sampling profile — the HTTP face of
+        ``ray_tpu profile``. Query params: ``kind`` (worker / task /
+        actor / all), ``id``, ``duration`` (s, capped), ``hz``, and
+        ``format=json|html`` (html renders the merged flamegraph)."""
+        from aiohttp import web
+
+        from ray_tpu.util import profiler
+        from ray_tpu.util.state import _call
+
+        kind = request.query.get("kind", "all")
+        ident = request.query.get("id", "")
+        fmt = request.query.get("format", "json")
+        try:
+            duration = min(float(request.query.get("duration", 2.0)),
+                           60.0)
+            hz = min(float(request.query.get("hz", 100.0)), 1000.0)
+        except ValueError as e:
+            # Malformed query numbers are the caller's error, not a 500.
+            return web.json_response({"error": str(e)}, status=400)
+        loop = asyncio.get_event_loop()
+        try:
+            reply = await loop.run_in_executor(
+                None, lambda: _call("profile_capture_cluster", {
+                    "kind": kind, "id": ident,
+                    "duration_s": duration, "hz": hz}))
+            if reply.get("error"):
+                # Never render a capture error as an empty 0-sample
+                # flamegraph — surface it regardless of format.
+                return web.json_response({"error": reply["error"]},
+                                         status=400)
+            if fmt == "html":
+                merged = profiler.merge_folded(
+                    [e for e in reply.get("entries", [])
+                     if not e.get("error")])
+                html = profiler.flamegraph_html(
+                    merged, title=f"ray_tpu profile {kind} {ident}")
+                return web.Response(text=html,
+                                    content_type="text/html")
+            return web.json_response(reply)
+        except Exception as e:
+            return web.json_response({"error": str(e)}, status=500)
 
     async def _metrics(self, request):
         from aiohttp import web

@@ -169,6 +169,45 @@ def cmd_debug(args):
         ray_tpu.shutdown()
 
 
+def cmd_profile(args):
+    """Live profiling plane: fan the sampling profiler out over the
+    cluster (reference: the dashboard's py-spy capture buttons /
+    `ray stack`, as a CLI) and write folded stacks + flamegraph HTML."""
+    ray_tpu = _attach()
+    from ray_tpu.util import profiler
+
+    kind = "all" if args.kind == "cluster" else args.kind
+    if kind != "all" and not args.id:
+        print(f"profile {args.kind} requires an id", file=sys.stderr)
+        sys.exit(2)
+    try:
+        print(f"sampling {args.kind} "
+              f"{args.id or ''} for {args.duration:g}s at "
+              f"{args.hz:g} Hz ...", flush=True)
+        reply = profiler.capture_cluster(
+            kind, args.id, duration_s=args.duration, hz=args.hz)
+        if reply.get("error"):
+            print(f"error: {reply['error']}", file=sys.stderr)
+            sys.exit(1)
+        manifest = profiler.write_profile_outputs(
+            reply, args.out,
+            title=f"ray_tpu profile {args.kind} {args.id or ''}".strip())
+        print(f"wrote profile to {args.out} "
+              f"({manifest['samples']} samples from "
+              f"{len(manifest['sources'])} process(es))")
+        print(f"  flamegraph: {manifest['flamegraph']}")
+        buckets = sorted(manifest["tasks"].items(),
+                         key=lambda kv: -kv[1].get("samples", 0))
+        for ident, bucket in buckets[:10]:
+            print(f"  {bucket.get('samples', 0):>6} samples  "
+                  f"{bucket.get('name', '?')} ({ident}) "
+                  f"on {bucket.get('source', '?')}")
+        if manifest["errors"]:
+            print(f"  unreachable: {json.dumps(manifest['errors'])}")
+    finally:
+        ray_tpu.shutdown()
+
+
 def cmd_list(args):
     ray_tpu = _attach()
     from ray_tpu.util import state as ust
@@ -325,11 +364,32 @@ def main(argv=None):
     d.add_argument("--timeout", type=float, default=10.0)
     d.set_defaults(fn=cmd_debug)
     d = dsub.add_parser(
-        "why", help="explain why a task/actor/object is in its state")
-    d.add_argument("kind", choices=["task", "actor", "object"])
+        "why", help="explain why a task/actor/object/placement-group "
+        "is in its state")
+    d.add_argument("kind", choices=["task", "actor", "object",
+                                    "placement-group"])
     d.add_argument("id", help="full or prefix hex id")
     d.add_argument("--timeout", type=float, default=5.0)
     d.set_defaults(fn=cmd_debug)
+
+    p = sub.add_parser(
+        "profile", help="on-demand cluster sampling profiler "
+        "(folded stacks + flamegraph HTML, task-attributed)")
+    p.add_argument("kind", choices=["worker", "task", "actor",
+                                    "cluster"],
+                   help="what to sample: one worker, the worker "
+                   "running a task, an actor's worker, or every "
+                   "process")
+    p.add_argument("id", nargs="?", default=None,
+                   help="full or prefix hex id (not needed for "
+                   "'cluster')")
+    p.add_argument("--duration", type=float, default=10.0,
+                   help="sampling window in seconds")
+    p.add_argument("--hz", type=float, default=100.0,
+                   help="sampling rate")
+    p.add_argument("--out", "-o", default="ray_tpu_profile",
+                   help="output directory")
+    p.set_defaults(fn=cmd_profile)
 
     p = sub.add_parser("submit", help="submit a job")
     p.add_argument("--working-dir", default=None)

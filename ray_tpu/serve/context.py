@@ -12,6 +12,9 @@ class RequestContext:
     multiplexed_model_id: str = ""
     route: str = ""
     deployment: str = ""
+    # Replica-assigned id for this request — correlates replica logs,
+    # profiler attribution buckets, and streamed responses.
+    request_id: str = ""
 
 
 _request_context: contextvars.ContextVar = contextvars.ContextVar(

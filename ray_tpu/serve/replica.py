@@ -98,9 +98,10 @@ class Replica:
         two lanes differ only in how they execute the callable. Yields
         a one-slot dict; set ``scope["status"] = "ok"`` on success."""
         import contextlib
+        import uuid
 
         from ray_tpu.serve import context as _ctx
-        from ray_tpu.util import telemetry, tracing
+        from ray_tpu.util import profiler, telemetry, tracing
 
         model_id = kwargs.pop("__serve_multiplexed_model_id", "")
         trace_ctx = kwargs.pop("__serve_trace_ctx", None)
@@ -116,15 +117,24 @@ class Replica:
                     # path).
                     tracing.setup_tracing("ray_tpu.serve.replica")
                     stack.enter_context(tracing.span(label, trace_ctx))
+                request_id = uuid.uuid4().hex[:12]
                 _ctx._set_request_context(_ctx.RequestContext(
                     multiplexed_model_id=model_id,
-                    deployment=self.deployment_name))
+                    deployment=self.deployment_name,
+                    request_id=request_id))
+                # Profiler attribution: sampled stacks of this request
+                # land under serve:<deployment> with the request id.
+                prof_token = profiler.push_thread_context(
+                    serve_request=request_id,
+                    name=f"serve:{self.deployment_name}",
+                    deployment=self.deployment_name)
                 self.num_ongoing += 1
                 t0 = time.perf_counter()
                 scope = {"status": "error"}
                 try:
                     yield scope
                 finally:
+                    profiler.pop_thread_context(prof_token)
                     self.num_ongoing -= 1
                     self.total_served += 1
                     telemetry.inc(

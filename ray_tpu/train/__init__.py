@@ -19,7 +19,9 @@ from ray_tpu.train.session import (
     get_checkpoint,
     get_context,
     get_dataset_shard,
+    instrument_step,
     report,
+    step_phase,
 )
 from ray_tpu.train.predictor import JaxPredictor, predict_dataset
 from ray_tpu.train.trainer import DataParallelTrainer, JaxTrainer
@@ -44,6 +46,8 @@ __all__ = [
     "get_checkpoint",
     "get_context",
     "get_dataset_shard",
+    "instrument_step",
     "predict_dataset",
     "report",
+    "step_phase",
 ]

@@ -53,6 +53,9 @@ class _GangHealthMonitor(threading.Thread):
         #: set on abort (queried while ranks are alive, because a dead
         #: rank can no longer be asked).
         self.seen_groups: set = set()
+        #: rank -> (step, phase) last published to the timeline; one
+        #: train/step:r<rank> lane marker per CHANGE, not per sweep.
+        self._published: Dict[int, tuple] = {}
 
     def stop(self) -> None:
         self._stop.set()
@@ -107,14 +110,65 @@ class _GangHealthMonitor(threading.Thread):
                     continue
                 self._misses[rank] = 0
                 self.seen_groups.update(hb.get("groups") or ())
+                self._publish_step_heartbeat(rank, hb)
                 if (hb.get("running") and self.hang_timeout_s
                         and hb.get("idle_s", 0.0) > self.hang_timeout_s):
+                    from ray_tpu.util import flight_recorder
+
+                    flight_recorder.record(
+                        "train", "step_heartbeat_stale",
+                        severity="error", rank=rank,
+                        step=hb.get("reports", 0),
+                        phase=hb.get("phase") or "",
+                        idle_s=round(hb["idle_s"], 1))
                     self._abort(
                         "hung", rank,
-                        f"rank {rank} hung in step {hb.get('reports', 0)}"
-                        f" (no progress for {hb['idle_s']:.1f}s, "
+                        f"{self._attribute_stall(rank, hb)} "
+                        f"(no progress for {hb['idle_s']:.1f}s, "
                         f"hang_timeout_s={self.hang_timeout_s:.1f})")
                     return
+
+    def _publish_step_heartbeat(self, rank: int, hb: Dict) -> None:
+        """Per-rank observability of the device step counter: the
+        staleness gauge every sweep, and a train/step:r<rank> timeline
+        marker whenever the (step, phase) pair advances."""
+        from ray_tpu.util import telemetry
+
+        telemetry.set_gauge(
+            "ray_tpu_train_step_heartbeat_age_seconds",
+            hb.get("idle_s", 0.0), {"rank": str(rank)})
+        step = hb.get("reports", 0)
+        phase = hb.get("phase") or ""
+        if self._published.get(rank) != (step, phase):
+            self._published[rank] = (step, phase)
+            telemetry.event(
+                f"train/step:r{rank}",
+                f"step {step} {phase or 'python'}",
+                args={"rank": rank, "step": step, "phase": phase})
+
+    @staticmethod
+    def _attribute_stall(rank: int, hb: Dict) -> str:
+        """Turn a stale heartbeat into a causal stall attribution using
+        the step phase the rank published host-side around its jitted
+        step (arXiv:2204.06514's separation: compile stall vs
+        collective stall vs input/python starvation)."""
+        step = hb.get("reports", 0)
+        phase = hb.get("phase") or ""
+        age = hb.get("phase_age_s", hb.get("idle_s", 0.0))
+        if phase == "compile":
+            return (f"rank {rank} hung compiling step {step} "
+                    f"(in the compile phase for {age:.1f}s — XLA "
+                    "compilation stall)")
+        if phase == "step":
+            return (f"rank {rank} hung: stalled in jitted step {step} "
+                    f"(in-step for {age:.1f}s — device or collective "
+                    "stall, not host python)")
+        if phase:
+            return (f"rank {rank} hung in {phase} phase of step {step} "
+                    f"(for {age:.1f}s)")
+        return (f"rank {rank} hung at python level in step {step} "
+                "(no device step phase active — host-side block, e.g. "
+                "input pipeline or a lock)")
 
     def _abort(self, kind: str, rank: int, message: str) -> None:
         if self._stop.is_set():

@@ -8,7 +8,9 @@ outbox, which the driver-side BackendExecutor streams via next_report().
 
 from __future__ import annotations
 
+import contextlib
 import dataclasses
+import functools
 import queue
 import threading
 import time
@@ -60,16 +62,34 @@ class _TrainSession:
         # last_activity its staleness clock (monotonic).
         self.report_count = 0
         self.last_activity = time.monotonic()
+        # Device step-counter heartbeat (live profiling plane): the
+        # train loop advances step_phase host-side around its jitted
+        # step (step_phase()/instrument_step below), so the gang
+        # monitor can attribute a stall to "compiling" vs "stuck in
+        # the jitted step (device/collective)" vs "blocked at python
+        # level" instead of a generic hang. "" = python-level code
+        # between phases.
+        self.step_phase = ""
+        self.phase_since = time.monotonic()
         # Chaos lane (util/chaos.py TrainWorkerKiller "hang" mode):
         # stalls the train loop inside report() WITHOUT blocking the
         # actor's RPC loop, so heartbeats stay healthy while progress
         # stops — exactly the signature of a wedged collective/device.
         self.chaos_hang_until = 0.0
 
+    def set_phase(self, phase: str) -> None:
+        self.step_phase = phase
+        self.phase_since = time.monotonic()
+
     def report(self, metrics: Dict[str, Any],
                checkpoint: Optional[Checkpoint] = None) -> None:
         from ray_tpu.util import telemetry
 
+        # Save/restore like step_phase(): report() may run INSIDE an
+        # enclosing phase context, and clobbering it to "" would
+        # misattribute a later stall in that context to python level.
+        prev_phase = self.step_phase
+        self.set_phase("report")
         while (time.monotonic() < self.chaos_hang_until
                and not self.stop_requested.is_set()):
             time.sleep(0.05)
@@ -83,6 +103,7 @@ class _TrainSession:
         self.report_count += 1
         self.last_activity = time.monotonic()
         self.outbox.put(("report", dict(metrics), checkpoint))
+        self.set_phase(prev_phase)
         # Cooperative early stop (Tune schedulers): raising here unwinds
         # the user loop; the executor turns it into a clean finish.
         if self.stop_requested.is_set():
@@ -140,3 +161,39 @@ def get_dataset_shard(name: str = "train"):
     if ds is None:
         raise KeyError(f"no dataset named {name!r} was passed to the trainer")
     return ds
+
+
+@contextlib.contextmanager
+def step_phase(phase: str):
+    """Mark the train loop as inside ``phase`` — the device
+    step-counter heartbeat the gang health monitor reads. Use
+    ``"compile"`` around explicit AOT compilation and ``"step"`` around
+    the jitted step call (or wrap the step with ``instrument_step``,
+    which does both); a rank that wedges inside the context is then
+    attributed to that phase instead of a generic hang."""
+    sess = _get_session()
+    prev = sess.step_phase
+    sess.set_phase(phase)
+    try:
+        yield
+    finally:
+        sess.set_phase(prev)
+
+
+def instrument_step(step_fn):
+    """Wrap a (jitted) train-step callable for the device step-counter
+    heartbeat: the first call — where jit traces and XLA compiles — is
+    attributed to the ``compile`` phase, every later call to ``step``.
+    Advanced host-side around the call, so a wedged collective inside
+    the step shows up as stalled-in-step within the hang timeout."""
+    state = {"compiled": False}
+
+    @functools.wraps(step_fn)
+    def wrapped(*args, **kwargs):
+        phase = "step" if state["compiled"] else "compile"
+        with step_phase(phase):
+            out = step_fn(*args, **kwargs)
+        state["compiled"] = True
+        return out
+
+    return wrapped

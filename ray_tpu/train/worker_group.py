@@ -138,6 +138,11 @@ class TrainWorker:
             running=bool(thread is not None and thread.is_alive()),
             idle_s=time.monotonic() - sess.last_activity,
             groups=local_group_names(),
+            # Device step-counter heartbeat (session.step_phase /
+            # instrument_step): which phase of the step the loop is in
+            # and for how long — the monitor's hang attribution input.
+            phase=sess.step_phase,
+            phase_age_s=time.monotonic() - sess.phase_since,
         )
         return out
 

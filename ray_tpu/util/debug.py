@@ -81,15 +81,19 @@ def _jsonable_metrics(merged: Dict[str, dict]) -> Dict[str, dict]:
     return out
 
 
-def write_debug_bundle(out_dir: str, timeout_s: float = 10.0) -> dict:
+def write_debug_bundle(out_dir: str, timeout_s: float = 10.0,
+                       profile_duration_s: float = 1.0) -> dict:
     """Write a cluster-wide post-mortem bundle and return its manifest.
 
     Layout: ``rings/<source>.json``, ``stacks/<source>.txt``,
     ``state/{nodes,workers,actors,tasks,objects,placement_groups,
     jobs}.json``, ``sched_state.json``, ``metrics.json``,
-    ``timeline.json``, ``manifest.json``. Sections that fail (a dead
-    subsystem is exactly when you need the rest) are recorded in the
-    manifest's ``errors`` instead of aborting the bundle."""
+    ``timeline.json``, ``profile/`` (a short cluster-wide sampling
+    capture: per-source folded stacks + flamegraph HTML;
+    ``profile_duration_s=0`` skips it), ``manifest.json``. Sections
+    that fail (a dead subsystem is exactly when you need the rest) are
+    recorded in the manifest's ``errors`` instead of aborting the
+    bundle."""
     os.makedirs(out_dir, exist_ok=True)
     manifest: Dict[str, Any] = {"created": time.time(), "errors": {},
                                 "sources": [], "nodes": []}
@@ -102,9 +106,19 @@ def write_debug_bundle(out_dir: str, timeout_s: float = 10.0) -> dict:
     nodes_seen = set()
     for entry in dump["entries"]:
         source = _sanitize(entry.get("source", "unknown"))
-        manifest["sources"].append(entry.get("source", "unknown"))
         if entry.get("node_id"):
             nodes_seen.add(entry["node_id"])
+        if entry.get("shipped"):
+            # A dead process's shipped ring tail: ring evidence only —
+            # no live stacks exist for it, so it files under rings/
+            # and its own manifest list, not sources.
+            manifest.setdefault("shipped", []).append(
+                entry.get("source", "unknown"))
+            with open(os.path.join(rings_dir, f"{source}.json"),
+                      "w") as f:
+                json.dump(entry, f, indent=1)
+            continue
+        manifest["sources"].append(entry.get("source", "unknown"))
         if entry.get("error"):
             manifest["errors"][source] = entry["error"]
             continue
@@ -150,6 +164,25 @@ def write_debug_bundle(out_dir: str, timeout_s: float = 10.0) -> dict:
         except Exception as e:  # noqa: BLE001
             manifest["errors"][name] = f"{type(e).__name__}: {e}"
 
+    if profile_duration_s and profile_duration_s > 0:
+        # A short sampling window across every process: "what was
+        # everyone DOING" alongside the point-in-time stacks.
+        try:
+            from ray_tpu.util import profiler
+
+            reply = profiler.capture_cluster(
+                "all", duration_s=profile_duration_s, hz=50.0)
+            prof = profiler.write_profile_outputs(
+                reply, os.path.join(out_dir, "profile"),
+                title="debug bundle profile")
+            manifest["profile"] = {
+                "sources": prof["sources"],
+                "samples": prof["samples"],
+                "unreachable": prof["errors"],
+            }
+        except Exception as e:  # noqa: BLE001
+            manifest["errors"]["profile"] = f"{type(e).__name__}: {e}"
+
     with open(os.path.join(out_dir, "manifest.json"), "w") as f:
         json.dump(manifest, f, indent=1)
     return manifest
@@ -172,10 +205,11 @@ def _timeline_json():
 # ---------------------------------------------------------------------------
 
 def why(kind: str, ident: str, timeout_s: float = 5.0) -> str:
-    """Explain a task/actor/object's current state causally. ``ident``
-    is a full or prefix hex id (objects need the full hex to consult
-    the directory). One cluster-wide ring fetch serves every evidence
-    trail the explanation needs (including the object→task recursion)."""
+    """Explain a task/actor/object/placement-group's current state
+    causally. ``ident`` is a full or prefix hex id (objects need the
+    full hex to consult the directory). One cluster-wide ring fetch
+    serves every evidence trail the explanation needs (including the
+    object→task recursion)."""
     kind = kind.lower()
     ident = ident.lower()
     try:
@@ -189,7 +223,10 @@ def why(kind: str, ident: str, timeout_s: float = 5.0) -> str:
         return "\n".join(_why_actor(ident, dump))
     if kind == "object":
         return "\n".join(_why_object(ident, dump))
-    raise ValueError(f"unknown kind {kind!r} (task|actor|object)")
+    if kind in ("placement-group", "placement_group", "pg"):
+        return "\n".join(_why_pg(ident, dump))
+    raise ValueError(
+        f"unknown kind {kind!r} (task|actor|object|placement-group)")
 
 
 def _matching_flight_events(tag_key: str, ident: str, dump: dict,
@@ -319,6 +356,67 @@ def _why_actor(ident: str, dump: dict) -> List[str]:
         lines.append(f"  running on worker {a['address'][2][:12]} "
                      f"at {a['address'][0]}:{a['address'][1]}")
     trail = _matching_flight_events("actor", ident, dump)
+    if trail:
+        lines.append("recorded events:")
+        lines.extend(trail)
+    return lines
+
+
+def _mentioning_flight_events(needle: str, dump: dict,
+                              limit: int = 12) -> List[str]:
+    """Recorded events whose tag VALUES mention an id prefix anywhere —
+    PG involvement usually rides inside wait-reason / message text
+    rather than a dedicated tag."""
+    rows = []
+    for entry in dump["entries"]:
+        for ev in entry.get("events") or []:
+            tags = ev.get("tags") or {}
+            if any(needle in str(v) for v in tags.values()):
+                rows.append((ev["ts"], entry.get("source", "?"), ev))
+    rows.sort(key=lambda r: r[0])
+    out = []
+    for ts, source, ev in rows[-limit:]:
+        detail = ", ".join(f"{k}={v}"
+                           for k, v in (ev.get("tags") or {}).items())
+        stamp = time.strftime("%H:%M:%S", time.localtime(ts))
+        out.append(f"  [{stamp}] {source}: {ev['subsystem']}/"
+                   f"{ev['event']}" + (f" ({detail})" if detail else ""))
+    return out
+
+
+def _why_pg(ident: str, dump: dict) -> List[str]:
+    """Walk a placement group's bundle placement + the leases waiting
+    on it + recorded lease_infeasible/lease_wait evidence."""
+    lines: List[str] = []
+    sched = _call("debug_sched_state")
+    pgs = [pg for pg in sched.get("pgs", [])
+           if pg["pg_id"].startswith(ident)]
+    if not pgs:
+        return [f"no placement group with id prefix {ident!r}"]
+    pg = pgs[0]
+    pg_hex = pg["pg_id"]
+    name = pg.get("name") or pg_hex[:16]
+    lines.append(f"placement group {name} is {pg['state']} "
+                 f"({pg['bundles_placed']}/{pg['bundles']} bundles "
+                 f"placed, strategy {pg['strategy']})")
+    if pg["bundles_placed"] < pg["bundles"]:
+        lines.append(f"  {pg['bundles'] - pg['bundles_placed']} "
+                     "bundle(s) unplaced — cluster capacity below the "
+                     "gang's demand or fragmented across nodes")
+        lines.append(f"  cluster: {_cluster_availability_line(sched)}")
+    # Leases parked against (or waiting for) THIS PG: involvement shows
+    # up in the scheduler's wait-reason text (the sched-state rows
+    # carry only the strategy type name, not the PG id, so a bare
+    # strategy match would drag in other PGs' leases).
+    waiting = [p for p in sched.get("pending", [])
+               if pg_hex[:8] in (p.get("wait_reason") or "")]
+    for p in waiting:
+        what = "actor creation" if p["is_actor_creation"] else "task"
+        lines.append(f"  pending {what} {p['name'] or p['task_id'][:16]}"
+                     f" (queued {p['age_s']:.1f}s): "
+                     f"{p['wait_reason'] or 'not yet evaluated'}")
+    trail = (_matching_flight_events("pg", pg_hex[:8], dump)
+             + _mentioning_flight_events(pg_hex[:8], dump))
     if trail:
         lines.append("recorded events:")
         lines.extend(trail)

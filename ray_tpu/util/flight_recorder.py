@@ -64,9 +64,12 @@ CATALOG: Dict[str, tuple] = {
             "node_reattached", "worker_dead", "actor_state"),
     # collective/collective.py
     "collective": ("group_created", "group_destroyed"),
-    # train/backend_executor.py + train/trainer.py
+    # train/backend_executor.py + train/trainer.py;
+    # "step_heartbeat_stale" is the gang monitor attributing a stale
+    # device step-counter heartbeat (step + phase in the tags) right
+    # before the hang abort fires.
     "train": ("heartbeat_miss", "gang_abort", "gang_restart",
-              "elastic_resize"),
+              "elastic_resize", "step_heartbeat_stale"),
     # serve/router.py (streaming lifecycle rides the router — it sees
     # both the HTTP proxy's streams and driver-side handle streams);
     # "autoscale" is recorded by the controller on every replica-target
@@ -79,6 +82,13 @@ CATALOG: Dict[str, tuple] = {
     "engine": ("admitted", "evicted"),
     # the debug plane itself (util/flight_recorder.py)
     "debug": ("postmortem",),
+    # live profiling plane (util/profiler.py): an on-demand capture
+    # window completed in this process.
+    "profile": ("captured",),
+    # ring shipping (this module): this process's ring tail was pushed
+    # to the head KV after a severity>=error event, so a later SIGKILL
+    # still leaves evidence in debug_dump_cluster.
+    "fr": ("ring_shipped",),
     # swallowed-exception audit (tools/analysis silent-except checker):
     # sites converted from `except Exception: pass` record the error
     # they drop here, so "nothing happened" still leaves evidence.
@@ -143,13 +153,17 @@ def record(subsystem: str, event: str, severity: str = INFO,
     """Append one event. ``subsystem`` and ``event`` MUST be literal
     names from ``CATALOG`` (lint-enforced); variable detail rides in
     ``tags``. Hot-path cost when enabled: one time() call + one atomic
-    deque append; when disabled: one cached bool check."""
+    deque append; when disabled: one cached bool check. Error-severity
+    events additionally request a ring ship to the head (rare by
+    construction, and throttled by the metrics push window)."""
     if not enabled():
         return
     ring = _ring
     if ring is None:
         ring = _get_ring()
     ring.append((time.time(), subsystem, event, severity, tags or None))
+    if severity == ERROR:
+        _request_ship()
 
 
 def swallow(site: str, error: BaseException,
@@ -204,13 +218,108 @@ def _coerce(value: Any):
 
 def reset_for_testing(capacity: Optional[int] = None) -> None:
     """Drop cached state; optionally pin a new ring capacity."""
-    global _enabled, _ring
+    global _enabled, _ring, _ship_pending
     with _setup_lock:
         _enabled = None
+        _ship_pending = False
         if capacity is not None:
             _ring = collections.deque(maxlen=max(1, capacity))
         else:
             _ring = None
+
+
+# ---------------------------------------------------------------------------
+# ring shipping (evidence that survives SIGKILL)
+# ---------------------------------------------------------------------------
+#
+# The ring lives in process memory, so a SIGKILL'd worker used to take
+# its evidence with it. On any severity>=error event the ring TAIL is
+# shipped to the head KV (namespace "flightring", which the head keeps
+# past worker death) riding the metrics push throttle — a bounded batch
+# per window, no extra RPC cadence. ``debug_dump_cluster`` merges these
+# shipped rings for processes it can no longer reach.
+
+_SHIP_TAIL = 256
+_ship_pending = False
+_ship_hook_installed = False
+
+
+def _request_ship() -> None:
+    """Mark the ring dirty and nudge the metrics pusher; the actual
+    ship happens inside the (throttled) push, whose trailing flush
+    guarantees delivery within one interval."""
+    global _ship_pending
+    _ship_pending = True
+    try:
+        _install_ship_hook()
+        from ray_tpu.util import metrics as _metrics
+
+        _metrics._maybe_push()
+    except Exception:  # lint: allow-silent(recorder hot path must never raise)
+        pass
+
+
+def _install_ship_hook() -> None:
+    global _ship_hook_installed
+    if _ship_hook_installed:
+        return
+    _ship_hook_installed = True
+    from ray_tpu.util import metrics as _metrics
+
+    _metrics.register_push_hook(_ship_ring)
+
+
+def _ship_call(cw) -> tuple:
+    """(coroutine, event count) for one ring-tail ship — the single
+    place that knows the payload shape, key format, and namespace."""
+    payload = {
+        "pid": os.getpid(),
+        "node_id": os.environ.get("RAY_TPU_NODE_ID"),
+        "ts": time.time(),
+        "events": snapshot(limit=_SHIP_TAIL),
+    }
+    coro = cw.head.call("kv_put", {
+        "ns": "flightring",
+        "key": f"fr:{cw.worker_id.hex()}".encode(),
+        "value": json.dumps(payload).encode(),
+        "overwrite": True,
+    })
+    return coro, len(payload["events"])
+
+
+def _ship_ring(cw) -> None:
+    """Metrics push hook: ship this process's ring tail to the head KV
+    when an error event armed the flag (fire-and-forget on the loop
+    thread — the push path must not block on the head)."""
+    global _ship_pending
+    if not _ship_pending:
+        return
+    _ship_pending = False
+    try:
+        coro, n_events = _ship_call(cw)
+        cw.loop_thread.submit(coro)
+        record("fr", "ring_shipped", events=n_events)
+    except Exception as e:
+        swallow("flight_recorder.ship_ring", e)
+
+
+def ship_ring_now(timeout_s: float = 5.0) -> bool:
+    """Synchronously ship the ring tail (blocks until the head acks).
+    The deterministic variant for chaos hooks and tests — the throttled
+    path can't promise the write lands before a SIGKILL does."""
+    from ray_tpu.core.object_ref import get_core_worker
+
+    cw = get_core_worker()
+    if cw is None:
+        return False
+    try:
+        coro, n_events = _ship_call(cw)
+        cw.loop_thread.run(coro, timeout=timeout_s)
+    except Exception as e:
+        swallow("flight_recorder.ship_ring_now", e)
+        return False
+    record("fr", "ring_shipped", events=n_events)
+    return True
 
 
 # ---------------------------------------------------------------------------

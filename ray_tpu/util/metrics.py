@@ -257,7 +257,16 @@ def _maybe_push(force: bool = False, idle_skip: bool = False):
             sort_keys=True)
         if idle_skip and app_blob == _last_app_blob:
             # Trailing flush with nothing new beyond our own push
-            # traffic: quiesce (the next real record re-arms).
+            # traffic: skip the registry write, but still run the push
+            # hooks — a hook may have piggyback data armed inside the
+            # throttle window (the flight-recorder ring ship) whose
+            # delivery guarantee is exactly this flush. Then quiesce
+            # (the next real record re-arms).
+            for hook in list(_push_hooks):
+                try:
+                    hook(cw)
+                except Exception:  # lint: allow-silent(hooks are best-effort; a failing hook must not break the flush)
+                    pass
             return
         _last_push = now
         _last_app_blob = app_blob

@@ -1,0 +1,545 @@
+"""Live profiling plane: an in-process sampling profiler with
+task/step attribution.
+
+Reference: Ray's dashboard ships py-spy/memray capture buttons and
+``ray stack`` as its "what is this worker doing RIGHT NOW" story
+(dashboard/modules/reporter/profile_manager.py, arXiv:1712.05889);
+TPU training work shows per-step timing attribution is what separates
+"compile stall" from "collective stall" from "input starvation" when a
+pjit program wedges (arXiv:2204.06514). This module is the
+zero-dependency equivalent: a sampler thread reads
+``sys._current_frames()`` at a configurable Hz and aggregates folded
+stacks (``root;frame;frame`` → count, the flamegraph input format)
+with bounded memory.
+
+Two modes:
+
+- **on-demand** — ``capture(duration_s, hz)`` samples for a bounded
+  window and returns folded stacks + per-task attribution. The
+  ``profile_capture`` RPC (CoreWorker / node agent) runs it off-loop;
+  the head fans it out cluster-wide (``profile_capture_cluster``) for
+  ``ray_tpu profile worker|task|actor|cluster`` and ``GET /profile``.
+- **continuous** — ``maybe_start_continuous()`` starts an always-on
+  low-Hz background sampler (config ``profiler_continuous_enabled``)
+  that rewrites periodic folded snapshots into the session dir,
+  publishes a ``profile:<pid>`` timeline lane, and self-checks its
+  measured overhead against ``profiler_max_overhead_ratio`` (halving
+  its rate when it overshoots — the profiler must never become the
+  thing it profiles).
+
+Attribution: executors publish what each thread is doing
+(``push_thread_context(task=..., name=...)`` from the worker executor,
+``serve_request=...`` from Serve replicas, step phases from the train
+session) so every sampled stack lands under a ``task:<name>`` /
+``serve:<deployment>`` root instead of an anonymous thread, and the
+reply carries per-task sample buckets.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import sys
+import threading
+import time
+from typing import Any, Dict, List, Optional
+
+#: Frames kept per sampled stack (deep jax traces otherwise dominate
+#: the folded key space).
+MAX_DEPTH = 48
+#: Unique folded stacks retained per aggregation; the long tail folds
+#: into OVERFLOW_KEY so a pathological workload can't grow memory
+#: unboundedly.
+MAX_UNIQUE_STACKS = 4096
+OVERFLOW_KEY = "<overflow>"
+
+# ---------------------------------------------------------------------------
+# thread attribution registry
+# ---------------------------------------------------------------------------
+
+#: thread ident -> stack of label dicts. Only the owning thread mutates
+#: its own list (GIL-atomic dict ops); the sampler reads racily and
+#: tolerates a concurrent pop.
+_thread_labels: Dict[int, List[dict]] = {}
+
+
+def push_thread_context(**labels: Any) -> dict:
+    """Publish what the current thread is executing (task id/name,
+    serve request, ...). Returns a token for ``pop_thread_context`` —
+    tokens (not LIFO order) make this safe for interleaved coroutines
+    sharing one loop thread."""
+    stack = _thread_labels.setdefault(threading.get_ident(), [])
+    stack.append(labels)
+    return labels
+
+
+def pop_thread_context(token: Optional[dict] = None) -> None:
+    stack = _thread_labels.get(threading.get_ident())
+    if not stack:
+        return
+    if token is None:
+        stack.pop()
+        return
+    try:
+        stack.remove(token)
+    except ValueError:  # lint: allow-silent(token already popped — benign double-clear)
+        pass
+
+
+def current_thread_context() -> Optional[dict]:
+    stack = _thread_labels.get(threading.get_ident())
+    return stack[-1] if stack else None
+
+
+# ---------------------------------------------------------------------------
+# sampling core
+# ---------------------------------------------------------------------------
+
+def _add(counts: Dict[str, int], key: str, n: int = 1) -> None:
+    """Bounded folded-stack increment: beyond MAX_UNIQUE_STACKS new
+    keys collapse into OVERFLOW_KEY (existing keys keep counting)."""
+    if key in counts or len(counts) < MAX_UNIQUE_STACKS:
+        counts[key] = counts.get(key, 0) + n
+    else:
+        counts[OVERFLOW_KEY] = counts.get(OVERFLOW_KEY, 0) + n
+
+
+def _fold_frames(frame, max_depth: int = MAX_DEPTH) -> List[str]:
+    frames: List[str] = []
+    f = frame
+    while f is not None and len(frames) < max_depth:
+        code = f.f_code
+        frames.append(
+            f"{code.co_filename.rsplit('/', 1)[-1]}:{code.co_name}")
+        f = f.f_back
+    frames.reverse()
+    return frames
+
+
+def _sweep(counts: Dict[str, int], tasks: Dict[str, dict],
+           skip_ident: Optional[int]) -> int:
+    """Sample every live thread once into ``counts`` (folded) and
+    ``tasks`` (per-task sample buckets). Returns samples taken."""
+    thread_names = {t.ident: t.name for t in threading.enumerate()}
+    n = 0
+    for ident, frame in sys._current_frames().items():
+        if ident == skip_ident:
+            continue
+        try:
+            frames = _fold_frames(frame)
+        except Exception:  # lint: allow-silent(frame freed mid-walk — skip one sample)
+            continue
+        label = None
+        stack = _thread_labels.get(ident)
+        if stack:
+            try:
+                label = stack[-1]
+            except IndexError:  # lint: allow-silent(owner popped concurrently)
+                label = None
+        if label:
+            bucket = label.get("task") or label.get("serve_request") or ""
+            name = label.get("name") or bucket or "?"
+            # Names that carry their own kind prefix (Serve pushes
+            # "serve:<deployment>") keep it; plain task names get the
+            # task: root.
+            root = name if ":" in name else f"task:{name}"
+            if bucket:
+                entry = tasks.get(bucket)
+                if entry is None and len(tasks) < 512:
+                    entry = tasks[bucket] = dict(label, samples=0)
+                if entry is not None:
+                    entry["samples"] = entry.get("samples", 0) + 1
+        else:
+            root = f"thread:{thread_names.get(ident, ident)}"
+        _add(counts, ";".join([root] + frames) if frames else root)
+        n += 1
+    return n
+
+
+def capture(duration_s: float = 5.0, hz: float = 100.0) -> dict:
+    """On-demand sampling window over every thread of THIS process.
+    Blocks for ``duration_s`` (callers on an event loop must run it in
+    an executor); returns folded stacks, per-task attribution buckets
+    and the measured sampling-overhead ratio."""
+    duration_s = min(max(float(duration_s), 0.05), 600.0)
+    hz = min(max(float(hz), 1.0), 1000.0)
+    interval = 1.0 / hz
+    counts: Dict[str, int] = {}
+    tasks: Dict[str, dict] = {}
+    me = threading.get_ident()
+    t0 = time.monotonic()
+    deadline = t0 + duration_s
+    sample_time = 0.0
+    sweeps = 0
+    samples = 0
+    next_t = t0
+    while time.monotonic() < deadline:
+        s0 = time.perf_counter()
+        samples += _sweep(counts, tasks, me)
+        sample_time += time.perf_counter() - s0
+        sweeps += 1
+        next_t += interval
+        delay = next_t - time.monotonic()
+        if delay > 0:
+            time.sleep(delay)
+        else:
+            # Fell behind (slow sweep / busy host): re-anchor instead of
+            # spiraling into a zero-sleep loop.
+            next_t = time.monotonic()
+    elapsed = max(time.monotonic() - t0, 1e-9)
+    overhead = sample_time / elapsed
+    from ray_tpu.util import flight_recorder, telemetry
+
+    telemetry.inc("ray_tpu_profiler_samples_total", samples,
+                  {"mode": "on_demand"})
+    flight_recorder.record(
+        "profile", "captured", sweeps=sweeps, samples=samples,
+        duration_s=round(elapsed, 3), hz=hz,
+        overhead=round(overhead, 5))
+    return {
+        "pid": os.getpid(),
+        "ts": time.time(),
+        "duration_s": round(elapsed, 4),
+        "hz": hz,
+        "sweeps": sweeps,
+        "samples": samples,
+        "overhead_ratio": round(overhead, 5),
+        "folded": counts,
+        "tasks": tasks,
+    }
+
+
+# ---------------------------------------------------------------------------
+# folded-stack text + flamegraph HTML
+# ---------------------------------------------------------------------------
+
+def folded_text(folded: Dict[str, int]) -> str:
+    """The standard ``stack count`` lines (flamegraph.pl / speedscope
+    input), heaviest first."""
+    lines = [f"{stack} {count}" for stack, count in
+             sorted(folded.items(), key=lambda kv: -kv[1])]
+    return "\n".join(lines) + ("\n" if lines else "")
+
+
+def merge_folded(entries: List[dict]) -> Dict[str, int]:
+    """Merge per-process capture replies into one folded dict, each
+    stack rooted at its source (``worker:ab12...;task:f;...``)."""
+    merged: Dict[str, int] = {}
+    for entry in entries:
+        source = entry.get("source") or f"pid:{entry.get('pid', '?')}"
+        for stack, count in (entry.get("folded") or {}).items():
+            _add(merged, f"{source};{stack}", count)
+    return merged
+
+
+def _tree(folded: Dict[str, int]) -> dict:
+    root: dict = {"n": "all", "v": 0, "c": {}}
+    for stack, count in folded.items():
+        root["v"] += count
+        node = root
+        for part in stack.split(";"):
+            child = node["c"].get(part)
+            if child is None:
+                child = node["c"][part] = {"n": part, "v": 0, "c": {}}
+            child["v"] += count
+            node = child
+    def listify(node):
+        node["c"] = sorted((listify(ch) for ch in node["c"].values()),
+                           key=lambda ch: -ch["v"])
+        return node
+    return listify(root)
+
+
+_FLAME_TEMPLATE = """<!doctype html>
+<html><head><meta charset="utf-8"><title>%(title)s</title><style>
+body{font:12px monospace;margin:0;background:#1b1b1f;color:#ddd}
+#hdr{padding:8px 12px;border-bottom:1px solid #333}
+#fg{padding:8px 12px}
+.row{white-space:nowrap;height:18px}
+.fr{display:inline-block;height:16px;margin:1px 0 0 0;overflow:hidden;
+ vertical-align:top;border-radius:2px;cursor:pointer;color:#1b1b1f;
+ font-size:11px;padding:1px 0 0 3px;box-sizing:border-box}
+.fr:hover{filter:brightness(1.2)}
+#tip{padding:4px 12px;color:#9a9}
+</style></head><body>
+<div id="hdr">%(title)s &mdash; %(samples)s samples
+ (click a frame to zoom, click the root to reset)</div>
+<div id="fg"></div><div id="tip"></div>
+<script>
+var DATA=%(data)s;
+function color(name){
+ if(name.indexOf('task:')===0)return 'hsl(20,75%%,62%%)';
+ if(name.indexOf('thread:')===0)return 'hsl(210,45%%,62%%)';
+ if(name.indexOf('worker:')===0||name.indexOf('agent:')===0||
+    name.indexOf('head')===0)return 'hsl(260,35%%,66%%)';
+ var h=0;for(var i=0;i<name.length;i++)h=(h*31+name.charCodeAt(i))%%360;
+ return 'hsl('+h+',55%%,60%%)';}
+function render(root){
+ var fg=document.getElementById('fg');fg.innerHTML='';
+ var rows=[];
+ (function walk(node,depth,off){
+   if(!rows[depth])rows[depth]=[];
+   rows[depth].push({n:node.n,v:node.v,off:off,node:node});
+   var o=off;
+   node.c.forEach(function(ch){walk(ch,depth+1,o);o+=ch.v;});
+ })(root,0,0);
+ var total=root.v||1;
+ rows.forEach(function(row){
+   var div=document.createElement('div');div.className='row';
+   var cursor=0;
+   row.forEach(function(f){
+     var gap=(f.off-cursor)/total*100;
+     if(gap>0){var sp=document.createElement('span');
+       sp.className='fr';sp.style.width=gap+'%%';
+       sp.style.visibility='hidden';div.appendChild(sp);}
+     var w=f.v/total*100;
+     var el=document.createElement('span');el.className='fr';
+     el.style.width=w+'%%';el.style.background=color(f.n);
+     el.textContent=w>2?f.n:'';
+     el.title=f.n+' ('+f.v+' samples, '+(f.v/total*100).toFixed(1)+'%%)';
+     el.onclick=function(){render(f.node===root?DATA:f.node);
+       document.getElementById('tip').textContent=
+         'zoom: '+f.n+' ('+f.v+' samples)';};
+     div.appendChild(el);cursor=f.off+f.v;
+   });
+   fg.appendChild(div);
+ });}
+render(DATA);
+</script></body></html>
+"""
+
+
+def flamegraph_html(folded: Dict[str, int],
+                    title: str = "ray_tpu profile") -> str:
+    """A self-contained (no external assets) icicle-flamegraph HTML
+    page for a folded-stack dict. Title and frame names are attacker-
+    influenced (dashboard query params, user task names) — escape them
+    out of HTML/script contexts."""
+    import html as _html
+
+    tree = _tree(folded)
+    # <-escape so a frame named "</script>" cannot terminate the
+    # inline script block; the JS only ever assigns names via
+    # textContent/title, so no further escaping is needed client-side.
+    data = json.dumps(tree).replace("<", "\\u003c")
+    return _FLAME_TEMPLATE % {
+        "title": _html.escape(title),
+        "samples": tree["v"],
+        "data": data,
+    }
+
+
+# ---------------------------------------------------------------------------
+# continuous mode
+# ---------------------------------------------------------------------------
+
+class ContinuousSampler(threading.Thread):
+    """Always-on low-Hz sampler: aggregates folded stacks, rewrites a
+    per-process snapshot file every ``snapshot_interval_s``, emits a
+    ``profile:<pid>`` timeline lane and the overhead gauge, and halves
+    its rate whenever the measured overhead crosses the configured
+    bound."""
+
+    def __init__(self, hz: Optional[float] = None,
+                 snapshot_interval_s: Optional[float] = None,
+                 out_dir: Optional[str] = None,
+                 max_overhead: Optional[float] = None):
+        super().__init__(daemon=True, name="rtpu-profiler")
+        cfg = _config()
+        if hz is None:
+            hz = cfg.profiler_continuous_hz if cfg is not None else 10.0
+        if snapshot_interval_s is None:
+            snapshot_interval_s = (cfg.profiler_snapshot_interval_s
+                                   if cfg is not None else 5.0)
+        if max_overhead is None:
+            max_overhead = (cfg.profiler_max_overhead_ratio
+                            if cfg is not None else 0.02)
+        self.hz = float(hz)
+        self.snapshot_interval_s = float(snapshot_interval_s)
+        self.max_overhead = float(max_overhead)
+        self.out_dir = out_dir or _default_out_dir()
+        self.counts: Dict[str, int] = {}
+        self.tasks: Dict[str, dict] = {}
+        self.total_samples = 0
+        self.last_overhead_ratio = 0.0
+        self.throttled = False
+        self.snapshot_path = os.path.join(
+            self.out_dir, f"profile-{os.getpid()}.folded")
+        self._stop_event = threading.Event()
+
+    def stop(self) -> None:
+        self._stop_event.set()
+
+    def run(self) -> None:
+        from ray_tpu.util import telemetry
+
+        interval = 1.0 / max(self.hz, 0.1)
+        window_t0 = time.monotonic()
+        window_sample_time = 0.0
+        window_samples = 0
+        me = threading.get_ident()
+        while not self._stop_event.wait(interval):
+            s0 = time.perf_counter()
+            window_samples += _sweep(self.counts, self.tasks, me)
+            window_sample_time += time.perf_counter() - s0
+            now = time.monotonic()
+            if now - window_t0 < self.snapshot_interval_s:
+                continue
+            elapsed = max(now - window_t0, 1e-9)
+            self.last_overhead_ratio = window_sample_time / elapsed
+            self.total_samples += window_samples
+            self._snapshot(window_t0, elapsed, window_samples, telemetry)
+            if (self.last_overhead_ratio > self.max_overhead
+                    and interval < 2.0):
+                # Overhead self-check: the continuous mode must stay
+                # under its budget on any host — back off the rate
+                # rather than trusting the configured Hz.
+                interval *= 2.0
+                self.throttled = True
+            window_t0 = time.monotonic()
+            window_sample_time = 0.0
+            window_samples = 0
+
+    def _top_stack(self) -> str:
+        if not self.counts:
+            return ""
+        stack = max(self.counts.items(), key=lambda kv: kv[1])[0]
+        return stack.rsplit(";", 1)[-1]
+
+    def _snapshot(self, t0_mono: float, dur: float, samples: int,
+                  telemetry) -> None:
+        telemetry.inc("ray_tpu_profiler_samples_total", samples,
+                      {"mode": "continuous"})
+        telemetry.set_gauge("ray_tpu_profiler_overhead_ratio",
+                            self.last_overhead_ratio,
+                            {"proc": telemetry.proc_tag()})
+        telemetry.event(
+            f"profile:{os.getpid()}",
+            self._top_stack() or "idle",
+            ts=time.time() - dur, dur=dur,
+            args={"samples": samples,
+                  "overhead_ratio": round(self.last_overhead_ratio, 5),
+                  "throttled": self.throttled})
+        try:
+            os.makedirs(self.out_dir, exist_ok=True)
+            tmp = self.snapshot_path + ".tmp"
+            with open(tmp, "w") as f:
+                f.write(folded_text(self.counts))
+            os.replace(tmp, self.snapshot_path)
+        except OSError:  # lint: allow-silent(snapshot dir gone — sampler must not die)
+            pass
+
+
+_continuous: Optional[ContinuousSampler] = None
+_continuous_lock = threading.Lock()
+
+
+def _config():
+    try:
+        from ray_tpu.core.config import get_config
+
+        return get_config()
+    except Exception:  # config not bootstrapped (bare tools)
+        return None
+
+
+def _default_out_dir() -> str:
+    base = os.environ.get("RAY_TPU_SESSION_DIR")
+    if base:
+        return os.path.join(base, "profile")
+    import tempfile
+
+    return os.path.join(tempfile.gettempdir(), "ray_tpu", "profile")
+
+
+def continuous_enabled() -> bool:
+    cfg = _config()
+    if cfg is not None:
+        return bool(cfg.profiler_continuous_enabled)
+    return os.environ.get(
+        "RAY_TPU_PROFILER_CONTINUOUS_ENABLED", "0").lower() in (
+            "1", "true", "yes")
+
+
+def maybe_start_continuous() -> Optional[ContinuousSampler]:
+    """Start the per-process continuous sampler if configured on.
+    Idempotent; called from every process entrypoint (worker, agent,
+    head, driver)."""
+    global _continuous
+    if _continuous is not None:
+        return _continuous
+    if not continuous_enabled():
+        return None
+    with _continuous_lock:
+        if _continuous is None:
+            sampler = ContinuousSampler()
+            sampler.start()
+            _continuous = sampler
+    return _continuous
+
+
+def stop_continuous_for_testing() -> None:
+    global _continuous
+    with _continuous_lock:
+        if _continuous is not None:
+            _continuous.stop()
+            _continuous = None
+
+
+# ---------------------------------------------------------------------------
+# driver-side veneer (cluster fan-out + file outputs)
+# ---------------------------------------------------------------------------
+
+def capture_cluster(kind: str = "all", ident: Optional[str] = None,
+                    duration_s: float = 5.0, hz: float = 100.0) -> dict:
+    """Fan ``profile_capture`` out over the cluster (head handler
+    ``profile_capture_cluster``): ``kind`` targets one worker / the
+    worker running a task / an actor's worker, or every process."""
+    from ray_tpu.util.state import _call
+
+    return _call("profile_capture_cluster", {
+        "kind": kind,
+        "id": (ident or "").lower(),
+        "duration_s": duration_s,
+        "hz": hz,
+    })
+
+
+def _sanitize(name: str) -> str:
+    return "".join(c if c.isalnum() or c in "-_." else "-" for c in name)
+
+
+def write_profile_outputs(reply: dict, out_dir: str,
+                          title: str = "ray_tpu profile") -> dict:
+    """Write a capture-cluster reply as files: per-source
+    ``<source>.folded`` + ``<source>.html``, one merged
+    ``flamegraph.html``, and a ``profile.json`` manifest. Returns the
+    manifest."""
+    os.makedirs(out_dir, exist_ok=True)
+    manifest: Dict[str, Any] = {"sources": [], "errors": {},
+                                "samples": 0, "tasks": {}}
+    entries = reply.get("entries", [])
+    for entry in entries:
+        source = entry.get("source", "unknown")
+        safe = _sanitize(source)
+        if entry.get("error"):
+            manifest["errors"][safe] = entry["error"]
+            continue
+        manifest["sources"].append(source)
+        manifest["samples"] += entry.get("samples", 0)
+        for task_hex, bucket in (entry.get("tasks") or {}).items():
+            manifest["tasks"][task_hex] = dict(bucket, source=source)
+        folded = entry.get("folded") or {}
+        with open(os.path.join(out_dir, f"{safe}.folded"), "w") as f:
+            f.write(folded_text(folded))
+        with open(os.path.join(out_dir, f"{safe}.html"), "w") as f:
+            f.write(flamegraph_html(folded, title=f"{title} — {source}"))
+    merged = merge_folded([e for e in entries if not e.get("error")])
+    flame = os.path.join(out_dir, "flamegraph.html")
+    with open(flame, "w") as f:
+        f.write(flamegraph_html(merged, title=title))
+    manifest["flamegraph"] = flame
+    with open(os.path.join(out_dir, "profile.json"), "w") as f:
+        json.dump(dict(manifest, reply_ts=reply.get("ts")), f, indent=1,
+                  default=str)
+    return manifest

@@ -188,6 +188,15 @@ CATALOG: Dict[str, tuple] = {
         HISTOGRAM, "Time @serve.batch requests spend parked before "
         "their batch flushes.",
         (), LATENCY_BOUNDARIES),
+    # --- live profiling plane (util/profiler.py) ---
+    "ray_tpu_profiler_samples_total": (
+        COUNTER, "Stack samples taken by the sampling profiler "
+        "(on_demand captures / the continuous background sampler).",
+        ("mode",), None),
+    "ray_tpu_profiler_overhead_ratio": (
+        GAUGE, "Measured sampling overhead of the continuous profiler "
+        "(sampling time / wall time), per process.",
+        ("proc",), None),
     # --- train (train/session.py) ---
     "ray_tpu_train_reports_total": (
         COUNTER, "train.report() calls across training workers.",
@@ -197,6 +206,15 @@ CATALOG: Dict[str, tuple] = {
         (), SLOW_BOUNDARIES),
     # --- train recovery (train/backend_executor.py, train/trainer.py,
     # train/checkpoint_manager.py, tune/tune_controller.py) ---
+    # Per-rank staleness of the device step-counter heartbeat (seconds
+    # since the rank's step counter last advanced); the gang monitor
+    # sets it each sweep, so dashboards see a hang *growing* before the
+    # abort fires. "rank" keeps the per-rank series distinct through
+    # the last-write-wins gauge merge.
+    "ray_tpu_train_step_heartbeat_age_seconds": (
+        GAUGE, "Seconds since each rank's train step counter last "
+        "advanced, as observed by the gang health monitor.",
+        ("rank",), None),
     "ray_tpu_train_restarts_total": (
         COUNTER, "Gang restarts performed by the trainer, by failure "
         "kind (died / hung / unresponsive / error).",

@@ -13,6 +13,16 @@ one coherent picture. The local flight-recorder ring
 (util/flight_recorder.py) is merged the same way under ``fr:<subsystem>``
 lanes — scheduler wait reasons and node-state transitions land next to
 the task lanes they explain.
+
+Two more lane families ride the telemetry stream: ``profile:<pid>``
+(continuous-sampler snapshot windows from util/profiler.py — each
+window is a complete event whose name is the hottest stack leaf) and
+``train/step:r<rank>`` (the gang monitor's per-rank device
+step-counter heartbeats: one marker per step/phase change, so a rank
+wedged in compile reads differently from one stuck in its jitted
+step). When no cluster is attached (or nothing pushed yet), the export
+falls back to this process's local telemetry buffer so driver-side
+lanes still render.
 """
 
 from __future__ import annotations
@@ -116,10 +126,16 @@ def timeline(filename: Optional[str] = None,
         try:
             from ray_tpu.util import telemetry
 
-            trace.extend(
-                telemetry_trace_events(telemetry.collect_timeline_events()))
+            try:
+                merged = telemetry.collect_timeline_events()
+            except Exception:
+                # No cluster attached: this process's own buffer still
+                # carries its lanes (profile:<pid>, train/step:r<rank>,
+                # retries) — a driver-side export must not lose them.
+                merged = telemetry.local_timeline_events()
+            trace.extend(telemetry_trace_events(merged))
         except Exception:
-            pass  # no cluster attached / nothing pushed yet
+            pass  # telemetry plane disabled entirely
     if include_flight:
         try:
             from ray_tpu.util import flight_recorder

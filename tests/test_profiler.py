@@ -1,0 +1,409 @@
+"""Live profiling plane: sampler units (folded aggregation, bounded
+memory, attribution, continuous-mode overhead bound) and the cluster
+e2e lanes (on-demand capture of a busy worker with task attribution,
+killed-worker flight-ring shipping).
+
+Unit tests run first — they must see NO cluster (the timeline fallback
+and the no-core-worker shipping paths are part of what they test); the
+module-scoped cluster fixture only spins up for the e2e half.
+"""
+
+import json
+import os
+import signal
+import threading
+import time
+
+import pytest
+
+import ray_tpu
+from ray_tpu.util import flight_recorder as fr
+from ray_tpu.util import profiler, telemetry
+
+
+def _wait_for(predicate, timeout=30.0, interval=0.05, desc="condition"):
+    deadline = time.monotonic() + timeout
+    while time.monotonic() < deadline:
+        if predicate():
+            return
+        time.sleep(interval)
+    raise AssertionError(f"timed out waiting for {desc}")
+
+
+class _BusyThread(threading.Thread):
+    """A thread provably inside ``_busy_spin_marker`` while running."""
+
+    def __init__(self):
+        super().__init__(daemon=True, name="busy-probe")
+        self.stop = threading.Event()
+
+    def _busy_spin_marker(self):
+        x = 0
+        while not self.stop.is_set():
+            x += 1
+        return x
+
+    def run(self):
+        self._busy_spin_marker()
+
+
+@pytest.fixture
+def busy_thread():
+    t = _BusyThread()
+    t.start()
+    yield t
+    t.stop.set()
+    t.join(timeout=5)
+
+
+# ---------------------------------------------------------------------------
+# sampler units
+# ---------------------------------------------------------------------------
+
+def test_capture_folded_aggregation(busy_thread):
+    out = profiler.capture(duration_s=0.4, hz=200)
+    assert out["samples"] > 0
+    assert out["sweeps"] > 10
+    # Every sample landed in exactly one folded stack.
+    assert sum(out["folded"].values()) == out["samples"]
+    # The busy thread's frames are visible, rooted at its thread lane.
+    busy = [s for s in out["folded"] if "_busy_spin_marker" in s]
+    assert busy, f"busy frames missing from {list(out['folded'])[:5]}"
+    assert all(s.startswith("thread:busy-probe") for s in busy)
+    # The busy loop dominates its own thread's samples.
+    assert max(out["folded"][s] for s in busy) > out["sweeps"] * 0.5
+    # folded text round-trips as `stack count` lines.
+    text = profiler.folded_text(out["folded"])
+    first = text.splitlines()[0]
+    stack, count = first.rsplit(" ", 1)
+    assert int(count) == max(out["folded"].values())
+
+
+def test_task_attribution_buckets():
+    ready = threading.Event()
+    stop = threading.Event()
+
+    def attributed_work():
+        token = profiler.push_thread_context(
+            task="abc123def4567890", name="my_busy_task")
+        ready.set()
+        try:
+            while not stop.is_set():
+                pass
+        finally:
+            profiler.pop_thread_context(token)
+
+    t = threading.Thread(target=attributed_work, daemon=True)
+    t.start()
+    ready.wait(5)
+    try:
+        out = profiler.capture(duration_s=0.3, hz=200)
+    finally:
+        stop.set()
+        t.join(timeout=5)
+    # Sampled stacks of the attributed thread root at task:<name> ...
+    task_stacks = [s for s in out["folded"]
+                   if s.startswith("task:my_busy_task")]
+    assert task_stacks
+    assert any("attributed_work" in s for s in task_stacks)
+    # ... and the per-task bucket counts its samples.
+    bucket = out["tasks"]["abc123def4567890"]
+    assert bucket["name"] == "my_busy_task"
+    assert bucket["samples"] == sum(out["folded"][s]
+                                    for s in task_stacks)
+
+
+def test_pop_thread_context_token_order_independent():
+    a = profiler.push_thread_context(task="a", name="a")
+    b = profiler.push_thread_context(task="b", name="b")
+    # Interleaved-coroutine shape: the FIRST pusher pops first.
+    profiler.pop_thread_context(a)
+    assert profiler.current_thread_context() is b
+    profiler.pop_thread_context(b)
+    assert profiler.current_thread_context() is None
+    # Double-pop is benign.
+    profiler.pop_thread_context(b)
+
+
+def test_bounded_unique_stacks(monkeypatch):
+    monkeypatch.setattr(profiler, "MAX_UNIQUE_STACKS", 4)
+    counts = {}
+    for i in range(10):
+        profiler._add(counts, f"stack-{i}")
+    # 4 distinct keys + the overflow bucket, never more.
+    assert len(counts) == 5
+    assert counts[profiler.OVERFLOW_KEY] == 6
+    # Existing keys keep counting past the cap.
+    profiler._add(counts, "stack-0")
+    assert counts["stack-0"] == 2
+
+
+def test_flamegraph_html_self_contained():
+    folded = {"thread:main;a.py:f;a.py:g": 7,
+              "task:t;b.py:h": 3}
+    html = profiler.flamegraph_html(folded, title="unit test")
+    assert "<script>" in html and "</html>" in html
+    for frame in ("a.py:f", "a.py:g", "b.py:h", "task:t"):
+        assert frame in html
+    assert "unit test" in html
+    # Self-contained: no external asset fetches.
+    assert "http://" not in html and "https://" not in html
+    # The embedded tree is valid JSON with the right total.
+    data = html.split("var DATA=", 1)[1].split(";\n", 1)[0]
+    tree = json.loads(data)
+    assert tree["v"] == 10
+
+
+def test_merge_folded_roots_per_source():
+    merged = profiler.merge_folded([
+        {"source": "worker:aa", "folded": {"thread:x;f": 2}},
+        {"source": "head", "folded": {"thread:x;f": 5}},
+    ])
+    assert merged == {"worker:aa;thread:x;f": 2, "head;thread:x;f": 5}
+
+
+def test_continuous_sampler_overhead_bound(tmp_path, busy_thread):
+    """The always-on mode's acceptance bar: measured overhead on a busy
+    process stays under the configured 2% bound, snapshots land on
+    disk, and the overhead gauge + profile:<pid> timeline lane are
+    published."""
+    sampler = profiler.ContinuousSampler(
+        hz=10.0, snapshot_interval_s=0.3, out_dir=str(tmp_path),
+        max_overhead=0.02)
+    sampler.start()
+    try:
+        _wait_for(lambda: sampler.total_samples > 0, timeout=10,
+                  desc="a continuous snapshot window")
+        assert sampler.last_overhead_ratio <= 0.02, (
+            f"continuous sampler overhead {sampler.last_overhead_ratio:.4f}"
+            " exceeds the 2% bound")
+        assert not sampler.throttled
+        _wait_for(lambda: os.path.exists(sampler.snapshot_path),
+                  timeout=10, desc="the folded snapshot file")
+    finally:
+        sampler.stop()
+        sampler.join(timeout=5)
+    text = open(sampler.snapshot_path).read()
+    assert text.strip(), "snapshot file is empty"
+    stack, count = text.splitlines()[0].rsplit(" ", 1)
+    assert int(count) > 0 and ";" in stack
+    # Overhead gauge carries this process's tag.
+    gauge = telemetry.metric("ray_tpu_profiler_overhead_ratio")
+    assert any(("proc", telemetry.proc_tag()) in k
+               for k in gauge._values)
+    # The profile:<pid> lane rides the telemetry event stream.
+    lane = f"profile:{os.getpid()}"
+    assert any(ev["cat"] == lane
+               for ev in telemetry.local_timeline_events())
+
+
+def test_timeline_merges_profile_lane_without_cluster():
+    """No cluster attached: the timeline export falls back to the local
+    telemetry buffer, so the continuous sampler's lane still renders."""
+    telemetry.event(f"profile:{os.getpid()}", "window", dur=0.5,
+                    args={"samples": 3})
+    from ray_tpu.util.timeline import timeline
+
+    trace = timeline(events=[], include_flight=False)
+    assert any(ev["tid"] == f"profile:{os.getpid()}" for ev in trace)
+
+
+def test_maybe_start_continuous_gated_by_config():
+    from ray_tpu.core.config import get_config
+
+    cfg = get_config()
+    old = cfg.profiler_continuous_enabled
+    try:
+        cfg.profiler_continuous_enabled = False
+        assert profiler.maybe_start_continuous() is None
+        cfg.profiler_continuous_enabled = True
+        sampler = profiler.maybe_start_continuous()
+        assert sampler is not None and sampler.is_alive()
+        # Idempotent: a second call hands back the same thread.
+        assert profiler.maybe_start_continuous() is sampler
+    finally:
+        cfg.profiler_continuous_enabled = old
+        profiler.stop_continuous_for_testing()
+
+
+def test_error_event_arms_ring_ship():
+    fr.reset_for_testing(capacity=32)
+    fr.record("sched", "lease_wait", severity="warn", reason="x")
+    assert not fr._ship_pending, "warn must not arm the ship"
+    fr.record("gcs", "node_dead", severity="error", node="deadbeef")
+    assert fr._ship_pending, "error must arm the ship"
+    # No core worker here: ship_ring_now reports failure, never raises.
+    assert fr.ship_ring_now() is False
+    fr.reset_for_testing()
+
+
+# ---------------------------------------------------------------------------
+# e2e: on-demand capture + attribution, ring shipping past SIGKILL
+# ---------------------------------------------------------------------------
+
+@pytest.fixture(scope="module")
+def profile_cluster():
+    ray_tpu.init(num_cpus=4, num_tpus=0)
+    yield
+    ray_tpu.shutdown()
+
+
+def test_on_demand_capture_attributes_busy_task(profile_cluster,
+                                                tmp_path):
+    """The acceptance lane: profile the worker running a busy task and
+    get folded stacks whose top frames are attributed to that task,
+    plus flamegraph/folded outputs on disk."""
+
+    @ray_tpu.remote
+    def busy_burn(seconds):
+        t0 = time.monotonic()
+        x = 0
+        while time.monotonic() - t0 < seconds:
+            x += 1
+        return x
+
+    ref = busy_burn.remote(8.0)
+    task_hex = ref.id.task_id().hex()
+
+    from ray_tpu.util import state as ust
+
+    def running_with_worker():
+        rows = ust.list_tasks(
+            filters=[("task_id", "contains", task_hex)])
+        return any(r["state"] == "RUNNING" and r.get("worker_id")
+                   for r in rows)
+
+    _wait_for(running_with_worker, desc="busy task RUNNING at the head")
+
+    reply = profiler.capture_cluster("task", task_hex,
+                                     duration_s=1.5, hz=100)
+    assert not reply.get("error"), reply
+    (entry,) = reply["entries"]
+    assert entry["source"].startswith("worker:")
+    assert entry["samples"] > 0
+    # Top frames belong to the running task: the stacks rooted at
+    # task:busy_burn carry the task's code and dominate the executor
+    # thread across the window (parked I/O threads also produce stable
+    # stacks, so the claim is about the task lane, not a global max).
+    task_stacks = {s: c for s, c in entry["folded"].items()
+                   if s.startswith("task:busy_burn")}
+    assert task_stacks, sorted(entry["folded"])[:8]
+    assert any("busy_burn" in s for s in task_stacks)
+    assert max(task_stacks.values()) > entry["sweeps"] * 0.5
+    # Attribution bucket keyed by the task id.
+    bucket = entry["tasks"].get(task_hex[:16])
+    assert bucket and bucket["samples"] > 0
+    assert bucket["name"] == "busy_burn"
+
+    # `ray_tpu profile worker <id>` path: same worker, targeted by id.
+    reply2 = profiler.capture_cluster("worker", entry["worker_id"],
+                                      duration_s=0.5, hz=50)
+    assert not reply2.get("error"), reply2
+    assert reply2["entries"][0]["worker_id"] == entry["worker_id"]
+
+    # File outputs: folded text + self-contained flamegraph HTML.
+    out = str(tmp_path / "prof")
+    manifest = profiler.write_profile_outputs(reply, out)
+    assert manifest["samples"] == entry["samples"]
+    assert os.path.exists(manifest["flamegraph"])
+    html = open(manifest["flamegraph"]).read()
+    assert "busy_burn" in html
+    folded_files = [n for n in os.listdir(out) if n.endswith(".folded")]
+    assert folded_files
+    assert ray_tpu.get(ref, timeout=60) > 0
+
+
+def test_profile_cluster_all_covers_head_and_workers(profile_cluster):
+    @ray_tpu.remote
+    def touch():
+        return os.getpid()
+
+    ray_tpu.get(touch.remote())
+    reply = profiler.capture_cluster("all", duration_s=0.5, hz=50)
+    sources = {e["source"] for e in reply["entries"]
+               if not e.get("error")}
+    assert "head" in sources
+    assert any(s.startswith("worker:") for s in sources)
+    for e in reply["entries"]:
+        if not e.get("error"):
+            assert e["samples"] >= 0
+            assert "folded" in e
+
+
+def test_profile_capture_cluster_unknown_target(profile_cluster):
+    reply = profiler.capture_cluster("worker", "ffffffffffff",
+                                     duration_s=0.2)
+    assert reply.get("error")
+    assert reply["entries"] == []
+
+
+def test_ring_ships_on_error_via_push_throttle(profile_cluster):
+    """Driver-side: a severity>=error event arms the ship, the next
+    metrics push delivers the ring tail to the head KV."""
+    fr.record("gcs", "node_dead", severity="error",
+              node="ringship-probe")
+    from ray_tpu.util import metrics as um
+
+    um.flush_metrics()  # forces the push; the hook rides it
+
+    from ray_tpu.core.object_ref import get_core_worker
+    from ray_tpu.util.state import _call
+
+    wid = get_core_worker().worker_id.hex()
+
+    def shipped():
+        reply = _call("kv_get", {"ns": "flightring",
+                                 "key": f"fr:{wid}".encode()})
+        blob = reply.get("value")
+        if not blob:
+            return False
+        data = json.loads(bytes(blob).decode())
+        return any(e.get("event") == "node_dead"
+                   and (e.get("tags") or {}).get("node")
+                   == "ringship-probe" for e in data["events"])
+
+    _wait_for(shipped, timeout=15, desc="the ring tail in the head KV")
+
+    # A LIVE driver's shipped copy must not masquerade as a dead
+    # worker in dumps (drivers splice themselves in client-side).
+    from ray_tpu.util import debug as udebug
+
+    dump = udebug.cluster_debug_dump(include_stacks=False)
+    assert not any(e.get("shipped") and e.get("worker_id") == wid
+                   for e in dump["entries"])
+
+
+def test_killed_worker_ring_survives_in_debug_dump(profile_cluster):
+    """A SIGKILL'd worker leaves evidence: its shipped ring shows up in
+    debug_dump_cluster as a shipped:worker:* entry."""
+
+    @ray_tpu.remote(max_retries=0)
+    def doomed():
+        from ray_tpu.util import flight_recorder
+
+        flight_recorder.record(
+            "debug", "postmortem", severity="error",
+            reason="pre-SIGKILL evidence")
+        # Deterministic ship (the throttled path races a SIGKILL by
+        # design); then die hard — no flush, no atexit.
+        assert flight_recorder.ship_ring_now()
+        os.kill(os.getpid(), signal.SIGKILL)
+
+    with pytest.raises(Exception):
+        ray_tpu.get(doomed.remote(), timeout=60)
+
+    from ray_tpu.util import debug as udebug
+
+    def killed_ring_visible():
+        dump = udebug.cluster_debug_dump(include_stacks=False)
+        for entry in dump["entries"]:
+            if not entry.get("shipped"):
+                continue
+            for ev in entry.get("events", []):
+                tags = ev.get("tags") or {}
+                if tags.get("reason") == "pre-SIGKILL evidence":
+                    return True
+        return False
+
+    _wait_for(killed_ring_visible, timeout=20,
+              desc="the killed worker's shipped ring in the dump")
