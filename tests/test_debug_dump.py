@@ -110,6 +110,16 @@ def test_debug_dump_bundle_under_fault_injection(debug_cluster,
         open(os.path.join(out, "state", "workers.json")).read())
     assert len(workers_tbl) >= 2
 
+    # Live profiling plane: the bundle carries a short cluster-wide
+    # sampling capture — per-source folded stacks + a merged flamegraph.
+    assert manifest.get("profile"), "bundle missing the profile section"
+    assert "head" in manifest["profile"]["sources"]
+    prof_dir = os.path.join(out, "profile")
+    assert os.path.exists(os.path.join(prof_dir, "flamegraph.html"))
+    folded_files = [n for n in os.listdir(prof_dir)
+                    if n.endswith(".folded")]
+    assert len(folded_files) >= len(manifest["profile"]["sources"])
+
 
 def test_debug_stacks_cluster_wide(debug_cluster):
     @ray_tpu.remote
@@ -184,6 +194,36 @@ def test_why_task_blocked_on_busy_resource(debug_cluster, tmp_path):
         desc="the FINISHED task event to reach the head")
     done_text = udebug.why("task", task_hex[:16])
     assert "FINISHED" in done_text
+
+
+def test_why_placement_group_unplaceable(debug_cluster):
+    """`ray_tpu debug why placement-group <id>` walks bundle placement
+    and pending-wait evidence for a PG the cluster cannot place."""
+    pg = ray_tpu.placement_group([{"CPU": 1}, {"n2": 64}],
+                                 strategy="PACK")
+    try:
+        from ray_tpu.util.state import _call
+
+        def pg_visible():
+            return any(p["pg_id"] == pg.id_hex
+                       for p in _call("debug_sched_state")["pgs"])
+
+        _wait_for(pg_visible, desc="the PG in the scheduler state")
+
+        from ray_tpu.util import debug as udebug
+
+        text = udebug.why("placement-group", pg.id_hex[:16])
+        assert "placement group" in text
+        # The oversized n2 bundle cannot place: the walk names the
+        # shortfall and the cluster's availability.
+        assert "bundle(s) unplaced" in text
+        assert "cluster:" in text
+
+        # Unknown ids come back honest.
+        missing = udebug.why("placement-group", "f" * 16)
+        assert "no placement group" in missing
+    finally:
+        ray_tpu.remove_placement_group(pg)
 
 
 def test_postmortem_written_on_worker_crash(debug_cluster):

@@ -151,6 +151,26 @@ def test_serve_engine_and_autoscale_metrics_in_catalog():
         assert tuple(got_tags) == tag_keys, name
 
 
+def test_profiler_and_step_heartbeat_metrics_in_catalog():
+    """The live-profiling-plane metrics stay declared — the sampler
+    (on-demand + continuous) and the gang monitor's device step-counter
+    heartbeat emit through these names; a rename/removal would blind
+    the profiling plane."""
+    expected = {
+        "ray_tpu_profiler_samples_total": (
+            telemetry.COUNTER, ("mode",)),
+        "ray_tpu_profiler_overhead_ratio": (
+            telemetry.GAUGE, ("proc",)),
+        "ray_tpu_train_step_heartbeat_age_seconds": (
+            telemetry.GAUGE, ("rank",)),
+    }
+    for name, (kind, tag_keys) in expected.items():
+        assert name in telemetry.CATALOG, name
+        got_kind, _desc, got_tags, _bounds = telemetry.CATALOG[name]
+        assert got_kind == kind, name
+        assert tuple(got_tags) == tag_keys, name
+
+
 def test_catalog_metric_roundtrip():
     telemetry.reset_for_testing()
     try:

@@ -289,6 +289,104 @@ def test_hang_detected_under_report_timeout(ray_start, tmp_path):
     assert elapsed < 30.0, f"hang detection took {elapsed:.1f}s"
 
 
+def test_hang_attribution_by_step_phase(ray_start, tmp_path):
+    """The device step-counter heartbeat separates WHY a rank wedged:
+    a stall inside the compile phase, inside the jitted step, and at
+    plain python level yield three distinct gang-abort reasons instead
+    of one generic hang (live profiling plane)."""
+
+    def make_loop(phase):
+        def loop(config):
+            for step in range(3):
+                if step == 1:
+                    if phase is None:
+                        time.sleep(60)  # host-side block, no phase
+                    else:
+                        with train.step_phase(phase):
+                            time.sleep(60)  # wedged device stand-in
+                train.report({"step": step})
+        return loop
+
+    def run(name, loop):
+        trainer = train.JaxTrainer(
+            loop,
+            scaling_config=train.ScalingConfig(num_workers=1),
+            run_config=train.RunConfig(
+                name=name, storage_path=str(tmp_path),
+                failure_config=FailureConfig(
+                    max_failures=0,
+                    health_check_interval_s=0.25,
+                    hang_timeout_s=1.2)),
+        )
+        result = trainer.fit()
+        assert result.error is not None
+        return result.error
+
+    err = run("hang-compile", make_loop("compile"))
+    assert "hung compiling step 1" in err, err
+    assert "compilation stall" in err
+
+    err = run("hang-step", make_loop("step"))
+    assert "stalled in jitted step 1" in err, err
+    assert "device or collective" in err
+    assert "unresponsive" not in err
+
+    err = run("hang-python", make_loop(None))
+    assert "hung at python level in step 1" in err, err
+
+    # Each sweep fed the per-rank staleness gauge and the step/phase
+    # changes landed as train/step:r<rank> timeline lane markers.
+    from ray_tpu.util import telemetry
+
+    gauge = telemetry.metric(
+        "ray_tpu_train_step_heartbeat_age_seconds")
+    assert any(("rank", "0") in key for key in gauge._values)
+    lanes = {ev["cat"] for ev in telemetry.local_timeline_events()}
+    assert "train/step:r0" in lanes
+    # The stale-heartbeat evidence reached the flight ring.
+    from ray_tpu.util import flight_recorder
+
+    stale = [e for e in flight_recorder.snapshot()
+             if e["event"] == "step_heartbeat_stale"]
+    assert stale and stale[-1]["severity"] == "error"
+    assert stale[-1]["tags"]["step"] == 1
+
+
+def test_instrument_step_phases(ray_start, tmp_path):
+    """instrument_step advances the heartbeat host-side around the
+    jitted step: first call = compile, later calls = step, and the
+    session ends each report back at python level."""
+    def loop(config):
+        from ray_tpu.train import session as session_mod
+
+        sess = session_mod._get_session()
+        observed = []
+
+        def raw_step(x):
+            observed.append(sess.step_phase)
+            return x + 1
+
+        step_fn = train.instrument_step(raw_step)
+        acc = 0
+        for step in range(3):
+            acc = step_fn(acc)
+            train.report({"acc": acc, "observed": list(observed),
+                          "phase_after": sess.step_phase})
+
+    trainer = train.JaxTrainer(
+        loop,
+        scaling_config=train.ScalingConfig(num_workers=1),
+        run_config=train.RunConfig(name="instr",
+                                   storage_path=str(tmp_path)))
+    result = trainer.fit()
+    assert result.error is None
+    assert result.metrics["acc"] == 3
+    # Phase observed INSIDE the step: compile once, then step.
+    assert result.metrics["observed"] == ["compile", "step", "step"]
+    # ... and the wrapper restored python level before each report.
+    assert result.metrics["phase_after"] == ""
+
+
 def test_worker_death_detected_and_restart_resumes(ray_start, tmp_path):
     """A dying worker process aborts the gang with death attribution;
     the restart resumes from the latest committed checkpoint."""
