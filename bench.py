@@ -158,7 +158,10 @@ def _run_serve_cb_bench():
     """`bench.py serve-cb`: the continuous-batching load lane — 1k+
     concurrent SSE streams through the HTTP proxy against an engine
     deployment (p50/p99 TTFT, inter-chunk latency, chunks/s, shed
-    rate). Writes BENCH_SERVE_CB.json."""
+    rate). Writes BENCH_SERVE_CB.json plus
+    BENCH_SERVE_CB_HISTORY.json (the head's metrics time-series +
+    alert episodes over the run — the trajectory, not just the
+    endpoint)."""
     import os
     import subprocess
     import sys

@@ -168,6 +168,31 @@ class Config:
     # Task-event buffer flush (reference: task_event_buffer.h).
     task_events_report_interval_s: float = 1.0
     task_events_max_buffer_size: int = 10_000
+    # A pushed metrics snapshot older than this is stale: the summary
+    # surfaces flag it instead of merging it as current, and gauge
+    # carry-forward in history window queries stops.
+    metrics_staleness_s: float = 15.0
+
+    # --- cluster health plane (core/health.py) ---
+    # Head-side bounded per-series time-series over metrics pushes
+    # (util/metrics_history.py). On by default: append cost is
+    # O(changed series) per push and memory is hard-capped below.
+    metrics_history_enabled: bool = True
+    # Fine ring length per series (one point per *change*, so at the 2s
+    # push cadence 240 points cover >= 8 minutes of a busy series).
+    metrics_history_recent_points: int = 240
+    # Coarse ring: one point per interval, extending coverage to hours
+    # (360 x 30s = 3h) behind the fine ring.
+    metrics_history_coarse_points: int = 360
+    metrics_history_coarse_interval_s: float = 30.0
+    # Hard byte budget for the whole store; least-recently-updated
+    # series are evicted whole past this (eviction counter exported).
+    metrics_history_max_bytes: int = 16 * 1024 * 1024
+    # SLO/alert rule engine (util/alerts.py) over the history store.
+    alerts_enabled: bool = True
+    # Min seconds between rule sweeps (pushes arrive per-proc, so the
+    # raw hook cadence is n_procs / report_interval).
+    alerts_eval_interval_s: float = 1.0
 
     # --- flight recorder / debug plane (util/flight_recorder.py) ---
     # Always-on per-process ring of structured decision events (scheduler

@@ -118,6 +118,12 @@ class HeadService:
         # (worker_id hex -> human-readable cause, served to owners).
         self._mem_monitor = None
         self._death_reasons: Dict[str, str] = {}
+        # Cluster health plane: bounded metrics time-series + SLO alert
+        # rules, fed from every metrics push landing in the KV
+        # (core/health.py). Best-effort by contract.
+        from ray_tpu.core.health import ClusterHealthPlane
+
+        self.health = ClusterHealthPlane(config)
 
     # ------------------------------------------------------------------
     # lifecycle
@@ -377,6 +383,10 @@ class HeadService:
                     self._publish("worker_logs",
                                   {"node": "head", "entries": entries})
                 self._report_node_metrics()
+                # Alerts must keep resolving when pushes stop arriving
+                # (a stalled cluster can't be the thing that freezes
+                # its own alert lifecycle).
+                self.health.tick()
             except Exception:
                 logger.exception("scheduler pump failed")
             if os.environ.get("RAY_TPU_DEBUG_PUMP"):
@@ -413,8 +423,12 @@ class HeadService:
 
                 snap = um.local_snapshot()
                 if snap:
-                    self.kv.setdefault("metrics", {})[b"metrics:head"] = (
-                        json.dumps(snap).encode())
+                    blob = json.dumps(
+                        dict(snap, _meta=um.push_meta())).encode()
+                    self.kv.setdefault("metrics", {})[b"metrics:head"] = blob
+                    # Direct KV write bypasses h_kv_put; feed the
+                    # health plane explicitly.
+                    self.health.on_metrics_push(b"metrics:head", blob)
             except Exception as e:
                 _swallow("gcs.metrics_snapshot", e)
 
@@ -561,6 +575,10 @@ class HeadService:
             "report_oom_kill": self.h_report_oom_kill,
             "ping": self.h_ping,
             "autoscaler_status": self.h_autoscaler_status,
+            "metrics_history": self.h_metrics_history,
+            "metrics_history_snapshot": self.h_metrics_history_snapshot,
+            "alerts": self.h_alerts,
+            "alerts_put_rule": self.h_alerts_put_rule,
             "debug_dump_cluster": self.h_debug_dump_cluster,
             "debug_sched_state": self.h_debug_sched_state,
             "profile_capture_cluster": self.h_profile_capture_cluster,
@@ -822,6 +840,9 @@ class HeadService:
         wid = handle.worker_id.hex()
         self.kv.get("metrics", {}).pop(f"metrics:{wid}".encode(), None)
         self.kv.get("timeline", {}).pop(f"timeline:{wid}".encode(), None)
+        # History keeps the dead proc's recorded points (that's the
+        # point of history) but stops gauge carry-forward for it.
+        self.health.on_proc_gone(f"metrics:{wid}")
         # The "flightring" namespace deliberately survives: a shipped
         # ring tail is exactly the evidence a SIGKILL'd worker left
         # behind, and debug_dump_cluster merges it for dead processes.
@@ -1247,6 +1268,10 @@ class HeadService:
         if not payload.get("overwrite", True) and key in ns:
             return {"added": False}
         ns[key] = payload["value"]
+        if ns_name == "metrics":
+            # Health plane rides the push: append into the history
+            # store + sweep the alert rules (never raises).
+            self.health.on_metrics_push(key, payload["value"])
         if ns_name not in self.EPHEMERAL_KV_NS:
             self._persist_kv(ns_name, key, payload["value"])
             await self._commit_barrier()
@@ -1643,6 +1668,29 @@ class HeadService:
         if monitor is None:
             return {"enabled": False}
         return {"enabled": True, **monitor.status()}
+
+    # -- cluster health plane (core/health.py) -------------------------
+
+    async def h_metrics_history(self, conn, payload):
+        """Series index (no name) or windowed points / aggregates for
+        one catalog metric (``name`` + optional ``window_s`` / ``agg``
+        / ``tags`` / ``max_points``)."""
+        return self.health.history_reply(payload or {})
+
+    async def h_metrics_history_snapshot(self, conn, payload):
+        """Full store dump for debug bundles and bench artifacts."""
+        return self.health.snapshot_reply(payload or {})
+
+    async def h_alerts(self, conn, payload):
+        """Firing alerts, recent episodes (newest first), and the live
+        rule set — swept on demand so the answer is current."""
+        return self.health.alerts_reply()
+
+    async def h_alerts_put_rule(self, conn, payload):
+        """Add/replace one validated alert rule (or ``{"remove":
+        name}``). Validation failures come back as ``{"ok": False}``,
+        not exceptions — the CLI prints them."""
+        return self.health.put_rule(payload or {})
 
     # ------------------------------------------------------------------
     # debug plane (reference: `ray stack` / state-API debug dumps)

@@ -1,0 +1,151 @@
+"""Head-side cluster health plane: history store + alert engine glue.
+
+Owned by the head service. Every metrics push that lands in the head
+KV (``h_kv_put`` ns="metrics", plus the standalone head's own
+``_report_node_metrics`` write) flows through
+:meth:`ClusterHealthPlane.on_metrics_push`, which ingests the snapshot
+into the bounded :class:`MetricsHistoryStore` and — at
+``alerts_eval_interval_s`` cadence — sweeps the SLO rule engine. The
+head's periodic pump also calls :meth:`tick` so alerts keep resolving
+when pushes stop arriving (a dead cluster must not freeze its alerts
+in the "firing" state forever).
+
+Everything here is best-effort decoration on the KV write path: a
+failure inside the plane must never fail a metrics push.
+"""
+
+from __future__ import annotations
+
+import json
+import time
+from typing import Optional
+
+from ray_tpu.core.config import Config
+
+
+class ClusterHealthPlane:
+    def __init__(self, config: Config):
+        from ray_tpu.util.alerts import AlertEngine, default_rules
+        from ray_tpu.util.metrics_history import MetricsHistoryStore
+
+        self.enabled = bool(config.metrics_history_enabled)
+        self.store = MetricsHistoryStore(
+            recent_points=config.metrics_history_recent_points,
+            coarse_points=config.metrics_history_coarse_points,
+            coarse_interval_s=config.metrics_history_coarse_interval_s,
+            max_bytes=config.metrics_history_max_bytes,
+            staleness_s=config.metrics_staleness_s,
+        )
+        self.engine: Optional[AlertEngine] = None
+        if self.enabled and config.alerts_enabled:
+            self.engine = AlertEngine(self.store, rules=default_rules())
+        self._eval_interval = float(config.alerts_eval_interval_s)
+        self._last_eval = 0.0
+
+    # -- ingest (h_kv_put hook; must never raise) ------------------------
+
+    def on_metrics_push(self, key, value,
+                        now: Optional[float] = None) -> None:
+        if not self.enabled:
+            return
+        try:
+            proc = key.decode() if isinstance(key, (bytes, bytearray)) \
+                else str(key)
+            snap = json.loads(bytes(value).decode())
+            if not isinstance(snap, dict):
+                return
+            now = time.time() if now is None else now
+            self.store.ingest(proc, snap, ts=now)
+            self.maybe_evaluate(now)
+        except Exception as e:  # lint: allow-silent(health plane is decoration on the KV write path; see swallow below)
+            from ray_tpu.util import flight_recorder
+
+            flight_recorder.swallow("health.on_metrics_push", e)
+
+    def on_proc_gone(self, key) -> None:
+        if not self.enabled:
+            return
+        proc = key.decode() if isinstance(key, (bytes, bytearray)) \
+            else str(key)
+        self.store.on_proc_gone(proc)
+
+    # -- evaluation ------------------------------------------------------
+
+    def maybe_evaluate(self, now: Optional[float] = None) -> None:
+        if self.engine is None:
+            return
+        now = time.time() if now is None else now
+        if now - self._last_eval < self._eval_interval:
+            return
+        self._last_eval = now
+        self.engine.evaluate(now)
+        try:
+            from ray_tpu.util import telemetry
+
+            telemetry.set_gauge("ray_tpu_metrics_history_series",
+                                self.store.series_count())
+            telemetry.set_gauge("ray_tpu_metrics_history_bytes",
+                                self.store.bytes_used)
+        except Exception:  # lint: allow-silent(store stat gauges are decoration)
+            pass
+
+    def tick(self) -> None:
+        """Pump-driven sweep so alerts resolve without fresh pushes."""
+        self.maybe_evaluate()
+
+    # -- handler payloads ------------------------------------------------
+
+    def history_reply(self, payload: dict) -> dict:
+        if not self.enabled:
+            return {"enabled": False, "series": []}
+        name = payload.get("name")
+        if not name:
+            return {"enabled": True, "series": self.store.index(),
+                    "bytes": self.store.bytes_used,
+                    "evictions": self.store.evictions}
+        window_s = float(payload.get("window_s") or 600.0)
+        tags = payload.get("tags") or None
+        out = {
+            "enabled": True, "name": name, "window_s": window_s,
+            "series": self.store.query_points(
+                name, window_s=window_s, tags=tags,
+                max_points=int(payload.get("max_points") or 360)),
+        }
+        agg = payload.get("agg")
+        if agg:
+            out["agg"] = agg
+            out["aggregates"] = self.store.window_agg(
+                name, agg, window_s, tags=tags)
+        return out
+
+    def snapshot_reply(self, payload: dict) -> dict:
+        if not self.enabled:
+            return {"enabled": False, "series": [], "series_count": 0}
+        snap = self.store.snapshot(
+            max_points=int(payload.get("max_points") or 512))
+        snap["enabled"] = True
+        return snap
+
+    def alerts_reply(self) -> dict:
+        if self.engine is None:
+            return {"enabled": False, "firing": [], "episodes": [],
+                    "rules": []}
+        # Sweep before answering so the caller never sees an alert that
+        # already aged out but hasn't been re-evaluated.
+        self.engine.evaluate()
+        return self.engine.state()
+
+    def put_rule(self, payload: dict) -> dict:
+        from ray_tpu.util.alerts import AlertRule
+
+        if self.engine is None:
+            return {"ok": False, "error": "alert engine disabled"}
+        try:
+            if payload.get("remove"):
+                self.engine.remove_rule(str(payload["remove"]))
+                return {"ok": True, "rules": len(self.engine.rules)}
+            rule = AlertRule.from_dict(payload)
+            self.engine.add_rule(rule)
+            return {"ok": True, "rules": len(self.engine.rules)}
+        except Exception as e:
+            return {"ok": False, "error": str(e)}

@@ -43,6 +43,10 @@ class DashboardActor:
         app.router.add_get("/profile", self._profile)
         app.router.add_get("/api/profile", self._profile)
         app.router.add_get("/metrics", self._metrics)
+        app.router.add_get("/metrics/history", self._metrics_history)
+        app.router.add_get("/api/metrics/history", self._metrics_history)
+        app.router.add_get("/alerts", self._alerts)
+        app.router.add_get("/api/alerts", self._alerts)
         app.router.add_get("/healthz", self._healthz)
         self._runner = web.AppRunner(app)
         await self._runner.setup()
@@ -192,6 +196,40 @@ class DashboardActor:
                                 content_type="text/plain")
         except Exception as e:
             return web.Response(status=500, text=str(e))
+
+    async def _metrics_history(self, request):
+        """Head-side metrics time-series (cluster health plane). No
+        query params: the series index. With ``name``: windowed points
+        for that metric (``window`` seconds, optional ``agg`` /
+        ``points`` cap / remaining params as tag filters)."""
+        def produce():
+            from ray_tpu.util.state import _call
+
+            payload = {}
+            q = request.query
+            if q.get("name"):
+                payload["name"] = q["name"]
+                payload["window_s"] = float(q.get("window", 600.0))
+                if q.get("agg"):
+                    payload["agg"] = q["agg"]
+                if q.get("points"):
+                    payload["max_points"] = int(q["points"])
+                tags = {k: v for k, v in q.items()
+                        if k not in ("name", "window", "agg", "points")}
+                if tags:
+                    payload["tags"] = tags
+            return _call("metrics_history", payload)
+
+        return await self._json(produce)
+
+    async def _alerts(self, request):
+        """Firing alerts + recent fire/resolve episodes + rule set."""
+        def produce():
+            from ray_tpu.util.state import _call
+
+            return _call("alerts")
+
+        return await self._json(produce)
 
     async def _healthz(self, request):
         from aiohttp import web

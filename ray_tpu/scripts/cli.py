@@ -76,6 +76,28 @@ def _attach():
 
 def cmd_status(args):
     ray_tpu = _attach()
+    if getattr(args, "watch", False):
+        interval = max(0.2, getattr(args, "interval", 2.0))
+        try:
+            while True:
+                # ANSI clear + home: a live top-style surface, not a
+                # scrolling log.
+                print("\x1b[2J\x1b[H", end="")
+                print(f"ray_tpu status  "
+                      f"{time.strftime('%H:%M:%S')}  "
+                      f"(refresh {interval:g}s, ctrl-c to stop)")
+                _print_status(ray_tpu)
+                time.sleep(interval)
+        except KeyboardInterrupt:  # lint: allow-silent(ctrl-c is the watch loop's exit gesture)
+            pass
+        finally:
+            ray_tpu.shutdown()
+        return
+    _print_status(ray_tpu)
+    ray_tpu.shutdown()
+
+
+def _print_status(ray_tpu):
     from ray_tpu.util import state as ust
 
     total = ray_tpu.cluster_resources()
@@ -123,7 +145,21 @@ def cmd_status(args):
             if data["type"] == "counter":
                 total = sum(data["values"].values())
                 print(f"  {name}: {total:g}")
-    ray_tpu.shutdown()
+    try:
+        reply = ust._call("alerts")
+    except Exception:
+        reply = {}
+    if reply.get("enabled"):
+        firing = reply.get("firing", [])
+        if firing:
+            print(f"== alerts: {len(firing)} FIRING ==")
+            for f in firing:
+                tags = ",".join(f"{k}={v}"
+                                for k, v in sorted(f["tags"].items()))
+                print(f"  [{f.get('severity', 'warn').upper()}] "
+                      f"{f['rule']} {{{tags}}} value={f.get('value'):g}")
+        else:
+            print("== alerts: none firing ==")
 
 
 def cmd_summary(args):
@@ -231,23 +267,103 @@ def _fmt_tags(tk) -> str:
     return "{" + ",".join(f"{k}={v}" for k, v in tk) + "}"
 
 
+_SPARK_CHARS = "▁▂▃▄▅▆▇█"
+
+
+def _sparkline(values, width: int = 60) -> str:
+    """Render a value series as a unicode sparkline (pure; testable)."""
+    vals = [float(v) for v in values]
+    if not vals:
+        return ""
+    if len(vals) > width:
+        # Evenly resample down to the display width.
+        step = len(vals) / width
+        vals = [vals[min(len(vals) - 1, int(i * step))]
+                for i in range(width)]
+    lo, hi = min(vals), max(vals)
+    span = (hi - lo) or 1.0
+    top = len(_SPARK_CHARS) - 1
+    return "".join(_SPARK_CHARS[int((v - lo) / span * top)]
+                   for v in vals)
+
+
+def _render_history(reply, window_s: float) -> list:
+    """Pure renderer for `ray_tpu metrics --history <name>` output."""
+    lines = []
+    if not reply.get("enabled", True):
+        return ["metrics history disabled "
+                "(RAY_TPU_METRICS_HISTORY_ENABLED=0)"]
+    series = reply.get("series", [])
+    if not series:
+        return [f"no history for {reply.get('name', '?')} "
+                f"in the last {window_s:g}s"]
+    for s in series:
+        pts = s.get("points", [])
+        vals = [p[1] for p in pts]
+        tags = ",".join(f"{k}={v}"
+                        for k, v in sorted(s.get("tags", {}).items()))
+        stale = "" if s.get("fresh", True) else "  [STALE]"
+        head = f"{{{tags}}}" if tags else "(no tags)"
+        lines.append(f"{head} ({s.get('kind', '?')}, "
+                     f"{len(pts)} points){stale}")
+        if vals:
+            lines.append(f"  {_sparkline(vals)}")
+            lines.append(f"  min={min(vals):g} max={max(vals):g} "
+                         f"last={vals[-1]:g}")
+    for agg_row in reply.get("aggregates", []):
+        tags = ",".join(f"{k}={v}" for k, v in
+                        sorted(agg_row.get("tags", {}).items()))
+        lines.append(f"{reply.get('agg')}[{window_s:g}s]"
+                     f"{{{tags}}} = {agg_row.get('value'):g}")
+    return lines
+
+
 def cmd_metrics(args):
     """Merged cluster metrics snapshot (reference: the dashboard's
-    Prometheus scrape, as a one-shot CLI)."""
+    Prometheus scrape, as a one-shot CLI); ``--history <name>`` renders
+    the head-side time-series as sparklines instead."""
     ray_tpu = _attach()
     from ray_tpu.util import metrics as um
 
+    if getattr(args, "history", None):
+        from ray_tpu.util.state import _call
+
+        payload = {"name": args.history, "window_s": args.window}
+        if getattr(args, "agg", None):
+            payload["agg"] = args.agg
+        reply = _call("metrics_history", payload)
+        print(f"{args.history} — last {args.window:g}s")
+        for line in _render_history(reply, args.window):
+            print(line)
+        ray_tpu.shutdown()
+        return
     if args.format == "prometheus":
         print(um.prometheus_text(), end="")
         ray_tpu.shutdown()
         return
-    merged = um.collect_metrics()
+    detailed = um.collect_metrics_detailed()
+    merged = detailed["merged"]
+    stale = detailed["stale"]
+    procs = detailed["procs"]
+    if procs:
+        parts = []
+        for p in procs:
+            age = (f"{p['age_s']:.1f}s" if p.get("age_s") is not None
+                   else "age unknown")
+            parts.append(f"{p['proc']} {age}"
+                         + (" STALE" if p.get("stale") else ""))
+        n_stale = sum(1 for p in procs if p.get("stale"))
+        print(f"== snapshots: {len(procs)} procs"
+              + (f", {n_stale} stale" if n_stale else "") + " ==")
+        for part in parts:
+            print(f"  {part}")
     if not merged:
         print("no metrics reported yet")
     for name, data in sorted(merged.items()):
         print(f"{name} ({data['type']})"
               + (f" — {data['description']}" if data.get("description")
                  else ""))
+        stale_series = set(map(tuple, stale.get(name, ())))
         if data["type"] == "histogram":
             for tk, h in sorted(data["values"].items()):
                 count, total = h[-1], h[-2]
@@ -256,8 +372,70 @@ def cmd_metrics(args):
                       f"count={count} mean={mean_ms:.2f}ms")
         else:
             for tk, v in sorted(data["values"].items()):
-                print(f"  {_fmt_tags(tk) or '(no tags)'}: {v:g}")
+                flag = "  [STALE]" if tk in stale_series else ""
+                print(f"  {_fmt_tags(tk) or '(no tags)'}: {v:g}{flag}")
     ray_tpu.shutdown()
+
+
+def _render_alerts(reply, limit: int = 20) -> list:
+    """Pure renderer for `ray_tpu alerts` (testable without a tty)."""
+    lines = []
+    if not reply.get("enabled", True):
+        return ["alert engine disabled (RAY_TPU_ALERTS_ENABLED=0)"]
+
+    def fmt_tags(tags):
+        inner = ",".join(f"{k}={v}" for k, v in sorted(tags.items()))
+        return f"{{{inner}}}" if inner else ""
+
+    def fmt_ts(ts):
+        return (time.strftime("%H:%M:%S", time.localtime(ts))
+                if ts else "?")
+
+    firing = reply.get("firing", [])
+    if firing:
+        lines.append(f"FIRING ({len(firing)}):")
+        for f in firing:
+            lines.append(
+                f"  [{f.get('severity', 'warn').upper()}] {f['rule']} "
+                f"{fmt_tags(f.get('tags', {}))} "
+                f"value={f.get('value'):g} "
+                f"since {fmt_ts(f.get('fired_ts'))}")
+    else:
+        lines.append("FIRING: none")
+    episodes = reply.get("episodes", [])[:limit]
+    if episodes:
+        lines.append(f"recent episodes (newest first, {len(episodes)}"
+                     f" of {len(reply.get('episodes', []))}):")
+        for ep in episodes:
+            state = ("resolved " + fmt_ts(ep.get("resolved_ts"))
+                     if ep.get("resolved_ts") else "STILL FIRING")
+            vals = [p[1] for p in ep.get("evidence", [])]
+            spark = f"  {_sparkline(vals, width=24)}" if vals else ""
+            lines.append(
+                f"  {fmt_ts(ep.get('fired_ts'))} {ep['rule']} "
+                f"{fmt_tags(ep.get('tags', {}))} "
+                f"value={ep.get('value'):g} -> {state}{spark}")
+    rules = reply.get("rules", [])
+    lines.append(f"rules: {len(rules)} loaded "
+                 f"({', '.join(r['name'] for r in rules)})")
+    return lines
+
+
+def cmd_alerts(args):
+    """SLO/alert state from the head's cluster health plane."""
+    ray_tpu = _attach()
+    from ray_tpu.util.state import _call
+
+    try:
+        if getattr(args, "rules", False):
+            reply = _call("alerts")
+            print(json.dumps(reply.get("rules", []), indent=2))
+            return
+        reply = _call("alerts")
+        for line in _render_alerts(reply, limit=args.limit):
+            print(line)
+    finally:
+        ray_tpu.shutdown()
 
 
 def cmd_timeline(args):
@@ -330,6 +508,10 @@ def main(argv=None):
     p.set_defaults(fn=cmd_start)
 
     p = sub.add_parser("status", help="cluster resource status")
+    p.add_argument("--watch", action="store_true",
+                   help="refresh continuously (top-style) until ctrl-c")
+    p.add_argument("--interval", type=float, default=2.0,
+                   help="refresh interval for --watch (seconds)")
     p.set_defaults(fn=cmd_status)
 
     p = sub.add_parser("summary", help="task/actor summaries")
@@ -344,7 +526,24 @@ def main(argv=None):
     p = sub.add_parser("metrics", help="merged cluster metrics snapshot")
     p.add_argument("--format", choices=["summary", "prometheus"],
                    default="summary")
+    p.add_argument("--history", metavar="NAME", default=None,
+                   help="render the head-side time-series for one "
+                   "metric as sparklines instead of the snapshot")
+    p.add_argument("--window", type=float, default=600.0,
+                   help="history window in seconds (with --history)")
+    p.add_argument("--agg", default=None,
+                   help="also print a window aggregate (delta/rate/"
+                   "max/avg/p99/... per the metric kind)")
     p.set_defaults(fn=cmd_metrics)
+
+    p = sub.add_parser(
+        "alerts", help="SLO alert state: firing now + recent "
+        "fire/resolve episodes with series evidence")
+    p.add_argument("--limit", type=int, default=20,
+                   help="episodes to show")
+    p.add_argument("--rules", action="store_true",
+                   help="dump the loaded rule set as JSON")
+    p.set_defaults(fn=cmd_alerts)
 
     p = sub.add_parser("timeline", help="dump chrome-tracing timeline")
     p.add_argument("--output", "-o", default="timeline.json")

@@ -11,6 +11,10 @@ ingress path — aiohttp client -> proxy SSE -> router -> replica engine
 -> per-sequence stream lanes — at 1k+ concurrent streams.
 
 Writes ``BENCH_SERVE_CB.json`` via ``--json``; importable (``run``).
+Alongside the summary json, ``--json`` also snapshots the head's
+metrics history store + alert state into ``<name>_HISTORY.json`` —
+the BENCH artifact carries the run's trajectory (TTFT series, queue
+depth, shed counters over time), not just the endpoint numbers.
 """
 
 from __future__ import annotations
@@ -154,6 +158,38 @@ def main():
             json.dump({k: round(v, 3) for k, v in results.items()}, f,
                       indent=1)
             f.write("\n")
+        write_history_artifact(_history_path(args.json))
+
+
+def _history_path(json_path: str) -> str:
+    base = (json_path[:-5] if json_path.endswith(".json")
+            else json_path)
+    return f"{base}_HISTORY.json"
+
+
+def write_history_artifact(path: str) -> bool:
+    """Snapshot the head's metrics history + alert state next to the
+    bench summary. Best-effort: a disabled health plane (or a cluster
+    already torn down) prints a note instead of failing the bench."""
+    try:
+        from ray_tpu.util.state import _call
+
+        hist = _call("metrics_history_snapshot", {"max_points": 360})
+        alerts = _call("alerts")
+        import json
+
+        with open(path, "w") as f:
+            json.dump({"history": hist, "alerts": alerts}, f, indent=1,
+                      default=str)
+            f.write("\n")
+        print(f"history snapshot: {path} "
+              f"({hist.get('series_count', 0)} series, "
+              f"{hist.get('point_count', 0)} points, "
+              f"{len(alerts.get('episodes', []))} alert episodes)")
+        return True
+    except Exception as e:  # noqa: BLE001 — artifact is decoration
+        print(f"history snapshot unavailable: {e}")
+        return False
 
 
 if __name__ == "__main__":

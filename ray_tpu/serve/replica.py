@@ -123,11 +123,17 @@ class Replica:
                     deployment=self.deployment_name,
                     request_id=request_id))
                 # Profiler attribution: sampled stacks of this request
-                # land under serve:<deployment> with the request id.
-                prof_token = profiler.push_thread_context(
+                # land under serve:<deployment> with the request id —
+                # and, for @serve.multiplexed deployments, the model id
+                # the request was routed for, so a hot model stands out
+                # in the per-bucket sample counts.
+                prof_labels = dict(
                     serve_request=request_id,
                     name=f"serve:{self.deployment_name}",
                     deployment=self.deployment_name)
+                if model_id:
+                    prof_labels["model_id"] = model_id
+                prof_token = profiler.push_thread_context(**prof_labels)
                 self.num_ongoing += 1
                 t0 = time.perf_counter()
                 scope = {"status": "error"}

@@ -59,6 +59,20 @@ class _GangHealthMonitor(threading.Thread):
 
     def stop(self) -> None:
         self._stop.set()
+        self._reset_heartbeat_gauges()
+
+    def _reset_heartbeat_gauges(self) -> None:
+        """Zero the per-rank staleness gauges this monitor published.
+        Once the sweep stops, nothing updates them — without the reset
+        a hung rank's last (huge) age would sit in the merged gauges
+        forever, and the health plane's train_rank_stalled alert could
+        never resolve after the abort."""
+        from ray_tpu.util import telemetry
+
+        for rank in self._published:
+            telemetry.set_gauge(
+                "ray_tpu_train_step_heartbeat_age_seconds",
+                0.0, {"rank": str(rank)})
 
     def run(self) -> None:
         import ray_tpu
@@ -174,6 +188,7 @@ class _GangHealthMonitor(threading.Thread):
         if self._stop.is_set():
             return  # shutdown race: workers are being torn down on purpose
         logger.warning("gang health monitor aborting: %s", message)
+        self._reset_heartbeat_gauges()
         self.executor._on_gang_failure(kind, message,
                                        groups=self.seen_groups,
                                        dead_rank=rank if kind == "died"

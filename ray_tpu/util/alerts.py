@@ -1,0 +1,358 @@
+"""Declarative SLO/alert rules evaluated head-side over metrics history.
+
+A rule names a telemetry-catalog metric, a window aggregate (``delta``
+/``rate`` for counters, ``last``/``max``/``min``/``avg`` for gauges,
+``pNN``/``delta``/``rate`` for histograms — see
+:meth:`MetricsHistoryStore.window_agg`), a comparison against a
+threshold, and a ``for_s`` sustain window. The engine walks a
+pending → firing → resolved lifecycle per (rule, series tag set):
+a breach must hold for ``for_s`` seconds before the alert fires, and
+both transitions record a flight-recorder event under the ``alert``
+subsystem carrying the offending series window as evidence, plus a
+timeline event, the ``ray_tpu_alerts_firing`` gauge, and the
+``ray_tpu_alerts_transitions_total`` counter.
+
+Rules are validated against ``telemetry.CATALOG`` at registration:
+a typo'd metric name, an undeclared tag key, or an aggregate that does
+not fit the metric kind raises ``ValueError`` — the catalog lint in
+tier-1 holds the DEFAULT_RULES to the same bar.
+"""
+
+from __future__ import annotations
+
+import json
+import time
+from collections import deque
+from dataclasses import dataclass, field
+from typing import Dict, List, Optional
+
+#: Aggregates accepted per catalog metric kind.
+AGGS_BY_KIND = {
+    "counter": ("delta", "rate", "last"),
+    "gauge": ("last", "max", "min", "avg"),
+    "histogram": ("p50", "p90", "p95", "p99", "delta", "rate"),
+}
+
+_OPS = {
+    ">": lambda a, b: a > b,
+    ">=": lambda a, b: a >= b,
+    "<": lambda a, b: a < b,
+    "<=": lambda a, b: a <= b,
+    "==": lambda a, b: a == b,
+}
+
+#: Evidence payload caps: full window in the episode record, a compact
+#: tail in the flight-recorder tags (the ring ships over RPC).
+_EVIDENCE_POINTS = 64
+_EVIDENCE_TAG_CHARS = 900
+
+
+@dataclass
+class AlertRule:
+    """One SLO predicate over a catalog metric."""
+
+    name: str
+    metric: str
+    agg: str
+    op: str
+    threshold: float
+    window_s: float = 60.0
+    for_s: float = 0.0
+    severity: str = "warn"  # "warn" | "error"
+    tags: Dict[str, str] = field(default_factory=dict)
+    description: str = ""
+
+    def to_dict(self) -> dict:
+        return {
+            "name": self.name, "metric": self.metric, "agg": self.agg,
+            "op": self.op, "threshold": self.threshold,
+            "window_s": self.window_s, "for_s": self.for_s,
+            "severity": self.severity, "tags": dict(self.tags),
+            "description": self.description,
+        }
+
+    @classmethod
+    def from_dict(cls, d: dict) -> "AlertRule":
+        return cls(
+            name=str(d["name"]), metric=str(d["metric"]),
+            agg=str(d["agg"]), op=str(d["op"]),
+            threshold=float(d["threshold"]),
+            window_s=float(d.get("window_s", 60.0)),
+            for_s=float(d.get("for_s", 0.0)),
+            severity=str(d.get("severity", "warn")),
+            tags=dict(d.get("tags") or {}),
+            description=str(d.get("description", "")),
+        )
+
+
+def validate_rule(rule: AlertRule, catalog: Optional[dict] = None) -> None:
+    """Reject rules that reference anything outside the telemetry
+    catalog (metric name, tag keys) or whose aggregate does not fit the
+    metric's kind. Raises ``ValueError``."""
+    if catalog is None:
+        from ray_tpu.util import telemetry
+
+        catalog = telemetry.CATALOG
+    if not rule.name:
+        raise ValueError("alert rule needs a name")
+    spec = catalog.get(rule.metric)
+    if spec is None:
+        raise ValueError(
+            f"alert rule {rule.name!r}: metric {rule.metric!r} is not in "
+            f"the telemetry catalog")
+    kind, _desc, tag_keys = spec[0], spec[1], spec[2]
+    allowed = AGGS_BY_KIND.get(kind, ())
+    if rule.agg not in allowed:
+        raise ValueError(
+            f"alert rule {rule.name!r}: agg {rule.agg!r} is not valid "
+            f"for {kind} metric {rule.metric!r} (allowed: {allowed})")
+    if rule.op not in _OPS:
+        raise ValueError(
+            f"alert rule {rule.name!r}: unknown op {rule.op!r}")
+    for k in rule.tags:
+        if k not in tag_keys:
+            raise ValueError(
+                f"alert rule {rule.name!r}: tag {k!r} is not declared "
+                f"for {rule.metric!r} (declared: {tuple(tag_keys)})")
+    if rule.window_s <= 0:
+        raise ValueError(f"alert rule {rule.name!r}: window_s must be > 0")
+    if rule.for_s < 0:
+        raise ValueError(f"alert rule {rule.name!r}: for_s must be >= 0")
+    if rule.severity not in ("warn", "error"):
+        raise ValueError(
+            f"alert rule {rule.name!r}: severity must be warn|error")
+
+
+def default_rules() -> List[AlertRule]:
+    """The shipped SLO rule set. Thresholds are deliberately loose —
+    they flag pathology, not tuning opportunities; tighten per
+    deployment via ``alerts_put_rule``."""
+    return [
+        AlertRule(
+            "train_rank_stalled",
+            "ray_tpu_train_step_heartbeat_age_seconds", "max", ">",
+            30.0, window_s=120.0, for_s=5.0, severity="error",
+            description="A train rank's device step counter stopped "
+            "advancing (per-rank; precursor of a gang hang abort)."),
+        AlertRule(
+            "circuit_breaker_open",
+            "ray_tpu_circuit_breaker_transitions_total", "delta", ">=",
+            1.0, window_s=60.0, for_s=0.0, severity="warn",
+            tags={"state": "open"},
+            description="A circuit breaker opened in the window; "
+            "resolves when no new opens age in."),
+        AlertRule(
+            "serve_ttft_p99_high",
+            "ray_tpu_serve_stream_ttft_seconds", "p99", ">",
+            2.0, window_s=120.0, for_s=10.0, severity="warn",
+            description="Streaming time-to-first-token p99 over target "
+            "(per deployment)."),
+        AlertRule(
+            "engine_queue_backlog",
+            "ray_tpu_serve_engine_queue_depth", "avg", ">",
+            64.0, window_s=60.0, for_s=15.0, severity="warn",
+            description="A replica engine's admission queue stayed deep "
+            "(sustained backlog, not a burst)."),
+        AlertRule(
+            "serve_shed_rate",
+            "ray_tpu_serve_replica_sheds_total", "rate", ">",
+            1.0, window_s=60.0, for_s=10.0, severity="warn",
+            description="Replicas are being shed from routing faster "
+            "than one per second (breaker churn)."),
+        AlertRule(
+            "node_suspect",
+            "ray_tpu_gcs_nodes", "max", ">=",
+            1.0, window_s=60.0, for_s=3.0, severity="warn",
+            tags={"state": "SUSPECT"},
+            description="Nodes sat in the SUSPECT death-grace window "
+            "(node churn; every DEAD transition passes through here)."),
+        AlertRule(
+            "object_spill_rate",
+            "ray_tpu_object_spilled_bytes_total", "rate", ">",
+            64.0 * 1024 * 1024, window_s=60.0, for_s=10.0,
+            severity="warn",
+            description="Object store spilling to disk faster than "
+            "64 MiB/s (memory pressure)."),
+        AlertRule(
+            "profiler_overhead",
+            "ray_tpu_profiler_overhead_ratio", "max", ">",
+            0.05, window_s=120.0, for_s=30.0, severity="warn",
+            description="Continuous profiler overhead above 5% of wall "
+            "time on some process."),
+    ]
+
+
+class AlertEngine:
+    """Firing/resolved state machines over a MetricsHistoryStore."""
+
+    def __init__(self, store, rules: Optional[List[AlertRule]] = None,
+                 clock=time.time, max_episodes: int = 256):
+        self._store = store
+        self._clock = clock
+        self.rules: Dict[str, AlertRule] = {}
+        #: (rule name, series tag tuple) -> state dict.
+        self._states: Dict[tuple, dict] = {}
+        self.episodes: deque = deque(maxlen=max_episodes)
+        for r in rules or ():
+            self.add_rule(r)
+
+    def add_rule(self, rule: AlertRule) -> None:
+        validate_rule(rule)
+        self.rules[rule.name] = rule
+
+    def remove_rule(self, name: str) -> None:
+        self.rules.pop(name, None)
+        for key in [k for k in self._states if k[0] == name]:
+            del self._states[key]
+
+    # -- evaluation ------------------------------------------------------
+
+    def evaluate(self, now: Optional[float] = None) -> List[dict]:
+        """Advance every rule's state machines; returns transitions."""
+        now = self._clock() if now is None else now
+        transitions: List[dict] = []
+        for rule in list(self.rules.values()):
+            try:
+                rows = self._store.window_agg(
+                    rule.metric, rule.agg, rule.window_s, now=now,
+                    tags=rule.tags or None)
+            except Exception:  # lint: allow-silent(a malformed series must not stop the sweep; the rule simply sees no data)
+                rows = []
+            op = _OPS[rule.op]
+            live = set()
+            for row in rows:
+                if not op(row["value"], rule.threshold):
+                    continue
+                tk = tuple(sorted(row["tags"].items()))
+                key = (rule.name, tk)
+                live.add(key)
+                st = self._states.get(key)
+                if st is None:
+                    st = self._states[key] = {
+                        "state": "pending", "since": now}
+                st["value"] = row["value"]
+                if (st["state"] == "pending"
+                        and now - st["since"] >= rule.for_s):
+                    self._fire(rule, tk, st, now, transitions)
+            for key in [k for k, st in self._states.items()
+                        if k[0] == rule.name and k not in live]:
+                st = self._states.pop(key)
+                if st["state"] == "firing":
+                    self._resolve(rule, key[1], st, now, transitions)
+        self._publish_gauges()
+        return transitions
+
+    def _evidence(self, rule: AlertRule, tk: tuple,
+                  now: float) -> List[list]:
+        # A breach sustained by gauge carry-forward can have no point
+        # inside the rule window itself; widen until the series tail
+        # shows up — a fired alert with empty evidence is useless.
+        for window_s in (rule.window_s, 8 * rule.window_s + 600.0):
+            try:
+                rows = self._store.query_points(
+                    rule.metric, window_s, now=now, tags=dict(tk),
+                    max_points=_EVIDENCE_POINTS)
+            except Exception:  # lint: allow-silent(evidence is best-effort decoration on the transition)
+                return []
+            for row in rows:
+                if (tuple(sorted(row["tags"].items())) == tk
+                        and row["points"]):
+                    return [[round(t, 3), v] for t, v in row["points"]]
+        return []
+
+    def _fire(self, rule: AlertRule, tk: tuple, st: dict, now: float,
+              transitions: List[dict]) -> None:
+        from ray_tpu.util import flight_recorder, telemetry
+
+        evidence = self._evidence(rule, tk, now)
+        episode = {
+            "rule": rule.name, "metric": rule.metric,
+            "agg": rule.agg, "op": rule.op,
+            "threshold": rule.threshold,
+            "severity": rule.severity, "tags": dict(tk),
+            "value": st["value"], "pending_ts": st["since"],
+            "fired_ts": now, "resolved_ts": None,
+            "window_s": rule.window_s,
+            "evidence": evidence,
+            "description": rule.description,
+        }
+        st["state"] = "firing"
+        st["fired_at"] = now
+        st["episode"] = episode
+        self.episodes.append(episode)
+        transitions.append({"event": "fired", "episode": episode})
+        flight_recorder.record(
+            "alert", "fired",
+            severity="error" if rule.severity == "error" else "warn",
+            rule=rule.name, metric=rule.metric,
+            series=_fmt_tags(tk), value=round(float(st["value"]), 6),
+            threshold=rule.threshold,
+            window=json.dumps(evidence[-16:])[:_EVIDENCE_TAG_CHARS])
+        telemetry.inc("ray_tpu_alerts_transitions_total", 1,
+                      {"rule": rule.name, "state": "fired"})
+        telemetry.event("alerts", f"{rule.name} fired", ts=now,
+                        args={"series": _fmt_tags(tk),
+                              "value": st["value"]})
+
+    def _resolve(self, rule: AlertRule, tk: tuple, st: dict,
+                 now: float, transitions: List[dict]) -> None:
+        from ray_tpu.util import flight_recorder, telemetry
+
+        episode = st.get("episode") or {}
+        episode["resolved_ts"] = now
+        transitions.append({"event": "resolved", "episode": episode})
+        flight_recorder.record(
+            "alert", "resolved", severity="info",
+            rule=rule.name, metric=rule.metric, series=_fmt_tags(tk),
+            duration_s=round(now - st.get("fired_at", now), 3),
+            window=json.dumps(self._evidence(rule, tk, now)[-16:])
+            [:_EVIDENCE_TAG_CHARS])
+        telemetry.inc("ray_tpu_alerts_transitions_total", 1,
+                      {"rule": rule.name, "state": "resolved"})
+        telemetry.event("alerts", f"{rule.name} resolved", ts=now,
+                        args={"series": _fmt_tags(tk)})
+
+    def _publish_gauges(self) -> None:
+        try:
+            from ray_tpu.util import telemetry
+
+            counts = {name: 0 for name in self.rules}
+            for (rule_name, _tk), st in self._states.items():
+                if st["state"] == "firing":
+                    counts[rule_name] = counts.get(rule_name, 0) + 1
+            for name, n in counts.items():
+                telemetry.set_gauge("ray_tpu_alerts_firing", n,
+                                    {"rule": name})
+        except Exception:  # lint: allow-silent(gauge publication is decoration; the state machines are authoritative)
+            pass
+
+    # -- introspection ---------------------------------------------------
+
+    def firing(self) -> List[dict]:
+        out = []
+        for (rule_name, tk), st in self._states.items():
+            if st["state"] != "firing":
+                continue
+            rule = self.rules.get(rule_name)
+            out.append({
+                "rule": rule_name, "tags": dict(tk),
+                "value": st.get("value"),
+                "fired_ts": st.get("fired_at"),
+                "severity": rule.severity if rule else "warn",
+                "metric": rule.metric if rule else "",
+                "description": rule.description if rule else "",
+            })
+        out.sort(key=lambda r: r.get("fired_ts") or 0.0)
+        return out
+
+    def state(self) -> dict:
+        return {
+            "enabled": True,
+            "firing": self.firing(),
+            "episodes": list(self.episodes)[::-1],  # newest first
+            "rules": [r.to_dict() for r in self.rules.values()],
+        }
+
+
+def _fmt_tags(tk: tuple) -> str:
+    return ",".join(f"{k}={v}" for k, v in tk) or "-"

@@ -88,12 +88,15 @@ def write_debug_bundle(out_dir: str, timeout_s: float = 10.0,
     Layout: ``rings/<source>.json``, ``stacks/<source>.txt``,
     ``state/{nodes,workers,actors,tasks,objects,placement_groups,
     jobs}.json``, ``sched_state.json``, ``metrics.json``,
-    ``timeline.json``, ``profile/`` (a short cluster-wide sampling
-    capture: per-source folded stacks + flamegraph HTML;
-    ``profile_duration_s=0`` skips it), ``manifest.json``. Sections
-    that fail (a dead subsystem is exactly when you need the rest) are
-    recorded in the manifest's ``errors`` instead of aborting the
-    bundle."""
+    ``timeline.json``, ``history/series.json`` (the head's metrics
+    time-series store: the trajectory that LED here, not just the
+    endpoint), ``alerts.json`` (firing alerts + recent fire/resolve
+    episodes with series evidence), ``profile/`` (a short
+    cluster-wide sampling capture: per-source folded stacks +
+    flamegraph HTML; ``profile_duration_s=0`` skips it),
+    ``manifest.json``. Sections that fail (a dead subsystem is exactly
+    when you need the rest) are recorded in the manifest's ``errors``
+    instead of aborting the bundle."""
     os.makedirs(out_dir, exist_ok=True)
     manifest: Dict[str, Any] = {"created": time.time(), "errors": {},
                                 "sources": [], "nodes": []}
@@ -163,6 +166,34 @@ def write_debug_bundle(out_dir: str, timeout_s: float = 10.0,
                 json.dump(producer(), f, indent=1, default=str)
         except Exception as e:  # noqa: BLE001
             manifest["errors"][name] = f"{type(e).__name__}: {e}"
+
+    try:
+        hist = _call("metrics_history_snapshot", {"max_points": 512})
+        if hist.get("enabled"):
+            hist_dir = os.path.join(out_dir, "history")
+            os.makedirs(hist_dir, exist_ok=True)
+            with open(os.path.join(hist_dir, "series.json"), "w") as f:
+                json.dump(hist, f, indent=1, default=str)
+            manifest["history"] = {
+                "series": hist.get("series_count", 0),
+                "points": hist.get("point_count", 0),
+                "bytes": hist.get("bytes", 0),
+                "evictions": hist.get("evictions", 0),
+            }
+    except Exception as e:  # noqa: BLE001
+        manifest["errors"]["history"] = f"{type(e).__name__}: {e}"
+
+    try:
+        alerts = _call("alerts")
+        with open(os.path.join(out_dir, "alerts.json"), "w") as f:
+            json.dump(alerts, f, indent=1, default=str)
+        manifest["alerts"] = {
+            "enabled": alerts.get("enabled", False),
+            "firing": len(alerts.get("firing", [])),
+            "episodes": len(alerts.get("episodes", [])),
+        }
+    except Exception as e:  # noqa: BLE001
+        manifest["errors"]["alerts"] = f"{type(e).__name__}: {e}"
 
     if profile_duration_s and profile_duration_s > 0:
         # A short sampling window across every process: "what was

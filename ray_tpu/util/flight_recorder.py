@@ -96,6 +96,10 @@ CATALOG: Dict[str, tuple] = {
     # util/locks.py lockdep witness: a lock-order inversion was
     # detected at acquire time (before the deadlock interleaving).
     "lockdep": ("inversion",),
+    # util/alerts.py SLO rule engine (head-side): an alert rule crossed
+    # into firing or back to resolved; the offending series window
+    # rides in the tags as evidence.
+    "alert": ("fired", "resolved"),
 }
 
 _DEFAULT_CAPACITY = 2048

@@ -12,6 +12,7 @@ from __future__ import annotations
 
 import bisect
 import json
+import os
 import threading
 import time
 from typing import Callable, Dict, List, Optional, Sequence, Tuple
@@ -270,7 +271,10 @@ def _maybe_push(force: bool = False, idle_skip: bool = False):
             return
         _last_push = now
         _last_app_blob = app_blob
-        blob = json.dumps(snap).encode()
+        # "_meta" rides OUTSIDE the app_blob comparison above: it
+        # changes every push, so including it would turn the one-shot
+        # trailing flush into a perpetual idle heartbeat.
+        blob = json.dumps(dict(snap, _meta=push_meta(now))).encode()
         key = f"metrics:{cw.worker_id.hex()}".encode()
         cw.loop_thread.submit(cw.head.call("kv_put", {
             "ns": "metrics", "key": key, "value": blob,
@@ -297,9 +301,26 @@ def local_snapshot() -> Dict[str, dict]:
         return {name: m._snapshot() for name, m in _registry.items()}
 
 
-def collect_metrics() -> Dict[str, dict]:
-    """Merge all processes' metric snapshots (driver-side)."""
-    import ray_tpu
+def push_meta(now: Optional[float] = None) -> dict:
+    """The ``_meta`` stanza attached to every pushed snapshot: who
+    wrote it and when, so merge surfaces can age it instead of
+    presenting a dead process's last write as current."""
+    return {"ts": time.time() if now is None else now, "pid": os.getpid()}
+
+
+def staleness_window_s() -> float:
+    """Config-driven snapshot-staleness horizon (metrics_staleness_s)."""
+    try:
+        from ray_tpu.core.config import get_config
+
+        return float(get_config().metrics_staleness_s)
+    except Exception:  # metrics must work before config bootstraps
+        return 15.0
+
+
+def _fetch_snapshots() -> Dict[str, dict]:
+    """Raw per-process push snapshots from the head KV, keyed by the
+    KV key ("metrics:<worker id hex>" / "metrics:head")."""
     from ray_tpu.core.object_ref import get_core_worker
 
     cw = get_core_worker()
@@ -307,15 +328,50 @@ def collect_metrics() -> Dict[str, dict]:
         raise RuntimeError("ray_tpu not initialized")
     keys = cw.loop_thread.run(
         cw.head.call("kv_keys", {"ns": "metrics", "prefix": b"metrics:"}))
-    merged: Dict[str, dict] = {}
+    snaps: Dict[str, dict] = {}
     for key in keys.get("keys", []):
         reply = cw.loop_thread.run(
             cw.head.call("kv_get", {"ns": "metrics", "key": key}))
         blob = reply.get("value")
         if not blob:
             continue
-        snap = json.loads(bytes(blob).decode())
+        snaps[bytes(key).decode()] = json.loads(bytes(blob).decode())
+    return snaps
+
+
+def merge_snapshots(snaps: Dict[str, dict],
+                    now: Optional[float] = None,
+                    staleness_s: Optional[float] = None):
+    """Merge push-shaped snapshots into the ``collect_metrics`` shape,
+    staleness-aware. Counters and histogram buckets sum; a gauge series
+    is taken from the FRESHEST writer (by the pushed ``_meta`` ts)
+    rather than KV iteration order, so a dead worker's last write can
+    never shadow a live one.
+
+    Returns ``(merged, procs, stale)``: ``procs`` is one row per
+    snapshot (proc key, push ts, age, stale flag), ``stale`` maps
+    metric name -> [tag tuple, ...] for gauge series whose freshest
+    writer is itself past the staleness window — surfaces flag those
+    instead of presenting them as current.
+    """
+    now = time.time() if now is None else now
+    window = staleness_window_s() if staleness_s is None else staleness_s
+    merged: Dict[str, dict] = {}
+    gauge_ts: Dict[tuple, float] = {}
+    procs: List[dict] = []
+    for proc_key, snap in sorted(snaps.items()):
+        meta = snap.get("_meta") or {}
+        ts = float(meta.get("ts") or 0.0)
+        age = (now - ts) if ts else None
+        procs.append({
+            "proc": proc_key,
+            "ts": ts or None,
+            "age_s": round(age, 3) if age is not None else None,
+            "stale": bool(age is not None and age > window),
+        })
         for name, data in snap.items():
+            if name == "_meta" or not isinstance(data, dict):
+                continue
             dst = merged.setdefault(name, {
                 "type": data["type"],
                 "description": data.get("description", ""),
@@ -333,21 +389,53 @@ def collect_metrics() -> Dict[str, dict]:
                     tk = tuple(tuple(p) for p in k)
                     if data["type"] == "counter":
                         dst["values"][tk] = dst["values"].get(tk, 0.0) + v
-                    else:  # gauge: last write wins
-                        dst["values"][tk] = v
+                    else:  # gauge: freshest writer wins
+                        prev_ts = gauge_ts.get((name, tk))
+                        if prev_ts is None or ts >= prev_ts:
+                            dst["values"][tk] = v
+                            gauge_ts[(name, tk)] = ts
+    stale: Dict[str, list] = {}
+    for (name, tk), ts in gauge_ts.items():
+        if ts and now - ts > window:
+            stale.setdefault(name, []).append(tk)
+    return merged, procs, stale
+
+
+def collect_metrics() -> Dict[str, dict]:
+    """Merge all processes' metric snapshots (driver-side)."""
+    merged, _procs, _stale = merge_snapshots(_fetch_snapshots())
     return merged
+
+
+def collect_metrics_detailed() -> dict:
+    """``collect_metrics`` plus provenance: per-proc snapshot ages and
+    the gauge series whose freshest writer is past the staleness
+    window."""
+    merged, procs, stale = merge_snapshots(_fetch_snapshots())
+    return {"merged": merged, "procs": procs, "stale": stale}
 
 
 def prometheus_text() -> str:
     """Render the cluster's merged metrics in Prometheus exposition
     format (reference: the metrics agent's OpenCensus->Prometheus
     proxy)."""
-    return render_prometheus(collect_metrics())
+    merged, procs, stale = merge_snapshots(_fetch_snapshots())
+    return render_prometheus(merged, procs=procs, stale=stale)
 
 
-def render_prometheus(merged: Dict[str, dict]) -> str:
-    """Render a ``collect_metrics``-shaped dict as Prometheus text."""
+def render_prometheus(merged: Dict[str, dict],
+                      procs: Optional[List[dict]] = None,
+                      stale: Optional[Dict[str, list]] = None) -> str:
+    """Render a ``collect_metrics``-shaped dict as Prometheus text.
+    With provenance, snapshot ages lead as comments and stale gauge
+    series get a ``# STALE`` comment above their sample line."""
     out: List[str] = []
+    if procs:
+        for p in procs:
+            age = (f"{p['age_s']:.1f}s" if p.get("age_s") is not None
+                   else "unknown")
+            flag = " STALE" if p.get("stale") else ""
+            out.append(f"# ray_tpu snapshot {p['proc']} age={age}{flag}")
 
     def fmt_tags(tk) -> str:
         if not tk:
@@ -377,6 +465,11 @@ def render_prometheus(merged: Dict[str, dict]) -> str:
                 out.append(f"{name}_sum{fmt_tags(tk)} {h[-2]}")
                 out.append(f"{name}_count{fmt_tags(tk)} {h[-1]}")
         else:
+            stale_series = set(map(tuple, (stale or {}).get(name, ())))
             for tk, v in data["values"].items():
+                if tk in stale_series:
+                    out.append(f"# STALE series below: freshest writer "
+                               f"last pushed > "
+                               f"{staleness_window_s():.0f}s ago")
                 out.append(f"{name}{fmt_tags(tk)} {v}")
     return "\n".join(out) + "\n"

@@ -1,0 +1,449 @@
+"""Bounded per-series time-series history for the cluster health plane.
+
+Reference: Ray's GCS-centred control plane exists to make cluster state
+observable over time (arXiv:1712.05889), and the dashboard keeps short
+metric histories head-side for exactly this reason; TPU serving
+evaluations are framed as SLOs sustained over windows (TTFT
+percentiles under load), which needs trend data, not last-write-wins
+gauges. This module is the storage half: every metrics push that lands
+in the head KV is diffed against the previous snapshot and appended
+into fixed-size rings keyed by (metric name, tag set).
+
+Design constraints, in order:
+
+- **Hard memory bound.** Rings are fixed-size deques; beyond that, an
+  approximate byte budget evicts least-recently-updated series whole
+  (``evictions`` counts them) — on a 50-node soak the history store
+  must never become the thing that kills the head.
+- **O(changed series) append cost per push.** Counters and histograms
+  are diffed per-proc against the last snapshot and only appended when
+  the delta is non-zero; gauges only when the value changed. A fully
+  idle cluster appends nothing.
+- **Step-down downsampling.** Each series keeps a fine ring (every
+  change) plus a coarse ring (one point per ``coarse_interval_s``), so
+  a multi-hour window still renders without a multi-hour fine ring.
+
+Counter/histogram snapshots are cumulative PER PROCESS; the store
+keeps per-proc last values and appends the cluster-merged running
+value, so window ``delta``/``rate`` answers are cluster-wide. A
+process's FIRST snapshot seeds its baseline without appending (its
+pre-history counts are not a burst that just happened); when a series
+first appears after seeding, a zero point is recorded just before the
+first real one so window deltas over the series' birth are exact.
+"""
+
+from __future__ import annotations
+
+import time
+from collections import OrderedDict, deque
+from typing import Any, Dict, List, Optional, Tuple
+
+#: Approximate cost model for the byte budget (a [ts, value] pair plus
+#: list overhead; histogram points carry the merged bucket vector).
+_POINT_COST = 64
+_HIST_SLOT_COST = 16
+_SERIES_BASE_COST = 512
+
+TagTuple = Tuple[Tuple[str, str], ...]
+
+
+def _tag_tuple(pairs) -> TagTuple:
+    return tuple(sorted((str(k), str(v)) for k, v in pairs))
+
+
+def _tags_match(tags: TagTuple, want: Optional[Dict[str, str]]) -> bool:
+    if not want:
+        return True
+    d = dict(tags)
+    return all(d.get(k) == str(v) for k, v in want.items())
+
+
+class _Series:
+    __slots__ = ("name", "kind", "tags", "recent", "coarse",
+                 "last_coarse_ts", "last_value", "merged", "procs",
+                 "boundaries", "point_cost")
+
+    def __init__(self, name: str, kind: str, tags: TagTuple,
+                 recent_points: int, coarse_points: int,
+                 boundaries=None):
+        self.name = name
+        self.kind = kind
+        self.tags = tags
+        self.recent: deque = deque(maxlen=recent_points)
+        self.coarse: deque = deque(maxlen=coarse_points)
+        self.last_coarse_ts = 0.0
+        self.last_value: Any = None   # scalar, or merged hist vector
+        self.merged: Any = None       # cluster-merged running value
+        self.procs: set = set()
+        self.boundaries = boundaries
+        self.point_cost = (_POINT_COST if kind != "histogram"
+                           else _POINT_COST + _HIST_SLOT_COST
+                           * (len(boundaries or ()) + 3))
+
+    def points(self) -> List[list]:
+        """Coarse history spliced before the fine ring, oldest first."""
+        if self.recent:
+            head_ts = self.recent[0][0]
+            out = [p for p in self.coarse if p[0] < head_ts]
+            out.extend(self.recent)
+            return out
+        return list(self.coarse)
+
+    def cost(self) -> int:
+        return (_SERIES_BASE_COST
+                + (len(self.recent) + len(self.coarse)) * self.point_cost)
+
+
+class MetricsHistoryStore:
+    """Head-side bounded time-series store over metrics push snapshots.
+
+    Single-writer by construction (the head's event loop); a lock still
+    guards mutation vs. the query paths for direct (test/tool) use.
+    """
+
+    def __init__(self, recent_points: int = 240,
+                 coarse_points: int = 360,
+                 coarse_interval_s: float = 30.0,
+                 max_bytes: int = 16 * 1024 * 1024,
+                 staleness_s: float = 15.0):
+        from ray_tpu.util.locks import make_lock
+
+        self.recent_points = max(8, int(recent_points))
+        self.coarse_points = max(8, int(coarse_points))
+        self.coarse_interval_s = float(coarse_interval_s)
+        self.max_bytes = int(max_bytes)
+        self.staleness_s = float(staleness_s)
+        self._lock = make_lock("metrics_history.MetricsHistoryStore._lock")
+        #: (name, tags) -> _Series; ordered by last update (LRU evict).
+        self._series: "OrderedDict[tuple, _Series]" = OrderedDict()
+        #: proc key -> {(name, tags): raw cumulative value} (counters /
+        #: histograms; the diff baseline).
+        self._proc_last: Dict[str, Dict[tuple, Any]] = {}
+        self._proc_push_ts: Dict[str, float] = {}
+        self.bytes_used = 0
+        self.evictions = 0
+
+    # -- ingest ----------------------------------------------------------
+
+    def ingest(self, proc: str, snapshot: Dict[str, dict],
+               ts: Optional[float] = None) -> int:
+        """Diff one process's push snapshot in; returns points appended."""
+        now = time.time() if ts is None else float(ts)
+        appended = 0
+        with self._lock:
+            known = proc in self._proc_last
+            plast = self._proc_last.setdefault(proc, {})
+            self._proc_push_ts[proc] = now
+            for name, data in snapshot.items():
+                if name == "_meta" or not isinstance(data, dict):
+                    continue
+                kind = data.get("type")
+                if kind == "histogram":
+                    bounds = data.get("boundaries") or []
+                    for pairs, vec in data.get("hists", []):
+                        appended += self._ingest_cumulative(
+                            proc, plast, known, name, kind,
+                            _tag_tuple(pairs), [float(x) for x in vec],
+                            now, bounds)
+                elif kind == "counter":
+                    for pairs, value in data.get("values", []):
+                        appended += self._ingest_cumulative(
+                            proc, plast, known, name, kind,
+                            _tag_tuple(pairs), float(value), now, None)
+                elif kind == "gauge":
+                    for pairs, value in data.get("values", []):
+                        appended += self._ingest_gauge(
+                            proc, name, _tag_tuple(pairs), float(value),
+                            now)
+            if self.bytes_used > self.max_bytes:
+                self._evict(now)
+        return appended
+
+    def _get_series(self, name: str, kind: str, tags: TagTuple,
+                    boundaries=None) -> _Series:
+        key = (name, tags)
+        s = self._series.get(key)
+        if s is None:
+            s = self._series[key] = _Series(
+                name, kind, tags, self.recent_points,
+                self.coarse_points, boundaries)
+            self.bytes_used += _SERIES_BASE_COST
+        else:
+            self._series.move_to_end(key)
+        return s
+
+    def _append(self, s: _Series, ts: float, value) -> None:
+        rotated = len(s.recent) == s.recent.maxlen
+        s.recent.append([ts, value])
+        if not rotated:
+            self.bytes_used += s.point_cost
+        if ts - s.last_coarse_ts >= self.coarse_interval_s:
+            s.last_coarse_ts = ts
+            rotated = len(s.coarse) == s.coarse.maxlen
+            s.coarse.append([ts, value])
+            if not rotated:
+                self.bytes_used += s.point_cost
+
+    def _ingest_cumulative(self, proc: str, plast: dict, known: bool,
+                           name: str, kind: str, tags: TagTuple,
+                           value, ts: float, bounds) -> int:
+        key = (name, tags)
+        prev = plast.get(key)
+        plast[key] = value
+        if prev is None and not known:
+            return 0  # first snapshot from this proc: seed only
+        if kind == "histogram":
+            if prev is None:
+                delta = list(value)
+            else:
+                delta = [max(0.0, a - b) for a, b in zip(value, prev)]
+                if value[-1] < prev[-1]:  # proc restart: counts reset
+                    delta = list(value)
+            if delta[-1] == 0 and sum(delta) == 0:
+                return 0
+            s = self._get_series(name, kind, tags, bounds)
+            if s.merged is None:
+                s.merged = [0.0] * len(delta)
+                self._append(s, ts - 1e-3, list(s.merged))
+            s.merged = [a + b for a, b in zip(s.merged, delta)]
+            s.procs.add(proc)
+            s.last_value = s.merged
+            self._append(s, ts, list(s.merged))
+            return 1
+        # counter
+        if prev is None:
+            delta = value
+        else:
+            delta = value - prev
+            if delta < 0:  # proc restart: counter reset
+                delta = value
+        if delta == 0:
+            return 0
+        s = self._get_series(name, kind, tags)
+        if s.merged is None:
+            s.merged = 0.0
+            self._append(s, ts - 1e-3, 0.0)
+        s.merged += delta
+        s.procs.add(proc)
+        s.last_value = s.merged
+        self._append(s, ts, s.merged)
+        return 1
+
+    def _ingest_gauge(self, proc: str, name: str, tags: TagTuple,
+                      value: float, ts: float) -> int:
+        s = self._get_series(name, "gauge", tags)
+        s.procs.add(proc)
+        if s.last_value is not None and value == s.last_value:
+            return 0
+        s.last_value = value
+        self._append(s, ts, value)
+        return 1
+
+    def _evict(self, now: float) -> None:
+        """Drop least-recently-updated series until under the budget."""
+        dropped = 0
+        while self.bytes_used > self.max_bytes and len(self._series) > 1:
+            _key, s = self._series.popitem(last=False)
+            self.bytes_used -= s.cost()
+            dropped += 1
+        if not dropped:
+            return
+        self.evictions += dropped
+        try:
+            from ray_tpu.util import telemetry
+
+            telemetry.inc("ray_tpu_metrics_history_evictions_total",
+                          dropped)
+        except Exception:  # lint: allow-silent(eviction accounting is best-effort; the cap itself already held)
+            pass
+
+    def on_proc_gone(self, proc: str) -> None:
+        with self._lock:
+            self._proc_last.pop(proc, None)
+            self._proc_push_ts.pop(proc, None)
+            for s in self._series.values():
+                s.procs.discard(proc)
+
+    # -- queries ---------------------------------------------------------
+
+    def _fresh(self, s: _Series, now: float) -> bool:
+        return any(self._proc_push_ts.get(p, 0.0)
+                   >= now - self.staleness_s for p in s.procs)
+
+    def _select(self, name: str, tags: Optional[Dict[str, str]]
+                ) -> List[_Series]:
+        return [s for (n, tt), s in self._series.items()
+                if n == name and _tags_match(tt, tags)]
+
+    def series_count(self) -> int:
+        with self._lock:
+            return len(self._series)
+
+    def point_count(self) -> int:
+        with self._lock:
+            return sum(len(s.recent) + len(s.coarse)
+                       for s in self._series.values())
+
+    def index(self) -> List[dict]:
+        """One row per live series: name, kind, tags, coverage."""
+        now = time.time()
+        with self._lock:
+            out = []
+            for (name, tt), s in self._series.items():
+                pts = s.points()
+                out.append({
+                    "name": name, "kind": s.kind, "tags": dict(tt),
+                    "points": len(pts),
+                    "first_ts": pts[0][0] if pts else None,
+                    "last_ts": pts[-1][0] if pts else None,
+                    "fresh": self._fresh(s, now),
+                })
+            return out
+
+    def query_points(self, name: str, window_s: float = 600.0,
+                     now: Optional[float] = None,
+                     tags: Optional[Dict[str, str]] = None,
+                     max_points: Optional[int] = None) -> List[dict]:
+        """Scalar point series per matching tag set (histograms render
+        their cumulative observation count)."""
+        now = time.time() if now is None else now
+        cutoff = now - float(window_s)
+        with self._lock:
+            out = []
+            for s in self._select(name, tags):
+                pts = [[p[0], (p[1][-1] if s.kind == "histogram"
+                               else p[1])]
+                       for p in s.points() if p[0] >= cutoff]
+                if max_points and len(pts) > max_points:
+                    pts = pts[-max_points:]
+                out.append({"tags": dict(s.tags), "kind": s.kind,
+                            "points": pts,
+                            "fresh": self._fresh(s, now)})
+            return out
+
+    def window_agg(self, name: str, agg: str, window_s: float,
+                   now: Optional[float] = None,
+                   tags: Optional[Dict[str, str]] = None) -> List[dict]:
+        """One aggregate per matching series over the trailing window.
+
+        counters: ``delta`` / ``rate`` / ``last``; gauges: ``last`` /
+        ``max`` / ``min`` / ``avg`` (the last-known value carries
+        forward while any writing process is still pushing — a constant
+        gauge is current, a dead process's gauge is not); histograms:
+        ``p50``/``p90``/``p95``/``p99`` over the window's bucket delta,
+        plus ``delta``/``rate`` of the observation count.
+        """
+        now = time.time() if now is None else now
+        window_s = float(window_s)
+        cutoff = now - window_s
+        with self._lock:
+            out = []
+            for s in self._select(name, tags):
+                value = self._agg_one(s, agg, cutoff, now, window_s)
+                if value is None:
+                    continue
+                out.append({"tags": dict(s.tags), "kind": s.kind,
+                            "value": value})
+            return out
+
+    def _agg_one(self, s: _Series, agg: str, cutoff: float, now: float,
+                 window_s: float) -> Optional[float]:
+        pts = s.points()
+        baseline = None
+        window = []
+        for p in pts:
+            if p[0] < cutoff:
+                baseline = p
+            else:
+                window.append(p)
+        if s.kind == "gauge":
+            vals = [p[1] for p in window]
+            if self._fresh(s, now) and s.last_value is not None:
+                vals.append(s.last_value)  # carry-forward while live
+            if not vals:
+                return None
+            if agg in ("last", ""):
+                return vals[-1]
+            if agg == "max":
+                return max(vals)
+            if agg == "min":
+                return min(vals)
+            if agg == "avg":
+                return sum(vals) / len(vals)
+            raise ValueError(f"bad gauge agg {agg!r}")
+        if not window:
+            return None
+        base = baseline if baseline is not None else window[0]
+        last = window[-1]
+        if s.kind == "counter":
+            delta = last[1] - base[1]
+            if agg == "delta":
+                return delta
+            if agg == "rate":
+                return delta / window_s if window_s > 0 else 0.0
+            if agg in ("last", ""):
+                return last[1]
+            raise ValueError(f"bad counter agg {agg!r}")
+        # histogram
+        base_vec = base[1]
+        last_vec = last[1]
+        if agg == "delta":
+            return last_vec[-1] - base_vec[-1]
+        if agg == "rate":
+            return ((last_vec[-1] - base_vec[-1]) / window_s
+                    if window_s > 0 else 0.0)
+        if agg in ("p50", "p90", "p95", "p99"):
+            q = float(agg[1:]) / 100.0
+            nb = len(s.boundaries or [])
+            deltas = [max(0.0, a - b) for a, b in
+                      zip(last_vec[:nb + 1], base_vec[:nb + 1])]
+            return _bucket_percentile(s.boundaries or [], deltas, q)
+        raise ValueError(f"bad histogram agg {agg!r}")
+
+    def snapshot(self, max_points: Optional[int] = 512) -> dict:
+        """Full JSONable dump (debug bundles / bench artifacts)."""
+        series = []
+        now = time.time()
+        with self._lock:
+            for (name, tt), s in self._series.items():
+                pts = s.points()
+                if max_points and len(pts) > max_points:
+                    pts = pts[-max_points:]
+                series.append({
+                    "name": name, "kind": s.kind, "tags": dict(tt),
+                    "points": pts,
+                    "fresh": self._fresh(s, now),
+                })
+            return {
+                "ts": now,
+                "series_count": len(self._series),
+                "point_count": sum(len(x["points"]) for x in series),
+                "bytes": self.bytes_used,
+                "max_bytes": self.max_bytes,
+                "evictions": self.evictions,
+                "series": series,
+            }
+
+
+def _bucket_percentile(boundaries: List[float], deltas: List[float],
+                       q: float) -> Optional[float]:
+    """Prometheus-style histogram_quantile over a windowed bucket
+    delta vector (len(boundaries)+1 buckets, last = +Inf). Linear
+    interpolation inside the bucket; the +Inf bucket clamps to the
+    highest finite boundary."""
+    total = sum(deltas)
+    if total <= 0:
+        return None
+    rank = q * total
+    acc = 0.0
+    for i, count in enumerate(deltas):
+        if count <= 0:
+            continue
+        if acc + count >= rank:
+            lower = boundaries[i - 1] if i > 0 else 0.0
+            if i >= len(boundaries):  # +Inf bucket
+                return float(boundaries[-1]) if boundaries else 0.0
+            upper = boundaries[i]
+            return lower + (upper - lower) * ((rank - acc) / count)
+        acc += count
+    return float(boundaries[-1]) if boundaries else 0.0

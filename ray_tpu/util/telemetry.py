@@ -234,6 +234,23 @@ CATALOG: Dict[str, tuple] = {
     "ray_tpu_tune_trial_retries_total": (
         COUNTER, "Failed Tune trials restarted from their latest "
         "checkpoint under RunConfig.failure_config.", (), None),
+    # --- cluster health plane (core/health.py, util/metrics_history.py,
+    # util/alerts.py) ---
+    "ray_tpu_metrics_history_series": (
+        GAUGE, "Live series in the head-side metrics history store.",
+        (), None),
+    "ray_tpu_metrics_history_bytes": (
+        GAUGE, "Approximate bytes held by the metrics history store.",
+        (), None),
+    "ray_tpu_metrics_history_evictions_total": (
+        COUNTER, "Series evicted whole from the history store by the "
+        "hard byte cap (least-recently-updated first).", (), None),
+    "ray_tpu_alerts_firing": (
+        GAUGE, "Alert series currently firing, per rule.",
+        ("rule",), None),
+    "ray_tpu_alerts_transitions_total": (
+        COUNTER, "Alert lifecycle transitions (state fired/resolved).",
+        ("rule", "state"), None),
 }
 
 _KIND_TO_CLS = {

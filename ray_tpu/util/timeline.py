@@ -113,10 +113,48 @@ def flight_trace_events(events: List[dict]) -> List[dict]:
     return trace
 
 
+def alert_trace_events(episodes: List[dict]) -> List[dict]:
+    """Convert alert-engine episodes (util/alerts.py) into an
+    ``alerts`` lane next to the ``fr:``/``profile:`` lanes: resolved
+    episodes render as complete fire→resolve spans, still-firing ones
+    as instant fire markers (an open alert must be visible, not
+    dropped)."""
+    trace: List[dict] = []
+    for ep in episodes:
+        fired = float(ep.get("fired_ts") or 0.0)
+        resolved = ep.get("resolved_ts")
+        args = {
+            "rule": ep.get("rule", "?"),
+            "metric": ep.get("metric", ""),
+            "series": ",".join(f"{k}={v}" for k, v in
+                               sorted((ep.get("tags") or {}).items())),
+            "value": ep.get("value"),
+            "threshold": ep.get("threshold"),
+            "severity": ep.get("severity", "warn"),
+        }
+        out = {
+            "name": ep.get("rule", "?"),
+            "cat": "alerts",
+            "ts": fired * 1e6,
+            "pid": "ray_tpu",
+            "tid": "alerts",
+            "args": args,
+        }
+        if resolved:
+            out["ph"] = "X"
+            out["dur"] = max(0.0, (float(resolved) - fired) * 1e6)
+        else:
+            out["ph"] = "i"
+            out["s"] = "p"
+        trace.append(out)
+    return trace
+
+
 def timeline(filename: Optional[str] = None,
              events: Optional[List[dict]] = None,
              include_telemetry: bool = True,
-             include_flight: bool = True) -> List[dict]:
+             include_flight: bool = True,
+             include_alerts: bool = True) -> List[dict]:
     if events is None:
         from ray_tpu.util.state import list_task_events
 
@@ -142,6 +180,14 @@ def timeline(filename: Optional[str] = None,
 
             trace.extend(flight_trace_events(flight_recorder.snapshot()))
         except Exception:
+            pass
+    if include_alerts:
+        try:
+            from ray_tpu.util.state import _call
+
+            reply = _call("alerts")
+            trace.extend(alert_trace_events(reply.get("episodes", [])))
+        except Exception:  # lint: allow-silent(no cluster attached / engine disabled — lane is optional)
             pass
     if filename:
         with open(filename, "w") as f:

@@ -185,6 +185,10 @@ def test_dashboard_endpoints(tooling_cluster):
     assert isinstance(get_json("/api/nodes"), list)
     assert isinstance(get_json("/api/workers"), list)
     assert isinstance(get_json("/api/actors"), list)
+    hist = get_json("/api/metrics/history")
+    assert hist["enabled"] and isinstance(hist["series"], list)
+    alerts = get_json("/api/alerts")
+    assert isinstance(alerts.get("rules"), list)
     with urllib.request.urlopen(
             f"http://127.0.0.1:{port}/healthz", timeout=30) as r:
         assert r.read() == b"success"

@@ -1,0 +1,558 @@
+"""Cluster health plane coverage: the bounded metrics time-series
+store (seeding/delta semantics, downsampling, the hard byte cap), the
+SLO alert engine lifecycle under a fake clock, the merge-staleness
+surfaces, the CLI renderers, the timeline alerts lane — and the tier-1
+e2e: a FaultInjector-era breaker trip AND a stalled train rank raise
+two distinct alerts that fire with series-window evidence and resolve
+after the fault clears, visible through ``ray_tpu alerts`` and the
+debug bundle."""
+
+import json
+import os
+import time
+
+import pytest
+
+import ray_tpu
+from ray_tpu.util.alerts import AlertEngine, AlertRule
+from ray_tpu.util.metrics_history import MetricsHistoryStore
+
+
+# ---------------------------------------------------------------------------
+# history store units (no cluster)
+# ---------------------------------------------------------------------------
+
+
+def _counter(value, tags=()):
+    return {"type": "counter", "description": "",
+            "values": [[list(tags), value]]}
+
+
+def _gauge(value, tags=()):
+    return {"type": "gauge", "description": "",
+            "values": [[list(tags), value]]}
+
+
+def _hist(vec, boundaries, tags=()):
+    return {"type": "histogram", "description": "",
+            "boundaries": list(boundaries),
+            "hists": [[list(tags), list(vec)]]}
+
+
+def test_counter_first_snapshot_seeds_without_append():
+    st = MetricsHistoryStore()
+    # A process's pre-history cumulative count is not a burst.
+    assert st.ingest("p1", {"c": _counter(100.0)}, ts=1000.0) == 0
+    assert st.point_count() == 0
+    # The next push appends the increment (plus the series-birth zero
+    # point, so window deltas over the series' birth are exact).
+    assert st.ingest("p1", {"c": _counter(105.0)}, ts=1002.0) == 1
+    rows = st.window_agg("c", "delta", 60.0, now=1003.0)
+    assert len(rows) == 1 and rows[0]["value"] == pytest.approx(5.0)
+    rows = st.window_agg("c", "rate", 60.0, now=1003.0)
+    assert rows[0]["value"] == pytest.approx(5.0 / 60.0)
+
+
+def test_counter_new_series_from_known_proc_is_real_increment():
+    st = MetricsHistoryStore()
+    st.ingest("p1", {"a": _counter(7.0)}, ts=1000.0)  # seeds the proc
+    # A key newly appearing from a KNOWN proc is a real increment
+    # from zero, not pre-history.
+    assert st.ingest("p1", {"a": _counter(7.0),
+                            "b": _counter(3.0)}, ts=1002.0) == 1
+    rows = st.window_agg("b", "delta", 60.0, now=1003.0)
+    assert rows[0]["value"] == pytest.approx(3.0)
+
+
+def test_counter_restart_uses_raw_value():
+    st = MetricsHistoryStore()
+    st.ingest("p1", {"c": _counter(100.0)}, ts=1000.0)
+    st.ingest("p1", {"c": _counter(105.0)}, ts=1002.0)
+    # Cumulative value went DOWN: the proc restarted; its new raw
+    # count is the increment.
+    st.ingest("p1", {"c": _counter(2.0)}, ts=1004.0)
+    rows = st.window_agg("c", "delta", 60.0, now=1005.0)
+    assert rows[0]["value"] == pytest.approx(7.0)
+
+
+def test_unchanged_snapshot_appends_nothing():
+    """O(changed series): an idle cluster's re-pushes cost zero
+    points."""
+    st = MetricsHistoryStore()
+    snap = {"c": _counter(10.0), "g": _gauge(4.0)}
+    st.ingest("p1", snap, ts=1000.0)
+    before = st.point_count()
+    assert st.ingest("p1", snap, ts=1002.0) == 0
+    assert st.point_count() == before
+
+
+def test_gauge_change_only_and_carry_forward():
+    st = MetricsHistoryStore(staleness_s=15.0)
+    assert st.ingest("p1", {"g": _gauge(1.0)}, ts=1000.0) == 1
+    assert st.ingest("p1", {"g": _gauge(1.0)}, ts=1002.0) == 0
+    # No point falls inside the 5 s window, but the writer is still
+    # fresh: the last-known value carries forward.
+    rows = st.window_agg("g", "max", 5.0, now=1010.0)
+    assert rows[0]["value"] == pytest.approx(1.0)
+    assert st.window_agg("g", "avg", 5.0, now=1010.0)[0]["value"] \
+        == pytest.approx(1.0)
+    # Past the staleness horizon a dead writer's gauge is NOT
+    # presented as current.
+    assert st.window_agg("g", "max", 5.0, now=1100.0) == []
+
+
+def test_gauge_goes_stale_when_proc_gone():
+    st = MetricsHistoryStore(staleness_s=15.0)
+    st.ingest("p1", {"g": _gauge(2.0)}, ts=1000.0)
+    st.on_proc_gone("p1")
+    # No carry-forward for a departed writer: once its points age out
+    # of the window, the gauge is simply absent, not "still 2.0".
+    assert st.window_agg("g", "last", 5.0, now=1010.0) == []
+    assert st.index()[0]["fresh"] is False
+
+
+def test_histogram_percentile_from_window_bucket_delta():
+    st = MetricsHistoryStore()
+    bounds = [0.1, 1.0]
+    # vec layout: per-bucket counts [<=0.1, <=1.0, +Inf], sum, count.
+    st.ingest("p1", {"h": _hist([0, 0, 0, 0.0, 0], bounds)}, ts=1000.0)
+    st.ingest("p1", {"h": _hist([4, 4, 0, 2.0, 8], bounds)}, ts=1002.0)
+    p50 = st.window_agg("h", "p50", 60.0, now=1003.0)[0]["value"]
+    assert p50 == pytest.approx(0.1)  # rank 4 tops out bucket 1
+    p99 = st.window_agg("h", "p99", 60.0, now=1003.0)[0]["value"]
+    assert p99 == pytest.approx(0.1 + 0.9 * (7.92 - 4) / 4)
+    assert st.window_agg("h", "delta", 60.0, now=1003.0)[0]["value"] \
+        == pytest.approx(8.0)
+    # query_points renders the cumulative observation count.
+    pts = st.query_points("h", 60.0, now=1003.0)[0]["points"]
+    assert pts[-1][1] == pytest.approx(8.0)
+
+
+def test_downsampling_coarse_ring_extends_recent():
+    st = MetricsHistoryStore(recent_points=8, coarse_points=64,
+                             coarse_interval_s=10.0)
+    for i in range(30):
+        st.ingest("p1", {"g": _gauge(float(i))}, ts=1000.0 + 10.0 * i)
+    pts = st.query_points("g", 1e6, now=1300.0)[0]["points"]
+    # The fine ring alone holds 8 points; the coarse ring splices
+    # older history in front of it.
+    assert len(pts) > 8
+    assert pts[0][0] < pts[-8][0]
+    assert pts == sorted(pts, key=lambda p: p[0])
+
+
+def test_memory_hard_cap_evicts_instead_of_growing():
+    st = MetricsHistoryStore(max_bytes=8192)
+    for i in range(300):
+        st.ingest("p1", {"g": {
+            "type": "gauge", "description": "",
+            "values": [[[["i", str(i)]], float(i)]],
+        }}, ts=1000.0 + i)
+    assert st.evictions > 0
+    assert st.bytes_used <= st.max_bytes
+    assert st.series_count() < 300
+    # Survivors are the most recently updated series.
+    names = {s["tags"]["i"] for s in st.index()}
+    assert "299" in names and "0" not in names
+
+
+def test_eviction_keeps_proc_baselines():
+    """Diff baselines survive series eviction, so a re-created series
+    resumes correct deltas instead of re-counting history."""
+    st = MetricsHistoryStore(max_bytes=4096)
+    st.ingest("p1", {"c": _counter(100.0)}, ts=1000.0)
+    st.ingest("p1", {"c": _counter(110.0)}, ts=1001.0)
+    for i in range(200):  # flood: evicts the counter series
+        st.ingest("p1", {"g": {
+            "type": "gauge", "description": "",
+            "values": [[[["i", str(i)]], 1.0]],
+        }}, ts=1002.0 + i)
+    assert st.evictions > 0
+    st.ingest("p1", {"c": _counter(115.0)}, ts=1300.0)
+    rows = st.window_agg("c", "delta", 60.0, now=1301.0)
+    assert rows and rows[0]["value"] == pytest.approx(5.0)
+
+
+# ---------------------------------------------------------------------------
+# alert engine units (fake clock, no cluster)
+# ---------------------------------------------------------------------------
+
+
+def _gauge_rule(**kw):
+    base = dict(name="r", metric="ray_tpu_gcs_nodes", agg="max",
+                op=">", threshold=0.5, window_s=5.0, for_s=0.0,
+                tags={"state": "SUSPECT"})
+    base.update(kw)
+    return AlertRule(**base)
+
+
+def test_engine_pending_for_s_then_fire_then_resolve():
+    st = MetricsHistoryStore(staleness_s=15.0)
+    engine = AlertEngine(st, rules=[_gauge_rule(for_s=5.0)],
+                         clock=lambda: 0.0)
+    tags = (("state", "SUSPECT"),)
+    st.ingest("p1", {"ray_tpu_gcs_nodes": _gauge(1.0, tags)}, ts=1000.0)
+    assert engine.evaluate(now=1001.0) == []      # breach -> pending
+    assert engine.evaluate(now=1003.0) == []      # sustain not met
+    trans = engine.evaluate(now=1006.5)           # for_s=5 elapsed
+    assert [t["event"] for t in trans] == ["fired"]
+    ep = trans[0]["episode"]
+    assert ep["rule"] == "r" and ep["resolved_ts"] is None
+    assert ep["evidence"], "fired episode must carry series evidence"
+    assert engine.firing() and engine.firing()[0]["tags"] == dict(tags)
+    # Recovery: the gauge drops and the old high point has aged out of
+    # the 5 s window — the carry-forward value (0) stops breaching.
+    st.ingest("p1", {"ray_tpu_gcs_nodes": _gauge(0.0, tags)}, ts=1007.0)
+    trans = engine.evaluate(now=1008.0)
+    assert [t["event"] for t in trans] == ["resolved"]
+    assert trans[0]["episode"]["resolved_ts"] == 1008.0
+    assert engine.firing() == []
+    # state() serves episodes newest first with the full lifecycle.
+    state = engine.state()
+    assert state["enabled"] and state["episodes"][0]["rule"] == "r"
+    assert state["episodes"][0]["resolved_ts"] == 1008.0
+
+
+def test_engine_stays_firing_while_breach_in_window():
+    """A gauge dropping back does not resolve the alert until the high
+    point ages out of the rule's window — max is over the window, not
+    the instant."""
+    st = MetricsHistoryStore(staleness_s=60.0)
+    engine = AlertEngine(st, rules=[_gauge_rule()], clock=lambda: 0.0)
+    tags = (("state", "SUSPECT"),)
+    st.ingest("p1", {"ray_tpu_gcs_nodes": _gauge(2.0, tags)}, ts=1000.0)
+    assert [t["event"] for t in engine.evaluate(now=1001.0)] == ["fired"]
+    st.ingest("p1", {"ray_tpu_gcs_nodes": _gauge(0.0, tags)}, ts=1002.0)
+    assert engine.evaluate(now=1003.0) == []  # 2.0 still in the window
+    assert engine.firing()
+    trans = engine.evaluate(now=1008.0)       # high point aged out
+    assert [t["event"] for t in trans] == ["resolved"]
+
+
+def test_engine_counter_rule_resolves_when_delta_ages_out():
+    st = MetricsHistoryStore()
+    rule = AlertRule("cb", "ray_tpu_circuit_breaker_transitions_total",
+                     "delta", ">=", 1.0, window_s=5.0, for_s=0.0,
+                     tags={"state": "open"})
+    engine = AlertEngine(st, rules=[rule], clock=lambda: 0.0)
+    tags = (("state", "open"),)
+    name = "ray_tpu_circuit_breaker_transitions_total"
+    st.ingest("p1", {name: _counter(0.0, tags)}, ts=1000.0)
+    st.ingest("p1", {name: _counter(1.0, tags)}, ts=1001.0)
+    trans = engine.evaluate(now=1001.5)
+    assert [t["event"] for t in trans] == ["fired"]
+    # No new opens: the window empties and the rule resolves by
+    # absence (counters do not carry forward).
+    trans = engine.evaluate(now=1010.0)
+    assert [t["event"] for t in trans] == ["resolved"]
+
+
+def test_engine_flight_recorder_and_telemetry_on_transition():
+    from ray_tpu.util import flight_recorder, telemetry
+
+    st = MetricsHistoryStore()
+    engine = AlertEngine(st, rules=[_gauge_rule()], clock=lambda: 0.0)
+    tags = (("state", "SUSPECT"),)
+    st.ingest("p1", {"ray_tpu_gcs_nodes": _gauge(3.0, tags)}, ts=1000.0)
+    engine.evaluate(now=1001.0)
+    events = [e for e in flight_recorder.snapshot()
+              if e["subsystem"] == "alert" and e["event"] == "fired"
+              and e["tags"].get("rule") == "r"]
+    assert events, "fire must land in the flight ring"
+    assert json.loads(events[-1]["tags"]["window"]), "evidence window"
+    m = telemetry.metric("ray_tpu_alerts_transitions_total")
+    assert m._values.get((("rule", "r"), ("state", "fired")), 0) >= 1
+    g = telemetry.metric("ray_tpu_alerts_firing")
+    assert g._values.get((("rule", "r"),)) == 1
+
+
+def test_remove_rule_drops_states():
+    st = MetricsHistoryStore()
+    engine = AlertEngine(st, rules=[_gauge_rule()], clock=lambda: 0.0)
+    tags = (("state", "SUSPECT"),)
+    st.ingest("p1", {"ray_tpu_gcs_nodes": _gauge(3.0, tags)}, ts=1000.0)
+    engine.evaluate(now=1001.0)
+    engine.remove_rule("r")
+    assert engine.firing() == []
+    assert engine.evaluate(now=1002.0) == []
+
+
+# ---------------------------------------------------------------------------
+# merge staleness, CLI renderers, timeline lane (no cluster)
+# ---------------------------------------------------------------------------
+
+
+def test_merge_snapshots_freshest_gauge_wins_and_stale_flagged():
+    from ray_tpu.util import metrics as um
+
+    now = 10_000.0
+    fresh = {"_meta": {"ts": now - 1.0, "pid": 1},
+             "g": _gauge(1.0), "c": _counter(5.0)}
+    stale = {"_meta": {"ts": now - 300.0, "pid": 2},
+             "g": _gauge(2.0), "c": _counter(7.0)}
+    # KV iteration order must NOT decide: the stale proc sorts LAST
+    # (so last-write-wins would pick it) yet the fresh value wins.
+    merged, procs, stale_map = um.merge_snapshots(
+        {"metrics:a_fresh": fresh, "metrics:z_stale": stale},
+        now=now, staleness_s=15.0)
+    assert merged["g"]["values"][()] == 1.0
+    assert merged["c"]["values"][()] == 12.0  # counters still sum
+    by_proc = {p["proc"]: p for p in procs}
+    assert by_proc["metrics:z_stale"]["stale"] is True
+    assert by_proc["metrics:a_fresh"]["stale"] is False
+    assert by_proc["metrics:a_fresh"]["age_s"] == pytest.approx(1.0)
+    assert "g" not in stale_map  # freshest writer is inside the window
+    # Only stale writers left -> the series itself is flagged.
+    merged, _procs, stale_map = um.merge_snapshots(
+        {"metrics:z_stale": stale}, now=now, staleness_s=15.0)
+    assert stale_map == {"g": [()]}
+    text = um.render_prometheus(merged, procs=_procs, stale=stale_map)
+    assert "# ray_tpu snapshot metrics:z_stale" in text
+    assert "STALE" in text
+
+
+def test_sparkline_renderer():
+    from ray_tpu.scripts.cli import _SPARK_CHARS, _sparkline
+
+    assert _sparkline([]) == ""
+    flat = _sparkline([3.0, 3.0, 3.0])
+    assert len(set(flat)) == 1 and len(flat) == 3
+    ramp = _sparkline(list(range(8)), width=8)
+    assert ramp[0] == _SPARK_CHARS[0] and ramp[-1] == _SPARK_CHARS[-1]
+    assert len(_sparkline(list(range(1000)), width=60)) == 60
+
+
+def test_render_history_lines():
+    from ray_tpu.scripts.cli import _render_history
+
+    assert _render_history({"enabled": False}, 600)[0].startswith(
+        "metrics history disabled")
+    assert "no history" in _render_history(
+        {"enabled": True, "name": "x", "series": []}, 600)[0]
+    reply = {
+        "enabled": True, "name": "m",
+        "series": [{"tags": {"rank": "0"}, "kind": "gauge",
+                    "fresh": False,
+                    "points": [[1.0, 0.0], [2.0, 4.0], [3.0, 2.0]]}],
+        "agg": "max",
+        "aggregates": [{"tags": {"rank": "0"}, "value": 4.0}],
+    }
+    lines = _render_history(reply, 600)
+    text = "\n".join(lines)
+    assert "{rank=0} (gauge, 3 points)  [STALE]" in text
+    assert "min=0 max=4 last=2" in text
+    assert "max[600s]{rank=0} = 4" in text
+
+
+def test_render_alerts_lines():
+    from ray_tpu.scripts.cli import _render_alerts
+
+    assert _render_alerts({"enabled": False})[0].startswith(
+        "alert engine disabled")
+    reply = {
+        "enabled": True,
+        "firing": [{"rule": "stall", "tags": {"rank": "0"},
+                    "value": 31.5, "fired_ts": 1000.0,
+                    "severity": "error"}],
+        "episodes": [
+            {"rule": "stall", "tags": {"rank": "0"}, "value": 31.5,
+             "fired_ts": 1000.0, "resolved_ts": None,
+             "evidence": [[999.0, 10.0], [1000.0, 31.5]]},
+            {"rule": "cb", "tags": {}, "value": 1.0,
+             "fired_ts": 900.0, "resolved_ts": 950.0, "evidence": []},
+        ],
+        "rules": [{"name": "stall"}, {"name": "cb"}],
+    }
+    text = "\n".join(_render_alerts(reply))
+    assert "FIRING (1):" in text and "[ERROR] stall {rank=0}" in text
+    assert "STILL FIRING" in text
+    assert "cb" in text and "resolved" in text
+    assert "rules: 2 loaded (stall, cb)" in text
+
+
+def test_alert_trace_events_lane():
+    from ray_tpu.util.timeline import alert_trace_events
+
+    events = alert_trace_events([
+        {"rule": "a", "metric": "m", "tags": {"x": "1"}, "value": 2.0,
+         "threshold": 1.0, "severity": "warn",
+         "fired_ts": 100.0, "resolved_ts": 103.0},
+        {"rule": "b", "metric": "m", "tags": {}, "value": 5.0,
+         "threshold": 1.0, "severity": "error",
+         "fired_ts": 110.0, "resolved_ts": None},
+    ])
+    assert all(ev["tid"] == "alerts" and ev["cat"] == "alerts"
+               for ev in events)
+    span, instant = events
+    assert span["ph"] == "X" and span["dur"] == pytest.approx(3e6)
+    assert span["args"]["series"] == "x=1"
+    assert instant["ph"] == "i"  # an open alert stays visible
+
+
+def test_profiler_bucket_carries_model_id():
+    """@serve.multiplexed attribution: the replica pushes model_id into
+    the thread context; the sampler's per-request buckets carry it."""
+    from ray_tpu.util import profiler
+
+    token = profiler.push_thread_context(
+        serve_request="req-1", name="serve:dep", deployment="dep",
+        model_id="model-a")
+    try:
+        counts, tasks = {}, {}
+        profiler._sweep(counts, tasks, skip_ident=None)
+        assert tasks["req-1"]["model_id"] == "model-a"
+        assert tasks["req-1"]["samples"] >= 1
+        # The stack root stays serve:<deployment> — attribution rides
+        # the bucket labels, not the flame root.
+        assert any(k.startswith("serve:dep;") for k in counts)
+    finally:
+        profiler.pop_thread_context(token)
+
+
+# ---------------------------------------------------------------------------
+# e2e: breaker trip + stalled rank fire and resolve through the head
+# ---------------------------------------------------------------------------
+
+
+def _poll(predicate, timeout_s=30.0, interval_s=0.5):
+    deadline = time.monotonic() + timeout_s
+    while True:
+        value = predicate()
+        if value:
+            return value
+        if time.monotonic() > deadline:
+            return predicate()
+        time.sleep(interval_s)
+
+
+def test_breaker_and_stalled_rank_alerts_e2e(ray_start_isolated,
+                                             tmp_path):
+    from ray_tpu import train
+    from ray_tpu.core.retry import CircuitBreaker
+    from ray_tpu.scripts.cli import _render_alerts
+    from ray_tpu.train.config import FailureConfig
+    from ray_tpu.util import metrics as um
+    from ray_tpu.util.state import _call
+
+    # Tight rules so the episode fits test wall-time: a breaker open in
+    # the last 3 s, and any rank heartbeat age above 1 s.
+    for rule in (
+        {"name": "e2e_breaker",
+         "metric": "ray_tpu_circuit_breaker_transitions_total",
+         "agg": "delta", "op": ">=", "threshold": 1.0,
+         "window_s": 3.0, "for_s": 0.0, "tags": {"state": "open"}},
+        {"name": "e2e_stall",
+         "metric": "ray_tpu_train_step_heartbeat_age_seconds",
+         "agg": "max", "op": ">", "threshold": 1.0,
+         "window_s": 3.0, "for_s": 0.0, "severity": "error"},
+    ):
+        reply = _call("alerts_put_rule", rule)
+        assert reply["ok"], reply
+
+    # Seed the driver's push baseline in the history store first: a
+    # proc's FIRST snapshot deliberately appends nothing.
+    um.flush_metrics()
+
+    # Fault 1: FaultInjector-driven breaker open. Partition the task
+    # push path so the driver observes real injected faults, and feed
+    # those failures into a breaker exactly as the serve router does
+    # on replica call failures (retry.py's transition telemetry is the
+    # alert's signal either way).
+    from ray_tpu.core import rpc as rpc_mod
+
+    fi = rpc_mod.get_fault_injector()
+    fi.install("partition", method="push_tasks", direction="send",
+               max_matches=2)
+    cb = CircuitBreaker(failure_threshold=2, reset_timeout_s=0.5)
+    try:
+        @ray_tpu.remote
+        def victim():
+            return 1
+
+        assert ray_tpu.get(victim.remote(), timeout=120) == 1
+        assert fi.stats.get("partition", 0) >= 1, "no fault injected"
+        for _ in range(2):
+            cb.record_failure("replica:faulted")  # -> OPEN transition
+    finally:
+        fi.reset()
+        rpc_mod.reset_fault_injector()
+    um.flush_metrics()
+
+    def breaker_fired():
+        reply = _call("alerts")
+        return any(ep["rule"] == "e2e_breaker"
+                   for ep in reply["episodes"]) and reply
+    assert _poll(breaker_fired, timeout_s=20.0), \
+        "breaker-open alert never fired"
+
+    # Fault 2: rank 0 stalls mid-loop; the gang monitor's heartbeat-age
+    # gauge rises until the hang abort, then resets to zero.
+    def loop(config):
+        for step in range(5):
+            if step == 2:
+                time.sleep(60)  # wedged device stand-in
+            train.report({"step": step})
+
+    trainer = train.JaxTrainer(
+        loop,
+        scaling_config=train.ScalingConfig(num_workers=1),
+        run_config=train.RunConfig(
+            name="health_e2e", storage_path=str(tmp_path),
+            failure_config=FailureConfig(
+                max_failures=0,
+                health_check_interval_s=0.25,
+                hang_timeout_s=4.0)),
+    )
+    start = time.monotonic()
+    result = trainer.fit()
+    assert result.error is not None and "hung" in result.error
+    assert time.monotonic() - start < 60.0
+    um.flush_metrics()  # ship the post-abort zeroed gauge
+
+    # Both episodes exist and BOTH resolved after the faults cleared
+    # (the breaker delta aged out of its window; the stall gauge was
+    # reset by the monitor's abort path).
+    def both_resolved():
+        reply = _call("alerts")
+        eps = {ep["rule"]: ep for ep in reply["episodes"]}
+        if "e2e_breaker" not in eps or "e2e_stall" not in eps:
+            return None
+        if not all(eps[r]["resolved_ts"]
+                   for r in ("e2e_breaker", "e2e_stall")):
+            return None
+        return reply
+    reply = _poll(both_resolved, timeout_s=40.0)
+    assert reply, f"episodes never resolved: {_call('alerts')}"
+    eps = {ep["rule"]: ep for ep in reply["episodes"]}
+    for name in ("e2e_breaker", "e2e_stall"):
+        ep = eps[name]
+        assert ep["evidence"], f"{name}: no series-window evidence"
+        assert ep["fired_ts"] < ep["resolved_ts"]
+    assert eps["e2e_stall"]["tags"].get("rank") == "0"
+    assert eps["e2e_stall"]["value"] > 1.0
+
+    # The operator surface shows the episode.
+    text = "\n".join(_render_alerts(reply))
+    assert "e2e_breaker" in text and "e2e_stall" in text
+
+    # The history store served the evidence series.
+    hist = _call("metrics_history", {
+        "name": "ray_tpu_train_step_heartbeat_age_seconds",
+        "window_s": 600.0, "agg": "max"})
+    assert hist["enabled"] and hist["series"]
+    assert any(p[1] > 1.0 for s in hist["series"]
+               for p in s["points"])
+
+    # And the debug bundle carries the whole episode.
+    out = os.path.join(str(tmp_path), "bundle")
+    from ray_tpu.util.debug import write_debug_bundle
+
+    manifest = write_debug_bundle(out, profile_duration_s=0)
+    assert "history" in manifest and "alerts" in manifest
+    with open(os.path.join(out, "history", "series.json")) as f:
+        series = json.load(f)
+    assert series["series_count"] > 0 and series["series"]
+    with open(os.path.join(out, "alerts.json")) as f:
+        dumped = json.load(f)
+    rules_seen = {ep["rule"] for ep in dumped["episodes"]}
+    assert {"e2e_breaker", "e2e_stall"} <= rules_seen

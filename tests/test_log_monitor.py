@@ -1,0 +1,93 @@
+"""LogTailer edge paths (core/log_monitor.py): rotation/truncation
+restart, partial-line carry across polls, the per-poll byte cap, and
+the worker-*.log filename filter. Pure filesystem tests — no cluster."""
+
+import os
+
+from ray_tpu.core.log_monitor import MAX_BYTES_PER_POLL, LogTailer
+
+
+def _write(path, data, mode="ab"):
+    with open(path, mode) as f:
+        f.write(data)
+
+
+def test_poll_returns_new_complete_lines(tmp_path):
+    t = LogTailer(str(tmp_path))
+    p = tmp_path / "worker-abc123.log"
+    _write(p, b"one\ntwo\n")
+    out = t.poll()
+    assert out == [("abc123", ["one", "two"])]
+    # Nothing new: no entry at all (not an empty one).
+    assert t.poll() == []
+    _write(p, b"three\n")
+    assert t.poll() == [("abc123", ["three"])]
+
+
+def test_partial_line_carried_across_polls(tmp_path):
+    t = LogTailer(str(tmp_path))
+    p = tmp_path / "worker-w.log"
+    _write(p, b"head\npart")
+    assert t.poll() == [("w", ["head"])]
+    # The unterminated tail is held, not emitted as a broken line.
+    _write(p, b"ial\ntail\n")
+    assert t.poll() == [("w", ["partial", "tail"])]
+
+
+def test_rotation_restart_when_file_shrinks(tmp_path):
+    """size < offset means the file was rotated/truncated in place:
+    the tailer restarts from 0 instead of silently going quiet."""
+    t = LogTailer(str(tmp_path))
+    p = tmp_path / "worker-w.log"
+    _write(p, b"old line one\nold line two\n")
+    assert t.poll() == [("w", ["old line one", "old line two"])]
+    _write(p, b"new\n", mode="wb")  # rotation: shorter fresh content
+    assert t.poll() == [("w", ["new"])]
+
+
+def test_truncation_to_empty_then_regrow(tmp_path):
+    t = LogTailer(str(tmp_path))
+    p = tmp_path / "worker-w.log"
+    _write(p, b"before\n")
+    assert t.poll() == [("w", ["before"])]
+    _write(p, b"", mode="wb")       # truncated to zero
+    assert t.poll() == []           # size == offset(0): nothing yet
+    _write(p, b"after\n")
+    assert t.poll() == [("w", ["after"])]
+
+
+def test_per_poll_byte_cap(tmp_path):
+    """A worker spamming output cannot wedge a poll: each poll reads at
+    most MAX_BYTES_PER_POLL per file and catches up on later polls
+    without losing or splitting lines."""
+    t = LogTailer(str(tmp_path))
+    p = tmp_path / "worker-w.log"
+    line = b"x" * 99 + b"\n"        # 100 bytes/line
+    total = (MAX_BYTES_PER_POLL // 100) + 50
+    _write(p, line * total)
+    first = t.poll()[0][1]
+    assert len(first) < total       # capped, not one giant read
+    # The cap lands mid-line; the fragment must carry, not emit.
+    assert all(len(ln) == 99 for ln in first)
+    got = len(first)
+    while True:
+        out = t.poll()
+        if not out:
+            break
+        assert all(len(ln) == 99 for ln in out[0][1])
+        got += len(out[0][1])
+    assert got == total             # nothing lost across capped polls
+
+
+def test_only_worker_log_files_are_tailed(tmp_path):
+    t = LogTailer(str(tmp_path))
+    _write(tmp_path / "worker-ok.log", b"yes\n")
+    _write(tmp_path / "other.log", b"no\n")
+    _write(tmp_path / "worker-ok.txt", b"no\n")
+    _write(tmp_path / "head.log", b"no\n")
+    out = t.poll()
+    assert out == [("ok", ["yes"])]
+
+
+def test_missing_directory_is_quiet():
+    assert LogTailer("/nonexistent/logs/dir").poll() == []

@@ -171,6 +171,72 @@ def test_profiler_and_step_heartbeat_metrics_in_catalog():
         assert tuple(got_tags) == tag_keys, name
 
 
+def test_health_plane_metrics_in_catalog():
+    """The cluster-health-plane metrics stay declared — the history
+    store's stats/eviction counter and the alert engine's lifecycle
+    counters emit through these names; a rename/removal would blind
+    the health plane."""
+    expected = {
+        "ray_tpu_metrics_history_series": (telemetry.GAUGE, ()),
+        "ray_tpu_metrics_history_bytes": (telemetry.GAUGE, ()),
+        "ray_tpu_metrics_history_evictions_total": (
+            telemetry.COUNTER, ()),
+        "ray_tpu_alerts_firing": (telemetry.GAUGE, ("rule",)),
+        "ray_tpu_alerts_transitions_total": (
+            telemetry.COUNTER, ("rule", "state")),
+    }
+    for name, (kind, tag_keys) in expected.items():
+        assert name in telemetry.CATALOG, name
+        got_kind, _desc, got_tags, _bounds = telemetry.CATALOG[name]
+        assert got_kind == kind, name
+        assert tuple(got_tags) == tag_keys, name
+
+
+def test_alert_rules_reference_only_catalog_metrics():
+    """Catalog lint extension: every alert rule — the shipped defaults
+    and anything constructed through AlertRule/validate_rule — may only
+    reference declared catalog metrics and tag keys, with an aggregate
+    that fits the metric's kind. A rule naming a typo'd metric fails
+    tier-1 here, not silently at evaluation time."""
+    import pytest
+
+    from ray_tpu.util import alerts
+
+    rules = alerts.default_rules()
+    assert len(rules) >= 8, "default SLO rule set shrank"
+    for rule in rules:
+        alerts.validate_rule(rule)  # raises on any catalog violation
+        spec = telemetry.CATALOG[rule.metric]
+        assert rule.agg in alerts.AGGS_BY_KIND[spec[0]], rule.name
+        for tag_key in rule.tags:
+            assert tag_key in spec[2], (rule.name, tag_key)
+    # The mandated default coverage: one rule per pathology class.
+    covered = {r.metric for r in rules}
+    for metric in (
+        "ray_tpu_train_step_heartbeat_age_seconds",
+        "ray_tpu_circuit_breaker_transitions_total",
+        "ray_tpu_serve_stream_ttft_seconds",
+        "ray_tpu_serve_engine_queue_depth",
+        "ray_tpu_serve_replica_sheds_total",
+        "ray_tpu_gcs_nodes",
+        "ray_tpu_object_spilled_bytes_total",
+        "ray_tpu_profiler_overhead_ratio",
+    ):
+        assert metric in covered, f"default rules lost {metric}"
+    # And the lint itself has teeth: typo'd metric, undeclared tag,
+    # kind-mismatched aggregate all fail validation.
+    with pytest.raises(ValueError, match="not in"):
+        alerts.validate_rule(alerts.AlertRule(
+            "bad", "ray_tpu_does_not_exist_total", "delta", ">", 1.0))
+    with pytest.raises(ValueError, match="not declared"):
+        alerts.validate_rule(alerts.AlertRule(
+            "bad", "ray_tpu_tasks_total", "delta", ">", 1.0,
+            tags={"nope": "x"}))
+    with pytest.raises(ValueError, match="not valid"):
+        alerts.validate_rule(alerts.AlertRule(
+            "bad", "ray_tpu_tasks_total", "p99", ">", 1.0))
+
+
 def test_catalog_metric_roundtrip():
     telemetry.reset_for_testing()
     try:
