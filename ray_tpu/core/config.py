@@ -219,6 +219,34 @@ class Config:
     # Measured-overhead self-check: when sampling time / wall time
     # crosses this, the continuous sampler halves its rate.
     profiler_max_overhead_ratio: float = 0.02
+    # Retention for the continuous sampler's snapshot directory
+    # (<session>/profile/): oldest files beyond either cap are deleted
+    # after each snapshot rewrite, so a long soak can't fill the disk.
+    # 0 disables the corresponding bound.
+    profiler_snapshot_max_files: int = 64
+    profiler_snapshot_max_bytes: int = 32 * 1024 * 1024
+
+    # --- device trace plane (util/device_trace.py) ---
+    # Hard cap on one jax.profiler capture window; requests above it
+    # are clamped (a capture holds the per-process capture lock for
+    # its whole duration).
+    device_trace_max_duration_s: float = 60.0
+    # A trace file above this is dropped with an error instead of
+    # shipped over RPC / retained on disk (device traces grow with
+    # ops x duration; the fan-out reply must stay bounded).
+    device_trace_max_trace_bytes: int = 64 * 1024 * 1024
+    # Retention for <session>/device_trace/ raw trace files (same
+    # oldest-first policy as the profiler snapshot dir; 0 disables).
+    device_trace_retain_files: int = 8
+    device_trace_retain_bytes: int = 256 * 1024 * 1024
+
+    # --- experiment-state journal (core/health.py) ---
+    # Periodically persist the head's metrics-history rings + open
+    # alert state to <session>/health_journal/ and reload them on head
+    # start, so a restarted driver recovers metrics_history and alert
+    # continuity instead of starting cold.
+    health_journal_enabled: bool = True
+    health_journal_interval_s: float = 30.0
 
     # --- lockdep witness (util/locks.py) ---
     # Debug-mode instrumented locks: record cross-thread lock

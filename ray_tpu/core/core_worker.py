@@ -627,6 +627,7 @@ class CoreWorker:
             "ping": self.h_ping,
             "debug_dump": self.h_debug_dump,
             "profile_capture": self.h_profile_capture,
+            "device_trace_capture": self.h_device_trace_capture,
             "fetch_device_shard": self.h_fetch_device_shard,
             "donate_device_shards": self.h_donate_device_shards,
         }
@@ -666,6 +667,24 @@ class CoreWorker:
         hz = float(payload.get("hz", 100.0))
         out = await asyncio.get_running_loop().run_in_executor(
             None, lambda: profiler.capture(duration, hz))
+        out.update(worker_id=self.worker_id.hex(), mode=self.mode,
+                   node_id=self.node_id_hex)
+        return out
+
+    async def h_device_trace_capture(self, conn, payload):
+        """Device-trace plane: run a bounded jax.profiler window in
+        this process and return the parsed ops/steps/lanes plus raw
+        trace bytes. start/stop_trace and the parse both block, so the
+        whole capture runs on the executor pool — the event loop keeps
+        serving while the (likely jitted-step) workload is traced. A
+        capture already in flight is rejected inside capture() with a
+        structured error, never queued."""
+        payload = payload or {}
+        from ray_tpu.util import device_trace
+
+        duration = float(payload.get("duration_s", 2.0))
+        out = await asyncio.get_running_loop().run_in_executor(
+            None, lambda: device_trace.capture(duration))
         out.update(worker_id=self.worker_id.hex(), mode=self.mode,
                    node_id=self.node_id_hex)
         return out

@@ -123,7 +123,8 @@ class HeadService:
         # (core/health.py). Best-effort by contract.
         from ray_tpu.core.health import ClusterHealthPlane
 
-        self.health = ClusterHealthPlane(config)
+        self.health = ClusterHealthPlane(config,
+                                         session_dir=session_dir)
 
     # ------------------------------------------------------------------
     # lifecycle
@@ -582,6 +583,8 @@ class HeadService:
             "debug_dump_cluster": self.h_debug_dump_cluster,
             "debug_sched_state": self.h_debug_sched_state,
             "profile_capture_cluster": self.h_profile_capture_cluster,
+            "device_trace_capture_cluster":
+                self.h_device_trace_capture_cluster,
             # Serve the head-host node store for cross-node pulls.
             **object_transfer.serve_handlers(),
         }
@@ -1821,6 +1824,110 @@ class HeadService:
             })
         return out
 
+    def _fanout_targets(self, kind: str, ident: str):
+        """Resolve a capture fan-out target set: ``(targets, error)``
+        where targets are ``(source, node_hex, connection)`` rows.
+        Shared by the host-sampler and device-trace fan-outs — the
+        worker|task|actor|all grammar must stay identical between
+        them. ``kind`` is pre-validated by the callers."""
+        def live_workers(prefix=None):
+            found = []
+            for h in self.pool.workers.values():
+                c = h.connection
+                if c is None or getattr(c, "closed", False):
+                    continue
+                if prefix and not h.worker_id.hex().startswith(prefix):
+                    continue
+                found.append((f"worker:{h.worker_id.hex()}",
+                              h.node_id.hex(), c))
+            return found
+
+        if kind == "worker":
+            if not ident:
+                return [], "worker id required"
+            targets = live_workers(ident)
+            if not targets:
+                return [], f"no live worker with id prefix {ident!r}"
+            return targets, None
+        if kind == "actor":
+            if not ident:
+                return [], "actor id required"
+            wid = None
+            for actor_id, info in self.actors.items():
+                if (actor_id.hex().startswith(ident)
+                        and info.address is not None):
+                    wid = info.address.worker_id_hex
+                    break
+            if wid is None:
+                return [], f"no live actor with id prefix {ident!r}"
+            targets = live_workers(wid)
+            if not targets:
+                return [], (f"actor {ident[:16]}'s worker {wid[:12]} "
+                            "is not reachable")
+            return targets, None
+        if kind == "task":
+            if not ident:
+                return [], "task id required"
+            wid = None
+            state = None
+            for ev in reversed(self.task_events):
+                if (ev.get("task_id", "").startswith(ident)
+                        and ev.get("worker_id")):
+                    wid, state = ev["worker_id"], ev.get("state")
+                    break
+            if wid is None:
+                return [], (f"no task event with id prefix {ident!r} "
+                            "names a worker (wrong id, or events "
+                            "rotated out)")
+            targets = live_workers(wid)
+            if not targets:
+                return [], (f"task {ident[:16]}'s worker {wid[:12]} "
+                            f"(last state {state}) is not reachable")
+            return targets, None
+        # all
+        targets = live_workers()
+        for node_id, agent in self._node_agents.items():
+            if not getattr(agent, "closed", False):
+                targets.append((f"agent:{node_id.hex()}",
+                                node_id.hex(), agent))
+        return targets, None
+
+    async def _capture_fanout(self, kind: str, ident: str, method: str,
+                              req: dict, timeout: float,
+                              head_capture) -> dict:
+        """Common fan-out body for the profile / device-trace capture
+        handlers: resolve targets, call ``method`` on each with
+        per-source error entries, and (for ``kind=all``) run
+        ``head_capture`` in an executor for this head's own slice."""
+        targets, error = self._fanout_targets(kind, ident)
+        if error:
+            return {"entries": [], "error": error}
+
+        async def one(source, node_hex, c):
+            try:
+                rep = await c.call(method, req, timeout=timeout)
+                rep["source"] = source
+                rep.setdefault("node_id", node_hex)
+                return rep
+            except Exception as e:  # noqa: BLE001 — capture must survive peers
+                return {"source": source, "node_id": node_hex,
+                        "error": f"{type(e).__name__}: {e}"}
+
+        gathered = asyncio.gather(*(one(*t) for t in targets))
+        if kind == "all":
+            head_cap, entries = await asyncio.gather(
+                asyncio.get_running_loop().run_in_executor(
+                    None, head_capture),
+                gathered)
+            head_cap["source"] = "head"
+            head_cap["node_id"] = (self.default_node_id.hex()
+                                   if hasattr(self, "default_node_id")
+                                   else None)
+            entries = [head_cap] + list(entries)
+        else:
+            entries = list(await gathered)
+        return {"entries": entries, "ts": time.time(), **req}
+
     async def h_profile_capture_cluster(self, conn, payload):
         """Fan the ``profile_capture`` sampling window out — to one
         worker (``kind=worker``), the worker running a task
@@ -1843,97 +1950,37 @@ class HeadService:
         }
         timeout = req["duration_s"] + float(
             payload.get("timeout_s", 10.0))
+        from ray_tpu.util import profiler
 
-        def live_workers(prefix=None):
-            found = []
-            for h in self.pool.workers.values():
-                c = h.connection
-                if c is None or getattr(c, "closed", False):
-                    continue
-                if prefix and not h.worker_id.hex().startswith(prefix):
-                    continue
-                found.append((f"worker:{h.worker_id.hex()}",
-                              h.node_id.hex(), c))
-            return found
+        return await self._capture_fanout(
+            kind, ident, "profile_capture", req, timeout,
+            lambda: profiler.capture(**req))
 
-        targets = []
-        if kind == "worker":
-            if not ident:
-                return {"entries": [], "error": "worker id required"}
-            targets = live_workers(ident)
-            if not targets:
-                return {"entries": [], "error":
-                        f"no live worker with id prefix {ident!r}"}
-        elif kind == "actor":
-            if not ident:
-                return {"entries": [], "error": "actor id required"}
-            wid = None
-            for actor_id, info in self.actors.items():
-                if (actor_id.hex().startswith(ident)
-                        and info.address is not None):
-                    wid = info.address.worker_id_hex
-                    break
-            if wid is None:
-                return {"entries": [], "error":
-                        f"no live actor with id prefix {ident!r}"}
-            targets = live_workers(wid)
-            if not targets:
-                return {"entries": [], "error":
-                        f"actor {ident[:16]}'s worker {wid[:12]} is "
-                        "not reachable"}
-        elif kind == "task":
-            if not ident:
-                return {"entries": [], "error": "task id required"}
-            wid = None
-            state = None
-            for ev in reversed(self.task_events):
-                if (ev.get("task_id", "").startswith(ident)
-                        and ev.get("worker_id")):
-                    wid, state = ev["worker_id"], ev.get("state")
-                    break
-            if wid is None:
-                return {"entries": [], "error":
-                        f"no task event with id prefix {ident!r} names "
-                        "a worker (wrong id, or events rotated out)"}
-            targets = live_workers(wid)
-            if not targets:
-                return {"entries": [], "error":
-                        f"task {ident[:16]}'s worker {wid[:12]} "
-                        f"(last state {state}) is not reachable"}
-        else:  # all
-            targets = live_workers()
-            for node_id, agent in self._node_agents.items():
-                if not getattr(agent, "closed", False):
-                    targets.append((f"agent:{node_id.hex()}",
-                                    node_id.hex(), agent))
+    async def h_device_trace_capture_cluster(self, conn, payload):
+        """Fan the ``device_trace_capture`` window out with the same
+        worker|task|actor|all grammar as the host sampler. Each target
+        runs one bounded jax.profiler window off its event loop and
+        returns the parsed ops/steps/lanes plus the raw trace bytes;
+        a dead peer or a per-process capture failure (concurrent
+        capture, missing backend, oversized trace) comes back as a
+        per-source error entry — the fan-out itself never fails."""
+        payload = payload or {}
+        kind = payload.get("kind", "all")
+        if kind not in ("worker", "task", "actor", "all"):
+            return {"entries": [], "error":
+                    f"unknown kind {kind!r} (worker|task|actor|all)"}
+        ident = (payload.get("id") or "").lower()
+        req = {"duration_s": float(payload.get("duration_s", 2.0))}
+        # Device captures carry jax import + trace flush on top of the
+        # window itself, so the per-target deadline is roomier than the
+        # host sampler's.
+        timeout = req["duration_s"] + float(
+            payload.get("timeout_s", 30.0))
+        from ray_tpu.util import device_trace
 
-        async def one(source, node_hex, c):
-            try:
-                rep = await c.call("profile_capture", req,
-                                   timeout=timeout)
-                rep["source"] = source
-                rep.setdefault("node_id", node_hex)
-                return rep
-            except Exception as e:  # noqa: BLE001 — capture must survive peers
-                return {"source": source, "node_id": node_hex,
-                        "error": f"{type(e).__name__}: {e}"}
-
-        gathered = asyncio.gather(*(one(*t) for t in targets))
-        if kind == "all":
-            from ray_tpu.util import profiler
-
-            head_cap, entries = await asyncio.gather(
-                asyncio.get_running_loop().run_in_executor(
-                    None, lambda: profiler.capture(**req)),
-                gathered)
-            head_cap["source"] = "head"
-            head_cap["node_id"] = (self.default_node_id.hex()
-                                   if hasattr(self, "default_node_id")
-                                   else None)
-            entries = [head_cap] + list(entries)
-        else:
-            entries = list(await gathered)
-        return {"entries": entries, "ts": time.time(), **req}
+        return await self._capture_fanout(
+            kind, ident, "device_trace_capture", req, timeout,
+            lambda: device_trace.capture(**req))
 
     async def h_debug_sched_state(self, conn, payload):
         """The scheduler's live waiting state, for the `why` explainer:

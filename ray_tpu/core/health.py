@@ -17,6 +17,7 @@ failure inside the plane must never fail a metrics push.
 from __future__ import annotations
 
 import json
+import os
 import time
 from typing import Optional
 
@@ -24,7 +25,8 @@ from ray_tpu.core.config import Config
 
 
 class ClusterHealthPlane:
-    def __init__(self, config: Config):
+    def __init__(self, config: Config,
+                 session_dir: Optional[str] = None):
         from ray_tpu.util.alerts import AlertEngine, default_rules
         from ray_tpu.util.metrics_history import MetricsHistoryStore
 
@@ -41,6 +43,17 @@ class ClusterHealthPlane:
             self.engine = AlertEngine(self.store, rules=default_rules())
         self._eval_interval = float(config.alerts_eval_interval_s)
         self._last_eval = 0.0
+        # Experiment-state journal: the metric trajectory and open-alert
+        # state survive a head restart (the "what led here" record would
+        # otherwise die with the process holding it).
+        self._journal_dir: Optional[str] = None
+        self._journal_interval = float(config.health_journal_interval_s)
+        self._last_journal = 0.0
+        if (self.enabled and session_dir
+                and config.health_journal_enabled):
+            self._journal_dir = os.path.join(session_dir,
+                                             "health_journal")
+            self._load_journal(config)
 
     # -- ingest (h_kv_put hook; must never raise) ------------------------
 
@@ -92,6 +105,61 @@ class ClusterHealthPlane:
     def tick(self) -> None:
         """Pump-driven sweep so alerts resolve without fresh pushes."""
         self.maybe_evaluate()
+        self.maybe_journal()
+
+    # -- experiment-state journal ----------------------------------------
+
+    def _load_journal(self, config: Config) -> None:
+        """Reload the previous head's journal on start (best-effort:
+        a corrupt or missing journal means starting cold, not failing
+        head bring-up)."""
+        try:
+            hist_path = os.path.join(self._journal_dir, "history.json")
+            if os.path.exists(hist_path):
+                with open(hist_path) as f:
+                    self.store.restore(json.load(f))
+            if self.engine is not None:
+                alerts_path = os.path.join(self._journal_dir,
+                                           "alerts.json")
+                if os.path.exists(alerts_path):
+                    with open(alerts_path) as f:
+                        self.engine.restore(json.load(f))
+                    # Restored firing alerts must not be insta-resolved
+                    # by the first sweep before any process has pushed
+                    # again: hold evaluation for one staleness window.
+                    self._last_eval = (time.time()
+                                       + float(config.metrics_staleness_s))
+        except Exception as e:  # lint: allow-silent(journal reload is decoration on head start; see swallow)
+            from ray_tpu.util import flight_recorder
+
+            flight_recorder.swallow("health.load_journal", e)
+
+    def maybe_journal(self, now: Optional[float] = None) -> None:
+        """Write the history rings + open-alert state to the session
+        dir at ``health_journal_interval_s`` cadence (tmp + rename, so
+        a crash mid-write leaves the previous journal intact)."""
+        if self._journal_dir is None:
+            return
+        now = time.time() if now is None else now
+        if now - self._last_journal < self._journal_interval:
+            return
+        self._last_journal = now
+        try:
+            os.makedirs(self._journal_dir, exist_ok=True)
+            docs = [("history.json", self.store.snapshot(512))]
+            if self.engine is not None:
+                docs.append(("alerts.json",
+                             self.engine.journal_state()))
+            for name, doc in docs:
+                path = os.path.join(self._journal_dir, name)
+                tmp = path + ".tmp"
+                with open(tmp, "w") as f:
+                    json.dump(doc, f, default=str)
+                os.replace(tmp, path)
+        except Exception as e:  # lint: allow-silent(journal write is decoration on the pump; see swallow)
+            from ray_tpu.util import flight_recorder
+
+            flight_recorder.swallow("health.journal", e)
 
     # -- handler payloads ------------------------------------------------
 

@@ -109,6 +109,7 @@ class NodeAgent:
             "shutdown_node": self.h_shutdown_node,
             "debug_dump": self.h_debug_dump,
             "profile_capture": self.h_profile_capture,
+            "device_trace_capture": self.h_device_trace_capture,
             **object_transfer.serve_handlers(),
         }
 
@@ -141,6 +142,21 @@ class NodeAgent:
         hz = float(payload.get("hz", 100.0))
         out = await asyncio.get_running_loop().run_in_executor(
             None, lambda: profiler.capture(duration, hz))
+        out.update(mode="agent", node_id=self.node_id_hex)
+        return out
+
+    async def h_device_trace_capture(self, conn, payload):
+        """The agent's slice of the device-trace plane. Agents rarely
+        touch a device, but the capture still yields the host-lane
+        sampler sweep and (on shared-backend nodes) any device activity
+        the agent process itself drives — and a uniform surface keeps
+        the ``kind=all`` fan-out simple."""
+        payload = payload or {}
+        from ray_tpu.util import device_trace
+
+        duration = float(payload.get("duration_s", 2.0))
+        out = await asyncio.get_running_loop().run_in_executor(
+            None, lambda: device_trace.capture(duration))
         out.update(mode="agent", node_id=self.node_id_hex)
         return out
 

@@ -42,6 +42,8 @@ class DashboardActor:
         app.router.add_get("/api/debug", self._debug)
         app.router.add_get("/profile", self._profile)
         app.router.add_get("/api/profile", self._profile)
+        app.router.add_get("/trace", self._trace)
+        app.router.add_get("/api/trace", self._trace)
         app.router.add_get("/metrics", self._metrics)
         app.router.add_get("/metrics/history", self._metrics_history)
         app.router.add_get("/api/metrics/history", self._metrics_history)
@@ -180,6 +182,48 @@ class DashboardActor:
                     merged, title=f"ray_tpu profile {kind} {ident}")
                 return web.Response(text=html,
                                     content_type="text/html")
+            return web.json_response(reply)
+        except Exception as e:
+            return web.json_response({"error": str(e)}, status=500)
+
+    async def _trace(self, request):
+        """On-demand cluster device trace — the HTTP face of
+        ``ray_tpu profile --device``. Query params: ``kind`` (worker /
+        task / actor / all), ``id``, ``duration`` (s, capped), and
+        ``format=json|html`` (html renders the merged host+device
+        timeline). JSON replies strip the raw gzipped trace bytes —
+        fetch those via the CLI, which writes them per-source."""
+        from aiohttp import web
+
+        from ray_tpu.util import device_trace
+        from ray_tpu.util.state import _call
+
+        kind = request.query.get("kind", "all")
+        ident = request.query.get("id", "")
+        fmt = request.query.get("format", "json")
+        try:
+            duration = min(float(request.query.get("duration", 2.0)),
+                           60.0)
+        except ValueError as e:
+            return web.json_response({"error": str(e)}, status=400)
+        loop = asyncio.get_event_loop()
+        try:
+            reply = await loop.run_in_executor(
+                None, lambda: _call("device_trace_capture_cluster", {
+                    "kind": kind, "id": ident,
+                    "duration_s": duration}))
+            if reply.get("error"):
+                return web.json_response({"error": reply["error"]},
+                                         status=400)
+            entries = reply.get("entries", [])
+            if fmt == "html":
+                html = device_trace.unified_timeline_html(
+                    device_trace.merged_timeline_events(entries),
+                    title=f"ray_tpu trace {kind} {ident}".strip())
+                return web.Response(text=html,
+                                    content_type="text/html")
+            reply["entries"] = [device_trace.entry_json(e)
+                                for e in entries]
             return web.json_response(reply)
         except Exception as e:
             return web.json_response({"error": str(e)}, status=500)

@@ -216,6 +216,11 @@ def cmd_profile(args):
     if kind != "all" and not args.id:
         print(f"profile {args.kind} requires an id", file=sys.stderr)
         sys.exit(2)
+    if getattr(args, "device", False):
+        # --device flips to the device-trace plane; the host-sampler
+        # default below is unchanged.
+        _cmd_profile_device(ray_tpu, kind, args)
+        return
     try:
         print(f"sampling {args.kind} "
               f"{args.id or ''} for {args.duration:g}s at "
@@ -240,6 +245,45 @@ def cmd_profile(args):
                   f"on {bucket.get('source', '?')}")
         if manifest["errors"]:
             print(f"  unreachable: {json.dumps(manifest['errors'])}")
+    finally:
+        ray_tpu.shutdown()
+
+
+def _cmd_profile_device(ray_tpu, kind, args):
+    """Device-trace plane: fan a bounded jax.profiler window out over
+    the cluster and write per-source trace.json.gz + ops.json plus a
+    merged host+device timeline HTML."""
+    from ray_tpu.util import device_trace
+
+    try:
+        print(f"device-tracing {args.kind} {args.id or ''} for "
+              f"{args.duration:g}s ...", flush=True)
+        reply = device_trace.capture_cluster(
+            kind, args.id, duration_s=args.duration)
+        if reply.get("error"):
+            print(f"error: {reply['error']}", file=sys.stderr)
+            sys.exit(1)
+        manifest = device_trace.write_trace_outputs(
+            reply, args.out,
+            title=(f"ray_tpu profile --device {args.kind} "
+                   f"{args.id or ''}").strip())
+        print(f"wrote device trace to {args.out} "
+              f"({manifest['device_events']} device op event(s) from "
+              f"{len(manifest['sources'])} process(es))")
+        print(f"  timeline: {manifest['timeline']}")
+        for row in manifest["steps"][:12]:
+            ops = ", ".join(f"{name} {us / 1e3:.1f}ms"
+                            for name, us in row.get("top_ops", [])[:3])
+            print(f"  rank {row.get('rank')} step {row.get('step')}: "
+                  f"compile {row.get('compile_ms', 0):.1f}ms "
+                  f"execute {row.get('execute_ms', 0):.1f}ms "
+                  f"gap {row.get('gap_ms', 0):.1f}ms"
+                  + (f"  [{ops}]" if ops else ""))
+        if len(manifest["steps"]) > 12:
+            print(f"  ... {len(manifest['steps']) - 12} more step "
+                  "row(s) in trace.json")
+        if manifest["errors"]:
+            print(f"  failed: {json.dumps(manifest['errors'])}")
     finally:
         ray_tpu.shutdown()
 
@@ -588,6 +632,11 @@ def main(argv=None):
                    help="sampling rate")
     p.add_argument("--out", "-o", default="ray_tpu_profile",
                    help="output directory")
+    p.add_argument("--device", action="store_true",
+                   help="capture a jax.profiler device trace instead "
+                   "of the host sampler: per-source trace.json.gz + "
+                   "parsed op table + merged host+device timeline "
+                   "HTML with per-step compile/execute breakdown")
     p.set_defaults(fn=cmd_profile)
 
     p = sub.add_parser("submit", help="submit a job")

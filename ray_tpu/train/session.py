@@ -17,6 +17,7 @@ import time
 from typing import Any, Dict, Optional
 
 from ray_tpu.train.checkpoint import Checkpoint
+from ray_tpu.util import device_trace
 
 _session_lock = threading.Lock()
 _session: Optional["_TrainSession"] = None
@@ -80,6 +81,11 @@ class _TrainSession:
     def set_phase(self, phase: str) -> None:
         self.step_phase = phase
         self.phase_since = time.monotonic()
+        # Mirror every phase edge into the device-trace recorder's
+        # wall-clock window ring, so a jax.profiler capture of this
+        # process can attribute each XLA op span to "step N /
+        # compile|execute" for this rank.
+        device_trace.note_phase(phase, rank=self.context.world_rank)
 
     def report(self, metrics: Dict[str, Any],
                checkpoint: Optional[Checkpoint] = None) -> None:

@@ -353,6 +353,47 @@ class AlertEngine:
             "rules": [r.to_dict() for r in self.rules.values()],
         }
 
+    # -- journal ---------------------------------------------------------
+
+    def journal_state(self) -> dict:
+        """JSONable open-alert state for the head's experiment-state
+        journal: the episode history plus per-series state machines
+        (episodes referenced by firing states are carried by identity
+        through ``episode_index``, so resolve-after-restore stamps the
+        same episode record the journal stored)."""
+        ep_list = list(self.episodes)
+        ep_ids = {id(ep): i for i, ep in enumerate(ep_list)}
+        states = []
+        for (rule_name, tk), st in self._states.items():
+            row = {k: v for k, v in st.items() if k != "episode"}
+            ep = st.get("episode")
+            row["episode_index"] = ep_ids.get(id(ep)) if ep else None
+            states.append([rule_name, [list(p) for p in tk], row])
+        return {"episodes": ep_list, "states": states}
+
+    def restore(self, data: dict) -> int:
+        """Reload ``journal_state()`` output after a head restart;
+        returns state machines restored. Episodes for unknown rules are
+        kept (history is history); state machines for unknown rules are
+        dropped (the rule set is authoritative). Restored firing states
+        resolve normally once fresh pushes show the breach is gone —
+        the first post-restore evaluate() should be delayed past one
+        push interval so live-but-silent series aren't insta-resolved."""
+        ep_list = [dict(ep) for ep in data.get("episodes", [])]
+        self.episodes.extend(ep_list)
+        restored = 0
+        for rule_name, tk, row in data.get("states", []):
+            if rule_name not in self.rules:
+                continue
+            st = dict(row)
+            idx = st.pop("episode_index", None)
+            if idx is not None and 0 <= idx < len(ep_list):
+                st["episode"] = ep_list[idx]
+            key = (rule_name, tuple(tuple(p) for p in tk))
+            self._states[key] = st
+            restored += 1
+        return restored
+
 
 def _fmt_tags(tk: tuple) -> str:
     return ",".join(f"{k}={v}" for k, v in tk) or "-"

@@ -82,7 +82,8 @@ def _jsonable_metrics(merged: Dict[str, dict]) -> Dict[str, dict]:
 
 
 def write_debug_bundle(out_dir: str, timeout_s: float = 10.0,
-                       profile_duration_s: float = 1.0) -> dict:
+                       profile_duration_s: float = 1.0,
+                       trace_duration_s: float = 1.0) -> dict:
     """Write a cluster-wide post-mortem bundle and return its manifest.
 
     Layout: ``rings/<source>.json``, ``stacks/<source>.txt``,
@@ -93,10 +94,13 @@ def write_debug_bundle(out_dir: str, timeout_s: float = 10.0,
     endpoint), ``alerts.json`` (firing alerts + recent fire/resolve
     episodes with series evidence), ``profile/`` (a short
     cluster-wide sampling capture: per-source folded stacks +
-    flamegraph HTML; ``profile_duration_s=0`` skips it),
-    ``manifest.json``. Sections that fail (a dead subsystem is exactly
-    when you need the rest) are recorded in the manifest's ``errors``
-    instead of aborting the bundle."""
+    flamegraph HTML; ``profile_duration_s=0`` skips it), ``trace/``
+    (a short cluster-wide device-trace capture: per-source
+    trace.json.gz + parsed op tables + merged host+device timeline;
+    ``trace_duration_s=0`` skips it), ``manifest.json``. Sections that
+    fail (a dead subsystem is exactly when you need the rest) are
+    recorded in the manifest's ``errors`` instead of aborting the
+    bundle."""
     os.makedirs(out_dir, exist_ok=True)
     manifest: Dict[str, Any] = {"created": time.time(), "errors": {},
                                 "sources": [], "nodes": []}
@@ -213,6 +217,26 @@ def write_debug_bundle(out_dir: str, timeout_s: float = 10.0,
             }
         except Exception as e:  # noqa: BLE001
             manifest["errors"]["profile"] = f"{type(e).__name__}: {e}"
+
+    if trace_duration_s and trace_duration_s > 0:
+        # A short device-trace window across every process: which XLA
+        # ops were running, per train step, alongside the host samples.
+        try:
+            from ray_tpu.util import device_trace
+
+            reply = device_trace.capture_cluster(
+                "all", duration_s=trace_duration_s)
+            tr = device_trace.write_trace_outputs(
+                reply, os.path.join(out_dir, "trace"),
+                title="debug bundle device trace")
+            manifest["trace"] = {
+                "sources": tr["sources"],
+                "device_events": tr["device_events"],
+                "steps": len(tr["steps"]),
+                "unreachable": tr["errors"],
+            }
+        except Exception as e:  # noqa: BLE001
+            manifest["errors"]["trace"] = f"{type(e).__name__}: {e}"
 
     with open(os.path.join(out_dir, "manifest.json"), "w") as f:
         json.dump(manifest, f, indent=1)

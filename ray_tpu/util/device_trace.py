@@ -1,0 +1,890 @@
+"""Device-trace plane: cluster-wide ``jax.profiler`` capture with
+step attribution and unified host+device timelines.
+
+The host sampling profiler (util/profiler.py) attributes a stall to
+"stuck in jitted step N" and then goes blind — everything inside the
+XLA program is opaque, which is exactly where a TPU-native runtime
+spends its time. Production TPU work is profile-driven: both the
+pjit/TPUv4 training study (arXiv:2204.06514) and TPU serving
+evaluations diagnose step-time regressions from device traces, not
+host stacks. This module is the device half:
+
+- **capture** — ``capture(duration_s)`` wraps
+  ``jax.profiler.start_trace``/``stop_trace`` for a bounded window and
+  parses the emitted ``trace.json.gz`` (perfetto/chrome-trace JSON, so
+  no TF/XPlane proto deps) into timeline lanes, a per-op table and a
+  per-step breakdown. One capture at a time per process; a concurrent
+  request is rejected with a clear error, never queued. A light host
+  lane sampler runs alongside so the unified timeline shows host
+  threads and device ops on one time axis.
+- **step attribution** — the train session reports every step-phase
+  transition here (``note_phase``), building a wall-clock ring of
+  ``{step, phase, rank, t0, t1}`` windows; each parsed device span is
+  attributed by midpoint to "step N / compile|execute", giving every
+  train rank a ``{step, compile_ms, execute_ms, gap_ms, top_ops}``
+  breakdown.
+- **cluster wiring** — ``device_trace_capture`` RPC on CoreWorker and
+  the node agent (off-loop), ``device_trace_capture_cluster`` head
+  fan-out with worker|task|actor|all targeting,
+  ``ray_tpu profile --device``, dashboard ``GET /trace``, and a
+  ``trace/`` section in ``write_debug_bundle``.
+- **memory census** — ``device_memory_census()``: per-device
+  ``memory_stats()`` where the backend provides it (graceful ``null``
+  on CPU) plus a live-array census (count/bytes by sharding) from the
+  device object registry.
+
+Everything works under ``JAX_PLATFORMS=cpu``: the CPU backend emits
+XLA op events (``args.hlo_op``) on its client threads too, so the
+whole plane is tier-1 testable without a TPU.
+"""
+
+from __future__ import annotations
+
+import contextlib
+import glob
+import gzip
+import json
+import os
+import re
+import shutil
+import sys
+import tempfile
+import threading
+import time
+from collections import deque
+from typing import Any, Dict, List, Optional
+
+#: Device-op spans kept per parsed trace (longest first); the python
+#: helper lane jax traces alongside is dropped entirely.
+MAX_LANE_EVENTS = 3000
+#: Host-lane spans kept per capture.
+MAX_HOST_SPANS = 2000
+#: Step-phase windows retained per process.
+MAX_PHASE_WINDOWS = 4096
+#: Rows in the per-op aggregate table.
+DEFAULT_TOP_K = 25
+
+_GZIP_MAGIC = b"\x1f\x8b"
+
+
+def _config():
+    try:
+        from ray_tpu.core.config import get_config
+
+        return get_config()
+    except Exception:  # config not bootstrapped (bare tools)
+        return None
+
+
+def _default_out_dir() -> str:
+    base = os.environ.get("RAY_TPU_SESSION_DIR")
+    if base:
+        return os.path.join(base, "device_trace")
+    return os.path.join(tempfile.gettempdir(), "ray_tpu", "device_trace")
+
+
+# ---------------------------------------------------------------------------
+# step-phase window recorder (fed by train/session.py set_phase)
+# ---------------------------------------------------------------------------
+
+_phase_lock = threading.Lock()
+_phase_windows: deque = deque(maxlen=MAX_PHASE_WINDOWS)
+_phase_open: Optional[dict] = None
+_step_counter = 0
+
+
+def note_phase(phase: str, rank: Optional[int] = None) -> None:
+    """Record a step-phase transition (train session ``set_phase``
+    hook). Closes the open window, appends it to the ring, and advances
+    the step counter when a ``step`` window closes — so a window's
+    ``step`` is the index of the train step it belongs to (the compile
+    window for step N precedes step N's execute window)."""
+    global _phase_open, _step_counter
+    now = time.time()
+    with _phase_lock:
+        prev = _phase_open
+        if prev is not None:
+            prev["t1"] = now
+            _phase_windows.append(prev)
+            if prev["phase"] == "step":
+                _step_counter += 1
+        if rank is None and prev is not None:
+            rank = prev.get("rank")
+        _phase_open = (
+            {"phase": phase, "t0": now, "t1": None,
+             "step": _step_counter, "rank": rank}
+            if phase else None)
+
+
+def phase_windows(t0: float, t1: float) -> List[dict]:
+    """Closed windows overlapping ``[t0, t1]`` (wall clock), the open
+    window clipped to now. Each: ``{phase, step, rank, t0, t1}``."""
+    now = time.time()
+    with _phase_lock:
+        wins = [dict(w) for w in _phase_windows]
+        if _phase_open is not None:
+            wins.append(dict(_phase_open, t1=now))
+    return [w for w in wins if w["t1"] > t0 and w["t0"] < t1]
+
+
+def current_step() -> int:
+    with _phase_lock:
+        return _step_counter
+
+
+def reset_phase_windows_for_testing() -> None:
+    global _phase_open, _step_counter
+    with _phase_lock:
+        _phase_windows.clear()
+        _phase_open = None
+        _step_counter = 0
+
+
+@contextlib.contextmanager
+def step_phase(phase: str, rank: int = 0):
+    """Standalone phase marker for code running OUTSIDE a train
+    session (the train session routes its own ``set_phase`` here)."""
+    note_phase(phase, rank)
+    try:
+        yield
+    finally:
+        note_phase("", rank)
+
+
+def instrument_step(step_fn, rank: int = 0):
+    """Wrap a (jitted) step callable: first call attributed to
+    ``compile`` (jit traces + XLA compiles there), later calls to
+    ``step`` — the session-free twin of train.instrument_step."""
+    state = {"compiled": False}
+
+    def wrapped(*args, **kwargs):
+        with step_phase("step" if state["compiled"] else "compile",
+                        rank):
+            out = step_fn(*args, **kwargs)
+        state["compiled"] = True
+        return out
+
+    return wrapped
+
+
+# ---------------------------------------------------------------------------
+# host lane sampler (time-resolved host spans for the unified timeline)
+# ---------------------------------------------------------------------------
+
+class _HostLaneSampler(threading.Thread):
+    """Low-Hz top-of-stack sampler running only for the capture window:
+    consecutive sweeps where a thread shows the same leaf frame merge
+    into one span, so the unified timeline gets ``host:<pid>:<thread>``
+    lanes without a second always-on profiler."""
+
+    def __init__(self, hz: float = 25.0):
+        super().__init__(daemon=True, name="rtpu-trace-host")
+        self.interval = 1.0 / min(max(float(hz), 1.0), 100.0)
+        self._stop = threading.Event()
+        #: (ts, {ident: (thread_name, leaf)})
+        self._sweeps: List[tuple] = []
+
+    def stop(self) -> None:
+        self._stop.set()
+
+    def run(self) -> None:
+        me = threading.get_ident()
+        while not self._stop.wait(self.interval):
+            names = {t.ident: t.name for t in threading.enumerate()}
+            now = time.time()
+            seen: Dict[int, tuple] = {}
+            for ident, frame in sys._current_frames().items():
+                if ident == me or len(seen) >= 32:
+                    continue
+                try:
+                    code = frame.f_code
+                    leaf = (f"{code.co_filename.rsplit('/', 1)[-1]}"
+                            f":{code.co_name}")
+                except Exception:  # lint: allow-silent(frame freed mid-read — skip one sample)
+                    continue
+                seen[ident] = (names.get(ident, str(ident)), leaf)
+            self._sweeps.append((now, seen))
+
+    def lanes(self) -> List[dict]:
+        """Merge sweeps into telemetry-format lane events
+        (``{cat, name, ts, dur, args}``, seconds wall clock)."""
+        pid = os.getpid()
+        spans: List[dict] = []
+        open_spans: Dict[int, dict] = {}
+        for ts, seen in self._sweeps:
+            for ident, span in list(open_spans.items()):
+                cur = seen.get(ident)
+                if cur is None or cur[1] != span["name"]:
+                    span["dur"] = max(ts - span["ts"], self.interval)
+                    spans.append(span)
+                    del open_spans[ident]
+            for ident, (tname, leaf) in seen.items():
+                if ident not in open_spans:
+                    open_spans[ident] = {
+                        "cat": f"host:{pid}:{tname}", "name": leaf,
+                        "ts": ts, "args": {"thread": tname}}
+        tail = self._sweeps[-1][0] if self._sweeps else time.time()
+        for span in open_spans.values():
+            span["dur"] = max(tail - span["ts"], self.interval)
+            spans.append(span)
+        if len(spans) > MAX_HOST_SPANS:
+            spans.sort(key=lambda s: -s["dur"])
+            spans = spans[:MAX_HOST_SPANS]
+        spans.sort(key=lambda s: s["ts"])
+        return spans
+
+
+# ---------------------------------------------------------------------------
+# trace parser
+# ---------------------------------------------------------------------------
+
+def _demangle(name: str) -> str:
+    """XLA op instance -> op kind: strip the leading ``%`` and the
+    trailing instance counter (``loop_fusion.123`` -> ``loop_fusion``)."""
+    return re.sub(r"\.\d+$", "", name.lstrip("%"))
+
+
+def _load_trace_json(data) -> dict:
+    """bytes (gz or plain JSON) or a path -> the trace dict. Raises
+    ValueError with a diagnosable message on any corruption."""
+    if isinstance(data, str):
+        try:
+            with open(data, "rb") as f:
+                data = f.read()
+        except OSError as e:
+            raise ValueError(f"trace unreadable: {e}") from e
+    if not isinstance(data, (bytes, bytearray)):
+        raise ValueError(f"trace input must be bytes or a path, "
+                         f"got {type(data).__name__}")
+    raw = bytes(data)
+    if raw[:2] == _GZIP_MAGIC:
+        try:
+            raw = gzip.decompress(raw)
+        except Exception as e:
+            raise ValueError(f"trace gzip corrupt: {e}") from e
+    try:
+        doc = json.loads(raw.decode("utf-8", errors="replace"))
+    except Exception as e:
+        raise ValueError(f"trace JSON corrupt: {e}") from e
+    if not isinstance(doc, dict) or not isinstance(
+            doc.get("traceEvents"), list):
+        raise ValueError("trace JSON has no traceEvents list")
+    return doc
+
+
+def _self_times(events: List[dict]) -> Dict[int, float]:
+    """``id(event) -> self duration`` (dur minus directly nested child
+    durs) per (pid, tid) span stack — "top ops by SELF device time"
+    must not double-count a fusion inside its parent thunk."""
+    by_tid: Dict[tuple, List[dict]] = {}
+    for ev in events:
+        by_tid.setdefault((ev.get("pid"), ev.get("tid")), []).append(ev)
+    child_sum: Dict[int, float] = {}
+    for evs in by_tid.values():
+        evs.sort(key=lambda e: (e["ts"], -e.get("dur", 0.0)))
+        stack: List[dict] = []
+        for ev in evs:
+            end = ev["ts"] + ev.get("dur", 0.0)
+            while stack and (stack[-1]["ts"]
+                             + stack[-1].get("dur", 0.0)) <= ev["ts"]:
+                stack.pop()
+            if stack:
+                child_sum[id(stack[-1])] = (
+                    child_sum.get(id(stack[-1]), 0.0)
+                    + ev.get("dur", 0.0))
+            stack.append(ev)
+    return {id(ev): max(0.0, ev.get("dur", 0.0)
+                        - child_sum.get(id(ev), 0.0))
+            for ev in events}
+
+
+def parse_trace(data, t0_wall: float = 0.0,
+                windows: Optional[List[dict]] = None,
+                pid: Optional[int] = None,
+                top_k: int = DEFAULT_TOP_K) -> dict:
+    """Parse a jax.profiler ``trace.json.gz`` (bytes or path) into
+
+    - ``lanes`` — timeline lane events (``device:<pid>`` XLA op spans,
+      ``device:<pid>:compile`` codegen spans), wall-clock anchored at
+      ``t0_wall`` (the moment ``start_trace`` returned),
+    - ``ops`` — the per-op aggregate (top-K by self device time,
+      compile vs execute split, fusion names demangled),
+    - ``steps`` — the per-(rank, step) breakdown against the step-phase
+      ``windows`` (``{step, rank, compile_ms, execute_ms, gap_ms,
+      wall_ms, top_ops}``),
+    - ``summary`` — event counts and total compile/execute time.
+
+    A truncated/corrupt trace returns a structured ``{"error": ...}``
+    entry — never an exception (chaos contract: a SIGKILL mid-write
+    must not crash the merge)."""
+    pid = os.getpid() if pid is None else pid
+    try:
+        doc = _load_trace_json(data)
+    except ValueError as e:
+        return {"error": str(e), "ops": [], "steps": [], "lanes": [],
+                "summary": {}}
+
+    thread_names: Dict[tuple, str] = {}
+    process_names: Dict[Any, str] = {}
+    device_ops: List[dict] = []
+    compile_evs: List[dict] = []
+    n_python = n_events = 0
+    base = None  # trace-clock origin == the moment start_trace ran
+    for ev in doc["traceEvents"]:
+        if not isinstance(ev, dict):
+            continue
+        ph = ev.get("ph")
+        if ph == "M":
+            args = ev.get("args") or {}
+            if ev.get("name") == "thread_name":
+                thread_names[(ev.get("pid"), ev.get("tid"))] = \
+                    str(args.get("name", ""))
+            elif ev.get("name") == "process_name":
+                process_names[ev.get("pid")] = str(args.get("name", ""))
+            continue
+        if ph != "X":
+            continue
+        n_events += 1
+        ts = ev.get("ts")
+        if isinstance(ts, (int, float)) and (base is None or ts < base):
+            # Anchor on the EARLIEST event of any kind: the python
+            # start_trace event sits at ~0 on the trace clock, while
+            # the first device op can land arbitrarily late — so the
+            # minimum over device events alone would skew every
+            # wall-clock mapping by that lead time.
+            base = float(ts)
+        name = str(ev.get("name", ""))
+        if name.startswith("$"):
+            # jax's own python-level tracer: tens of thousands of
+            # events that duplicate what the host sampler already
+            # shows, time-skewed. Drop them wholesale.
+            n_python += 1
+            continue
+        try:
+            ev["ts"] = float(ev.get("ts", 0.0))
+            ev["dur"] = float(ev.get("dur", 0.0))
+        except (TypeError, ValueError):
+            continue
+        args = ev.get("args") or {}
+        tname = thread_names.get((ev.get("pid"), ev.get("tid")), "")
+        pname = process_names.get(ev.get("pid"), "")
+        if ("hlo_op" in args or "hlo_module" in args
+                or pname.startswith("/device:")):
+            device_ops.append(ev)
+        elif "codegen" in tname.lower() or "compil" in tname.lower():
+            compile_evs.append(ev)
+
+    if base is None:
+        base = min((e["ts"] for e in device_ops + compile_evs),
+                   default=0.0)
+    self_us = _self_times(device_ops)
+
+    # -- per-op aggregate ------------------------------------------------
+    table: Dict[str, dict] = {}
+    for ev in device_ops:
+        op = _demangle(str((ev.get("args") or {}).get("hlo_op")
+                           or ev.get("name", "?")))
+        row = table.setdefault(op, {"op": op, "count": 0,
+                                    "self_us": 0.0, "total_us": 0.0,
+                                    "phase": "execute"})
+        row["count"] += 1
+        row["self_us"] += self_us.get(id(ev), 0.0)
+        row["total_us"] += ev["dur"]
+    compile_us = sum(e["dur"] for e in compile_evs)
+    execute_us = sum(self_us.values())
+    ops = sorted(table.values(), key=lambda r: -r["self_us"])[:top_k]
+    for row in ops:
+        row["self_us"] = round(row["self_us"], 1)
+        row["total_us"] = round(row["total_us"], 1)
+
+    # -- step attribution ------------------------------------------------
+    windows = sorted(windows or [], key=lambda w: w["t0"])
+    steps: Dict[tuple, dict] = {}
+    unattributed_us = 0.0
+
+    def _window_for(mid: float) -> Optional[dict]:
+        for w in windows:
+            if w["t0"] <= mid < w["t1"]:
+                return w
+        return None
+
+    for ev, dur_us, kind in (
+            [(e, self_us.get(id(e), 0.0), "op") for e in device_ops]
+            + [(e, e["dur"], "compile") for e in compile_evs]):
+        mid = t0_wall + (ev["ts"] - base + ev["dur"] / 2.0) / 1e6
+        w = _window_for(mid)
+        if w is None:
+            unattributed_us += dur_us
+            continue
+        key = (w.get("rank") or 0, w["step"])
+        row = steps.setdefault(key, {
+            "rank": key[0], "step": key[1], "compile_ms": 0.0,
+            "execute_ms": 0.0, "wall_ms": 0.0, "gap_ms": 0.0,
+            "top_ops": {}})
+        if w["phase"] == "compile" or kind == "compile":
+            row["compile_ms"] += dur_us / 1e3
+        else:
+            row["execute_ms"] += dur_us / 1e3
+        if kind == "op":
+            op = _demangle(str((ev.get("args") or {}).get("hlo_op")
+                               or ev.get("name", "?")))
+            row["top_ops"][op] = row["top_ops"].get(op, 0.0) + dur_us / 1e3
+    for w in windows:
+        key = (w.get("rank") or 0, w["step"])
+        if key in steps:
+            steps[key]["wall_ms"] += (w["t1"] - w["t0"]) * 1e3
+    step_rows = []
+    for row in sorted(steps.values(),
+                      key=lambda r: (r["rank"], r["step"])):
+        row["gap_ms"] = round(max(
+            0.0, row["wall_ms"] - row["compile_ms"] - row["execute_ms"]),
+            2)
+        row["top_ops"] = [[op, round(ms, 2)] for op, ms in sorted(
+            row["top_ops"].items(), key=lambda kv: -kv[1])[:5]]
+        for k in ("compile_ms", "execute_ms", "wall_ms"):
+            row[k] = round(row[k], 2)
+        step_rows.append(row)
+
+    # -- timeline lanes --------------------------------------------------
+    keep = device_ops + compile_evs
+    if len(keep) > MAX_LANE_EVENTS:
+        keep = sorted(keep, key=lambda e: -e["dur"])[:MAX_LANE_EVENTS]
+    lanes = []
+    compile_ids = {id(e) for e in compile_evs}
+    for ev in sorted(keep, key=lambda e: e["ts"]):
+        args = ev.get("args") or {}
+        cat = (f"device:{pid}:compile" if id(ev) in compile_ids
+               else f"device:{pid}")
+        lanes.append({
+            "cat": cat,
+            "name": str(args.get("hlo_op") or ev.get("name", "?")),
+            "ts": t0_wall + (ev["ts"] - base) / 1e6,
+            "dur": ev["dur"] / 1e6,
+            "args": {k: v for k, v in args.items()
+                     if k in ("hlo_op", "hlo_module")},
+        })
+
+    return {
+        "ops": ops,
+        "steps": step_rows,
+        "lanes": lanes,
+        "summary": {
+            "events": n_events,
+            "device_events": len(device_ops),
+            "compile_events": len(compile_evs),
+            "python_events_dropped": n_python,
+            "execute_us": round(execute_us, 1),
+            "compile_us": round(compile_us, 1),
+            "unattributed_us": round(unattributed_us, 1),
+        },
+    }
+
+
+# ---------------------------------------------------------------------------
+# device-memory census
+# ---------------------------------------------------------------------------
+
+def device_memory_census() -> dict:
+    """Per-device ``memory_stats()`` where the backend provides it
+    (``null`` on CPU — the CPU client reports none) plus a live-array
+    census by sharding from the device object registry."""
+    out: dict = {"devices": [],
+                 "arrays": {"count": 0, "bytes": 0, "by_sharding": {}}}
+    try:
+        import jax
+
+        for d in jax.devices():
+            try:
+                stats = d.memory_stats()
+            except Exception:  # backend without the API (== null)
+                stats = None
+            out["devices"].append({
+                "id": int(d.id), "platform": str(d.platform),
+                "memory_stats": stats})
+    except Exception as e:  # noqa: BLE001 — census degrades, never raises
+        out["devices_error"] = f"{type(e).__name__}: {e}"
+    try:
+        from ray_tpu.core import device_objects as dobj
+
+        by_sharding = out["arrays"]["by_sharding"]
+        with dobj._registry_lock:
+            for entry in dobj._registry.values():
+                for le in entry.leaves.values():
+                    desc = le.desc or {}
+                    if desc.get("kind") == "named":
+                        key = (f"named[{','.join(desc.get('mesh_axes') or ())}"
+                               f"={'x'.join(map(str, desc.get('mesh_shape') or ()))}]"
+                               f" {json.dumps(desc.get('spec'))}")
+                    else:
+                        key = desc.get("kind") or "?"
+                    row = by_sharding.setdefault(
+                        key, {"count": 0, "bytes": 0})
+                    row["count"] += 1
+                    row["bytes"] += int(le.nbytes or 0)
+                    out["arrays"]["count"] += 1
+                    out["arrays"]["bytes"] += int(le.nbytes or 0)
+    except Exception as e:  # noqa: BLE001
+        out["arrays_error"] = f"{type(e).__name__}: {e}"
+    return out
+
+
+# ---------------------------------------------------------------------------
+# capture
+# ---------------------------------------------------------------------------
+
+_capture_lock = threading.Lock()
+
+
+def _capture_failed(msg: str, status: str = "error") -> dict:
+    from ray_tpu.util import flight_recorder, telemetry
+
+    telemetry.inc("ray_tpu_device_trace_captures_total", 1,
+                  {"status": status})
+    flight_recorder.record("trace", "capture_failed",
+                           severity=flight_recorder.WARN,
+                           reason=msg[:200])
+    return {"pid": os.getpid(), "ts": time.time(), "error": msg}
+
+
+def capture(duration_s: float = 2.0, out_dir: Optional[str] = None,
+            host_hz: float = 25.0) -> dict:
+    """One bounded device-trace window over THIS process. Blocks for
+    ``duration_s`` (RPC handlers run it in an executor). Returns the
+    parsed reply — raw gz bytes (``trace_gz``), per-op table, per-step
+    breakdown, device + host lanes, memory census — or a structured
+    ``{"error": ...}`` entry (concurrent capture, jax missing, trace
+    over the byte cap)."""
+    cfg = _config()
+    max_duration = (cfg.device_trace_max_duration_s
+                    if cfg is not None else 60.0)
+    max_bytes = (cfg.device_trace_max_trace_bytes
+                 if cfg is not None else 64 * 1024 * 1024)
+    duration_s = min(max(float(duration_s), 0.05), float(max_duration))
+    if not _capture_lock.acquire(blocking=False):
+        return _capture_failed(
+            "device-trace capture already in progress in "
+            f"pid {os.getpid()} — one capture at a time per process",
+            status="rejected")
+    tmpdir = tempfile.mkdtemp(prefix="rtpu-devtrace-")
+    sampler = _HostLaneSampler(hz=host_hz)
+    try:
+        try:
+            import jax
+        except Exception as e:  # noqa: BLE001
+            return _capture_failed(f"jax unavailable: {e}")
+        sampler.start()
+        t0 = time.time()
+        try:
+            jax.profiler.start_trace(tmpdir)
+        except Exception as e:  # noqa: BLE001
+            return _capture_failed(f"start_trace failed: {e}")
+        try:
+            time.sleep(duration_s)
+        finally:
+            try:
+                jax.profiler.stop_trace()
+            except Exception as e:  # noqa: BLE001
+                return _capture_failed(f"stop_trace failed: {e}")
+        t1 = time.time()
+        sampler.stop()
+        paths = glob.glob(os.path.join(
+            tmpdir, "**", "*.trace.json.gz"), recursive=True)
+        if not paths:
+            return _capture_failed("no trace.json.gz produced by "
+                                   "jax.profiler")
+        with open(paths[0], "rb") as f:
+            raw = f.read()
+        if len(raw) > int(max_bytes):
+            return _capture_failed(
+                f"trace file too large ({len(raw)} > "
+                f"device_trace_max_trace_bytes={int(max_bytes)}); "
+                "shorten the capture window")
+        parsed = parse_trace(raw, t0_wall=t0,
+                             windows=phase_windows(t0, t1))
+        if parsed.get("error"):
+            return _capture_failed(f"trace parse failed: "
+                                   f"{parsed['error']}")
+        retained = _retain_trace(raw, t0, out_dir)
+        _record_capture_metrics(len(raw), parsed["steps"])
+        from ray_tpu.util import flight_recorder
+
+        flight_recorder.record(
+            "trace", "captured", duration_s=round(t1 - t0, 3),
+            bytes=len(raw), ops=len(parsed["ops"]),
+            steps=len(parsed["steps"]),
+            device_events=parsed["summary"].get("device_events", 0))
+        return {
+            "pid": os.getpid(),
+            "ts": t0,
+            "t0": t0,
+            "t1": t1,
+            "duration_s": round(t1 - t0, 4),
+            "trace_bytes": len(raw),
+            "trace_gz": raw,
+            "trace_path": retained,
+            "host_lanes": sampler.lanes(),
+            "census": device_memory_census(),
+            **parsed,
+        }
+    except Exception as e:  # noqa: BLE001 — the RPC must answer, not die
+        return _capture_failed(f"{type(e).__name__}: {e}")
+    finally:
+        sampler.stop()
+        shutil.rmtree(tmpdir, ignore_errors=True)
+        _capture_lock.release()
+
+
+def _retain_trace(raw: bytes, t0: float,
+                  out_dir: Optional[str]) -> Optional[str]:
+    """Keep the raw trace in the session's device_trace dir (rotated
+    under the retention flags) for post-hoc Perfetto loading."""
+    out_dir = out_dir or _default_out_dir()
+    try:
+        os.makedirs(out_dir, exist_ok=True)
+        path = os.path.join(
+            out_dir, f"trace-{os.getpid()}-{int(t0)}.json.gz")
+        tmp = path + ".tmp"
+        with open(tmp, "wb") as f:
+            f.write(raw)
+        os.replace(tmp, path)
+        cfg = _config()
+        if cfg is not None:
+            from ray_tpu.util.profiler import rotate_dir
+
+            rotate_dir(out_dir, cfg.device_trace_retain_files,
+                       cfg.device_trace_retain_bytes, keep=(path,))
+        return path
+    except OSError:  # lint: allow-silent(retention is best-effort; the reply already carries the bytes)
+        return None
+
+
+def _record_capture_metrics(nbytes: int, steps: List[dict]) -> None:
+    from ray_tpu.util import telemetry
+
+    telemetry.inc("ray_tpu_device_trace_captures_total", 1,
+                  {"status": "ok"})
+    telemetry.set_gauge("ray_tpu_device_trace_bytes", nbytes,
+                        {"proc": telemetry.proc_tag()})
+    for row in steps:
+        tags = {"rank": str(row["rank"])}
+        if row["execute_ms"] > 0:
+            telemetry.observe("ray_tpu_train_step_device_time_seconds",
+                              row["execute_ms"] / 1e3,
+                              dict(tags, phase="execute"))
+        if row["compile_ms"] > 0:
+            telemetry.observe("ray_tpu_train_step_device_time_seconds",
+                              row["compile_ms"] / 1e3,
+                              dict(tags, phase="compile"))
+
+
+# ---------------------------------------------------------------------------
+# driver-side veneer (cluster fan-out + file outputs)
+# ---------------------------------------------------------------------------
+
+def capture_cluster(kind: str = "all", ident: Optional[str] = None,
+                    duration_s: float = 2.0,
+                    timeout_s: float = 30.0) -> dict:
+    """Fan ``device_trace_capture`` out over the cluster (head handler
+    ``device_trace_capture_cluster``), same targeting grammar as the
+    host profiler: worker | task | actor | all."""
+    from ray_tpu.util.state import _call
+
+    return _call("device_trace_capture_cluster", {
+        "kind": kind,
+        "id": (ident or "").lower(),
+        "duration_s": duration_s,
+        "timeout_s": timeout_s,
+    })
+
+
+def _sanitize(name: str) -> str:
+    return "".join(c if c.isalnum() or c in "-_." else "-" for c in name)
+
+
+def entry_json(entry: dict) -> dict:
+    """A capture entry without the raw gz bytes (JSON surfaces)."""
+    return {k: v for k, v in entry.items() if k != "trace_gz"}
+
+
+def merged_timeline_events(entries: List[dict]) -> List[dict]:
+    """Chrome-trace events merging every source's device + host lanes,
+    plus this driver's telemetry lanes (``train/step:r<rank>``,
+    ``profile:<pid>``) clipped to the capture window — host flamegraph
+    lanes and device-op lanes on one wall-clock axis."""
+    from ray_tpu.util.timeline import telemetry_trace_events
+
+    lane_events: List[dict] = []
+    t_lo, t_hi = float("inf"), 0.0
+    for entry in entries:
+        if entry.get("error"):
+            continue
+        lane_events.extend(entry.get("lanes") or [])
+        lane_events.extend(entry.get("host_lanes") or [])
+        t_lo = min(t_lo, entry.get("t0") or float("inf"))
+        t_hi = max(t_hi, entry.get("t1") or 0.0)
+    try:
+        from ray_tpu.util import telemetry
+
+        try:
+            merged = telemetry.collect_timeline_events()
+        except Exception:
+            merged = telemetry.local_timeline_events()
+        if t_lo < t_hi:
+            merged = [ev for ev in merged
+                      if t_lo - 5.0 <= float(ev.get("ts", 0.0))
+                      <= t_hi + 5.0]
+        lane_events.extend(merged)
+    except Exception:  # lint: allow-silent(telemetry lanes are decoration on the device view)
+        pass
+    return telemetry_trace_events(lane_events)
+
+
+def write_trace_outputs(reply: dict, out_dir: str,
+                        title: str = "ray_tpu device trace") -> dict:
+    """Write a capture-cluster reply as files: per-source
+    ``<source>.trace.json.gz`` (Perfetto-loadable raw trace) +
+    ``<source>.ops.json`` (per-op table, per-step breakdown, census),
+    a merged ``timeline.json`` (chrome-trace) + ``timeline.html``
+    (unified host+device view), and a ``trace.json`` manifest."""
+    os.makedirs(out_dir, exist_ok=True)
+    manifest: Dict[str, Any] = {"sources": [], "errors": {},
+                                "steps": [], "device_events": 0}
+    entries = reply.get("entries", [])
+    for entry in entries:
+        source = entry.get("source") or f"pid:{entry.get('pid', '?')}"
+        safe = _sanitize(source)
+        if entry.get("error"):
+            manifest["errors"][safe] = entry["error"]
+            continue
+        manifest["sources"].append(source)
+        manifest["device_events"] += (entry.get("summary") or {}).get(
+            "device_events", 0)
+        raw = entry.get("trace_gz")
+        if raw:
+            with open(os.path.join(out_dir, f"{safe}.trace.json.gz"),
+                      "wb") as f:
+                f.write(raw)
+        with open(os.path.join(out_dir, f"{safe}.ops.json"), "w") as f:
+            json.dump({k: entry.get(k) for k in
+                       ("source", "pid", "node_id", "t0", "t1",
+                        "duration_s", "trace_bytes", "ops", "steps",
+                        "summary", "census")},
+                      f, indent=1, default=str)
+        for row in entry.get("steps") or []:
+            manifest["steps"].append(dict(row, source=source))
+    events = merged_timeline_events(entries)
+    with open(os.path.join(out_dir, "timeline.json"), "w") as f:
+        json.dump(events, f)
+    html_path = os.path.join(out_dir, "timeline.html")
+    with open(html_path, "w") as f:
+        f.write(unified_timeline_html(events, title=title))
+    manifest["timeline"] = html_path
+    with open(os.path.join(out_dir, "trace.json"), "w") as f:
+        json.dump(dict(manifest, reply_ts=reply.get("ts")), f,
+                  indent=1, default=str)
+    return manifest
+
+
+# ---------------------------------------------------------------------------
+# unified timeline HTML
+# ---------------------------------------------------------------------------
+
+_TIMELINE_TEMPLATE = """<!doctype html>
+<html><head><meta charset="utf-8"><title>%(title)s</title><style>
+body{font:12px monospace;margin:0;background:#1b1b1f;color:#ddd}
+#hdr{padding:8px 12px;border-bottom:1px solid #333}
+.lane{display:flex;align-items:center;height:20px;margin:1px 0}
+.label{width:280px;flex:none;overflow:hidden;white-space:nowrap;
+ text-overflow:ellipsis;color:#9a9;padding-right:8px;text-align:right}
+.track{position:relative;flex:1;height:16px;background:#232327;
+ border-radius:2px}
+.sp{position:absolute;top:1px;height:14px;min-width:1px;
+ border-radius:1px;overflow:hidden;font-size:10px;color:#1b1b1f;
+ cursor:default}
+.sp:hover{filter:brightness(1.3)}
+#axis{margin-left:280px;color:#667;padding:2px 0 8px 0}
+</style></head><body>
+<div id="hdr">%(title)s &mdash; %(nlanes)s lanes, %(nspans)s spans,
+ %(window)s window (hover a span for op + timing)</div>
+<div id="tl"></div><div id="axis"></div>
+<script>
+var DATA=%(data)s;
+function color(cat){
+ if(cat.indexOf('device:')===0)
+   return cat.indexOf(':compile')>0?'hsl(45,80%%,60%%)'
+                                   :'hsl(150,60%%,55%%)';
+ if(cat.indexOf('host:')===0)return 'hsl(210,50%%,62%%)';
+ if(cat.indexOf('train/step')===0)return 'hsl(20,75%%,62%%)';
+ if(cat.indexOf('profile:')===0)return 'hsl(280,40%%,64%%)';
+ var h=0;for(var i=0;i<cat.length;i++)h=(h*31+cat.charCodeAt(i))%%360;
+ return 'hsl('+h+',55%%,60%%)';}
+var tl=document.getElementById('tl');
+var span=Math.max(DATA.t1-DATA.t0,1e-6);
+DATA.lanes.forEach(function(lane){
+ var row=document.createElement('div');row.className='lane';
+ var lb=document.createElement('div');lb.className='label';
+ lb.textContent=lane.name;lb.title=lane.name;row.appendChild(lb);
+ var tr=document.createElement('div');tr.className='track';
+ lane.spans.forEach(function(s){
+   var el=document.createElement('div');el.className='sp';
+   el.style.left=((s[0]-DATA.t0)/span*100)+'%%';
+   el.style.width=Math.max(s[1]/span*100,0.05)+'%%';
+   el.style.background=color(lane.name);
+   el.title=s[2]+' ('+(s[1]*1000).toFixed(2)+' ms @ +'
+     +((s[0]-DATA.t0)*1000).toFixed(1)+' ms)';
+   if(s[1]/span>0.04)el.textContent=s[2];
+   tr.appendChild(el);
+ });
+ row.appendChild(tr);tl.appendChild(row);
+});
+document.getElementById('axis').textContent=
+ '0 ms'+Array(8).join('\\u2500\\u2500\\u2500\\u2500\\u2500')
+ +(span*1000).toFixed(1)+' ms';
+</script></body></html>
+"""
+
+#: Lane-name prefixes in display order: step markers first, then host
+#: sampler lanes, then the device lanes they explain.
+_LANE_ORDER = ("train/step", "task:", "profile:", "host:", "device:")
+
+
+def _lane_rank(name: str) -> tuple:
+    for i, prefix in enumerate(_LANE_ORDER):
+        if name.startswith(prefix):
+            return (i, name)
+    return (len(_LANE_ORDER), name)
+
+
+def unified_timeline_html(events: List[dict],
+                          title: str = "ray_tpu device trace") -> str:
+    """Self-contained HTML rendering chrome-trace events (one lane per
+    ``tid``) on a single wall-clock axis: host sampler lanes next to
+    ``device:<pid>`` XLA-op lanes. Names are attacker-influenced (task
+    names, query params) — escaped out of HTML/script contexts."""
+    import html as _html
+
+    lanes: Dict[str, List[list]] = {}
+    t0, t1 = float("inf"), 0.0
+    for ev in events:
+        if ev.get("ph") not in ("X", "B", "i"):
+            continue
+        ts = float(ev.get("ts", 0.0)) / 1e6
+        dur = float(ev.get("dur", 0.0) or 0.0) / 1e6
+        t0 = min(t0, ts)
+        t1 = max(t1, ts + dur)
+        lanes.setdefault(str(ev.get("tid", "?")), []).append(
+            [round(ts, 6), round(dur, 6), str(ev.get("name", "?"))])
+    if t0 > t1:
+        t0, t1 = 0.0, 1.0
+    lane_rows = [{"name": name, "spans": sorted(spans)}
+                 for name, spans in sorted(
+                     lanes.items(), key=lambda kv: _lane_rank(kv[0]))]
+    data = json.dumps({"t0": t0, "t1": t1, "lanes": lane_rows})
+    data = data.replace("<", "\\u003c")
+    return _TIMELINE_TEMPLATE % {
+        "title": _html.escape(title),
+        "nlanes": len(lane_rows),
+        "nspans": sum(len(r["spans"]) for r in lane_rows),
+        "window": f"{(t1 - t0):.2f}s",
+        "data": data,
+    }

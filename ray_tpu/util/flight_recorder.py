@@ -85,6 +85,10 @@ CATALOG: Dict[str, tuple] = {
     # live profiling plane (util/profiler.py): an on-demand capture
     # window completed in this process.
     "profile": ("captured",),
+    # device trace plane (util/device_trace.py): a jax.profiler
+    # capture window completed / failed (concurrent-capture rejection,
+    # missing backend, oversized or corrupt trace) in this process.
+    "trace": ("captured", "capture_failed"),
     # ring shipping (this module): this process's ring tail was pushed
     # to the head KV after a severity>=error event, so a later SIGKILL
     # still leaves evidence in debug_dump_cluster.

@@ -413,6 +413,10 @@ class MetricsHistoryStore:
                     "name": name, "kind": s.kind, "tags": dict(tt),
                     "points": pts,
                     "fresh": self._fresh(s, now),
+                    # Carried so restore() can rebuild histogram series
+                    # with working percentile aggregation.
+                    "boundaries": (list(s.boundaries)
+                                   if s.boundaries else None),
                 })
             return {
                 "ts": now,
@@ -423,6 +427,44 @@ class MetricsHistoryStore:
                 "evictions": self.evictions,
                 "series": series,
             }
+
+
+    def restore(self, snapshot: dict) -> int:
+        """Rebuild series from a ``snapshot()`` dump (the head's
+        experiment-state journal, reloaded on head restart); returns
+        points restored. Existing series are preserved — restore is
+        meant to run on an empty store before the first push.
+
+        Per-proc cumulative baselines are deliberately NOT restored:
+        after a head restart every process's next push re-seeds its
+        baseline (first-snapshot rule) and subsequent deltas continue
+        the restored merged value, so counters stay monotone across
+        the restart instead of double-counting pre-restart totals."""
+        restored = 0
+        with self._lock:
+            for row in snapshot.get("series", []):
+                name, kind = row.get("name"), row.get("kind")
+                pts = row.get("points") or []
+                if not name or not kind or not pts:
+                    continue
+                tags = _tag_tuple((row.get("tags") or {}).items())
+                if (name, tags) in self._series:
+                    continue
+                s = self._get_series(name, kind, tags,
+                                     row.get("boundaries"))
+                for ts, value in pts:
+                    self._append(s, float(ts),
+                                 (list(value) if kind == "histogram"
+                                  else float(value)))
+                    restored += 1
+                last = pts[-1][1]
+                s.last_value = (list(last) if kind == "histogram"
+                                else float(last))
+                if kind in ("counter", "histogram"):
+                    s.merged = s.last_value
+            if self.bytes_used > self.max_bytes:
+                self._evict(time.time())
+        return restored
 
 
 def _bucket_percentile(boundaries: List[float], deltas: List[float],

@@ -426,8 +426,61 @@ class ContinuousSampler(threading.Thread):
             with open(tmp, "w") as f:
                 f.write(folded_text(self.counts))
             os.replace(tmp, self.snapshot_path)
+            cfg = _config()
+            if cfg is not None:
+                # Retention: stale snapshots from dead pids (and
+                # anything else that lands here) rotate out oldest
+                # first, so a long soak can't fill the disk.
+                rotate_dir(self.out_dir,
+                           cfg.profiler_snapshot_max_files,
+                           cfg.profiler_snapshot_max_bytes,
+                           keep=(self.snapshot_path,))
         except OSError:  # lint: allow-silent(snapshot dir gone — sampler must not die)
             pass
+
+
+def rotate_dir(path: str, max_files: int = 0, max_bytes: int = 0,
+               keep=()) -> int:
+    """Bound a snapshot/output directory: delete the OLDEST regular
+    files (by mtime) once the file count or total bytes exceed the
+    caps. A cap of 0 disables that bound; paths in ``keep`` (the file
+    just written) are never deleted. Returns files removed. Shared by
+    the continuous sampler's snapshot dir and the device-trace output
+    dir — both accumulate per-process files with no other GC."""
+    max_files = int(max_files or 0)
+    max_bytes = int(max_bytes or 0)
+    if max_files <= 0 and max_bytes <= 0:
+        return 0
+    keep = {os.path.abspath(p) for p in keep}
+    entries = []
+    try:
+        with os.scandir(path) as it:
+            for de in it:
+                if not de.is_file(follow_symlinks=False):
+                    continue
+                if os.path.abspath(de.path) in keep:
+                    continue
+                st = de.stat(follow_symlinks=False)
+                entries.append((st.st_mtime, st.st_size, de.path))
+    except OSError:
+        return 0
+    entries.sort(reverse=True)  # newest first
+    kept_files = len(keep)
+    kept_bytes = 0
+    removed = 0
+    for mtime, size, fpath in entries:
+        over = ((max_files and kept_files >= max_files)
+                or (max_bytes and kept_bytes + size > max_bytes))
+        if over:
+            try:
+                os.remove(fpath)
+                removed += 1
+            except OSError:  # lint: allow-silent(raced with another rotator/reader — the bound still converges)
+                pass
+        else:
+            kept_files += 1
+            kept_bytes += size
+    return removed
 
 
 _continuous: Optional[ContinuousSampler] = None

@@ -251,6 +251,17 @@ CATALOG: Dict[str, tuple] = {
     "ray_tpu_alerts_transitions_total": (
         COUNTER, "Alert lifecycle transitions (state fired/resolved).",
         ("rule", "state"), None),
+    # --- device trace plane (util/device_trace.py) ---
+    "ray_tpu_device_trace_captures_total": (
+        COUNTER, "Device-trace capture windows, by outcome "
+        "(ok / error / rejected-concurrent).", ("status",), None),
+    "ray_tpu_device_trace_bytes": (
+        GAUGE, "Size of the last device trace file captured by this "
+        "process.", ("proc",), None),
+    "ray_tpu_train_step_device_time_seconds": (
+        HISTOGRAM, "Device time attributed to one train step by the "
+        "device-trace parser, split by phase (compile / execute) and "
+        "rank.", ("rank", "phase"), SLOW_BOUNDARIES),
 }
 
 _KIND_TO_CLS = {

@@ -1,0 +1,515 @@
+"""Device-trace plane: parser units (synthetic chrome-trace fixtures,
+wall-clock anchoring, compile/execute split, step attribution, corrupt
+input), the phase-window recorder, output rotation, the in-process
+capture e2e (a jitted step traced under JAX_PLATFORMS=cpu), and the
+cluster lanes (fan-out capture of a worker running an instrumented
+step, merged host+device timeline, debug-bundle section, SIGKILL
+mid-capture chaos).
+
+Unit tests run first — they must see NO cluster; the module-scoped
+cluster fixture only spins up for the e2e half.
+"""
+
+import gzip
+import json
+import os
+import signal
+import threading
+import time
+
+import pytest
+
+import ray_tpu
+from ray_tpu.util import device_trace
+
+
+def _wait_for(predicate, timeout=30.0, interval=0.05, desc="condition"):
+    deadline = time.monotonic() + timeout
+    while time.monotonic() < deadline:
+        if predicate():
+            return
+        time.sleep(interval)
+    raise AssertionError(f"timed out waiting for {desc}")
+
+
+def _mk_trace(events) -> bytes:
+    return gzip.compress(json.dumps(
+        {"displayTimeUnit": "ns", "traceEvents": events}).encode())
+
+
+# A synthetic jax.profiler trace: a device process with XLA ops (one
+# nesting pair), a codegen thread, and a `$`-prefixed python-tracer
+# event that sits at the trace-clock origin (ts=0 == start_trace).
+_SYNTH_EVENTS = [
+    {"ph": "M", "pid": 1, "name": "process_name",
+     "args": {"name": "/device:CPU:0"}},
+    {"ph": "M", "pid": 1, "tid": 10, "name": "thread_name",
+     "args": {"name": "tf_XLATfrtCpuClient/1"}},
+    {"ph": "M", "pid": 2, "name": "process_name",
+     "args": {"name": "python"}},
+    {"ph": "M", "pid": 2, "tid": 20, "name": "thread_name",
+     "args": {"name": "tf_xla-cpu-llvm-codegen/2"}},
+    {"ph": "X", "pid": 3, "tid": 30, "ts": 0, "dur": 100,
+     "name": "$profiler.py:10 start_trace"},
+    # fusion.1 [1000, 2000) with dot.2 [1200, 1600) nested inside:
+    # self times 600 / 400.
+    {"ph": "X", "pid": 1, "tid": 10, "ts": 1000, "dur": 1000,
+     "name": "fusion.1",
+     "args": {"hlo_op": "fusion.1", "hlo_module": "jit_step"}},
+    {"ph": "X", "pid": 1, "tid": 10, "ts": 1200, "dur": 400,
+     "name": "dot.2",
+     "args": {"hlo_op": "dot.2", "hlo_module": "jit_step"}},
+    {"ph": "X", "pid": 1, "tid": 10, "ts": 3000, "dur": 500,
+     "name": "sine.3",
+     "args": {"hlo_op": "sine.3", "hlo_module": "jit_step"}},
+    # codegen work (no hlo args; classified by thread name).
+    {"ph": "X", "pid": 2, "tid": 20, "ts": 1000, "dur": 800,
+     "name": "LlvmCompile"},
+    # outside every phase window -> unattributed.
+    {"ph": "X", "pid": 1, "tid": 10, "ts": 9000, "dur": 200,
+     "name": "tanh.4", "args": {"hlo_op": "tanh.4"}},
+]
+
+_T0 = 100.0
+_SYNTH_WINDOWS = [
+    {"phase": "compile", "t0": _T0 + 0.0005, "t1": _T0 + 0.002,
+     "step": 7, "rank": 1},
+    {"phase": "step", "t0": _T0 + 0.002, "t1": _T0 + 0.004,
+     "step": 7, "rank": 1},
+]
+
+
+# ---------------------------------------------------------------------------
+# parser units
+# ---------------------------------------------------------------------------
+
+def test_parse_trace_ops_split_and_anchor():
+    out = device_trace.parse_trace(_mk_trace(_SYNTH_EVENTS),
+                                   t0_wall=_T0,
+                                   windows=_SYNTH_WINDOWS, pid=42)
+    assert not out.get("error")
+    s = out["summary"]
+    assert s["device_events"] == 4
+    assert s["compile_events"] == 1
+    assert s["python_events_dropped"] == 1
+    # self-time nesting: fusion 600, dot 400, sine 500, tanh 200.
+    assert s["execute_us"] == 1700.0
+    assert s["compile_us"] == 800.0
+    assert s["unattributed_us"] == 200.0
+    # demangled, sorted by self device time.
+    by_op = {r["op"]: r for r in out["ops"]}
+    assert set(by_op) == {"fusion", "dot", "sine", "tanh"}
+    assert by_op["fusion"]["self_us"] == 600.0
+    assert by_op["fusion"]["total_us"] == 1000.0
+    assert by_op["dot"]["self_us"] == 400.0
+    assert [r["op"] for r in out["ops"][:2]] == ["fusion", "sine"]
+    # lanes: wall-clock anchored at t0_wall + (ts - base)/1e6, with the
+    # python event at ts=0 as the base even though it was dropped.
+    dev = [ln for ln in out["lanes"] if ln["cat"] == "device:42"]
+    comp = [ln for ln in out["lanes"] if ln["cat"] == "device:42:compile"]
+    assert len(dev) == 4 and len(comp) == 1
+    fusion_lane = next(ln for ln in dev if ln["name"] == "fusion.1")
+    assert fusion_lane["ts"] == pytest.approx(_T0 + 0.001)
+    assert fusion_lane["dur"] == pytest.approx(0.001)
+    assert fusion_lane["args"]["hlo_module"] == "jit_step"
+
+
+def test_parse_trace_step_attribution():
+    out = device_trace.parse_trace(_mk_trace(_SYNTH_EVENTS),
+                                   t0_wall=_T0,
+                                   windows=_SYNTH_WINDOWS, pid=42)
+    (row,) = out["steps"]
+    assert row["rank"] == 1 and row["step"] == 7
+    # compile window catches fusion+dot (device time inside a compile
+    # phase counts as compile) plus the codegen event: 0.6+0.4+0.8 ms.
+    assert row["compile_ms"] == pytest.approx(1.8)
+    # the step window catches sine's 0.5 ms of self time.
+    assert row["execute_ms"] == pytest.approx(0.5)
+    assert row["wall_ms"] == pytest.approx(3.5)
+    assert row["gap_ms"] == pytest.approx(3.5 - 1.8 - 0.5)
+    assert ["sine", 0.5] in row["top_ops"]
+
+
+@pytest.mark.parametrize("blob", [
+    b"not a gzip at all",
+    gzip.compress(b"{not json"),
+    gzip.compress(b'{"traceEvents": 7}'),
+    _mk_trace(_SYNTH_EVENTS)[:40],  # truncated mid-stream
+])
+def test_parse_trace_corrupt_input_structured_error(blob):
+    out = device_trace.parse_trace(blob)
+    assert out["error"]
+    assert out["ops"] == [] and out["steps"] == [] and out["lanes"] == []
+
+
+def test_demangle():
+    assert device_trace._demangle("%fusion.123") == "fusion"
+    assert device_trace._demangle("dot_general.4") == "dot_general"
+    assert device_trace._demangle("custom-call") == "custom-call"
+
+
+# ---------------------------------------------------------------------------
+# phase-window recorder
+# ---------------------------------------------------------------------------
+
+def test_phase_window_step_numbering():
+    device_trace.reset_phase_windows_for_testing()
+    try:
+        with device_trace.step_phase("compile", rank=3):
+            time.sleep(0.01)
+        for _ in range(2):
+            with device_trace.step_phase("step", rank=3):
+                time.sleep(0.01)
+        assert device_trace.current_step() == 2
+        wins = device_trace.phase_windows(0.0, time.time() + 1.0)
+        assert [(w["phase"], w["step"]) for w in wins] == [
+            ("compile", 0), ("step", 0), ("step", 1)]
+        assert all(w["rank"] == 3 for w in wins)
+        assert all(w["t1"] > w["t0"] for w in wins)
+        # range filter: a window entirely in the past is excluded.
+        assert device_trace.phase_windows(time.time() + 10,
+                                          time.time() + 20) == []
+    finally:
+        device_trace.reset_phase_windows_for_testing()
+
+
+# ---------------------------------------------------------------------------
+# output rotation (satellite: bounded snapshot/trace dirs)
+# ---------------------------------------------------------------------------
+
+def test_rotate_dir_bounds_files_and_bytes(tmp_path):
+    from ray_tpu.util.profiler import rotate_dir
+
+    d = str(tmp_path)
+    for i in range(10):
+        p = os.path.join(d, f"f{i}")
+        with open(p, "wb") as f:
+            f.write(b"x" * 100)
+        os.utime(p, (1000 + i, 1000 + i))  # f9 newest
+    assert rotate_dir(d, max_files=4) == 6
+    assert sorted(os.listdir(d)) == ["f6", "f7", "f8", "f9"]
+    # byte cap: 100B each, cap 250 -> the 2 newest survive.
+    assert rotate_dir(d, max_bytes=250) == 2
+    assert sorted(os.listdir(d)) == ["f8", "f9"]
+    # keep= pins a file regardless of age and counts against the cap.
+    assert rotate_dir(d, max_files=1,
+                      keep=(os.path.join(d, "f8"),)) == 1
+    assert os.listdir(d) == ["f8"]
+    # caps of 0 disable rotation entirely.
+    assert rotate_dir(d) == 0
+
+
+def test_continuous_sampler_snapshot_dir_rotated(tmp_path,
+                                                 monkeypatch):
+    """The continuous host sampler's snapshot dir stays bounded by the
+    profiler_snapshot_* flags (stale snapshots from dead pids are the
+    files rotation exists to delete)."""
+    from ray_tpu.core.config import Config
+    from ray_tpu.util import profiler, telemetry
+
+    d = str(tmp_path / "profile")
+    os.makedirs(d)
+    for i in range(6):
+        p = os.path.join(d, f"profile-{4000 + i}.folded")
+        with open(p, "w") as f:
+            f.write("stale 1\n" * 10)
+        os.utime(p, (2000 + i, 2000 + i))
+    cfg = Config()
+    cfg.profiler_snapshot_max_files = 3
+    cfg.profiler_snapshot_max_bytes = 0
+    monkeypatch.setattr(profiler, "_config", lambda: cfg)
+    s = profiler.ContinuousSampler(out_dir=d)
+    s._snapshot(time.monotonic(), 0.1, 0, telemetry)
+    names = os.listdir(d)
+    # own snapshot (pinned via keep=) + the 2 newest stale survivors.
+    assert os.path.basename(s.snapshot_path) in names
+    assert len(names) <= 3
+    assert "profile-4000.folded" not in names
+    assert "profile-4001.folded" not in names
+
+
+# ---------------------------------------------------------------------------
+# memory census
+# ---------------------------------------------------------------------------
+
+def test_device_memory_census_cpu_null_stats():
+    from ray_tpu.core import device_objects as dobj
+
+    census = device_trace.device_memory_census()
+    assert "devices_error" not in census
+    assert len(census["devices"]) >= 1
+    # CPU backend has no memory_stats: graceful null, never an error.
+    assert all(d["memory_stats"] is None for d in census["devices"])
+    assert all(d["platform"] == "cpu" for d in census["devices"])
+
+    # Live-array census counts registry entries by sharding kind.
+    entry = dobj._ObjectEntry(owned=True)
+    entry.leaves[0] = dobj._LeafEntry(
+        desc={"kind": "single"}, nbytes=4096)
+    with dobj._registry_lock:
+        dobj._registry["census-test"] = entry
+    try:
+        census = device_trace.device_memory_census()
+        arrays = census["arrays"]
+        assert arrays["count"] >= 1
+        assert arrays["bytes"] >= 4096
+        assert arrays["by_sharding"]["single"]["count"] >= 1
+    finally:
+        with dobj._registry_lock:
+            dobj._registry.pop("census-test", None)
+
+
+# ---------------------------------------------------------------------------
+# in-process capture e2e (JAX_PLATFORMS=cpu)
+# ---------------------------------------------------------------------------
+
+def test_capture_in_process_attributes_jitted_steps(tmp_path):
+    """The core acceptance lane, single-process: trace a jitted step
+    loop and get device-op lanes plus a per-step breakdown whose step
+    numbers continue the pre-capture counter with nonzero execute
+    time."""
+    import jax
+    import jax.numpy as jnp
+
+    device_trace.reset_phase_windows_for_testing()
+    x = jnp.ones((256, 256), jnp.float32)
+    raw_step = jax.jit(lambda a: jnp.tanh(a @ a))
+    wrapped = device_trace.instrument_step(raw_step, rank=0)
+    wrapped(x).block_until_ready()  # compile
+    wrapped(x).block_until_ready()  # step 0
+    wrapped(x).block_until_ready()  # step 1
+    assert device_trace.current_step() == 2
+
+    stop = threading.Event()
+
+    def pump():
+        while not stop.is_set():
+            wrapped(x).block_until_ready()
+            time.sleep(0.005)
+
+    t = threading.Thread(target=pump, daemon=True)
+    t.start()
+    try:
+        out = device_trace.capture(duration_s=0.8,
+                                   out_dir=str(tmp_path))
+    finally:
+        stop.set()
+        t.join(10)
+        device_trace.reset_phase_windows_for_testing()
+
+    assert not out.get("error"), out
+    assert out["summary"]["device_events"] > 0
+    # Step attribution: rows carry the post-warmup step numbers (the
+    # first two steps ran before the capture window) and real device
+    # execute time lands on them.
+    assert out["steps"], out["summary"]
+    assert all(row["step"] >= 2 for row in out["steps"])
+    exec_rows = [row for row in out["steps"] if row["execute_ms"] > 0]
+    assert exec_rows, out["steps"]
+    assert any(row["top_ops"] for row in exec_rows)
+    # Device lanes are wall-clock anchored inside the capture window.
+    pid = os.getpid()
+    dev = [ln for ln in out["lanes"] if ln["cat"] == f"device:{pid}"]
+    assert dev
+    assert all(out["t0"] - 1.0 <= ln["ts"] <= out["t1"] + 1.0
+               for ln in dev)
+    # Host sampler lanes rode along on the same clock.
+    assert any(ln["cat"].startswith(f"host:{pid}:")
+               for ln in out["host_lanes"])
+    # The raw gz was retained on disk and re-parses standalone.
+    assert out["trace_path"] and os.path.exists(out["trace_path"])
+    reparsed = device_trace.parse_trace(out["trace_gz"])
+    assert not reparsed.get("error")
+    assert reparsed["summary"]["device_events"] > 0
+
+
+def test_concurrent_capture_rejected(tmp_path):
+    res = {}
+
+    def bg():
+        res["out"] = device_trace.capture(duration_s=1.2,
+                                          out_dir=str(tmp_path))
+
+    t = threading.Thread(target=bg)
+    t.start()
+    time.sleep(0.3)
+    out2 = device_trace.capture(duration_s=0.2)
+    t.join(60)
+    assert out2.get("error") and "already in progress" in out2["error"]
+    assert not res["out"].get("error"), res["out"]
+
+
+# ---------------------------------------------------------------------------
+# cluster e2e
+# ---------------------------------------------------------------------------
+
+@pytest.fixture(scope="module")
+def trace_cluster():
+    ray_tpu.init(num_cpus=3, num_tpus=0)
+    yield
+    ray_tpu.shutdown()
+
+
+@ray_tpu.remote
+def _stepper(seconds):
+    """A worker-side instrumented jitted step loop (the workload the
+    acceptance criteria trace)."""
+    import jax
+    import jax.numpy as jnp
+
+    from ray_tpu.util import device_trace as dt
+
+    x = jnp.ones((256, 256), jnp.float32)
+    step = dt.instrument_step(jax.jit(lambda a: jnp.tanh(a @ a)),
+                              rank=0)
+    t0 = time.monotonic()
+    n = 0
+    while time.monotonic() - t0 < seconds:
+        step(x).block_until_ready()
+        n += 1
+        time.sleep(0.005)
+    return n
+
+
+def test_cluster_capture_merged_timeline(trace_cluster, tmp_path):
+    """The tier-1 acceptance lane: `ray_tpu profile --device` against a
+    worker running an instrumented jitted train step produces a merged
+    timeline with host sampler lanes AND device:<pid> XLA-op lanes,
+    plus a per-step breakdown with nonzero execute time on the right
+    step numbers."""
+    from ray_tpu.util import state as ust
+
+    ref = _stepper.remote(20.0)
+    task_hex = ref.id.task_id().hex()
+
+    def running():
+        rows = ust.list_tasks(
+            filters=[("task_id", "contains", task_hex)])
+        return any(r["state"] == "RUNNING" and r.get("worker_id")
+                   for r in rows)
+
+    _wait_for(running, desc="stepper RUNNING at the head")
+    time.sleep(1.0)  # let the jit warm up so the window sees steps
+
+    reply = device_trace.capture_cluster("task", task_hex,
+                                         duration_s=1.0)
+    assert not reply.get("error"), reply
+    (entry,) = reply["entries"]
+    assert not entry.get("error"), entry
+    assert entry["source"].startswith("worker:")
+    wpid = entry["pid"]
+    assert entry["summary"]["device_events"] > 0
+    assert any(ln["cat"] == f"device:{wpid}" for ln in entry["lanes"])
+    exec_rows = [r for r in entry["steps"] if r["execute_ms"] > 0]
+    assert exec_rows, entry["steps"]
+    # Step numbers advanced past the warm-up steps the worker ran
+    # before the capture window opened.
+    assert all(r["step"] >= 1 for r in exec_rows)
+    # The worker-targeted path resolves the same worker.
+    reply2 = device_trace.capture_cluster("worker",
+                                          entry["worker_id"],
+                                          duration_s=0.3)
+    assert not reply2.get("error"), reply2
+    assert reply2["entries"][0]["worker_id"] == entry["worker_id"]
+
+    # File outputs: raw gz + ops.json per source, merged timeline with
+    # BOTH host sampler lanes and device lanes on one axis.
+    out = str(tmp_path / "trace")
+    manifest = device_trace.write_trace_outputs(reply, out)
+    assert manifest["sources"] == [entry["source"]]
+    assert manifest["device_events"] > 0
+    assert any(r["execute_ms"] > 0 for r in manifest["steps"])
+    names = os.listdir(out)
+    assert any(n.endswith(".trace.json.gz") for n in names)
+    assert any(n.endswith(".ops.json") for n in names)
+    html = open(manifest["timeline"]).read()
+    assert f"device:{wpid}" in html
+    assert f"host:{wpid}:" in html
+    with open(os.path.join(out, "trace.json")) as f:
+        saved = json.load(f)
+    assert saved["steps"] and saved["sources"]
+    # The retained raw gz re-parses standalone (Perfetto-compatible
+    # file really is the trace, not a placeholder).
+    gz_name = next(n for n in names if n.endswith(".trace.json.gz"))
+    with open(os.path.join(out, gz_name), "rb") as f:
+        reparsed = device_trace.parse_trace(f.read())
+    assert not reparsed.get("error")
+    assert ray_tpu.get(ref, timeout=120) > 0
+
+
+def test_cluster_capture_unknown_target(trace_cluster):
+    reply = device_trace.capture_cluster("worker", "ffffffffffff",
+                                         duration_s=0.2)
+    assert reply.get("error")
+    assert reply["entries"] == []
+    reply = device_trace.capture_cluster("bogus-kind",
+                                         duration_s=0.2)
+    assert "unknown kind" in (reply.get("error") or "")
+
+
+def test_debug_bundle_trace_section(trace_cluster, tmp_path):
+    from ray_tpu.util import debug as udebug
+
+    out = str(tmp_path / "bundle")
+    manifest = udebug.write_debug_bundle(out, profile_duration_s=0,
+                                         trace_duration_s=0.3)
+    assert "trace" in manifest, manifest["errors"]
+    assert "head" in manifest["trace"]["sources"]
+    tdir = os.path.join(out, "trace")
+    names = os.listdir(tdir)
+    assert "timeline.html" in names and "trace.json" in names
+    assert any(n.endswith(".ops.json") for n in names)
+
+
+def test_worker_killed_mid_capture_yields_error_entry(trace_cluster):
+    """Chaos lane: SIGKILL the target worker while its device-trace
+    capture is in flight. The fan-out must come back with a per-source
+    error entry — no hang, no parser crash on the never-delivered
+    trace."""
+    from ray_tpu.util import state as ust
+
+    @ray_tpu.remote(max_retries=0)
+    def hold(seconds):
+        time.sleep(seconds)
+        return os.getpid()
+
+    ref = hold.remote(30.0)
+    task_hex = ref.id.task_id().hex()
+
+    def worker_of_task():
+        rows = ust.list_tasks(
+            filters=[("task_id", "contains", task_hex)])
+        for r in rows:
+            if r["state"] == "RUNNING" and r.get("worker_id"):
+                return r["worker_id"]
+        return None
+
+    _wait_for(lambda: worker_of_task() is not None,
+              desc="hold task RUNNING")
+    wid = worker_of_task()
+    pid = next(w["pid"] for w in ust.list_workers()
+               if w["worker_id"].startswith(wid))
+
+    res = {}
+
+    def fanout():
+        res["reply"] = device_trace.capture_cluster(
+            "worker", wid, duration_s=3.0, timeout_s=20.0)
+
+    t = threading.Thread(target=fanout, daemon=True)
+    t.start()
+    time.sleep(1.0)  # let start_trace begin in the worker
+    os.kill(pid, signal.SIGKILL)
+    t.join(60)
+    assert not t.is_alive(), "fan-out hung past the worker's death"
+    reply = res["reply"]
+    # Either the head resolved the target before it died (per-source
+    # error entry) or the connection dropped mid-call — both must
+    # surface as a structured error, never a hang or an exception.
+    if reply.get("error"):
+        assert reply["entries"] == []
+    else:
+        (entry,) = reply["entries"]
+        assert entry.get("error"), entry
+    with pytest.raises(Exception):
+        ray_tpu.get(ref, timeout=60)

@@ -410,6 +410,123 @@ def test_profiler_bucket_carries_model_id():
 
 
 # ---------------------------------------------------------------------------
+# experiment-state journal (satellite: history + open alerts survive a
+# head restart)
+# ---------------------------------------------------------------------------
+
+
+def test_history_snapshot_restore_round_trip():
+    st = MetricsHistoryStore()
+    now = time.time()
+    ctags = (("state", "FINISHED"),)
+    bounds = [0.1, 1.0, 10.0]
+    st.ingest("p1", {"ray_tpu_tasks_total": _counter(5.0, ctags)},
+              ts=now - 30)
+    st.ingest("p1", {"ray_tpu_tasks_total": _counter(9.0, ctags),
+                     "ray_tpu_gcs_nodes": _gauge(3.0)}, ts=now - 20)
+    st.ingest("p1", {"ray_tpu_train_step_seconds":
+                     _hist([0, 2, 0, 0, 2], bounds)}, ts=now - 15)
+    st.ingest("p1", {"ray_tpu_train_step_seconds":
+                     _hist([0, 5, 1, 0, 6], bounds)}, ts=now - 5)
+    snap = json.loads(json.dumps(st.snapshot(), default=str))
+
+    st2 = MetricsHistoryStore()
+    assert st2.restore(snap) > 0
+    # Counter window delta survives the round trip.
+    assert st2.window_agg("ray_tpu_tasks_total", "delta",
+                          60.0)[0]["value"] == 4.0
+    # Histogram boundaries rode the snapshot: percentiles still work.
+    p90 = st2.window_agg("ray_tpu_train_step_seconds", "p90", 60.0)
+    assert p90 and 0.1 <= p90[0]["value"] <= 10.0
+    # Continuity: the restarted head's first push from a proc seeds its
+    # baseline; the second continues the restored merged counter value
+    # instead of double-counting the pre-restart total.
+    st2.ingest("p1", {"ray_tpu_tasks_total": _counter(12.0, ctags)},
+               ts=now)
+    st2.ingest("p1", {"ray_tpu_tasks_total": _counter(15.0, ctags)},
+               ts=now + 1)
+    pts = st2.query_points("ray_tpu_tasks_total", 600.0,
+                           tags=dict(ctags))[0]["points"]
+    assert pts[-1][1] == 7.0  # 4 pre-restart + 3 post
+    assert [v for _, v in pts] == sorted(v for _, v in pts)
+
+
+def test_alert_engine_journal_restore_links_episode():
+    st = MetricsHistoryStore(staleness_s=60.0)
+    engine = AlertEngine(st, rules=[_gauge_rule()])
+    tags = (("state", "SUSPECT"),)
+    st.ingest("p1", {"ray_tpu_gcs_nodes": _gauge(2.0, tags)},
+              ts=1000.0)
+    assert [t["event"]
+            for t in engine.evaluate(now=1001.0)] == ["fired"]
+    data = json.loads(json.dumps(engine.journal_state(), default=str))
+
+    engine2 = AlertEngine(MetricsHistoryStore(),
+                          rules=[_gauge_rule()])
+    assert engine2.restore(data) == 1
+    (f,) = engine2.firing()
+    assert f["rule"] == "r" and f["tags"] == dict(tags)
+    # The restored firing state resolves against the SAME episode
+    # record the journal carried (identity via episode_index), so the
+    # episode history shows one fire->resolve lifecycle, not a dangling
+    # never-resolved entry.
+    trans = engine2.evaluate(now=2000.0)  # empty store: breach gone
+    assert [t["event"] for t in trans] == ["resolved"]
+    assert list(engine2.episodes)[-1]["resolved_ts"] == 2000.0
+    # State machines for rules the new head does not know are dropped;
+    # their episode history is kept.
+    data2 = dict(data, states=[["ghost_rule", [["a", "b"]],
+                                {"state": "firing"}]])
+    engine3 = AlertEngine(MetricsHistoryStore(),
+                          rules=[_gauge_rule()])
+    assert engine3.restore(data2) == 0
+    assert len(engine3.episodes) == 1
+
+
+def test_health_plane_journal_write_and_reload(tmp_path):
+    from ray_tpu.core.config import Config
+    from ray_tpu.core.health import ClusterHealthPlane
+
+    cfg = Config()
+    cfg.health_journal_interval_s = 0.0
+    d = str(tmp_path)
+    p = ClusterHealthPlane(cfg, session_dir=d)
+    tags = (("state", "SUSPECT"),)
+    now = time.time()
+    p.store.ingest("p1", {"ray_tpu_gcs_nodes": _gauge(2.0, tags)},
+                   ts=now)
+    p.engine.evaluate(now=now)         # node_suspect -> pending
+    p.engine.evaluate(now=now + 5.0)   # for_s=3 elapsed -> fired
+    assert any(f["rule"] == "node_suspect"
+               for f in p.engine.firing())
+    p.maybe_journal()
+    jdir = os.path.join(d, "health_journal")
+    assert sorted(os.listdir(jdir)) == ["alerts.json", "history.json"]
+
+    # "Head restart": a fresh plane over the same session dir reloads
+    # the rings and the open alert, and defers its first evaluation so
+    # the restored alert is not insta-resolved before any push arrives.
+    p2 = ClusterHealthPlane(cfg, session_dir=d)
+    rows = p2.store.query_points("ray_tpu_gcs_nodes", 600.0,
+                                 tags=dict(tags))
+    assert rows and rows[0]["points"]
+    assert any(f["rule"] == "node_suspect"
+               for f in p2.engine.firing())
+    assert p2._last_eval > time.time()
+    p2.maybe_evaluate()  # throttled by the restore hold-off
+    assert any(f["rule"] == "node_suspect"
+               for f in p2.engine.firing())
+
+    # Journalling disabled: no dir is consulted or created.
+    cfg_off = Config()
+    cfg_off.health_journal_enabled = False
+    p3 = ClusterHealthPlane(cfg_off, session_dir=str(tmp_path / "x"))
+    assert p3._journal_dir is None
+    p3.maybe_journal()
+    assert not os.path.exists(str(tmp_path / "x" / "health_journal"))
+
+
+# ---------------------------------------------------------------------------
 # e2e: breaker trip + stalled rank fire and resolve through the head
 # ---------------------------------------------------------------------------
 

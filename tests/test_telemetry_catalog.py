@@ -192,6 +192,31 @@ def test_health_plane_metrics_in_catalog():
         assert tuple(got_tags) == tag_keys, name
 
 
+def test_device_trace_metrics_in_catalog():
+    """The device-trace-plane metrics stay declared — capture() emits
+    through these names (capture counter, last-trace-size gauge,
+    per-step compile/execute device time); a rename/removal would
+    blind the device-trace plane. The ``trace`` flight-recorder
+    subsystem is pinned alongside: the capture/failure events are the
+    plane's audit trail."""
+    expected = {
+        "ray_tpu_device_trace_captures_total": (
+            telemetry.COUNTER, ("status",)),
+        "ray_tpu_device_trace_bytes": (telemetry.GAUGE, ("proc",)),
+        "ray_tpu_train_step_device_time_seconds": (
+            telemetry.HISTOGRAM, ("rank", "phase")),
+    }
+    for name, (kind, tag_keys) in expected.items():
+        assert name in telemetry.CATALOG, name
+        got_kind, _desc, got_tags, _bounds = telemetry.CATALOG[name]
+        assert got_kind == kind, name
+        assert tuple(got_tags) == tag_keys, name
+
+    from ray_tpu.util import flight_recorder as fr
+
+    assert fr.CATALOG.get("trace") == ("captured", "capture_failed")
+
+
 def test_alert_rules_reference_only_catalog_metrics():
     """Catalog lint extension: every alert rule — the shipped defaults
     and anything constructed through AlertRule/validate_rule — may only
