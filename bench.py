@@ -179,6 +179,28 @@ def _run_serve_cb_bench():
     )
 
 
+def _run_control_plane_bench():
+    """`bench.py control-plane`: the control-plane load lane — a
+    25-50 logical-node fake cluster driving registration + task +
+    actor + pubsub + KV churn, then the load observatory read back
+    out. Writes BENCH_CONTROL_PLANE.json (per-handler p50/p99
+    server-side timings, event-loop lag, fan-out amplification
+    factors)."""
+    import os
+    import subprocess
+    import sys
+
+    out = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                       "BENCH_CONTROL_PLANE.json")
+    subprocess.run(
+        [sys.executable, "-m", "ray_tpu.scripts.control_plane_bench",
+         "--json", out],
+        timeout=1200, check=True,
+        env={**os.environ, "JAX_PLATFORMS": "cpu",
+             "RAY_TPU_LOG_TO_DRIVER": "0"},
+    )
+
+
 def _run_transfer_device_bench():
     """`bench.py transfer-device`: the device-plane transfer lane —
     1 GiB sharded jax.Array, shared-device zero-copy get + cross-process
@@ -210,5 +232,7 @@ if __name__ == "__main__":
         _run_transfer_device_bench()
     elif len(sys.argv) > 1 and sys.argv[1] == "serve-cb":
         _run_serve_cb_bench()
+    elif len(sys.argv) > 1 and sys.argv[1] == "control-plane":
+        _run_control_plane_bench()
     else:
         main()

@@ -259,6 +259,9 @@ def _connect_driver(node: HeadNode, config: Config, namespace: str
         mode="driver",
     )
     peer = LocalPeer()
+    # In-process driver: its local head calls are accounted per caller
+    # kind just like socket peers (util/rpc_stats.py).
+    peer.state["caller_kind"] = "driver"
 
     async def notify_handler(method, payload):
         if method == "pubsub":

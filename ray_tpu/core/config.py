@@ -188,6 +188,17 @@ class Config:
     # Hard byte budget for the whole store; least-recently-updated
     # series are evicted whole past this (eviction counter exported).
     metrics_history_max_bytes: int = 16 * 1024 * 1024
+    # Per-metric series-count cap: a single metric name may hold at
+    # most this many tag sets before its least-recently-updated series
+    # are evicted (high-cardinality tag explosions must not LRU-thrash
+    # every other metric out of the byte budget above).
+    metrics_history_max_series_per_metric: int = 64
+    # --- control-plane load observatory (util/rpc_stats.py) ---
+    # Cadence of the self-scheduling event-loop lag probe installed on
+    # every process loop (head / agent / worker / driver); lag past the
+    # stall threshold leaves an rpc/loop_stall flight event.
+    event_loop_probe_interval_s: float = 0.25
+    event_loop_stall_threshold_s: float = 0.5
     # SLO/alert rule engine (util/alerts.py) over the history store.
     alerts_enabled: bool = True
     # Min seconds between rule sweeps (pushes arrive per-proc, so the

@@ -81,12 +81,36 @@ class HeadClient:
     async def call(self, method: str, payload=None, timeout=None):
         if self._conn is not None:
             return await self._conn.call(method, payload, timeout=timeout)
+        # Local (in-process driver) path: these calls never cross a
+        # socket, so Connection._dispatch can't account them — record
+        # into the same process-global table here or the busiest caller
+        # of an embedded head would be invisible to the observatory.
         handler = self._handlers[method]
-        if timeout is not None:
-            return await asyncio.wait_for(
-                handler(self._local_peer, payload), timeout
-            )
-        return await handler(self._local_peer, payload)
+        from ray_tpu.util import telemetry
+
+        if not telemetry.enabled():
+            if timeout is not None:
+                return await asyncio.wait_for(
+                    handler(self._local_peer, payload), timeout
+                )
+            return await handler(self._local_peer, payload)
+        from ray_tpu.util import rpc_stats
+
+        t0 = time.perf_counter()
+        ok = True
+        try:
+            if timeout is not None:
+                return await asyncio.wait_for(
+                    handler(self._local_peer, payload), timeout
+                )
+            return await handler(self._local_peer, payload)
+        except Exception:
+            ok = False
+            raise
+        finally:
+            rpc_stats.server_stats().record(
+                method, rpc_stats.caller_kind(self._local_peer),
+                0.0, time.perf_counter() - t0, ok=ok)
 
     @property
     def closed(self):

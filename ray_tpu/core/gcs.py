@@ -54,6 +54,26 @@ def _swallow(site: str, error: BaseException, **tags) -> None:
     flight_recorder.swallow(site, error, **tags)
 
 
+def _stamp_caller(conn, kind: str) -> None:
+    """Record the caller kind on the connection so the server-side RPC
+    accounting (util/rpc_stats.py) attributes this peer's subsequent
+    calls to worker/agent/driver instead of the generic fallback."""
+    state = getattr(conn, "state", None)
+    if isinstance(state, dict):
+        state["caller_kind"] = kind
+
+
+def _payload_nbytes(data) -> int:
+    """Approximate wire size of one pubsub payload (the per-subscriber
+    cost a publish multiplies)."""
+    try:
+        import msgpack
+
+        return len(msgpack.packb(data, use_bin_type=True))
+    except Exception:  # lint: allow-silent(size estimate only; non-msgpack-native payloads still publish)
+        return 0
+
+
 class HeadService:
     def __init__(self, config: Config, shm_store: ShmStore, session_dir: str,
                  host: str = "127.0.0.1", storage=None):
@@ -125,6 +145,13 @@ class HeadService:
 
         self.health = ClusterHealthPlane(config,
                                          session_dir=session_dir)
+        # Control-plane load observatory: pubsub fan-out / KV write
+        # amplification accounting (util/rpc_stats.py); the per-handler
+        # call accounting itself lives in the process-global
+        # ServerStats that core/rpc.py records into.
+        from ray_tpu.util.rpc_stats import AmplificationStats
+
+        self.rpc_amp = AmplificationStats()
 
     # ------------------------------------------------------------------
     # lifecycle
@@ -140,6 +167,13 @@ class HeadService:
             self.pool, spread_threshold=self.config.scheduler_spread_threshold
         )
         self._load_persisted()
+        # Preregister the full dispatch dict into the process-global
+        # accounting table: every handler shows in rpc_stats/hotrpc
+        # from boot (zero counts included), and the parity test can
+        # assert a newly added h_* cannot dodge instrumentation.
+        from ray_tpu.util import rpc_stats
+
+        rpc_stats.server_stats().register_methods(self.handlers())
         self._pump_task = asyncio.get_running_loop().create_task(
             self._periodic_pump()
         )
@@ -580,6 +614,7 @@ class HeadService:
             "metrics_history_snapshot": self.h_metrics_history_snapshot,
             "alerts": self.h_alerts,
             "alerts_put_rule": self.h_alerts_put_rule,
+            "rpc_stats": self.h_rpc_stats,
             "debug_dump_cluster": self.h_debug_dump_cluster,
             "debug_sched_state": self.h_debug_sched_state,
             "profile_capture_cluster": self.h_profile_capture_cluster,
@@ -599,6 +634,7 @@ class HeadService:
         handle = self.pool.on_registered(worker_id, address, conn)
         if handle is None:
             return {"ok": False, "error": "unknown worker"}
+        _stamp_caller(conn, "worker")
         self._conn_to_worker[conn] = handle
         self._spawn_backoff_s.pop(handle.node_id, None)
         self._spawn_backoff_until.pop(handle.node_id, None)
@@ -622,6 +658,7 @@ class HeadService:
         handling). A payload carrying a known ``node_id`` is a reconnect
         from a briefly partitioned agent: reattach instead of
         registering a fresh node."""
+        _stamp_caller(conn, "agent")
         prev_hex = payload.get("node_id")
         if prev_hex:
             node_id = NodeID.from_hex(prev_hex)
@@ -777,6 +814,7 @@ class HeadService:
         return {"ok": True}
 
     async def h_register_driver(self, conn, payload):
+        _stamp_caller(conn, "driver")
         self._job_counter += 1
         job_id = JobID.from_int(self._job_counter)
         self.jobs[job_id] = {
@@ -843,6 +881,15 @@ class HeadService:
         wid = handle.worker_id.hex()
         self.kv.get("metrics", {}).pop(f"metrics:{wid}".encode(), None)
         self.kv.get("timeline", {}).pop(f"timeline:{wid}".encode(), None)
+        # Drop the dead worker's pubsub subscriptions immediately (the
+        # conn's own on_close also discards, but a kill-path death can
+        # reach here while the socket still looks open).
+        conn = handle.connection
+        if conn is not None:
+            for channel, subs in self.subscribers.items():
+                if conn in subs:
+                    subs.discard(conn)
+                    self.rpc_amp.record_prune(channel, 1)
         # History keeps the dead proc's recorded points (that's the
         # point of history) but stops gauge carry-forward for it.
         self.health.on_proc_gone(f"metrics:{wid}")
@@ -1270,13 +1317,23 @@ class HeadService:
         key = payload["key"]
         if not payload.get("overwrite", True) and key in ns:
             return {"added": False}
-        ns[key] = payload["value"]
+        value = payload["value"]
+        ns[key] = value
+        fanout = 0
         if ns_name == "metrics":
             # Health plane rides the push: append into the history
-            # store + sweep the alert rules (never raises).
-            self.health.on_metrics_push(key, payload["value"])
+            # store + sweep the alert rules (never raises). That ingest
+            # is one downstream delivery beyond the store write.
+            self.health.on_metrics_push(key, value)
+            fanout += 1
+        fanout += len(self.subscribers.get(f"kv:{ns_name}", ()))
+        try:
+            nbytes = len(value)
+        except TypeError:
+            nbytes = 0
+        self.rpc_amp.record_kv_put(ns_name, nbytes, fanout)
         if ns_name not in self.EPHEMERAL_KV_NS:
-            self._persist_kv(ns_name, key, payload["value"])
+            self._persist_kv(ns_name, key, value)
             await self._commit_barrier()
         return {"added": True}
 
@@ -1325,12 +1382,31 @@ class HeadService:
         return {"ok": True}
 
     def _publish(self, channel: str, data):
-        for peer in list(self.subscribers.get(channel, ())):
+        subs = self.subscribers.get(channel)
+        if not subs:
+            return
+        # Prune dead subscriber conns BEFORE fanning out: without this
+        # every publish keeps notifying dead peers forever (swallowing
+        # the error each time), so fan-out cost grows monotonically
+        # with worker churn.
+        dead = [p for p in subs if getattr(p, "closed", False)]
+        for p in dead:
+            subs.discard(p)
+        if dead:
+            from ray_tpu.util import flight_recorder
+
+            flight_recorder.record(
+                "gcs", "subscriber_pruned", channel=channel,
+                pruned=len(dead))
+        for peer in list(subs):
             try:
                 peer.notify_forget("pubsub",
                                    {"channel": channel, "data": data})
             except Exception as e:
                 _swallow("gcs.pubsub_publish", e, channel=channel)
+        self.rpc_amp.record_publish(channel, len(subs),
+                                    _payload_nbytes(data),
+                                    pruned=len(dead))
 
     # ------------------------------------------------------------------
     # object directory
@@ -1694,6 +1770,36 @@ class HeadService:
         name}``). Validation failures come back as ``{"ok": False}``,
         not exceptions — the CLI prints them."""
         return self.health.put_rule(payload or {})
+
+    # -- control-plane load observatory (util/rpc_stats.py) ------------
+
+    async def h_rpc_stats(self, conn, payload):
+        """Head-process inbound-call accounting (per-handler times /
+        bytes / callers), event-loop lag (head-local probes + the
+        cluster-wide lag series from the history store), and pubsub/KV
+        amplification factors. One payload feeds the hotrpc CLI,
+        ``GET /rpc``, and the debug bundle ``rpc/`` section."""
+        from ray_tpu.util import rpc_stats
+
+        payload = payload or {}
+        snap = rpc_stats.server_stats().snapshot(
+            top=int(payload.get("top") or 20))
+        snap["loops"] = rpc_stats.probe_summaries()
+        snap["amplification"] = self.rpc_amp.snapshot()
+        lag = []
+        if self.health.enabled:
+            window_s = float(payload.get("window_s") or 300.0)
+            p99 = {tuple(sorted(r["tags"].items())): r["value"]
+                   for r in self.health.store.window_agg(
+                       "ray_tpu_event_loop_lag_seconds", "p99",
+                       window_s)}
+            for r in self.health.store.window_agg(
+                    "ray_tpu_event_loop_lag_seconds", "p50", window_s):
+                key = tuple(sorted(r["tags"].items()))
+                lag.append({"tags": r["tags"], "p50_s": r["value"],
+                            "p99_s": p99.get(key)})
+        snap["loop_lag_cluster"] = lag
+        return snap
 
     # ------------------------------------------------------------------
     # debug plane (reference: `ray stack` / state-API debug dumps)

@@ -37,6 +37,8 @@ class ClusterHealthPlane:
             coarse_interval_s=config.metrics_history_coarse_interval_s,
             max_bytes=config.metrics_history_max_bytes,
             staleness_s=config.metrics_staleness_s,
+            max_series_per_metric=(
+                config.metrics_history_max_series_per_metric),
         )
         self.engine: Optional[AlertEngine] = None
         if self.enabled and config.alerts_enabled:

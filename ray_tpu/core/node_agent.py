@@ -288,6 +288,15 @@ class NodeAgent:
     # ---- lifecycle ----
 
     async def start(self):
+        # Event-loop lag probe (control-plane observatory): the agent's
+        # loop serves worker spawns and object pulls for its node.
+        try:
+            from ray_tpu.util import rpc_stats
+
+            rpc_stats.install_probe(asyncio.get_running_loop(),
+                                    "node-agent")
+        except Exception:  # lint: allow-silent(lag probe is decoration; the agent must boot regardless)
+            pass
         self.server = rpc.Server(self.handlers(), name="node-agent")
         bind = "0.0.0.0" if self.host not in ("127.0.0.1",
                                               "localhost") else "127.0.0.1"

@@ -65,6 +65,19 @@ def _fr():
     return _flight
 
 
+# Server-side accounting (util/rpc_stats.py), bound lazily likewise.
+_rpc_stats = None
+
+
+def _rs():
+    global _rpc_stats
+    if _rpc_stats is None:
+        from ray_tpu.util import rpc_stats
+
+        _rpc_stats = rpc_stats
+    return _rpc_stats
+
+
 #: Cached config gate for per-RPC client/server spans (``trace_rpc`` /
 #: RAY_TPU_TRACE_RPC). None until first read; tests reset it directly.
 _trace_rpc_flag: Optional[bool] = None
@@ -378,8 +391,13 @@ class Connection:
         # exposes a send-less TransportSocket wrapper).
         self._sock = None
         self._sock_tried = False
-        # Arbitrary per-connection state (e.g. registered worker id).
+        # Arbitrary per-connection state (e.g. registered worker id,
+        # caller kind stamped by the registration handlers).
         self.state: Dict[str, Any] = {}
+        # Size of the most recent frame handed to _enqueue_now: read by
+        # _dispatch right after sending a reply to attribute reply
+        # bytes per handler (best-effort under concurrent sends).
+        self._last_enqueue_nbytes = 0
 
     def start(self):
         self._loop = asyncio.get_running_loop()
@@ -410,6 +428,10 @@ class Connection:
                         msg["d"] = d
                     d["__attachment__"] = blob
                 _tm().inc("ray_tpu_rpc_recv_bytes_total", nbytes)
+                # Local-only accounting stamps (never re-serialized):
+                # queue wait = this read timestamp to handler start.
+                msg["_rts"] = time.perf_counter()
+                msg["_rbs"] = nbytes
                 fi = _fault_injector
                 if fi is not None and fi.rules:
                     verdict = fi.on_frame("recv", self.name, msg.get("m"))
@@ -452,11 +474,22 @@ class Connection:
                 # never await run inline — one asyncio Task per
                 # tiny-task completion is the dominant loop
                 # overhead at high task rates.
+                t0 = time.perf_counter()
+                ok = True
                 try:
                     handler(self, msg.get("d"))
                 except Exception:
+                    ok = False
                     logger.exception("notify handler %s failed",
                                      msg.get("m"))
+                if _tm().enabled():
+                    rts = msg.get("_rts")
+                    rs = _rs()
+                    rs.server_stats().record(
+                        msg.get("m") or "?", rs.caller_kind(self),
+                        max(0.0, t0 - rts) if rts is not None else 0.0,
+                        time.perf_counter() - t0,
+                        recv_bytes=msg.get("_rbs") or 0, ok=ok)
             else:
                 self._loop.create_task(self._dispatch(t, msg))
         elif t == "req":
@@ -467,6 +500,7 @@ class Connection:
         handler = self.handlers.get(method)
         error = None
         result = None
+        t0 = time.perf_counter()
         if handler is None:
             error = f"no handler for method {method!r}"
         else:
@@ -482,6 +516,8 @@ class Connection:
                 except Exception as e:
                     logger.exception("handler %s failed", method)
                     error = f"{type(e).__name__}: {e}"
+        t1 = time.perf_counter()
+        reply_bytes = 0
         if t == "req":
             attachment = None
             if isinstance(result, WithAttachment):
@@ -489,6 +525,15 @@ class Connection:
                 result = result.payload
             await self._send({"t": "res", "i": msg["i"], "d": result,
                               "e": error}, attachment)
+            reply_bytes = self._last_enqueue_nbytes
+        if _tm().enabled():
+            rts = msg.get("_rts")
+            rs = _rs()
+            rs.server_stats().record(
+                method or "?", rs.caller_kind(self),
+                max(0.0, t0 - rts) if rts is not None else 0.0,
+                t1 - t0, recv_bytes=msg.get("_rbs") or 0,
+                reply_bytes=reply_bytes, ok=error is None)
 
     def _enqueue_frame(self, msg: dict, attachment=None) -> bool:
         """Fault-plane gate in front of ``_enqueue_now``: with no rules
@@ -541,6 +586,7 @@ class Connection:
             self._outbuf.append(mv.nbytes.to_bytes(8, "little"))
             self._outbuf.append(mv)  # flushed without joining (below)
         _tm().inc("ray_tpu_rpc_sent_bytes_total", nbytes)
+        self._last_enqueue_nbytes = nbytes
         if not self._flush_scheduled:
             self._flush_scheduled = True
             asyncio.get_running_loop().call_soon(self._flush)
@@ -827,6 +873,7 @@ class EventLoopThread:
     """A dedicated thread running an asyncio loop, shared per process."""
 
     def __init__(self, name: str = "ray-tpu-io"):
+        self.name = name
         self.loop = asyncio.new_event_loop()
         self._thread = threading.Thread(
             target=self._run, name=name, daemon=True
@@ -838,7 +885,18 @@ class EventLoopThread:
     def _run(self):
         asyncio.set_event_loop(self.loop)
         self.loop.call_soon(self._started.set)
+        # Event-loop lag probe, armed a beat after start: rpc_stats
+        # pulls in telemetry/config, which is not safe mid-bootstrap.
+        self.loop.call_later(0.5, self._install_lag_probe)
         self.loop.run_forever()
+
+    def _install_lag_probe(self):
+        try:
+            from ray_tpu.util import rpc_stats
+
+            rpc_stats.install_probe(self.loop, self.name)
+        except Exception:  # lint: allow-silent(lag probe is decoration; the loop must run regardless)
+            pass
 
     def run(self, coro, timeout: Optional[float] = None):
         """Run a coroutine on the loop from a foreign thread, blocking."""

@@ -958,6 +958,15 @@ async def _amain():
 
     loop = asyncio.get_running_loop()
     loop_thread = _LoopFacade(loop)
+    # Event-loop lag probe: the worker's loop serves task dispatch,
+    # replies, and replica loops — its lag is the per-process "am I
+    # starved" fact the observatory aggregates cluster-wide.
+    try:
+        from ray_tpu.util import rpc_stats
+
+        rpc_stats.install_probe(loop, "worker-loop")
+    except Exception:  # lint: allow-silent(lag probe is decoration; the worker must boot regardless)
+        pass
 
     # Job id is discovered from the first task spec; start with a nil-ish job.
     cw = CoreWorker(

@@ -49,6 +49,8 @@ class DashboardActor:
         app.router.add_get("/api/metrics/history", self._metrics_history)
         app.router.add_get("/alerts", self._alerts)
         app.router.add_get("/api/alerts", self._alerts)
+        app.router.add_get("/rpc", self._rpc)
+        app.router.add_get("/api/rpc", self._rpc)
         app.router.add_get("/healthz", self._healthz)
         self._runner = web.AppRunner(app)
         await self._runner.setup()
@@ -272,6 +274,23 @@ class DashboardActor:
             from ray_tpu.util.state import _call
 
             return _call("alerts")
+
+        return await self._json(produce)
+
+    async def _rpc(self, request):
+        """Control-plane load observatory — the HTTP face of
+        ``ray_tpu debug hotrpc``: per-handler server-side accounting,
+        top talkers, event-loop lag, pubsub/KV amplification. Query
+        params: ``top`` (table row cap), ``window`` (cluster loop-lag
+        aggregation window, seconds)."""
+        def produce():
+            from ray_tpu.util.state import _call
+
+            q = request.query
+            return _call("rpc_stats", {
+                "top": int(q.get("top", 20)),
+                "window_s": float(q.get("window", 300.0)),
+            })
 
         return await self._json(produce)
 

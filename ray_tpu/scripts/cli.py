@@ -174,12 +174,121 @@ def cmd_summary(args):
     ray_tpu.shutdown()
 
 
+def _fmt_bytes(n) -> str:
+    n = float(n or 0)
+    for unit in ("B", "KB", "MB", "GB"):
+        if n < 1024.0 or unit == "GB":
+            return f"{n:.0f}{unit}" if unit == "B" else f"{n:.1f}{unit}"
+        n /= 1024.0
+    return f"{n:.1f}GB"
+
+
+def _render_hotrpc(snap, top: int = 20) -> list:
+    """Pure renderer for `ray_tpu debug hotrpc` (testable without a
+    tty): per-handler server-side accounting, top talkers, event-loop
+    lag, and pubsub/KV amplification factors."""
+    def ms(v) -> str:
+        # Percentiles are None until a row has observations.
+        return f"{v * 1e3:.1f}ms" if v is not None else "?"
+
+    lines = []
+    methods = snap.get("methods", [])
+    busy = [m for m in methods if m.get("calls")]
+    lines.append(
+        f"== handlers: {len(methods)} tracked, {len(busy)} active "
+        f"(window {snap.get('since_s', 0):.0f}s, "
+        f"talker cap {snap.get('entry_cap')}"
+        + (f", overflow {snap['overflow']}" if snap.get("overflow")
+           else "") + ") ==")
+    hdr = (f"  {'method':<26} {'calls':>7} {'err':>5} "
+           f"{'p50':>8} {'p99':>8} {'max':>8} {'q.p99':>8} "
+           f"{'in':>9} {'out':>9}")
+    lines.append(hdr)
+    for m in busy[:top]:
+        lines.append(
+            f"  {m['method']:<26} {m['calls']:>7} {m['errors']:>5} "
+            f"{ms(m.get('handler_p50_s')):>8} "
+            f"{ms(m.get('handler_p99_s')):>8} "
+            f"{ms(m.get('handler_max_s')):>8} "
+            f"{ms(m.get('queue_wait_p99_s')):>8} "
+            f"{_fmt_bytes(m.get('recv_bytes')):>9} "
+            f"{_fmt_bytes(m.get('reply_bytes')):>9}")
+    idle = len(methods) - len(busy)
+    if idle:
+        lines.append(f"  ... {idle} registered handler(s) with no "
+                     "calls yet")
+    talkers = snap.get("talkers", [])
+    if talkers:
+        lines.append(f"top talkers (method x caller, {len(talkers)}):")
+        for t in talkers[:top]:
+            lines.append(
+                f"  {t['method']:<26} {t['caller']:<8} "
+                f"calls={t['calls']} "
+                f"time={t['handler_s'] * 1e3:.1f}ms "
+                f"in={_fmt_bytes(t.get('recv_bytes'))}")
+    loops = snap.get("loops", [])
+    if loops:
+        lines.append("event-loop lag (this process):")
+        for lp in loops:
+            lines.append(
+                f"  {lp['loop']:<14} ticks={lp['ticks']} "
+                f"p50={ms(lp.get('lag_p50_s'))} "
+                f"p99={ms(lp.get('lag_p99_s'))} "
+                f"max={ms(lp.get('lag_max_s'))} "
+                f"stalls={lp['stalls']}")
+    cluster = snap.get("loop_lag_cluster", [])
+    if cluster:
+        lines.append("event-loop lag (cluster, from metrics history):")
+        for row in cluster:
+            proc = row.get("tags", {}).get("proc", "?")
+            p50 = row.get("p50_s")
+            p99 = row.get("p99_s")
+            p50s = f"{p50 * 1e3:.1f}ms" if p50 is not None else "?"
+            p99s = f"{p99 * 1e3:.1f}ms" if p99 is not None else "?"
+            lines.append(f"  {proc:<28} p50={p50s} p99={p99s}")
+    amp = snap.get("amplification", {})
+    pubsub = amp.get("pubsub", [])
+    if pubsub:
+        lines.append(
+            "pubsub fan-out (per channel):"
+            + (f"  [{amp.get('pruned_total')} dead subscriber(s) "
+               "pruned]" if amp.get("pruned_total") else ""))
+        for ch in pubsub:
+            lines.append(
+                f"  {ch['channel']:<26} publishes={ch['publishes']} "
+                f"messages={ch['messages']} "
+                f"bytes={_fmt_bytes(ch['bytes'])} "
+                f"fanout={ch['fanout']} "
+                f"(avg {ch['fanout_avg']:.1f})"
+                + (f" drops={ch['drops_pruned']}"
+                   if ch.get("drops_pruned") else ""))
+    kv = amp.get("kv", [])
+    if kv:
+        lines.append("kv write amplification (per namespace):")
+        for ns in kv:
+            lines.append(
+                f"  {ns['ns']:<26} puts={ns['puts']} "
+                f"bytes={_fmt_bytes(ns['bytes'])} -> "
+                f"{_fmt_bytes(ns['amplified_bytes'])} on the wire "
+                f"(x{ns['amplification']:.1f})")
+    if not busy and not pubsub and not kv:
+        lines.append("no RPC traffic recorded yet")
+    return lines
+
+
 def cmd_debug(args):
     ray_tpu = _attach()
     from ray_tpu.util import debug as udebug
 
     try:
-        if args.debug_cmd == "stacks":
+        if args.debug_cmd == "hotrpc":
+            from ray_tpu.util.state import _call
+
+            snap = _call("rpc_stats", {"top": args.top,
+                                       "window_s": args.window})
+            for line in _render_hotrpc(snap, top=args.top):
+                print(line)
+        elif args.debug_cmd == "stacks":
             for source, threads in sorted(
                     udebug.cluster_stacks(args.timeout).items()):
                 print(f"==== {source} ====")
@@ -605,6 +714,15 @@ def main(argv=None):
         "(rings + stacks + state tables + metrics + timeline)")
     d.add_argument("--out", "-o", default="ray_tpu_debug")
     d.add_argument("--timeout", type=float, default=10.0)
+    d.set_defaults(fn=cmd_debug)
+    d = dsub.add_parser(
+        "hotrpc", help="control-plane load observatory: per-handler "
+        "server-side timings, top talkers, event-loop lag, and "
+        "pubsub/KV amplification factors")
+    d.add_argument("--top", type=int, default=20,
+                   help="rows to show per table")
+    d.add_argument("--window", type=float, default=300.0,
+                   help="cluster loop-lag aggregation window (seconds)")
     d.set_defaults(fn=cmd_debug)
     d = dsub.add_parser(
         "why", help="explain why a task/actor/object/placement-group "

@@ -1,0 +1,207 @@
+"""Control-plane load lane (`bench.py control-plane`).
+
+Stands up a fake multi-node cluster (virtual scheduling nodes, the
+scale-lane trick) and drives the three traffic classes the head's
+control plane serves — registration + task/actor churn, pubsub
+subscribe/publish churn, KV-put churn — then reads the load
+observatory back out (`rpc_stats`) and writes
+BENCH_CONTROL_PLANE.json: per-handler p50/p99 server-side timings,
+event-loop lag, and pubsub/KV fan-out amplification factors. The
+value of the lane is the round-over-round trend in handler latency
+and amplification, not the absolute throughput of this box.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import time
+
+
+def _task_churn(n_tasks: int) -> dict:
+    import ray_tpu
+
+    @ray_tpu.remote(num_cpus=1)
+    def nop(i):
+        return i
+
+    # Warm the worker pool so the churn measures the control plane,
+    # not process spawn.
+    ray_tpu.get([nop.remote(i) for i in range(32)], timeout=300)
+    t0 = time.perf_counter()
+    out = ray_tpu.get([nop.remote(i) for i in range(n_tasks)],
+                      timeout=900)
+    dt = time.perf_counter() - t0
+    assert out[-1] == n_tasks - 1
+    return {"num_tasks": n_tasks, "seconds": round(dt, 2),
+            "tasks_per_second": round(n_tasks / dt, 1)}
+
+
+def _actor_churn(n_actors: int) -> dict:
+    import ray_tpu
+
+    @ray_tpu.remote(num_cpus=0.01)
+    class A:
+        def ping(self):
+            return 1
+
+    t0 = time.perf_counter()
+    actors = [A.remote() for _ in range(n_actors)]
+    ray_tpu.get([a.ping.remote() for a in actors], timeout=900)
+    dt = time.perf_counter() - t0
+    for a in actors:
+        ray_tpu.kill(a)
+    return {"num_actors": n_actors, "seconds": round(dt, 2),
+            "actors_per_second": round(n_actors / dt, 2)}
+
+
+def _pubsub_churn(n_channels: int, n_publishes: int,
+                  n_subscribers: int = 4) -> dict:
+    import ray_tpu
+    from ray_tpu.util.state import _call
+
+    @ray_tpu.remote(num_cpus=0.01)
+    class Sub:
+        """Worker-side subscriber: registers this worker's head
+        connection on every bench channel so publishes fan out
+        across real conns (fanout > 1)."""
+
+        def subscribe(self, channels):
+            from ray_tpu.util.state import _call as call
+
+            for ch in channels:
+                call("subscribe", {"channel": ch})
+            return 1
+
+    channels = [f"bench-cp-{i}" for i in range(n_channels)]
+    subs = [Sub.remote() for _ in range(n_subscribers)]
+    ray_tpu.get([s.subscribe.remote(channels) for s in subs],
+                timeout=300)
+    for ch in channels:
+        _call("subscribe", {"channel": ch})  # the driver too
+    payload = "x" * 512
+    t0 = time.perf_counter()
+    for i in range(n_publishes):
+        _call("publish", {"channel": channels[i % n_channels],
+                          "data": {"seq": i, "blob": payload}})
+    dt = time.perf_counter() - t0
+    # Kill half the subscribers and publish again: the dead conns must
+    # be PRUNED from the fan-out sets (counted in the artifact), not
+    # notified forever.
+    for s in subs[: max(1, n_subscribers // 2)]:
+        ray_tpu.kill(s)
+    time.sleep(0.5)
+    for i, ch in enumerate(channels):
+        _call("publish", {"channel": ch,
+                          "data": {"seq": n_publishes + i}})
+    return {"channels": n_channels, "publishes": n_publishes,
+            "subscribers": n_subscribers + 1,
+            "seconds": round(dt, 2),
+            "publishes_per_second": round(n_publishes / dt, 1)}
+
+
+def _kv_churn(n_puts: int) -> dict:
+    from ray_tpu.util.state import _call
+
+    value = b"v" * 1024
+    t0 = time.perf_counter()
+    for i in range(n_puts):
+        _call("kv_put", {"ns": "bench", "key": f"cp-{i % 64}",
+                         "value": value})
+    dt = time.perf_counter() - t0
+    return {"puts": n_puts, "seconds": round(dt, 2),
+            "puts_per_second": round(n_puts / dt, 1)}
+
+
+def _summarize(snap: dict, top: int) -> dict:
+    """Distill an rpc_stats snapshot into the committed artifact
+    shape: per-handler p50/p99, loop lag, fan-out factors."""
+    handlers = []
+    for m in snap.get("methods", []):
+        if not m.get("calls"):
+            continue
+        handlers.append({
+            "method": m["method"],
+            "calls": m["calls"],
+            "errors": m["errors"],
+            "p50_ms": round(m["handler_p50_s"] * 1e3, 3),
+            "p99_ms": round(m["handler_p99_s"] * 1e3, 3),
+            "queue_p99_ms": round(m["queue_wait_p99_s"] * 1e3, 3),
+        })
+    handlers = handlers[:top]
+    loops = snap.get("loops", [])
+    lag_p99 = max((lp["lag_p99_s"] for lp in loops), default=0.0)
+    lag_p50 = max((lp["lag_p50_s"] for lp in loops), default=0.0)
+    amp = snap.get("amplification", {})
+    pubsub = amp.get("pubsub", [])
+    kv = amp.get("kv", [])
+    return {
+        "handlers": handlers,
+        "handlers_tracked": len(snap.get("methods", [])),
+        "rpc_calls_total": sum(m["calls"]
+                               for m in snap.get("methods", [])),
+        "loop_lag_p50_ms": round(lag_p50 * 1e3, 3),
+        "loop_lag_p99_ms": round(lag_p99 * 1e3, 3),
+        "loop_stalls": sum(lp["stalls"] for lp in loops),
+        "pubsub_fanout_max": max((c["fanout"] for c in pubsub),
+                                 default=0),
+        "kv_amplification_max": max((n["amplification"] for n in kv),
+                                    default=0.0),
+        "fanout": {"pubsub": pubsub, "kv": kv,
+                   "pruned_subscribers": amp.get("pruned_total", 0)},
+    }
+
+
+def main():
+    p = argparse.ArgumentParser()
+    p.add_argument("--json", default=None)
+    p.add_argument("--nodes", type=int, default=32,
+                   help="logical nodes (virtual scheduling nodes; "
+                   "the issue floor is 25)")
+    p.add_argument("--tasks", type=int, default=400)
+    p.add_argument("--actors", type=int, default=16)
+    p.add_argument("--channels", type=int, default=4)
+    p.add_argument("--publishes", type=int, default=200)
+    p.add_argument("--kv-puts", type=int, default=200)
+    p.add_argument("--top", type=int, default=12)
+    args = p.parse_args()
+
+    os.environ.setdefault("JAX_PLATFORMS", "cpu")
+    import ray_tpu
+    from ray_tpu import api
+
+    ray_tpu.init(num_cpus=8, num_tpus=0,
+                 object_store_memory=1 << 30)
+    for _ in range(args.nodes - 1):
+        api._global_node.add_node({"CPU": 8.0})
+
+    results = {"nodes": args.nodes}
+    t_all = time.perf_counter()
+    try:
+        results["task_churn"] = _task_churn(args.tasks)
+        results["actor_churn"] = _actor_churn(args.actors)
+        results["pubsub_churn"] = _pubsub_churn(args.channels,
+                                               args.publishes)
+        results["kv_churn"] = _kv_churn(args.kv_puts)
+        # Let the lag probes tick a little past the churn so the
+        # histogram reflects loaded AND idle periods.
+        time.sleep(1.0)
+
+        from ray_tpu.util.state import _call
+
+        snap = _call("rpc_stats", {"top": args.top})
+        results.update(_summarize(snap, args.top))
+        results["wall_s"] = round(time.perf_counter() - t_all, 2)
+        results["run_date"] = time.strftime("%Y-%m-%d")
+        print(json.dumps(results, indent=1))
+        if args.json:
+            with open(args.json, "w") as f:
+                json.dump(results, f, indent=1)
+                f.write("\n")
+    finally:
+        ray_tpu.shutdown()
+
+
+if __name__ == "__main__":
+    main()

@@ -179,6 +179,20 @@ def default_rules() -> List[AlertRule]:
             0.05, window_s=120.0, for_s=30.0, severity="warn",
             description="Continuous profiler overhead above 5% of wall "
             "time on some process."),
+        AlertRule(
+            "event_loop_lag",
+            "ray_tpu_event_loop_lag_seconds", "p99", ">",
+            0.25, window_s=60.0, for_s=5.0, severity="warn",
+            description="An event loop's lag-probe p99 stayed above "
+            "250 ms (per process+loop; a starved loop stalls every "
+            "RPC it serves)."),
+        AlertRule(
+            "rpc_handler_slow",
+            "ray_tpu_rpc_server_handler_seconds", "p99", ">",
+            1.0, window_s=60.0, for_s=10.0, severity="warn",
+            description="Server-side handler-time p99 above 1 s for "
+            "some RPC method (control-plane handlers should be "
+            "milliseconds)."),
     ]
 
 

@@ -92,7 +92,10 @@ def write_debug_bundle(out_dir: str, timeout_s: float = 10.0,
     ``timeline.json``, ``history/series.json`` (the head's metrics
     time-series store: the trajectory that LED here, not just the
     endpoint), ``alerts.json`` (firing alerts + recent fire/resolve
-    episodes with series evidence), ``profile/`` (a short
+    episodes with series evidence), ``rpc/stats.json`` (the
+    control-plane load observatory: per-handler RPC accounting,
+    top talkers, event-loop lag, pubsub/KV amplification),
+    ``profile/`` (a short
     cluster-wide sampling capture: per-source folded stacks +
     flamegraph HTML; ``profile_duration_s=0`` skips it), ``trace/``
     (a short cluster-wide device-trace capture: per-source
@@ -198,6 +201,22 @@ def write_debug_bundle(out_dir: str, timeout_s: float = 10.0,
         }
     except Exception as e:  # noqa: BLE001
         manifest["errors"]["alerts"] = f"{type(e).__name__}: {e}"
+
+    try:
+        rpc = _call("rpc_stats", {"top": 50})
+        rpc_dir = os.path.join(out_dir, "rpc")
+        os.makedirs(rpc_dir, exist_ok=True)
+        with open(os.path.join(rpc_dir, "stats.json"), "w") as f:
+            json.dump(rpc, f, indent=1, default=str)
+        manifest["rpc"] = {
+            "methods": len(rpc.get("methods", [])),
+            "talkers": len(rpc.get("talkers", [])),
+            "loops": len(rpc.get("loops", [])),
+            "pruned_subscribers": rpc.get("amplification", {})
+            .get("pruned_total", 0),
+        }
+    except Exception as e:  # noqa: BLE001
+        manifest["errors"]["rpc"] = f"{type(e).__name__}: {e}"
 
     if profile_duration_s and profile_duration_s > 0:
         # A short sampling window across every process: "what was

@@ -56,12 +56,17 @@ CATALOG: Dict[str, tuple] = {
     # core/core_worker.py)
     "object": ("sealed", "spilled", "restored", "pulled", "freed",
                "lost", "recovered", "shard_pulled", "shard_donated"),
-    # core/rpc.py + core/retry.py
+    # core/rpc.py + core/retry.py; "loop_stall" is the event-loop lag
+    # probe (util/rpc_stats.py) catching a scheduled-vs-actual delay
+    # past the stall threshold — the per-process evidence trail behind
+    # the ray_tpu_event_loop_lag_seconds histogram.
     "rpc": ("fault_injected", "conn_lost", "retry",
-            "deadline_exhausted", "breaker_open", "breaker_closed"),
-    # core/gcs.py cluster membership
+            "deadline_exhausted", "breaker_open", "breaker_closed",
+            "loop_stall"),
+    # core/gcs.py cluster membership + pubsub hygiene
     "gcs": ("node_alive", "node_suspect", "node_dead",
-            "node_reattached", "worker_dead", "actor_state"),
+            "node_reattached", "worker_dead", "actor_state",
+            "subscriber_pruned"),
     # collective/collective.py
     "collective": ("group_created", "group_destroyed"),
     # train/backend_executor.py + train/trainer.py;

@@ -105,7 +105,8 @@ class MetricsHistoryStore:
                  coarse_points: int = 360,
                  coarse_interval_s: float = 30.0,
                  max_bytes: int = 16 * 1024 * 1024,
-                 staleness_s: float = 15.0):
+                 staleness_s: float = 15.0,
+                 max_series_per_metric: int = 64):
         from ray_tpu.util.locks import make_lock
 
         self.recent_points = max(8, int(recent_points))
@@ -113,15 +114,19 @@ class MetricsHistoryStore:
         self.coarse_interval_s = float(coarse_interval_s)
         self.max_bytes = int(max_bytes)
         self.staleness_s = float(staleness_s)
+        self.max_series_per_metric = max(1, int(max_series_per_metric))
         self._lock = make_lock("metrics_history.MetricsHistoryStore._lock")
         #: (name, tags) -> _Series; ordered by last update (LRU evict).
         self._series: "OrderedDict[tuple, _Series]" = OrderedDict()
+        #: metric name -> live series count (per-metric cap accounting).
+        self._name_counts: Dict[str, int] = {}
         #: proc key -> {(name, tags): raw cumulative value} (counters /
         #: histograms; the diff baseline).
         self._proc_last: Dict[str, Dict[tuple, Any]] = {}
         self._proc_push_ts: Dict[str, float] = {}
         self.bytes_used = 0
         self.evictions = 0
+        self.cap_evictions = 0
 
     # -- ingest ----------------------------------------------------------
 
@@ -164,13 +169,44 @@ class MetricsHistoryStore:
         key = (name, tags)
         s = self._series.get(key)
         if s is None:
+            if self._name_counts.get(name, 0) >= \
+                    self.max_series_per_metric:
+                self._evict_one_of(name)
             s = self._series[key] = _Series(
                 name, kind, tags, self.recent_points,
                 self.coarse_points, boundaries)
+            self._name_counts[name] = self._name_counts.get(name, 0) + 1
             self.bytes_used += _SERIES_BASE_COST
         else:
             self._series.move_to_end(key)
         return s
+
+    def _drop_series(self, key: tuple, s: _Series) -> None:
+        """Bookkeeping shared by both eviction paths."""
+        self.bytes_used -= s.cost()
+        n = self._name_counts.get(s.name, 0) - 1
+        if n > 0:
+            self._name_counts[s.name] = n
+        else:
+            self._name_counts.pop(s.name, None)
+
+    def _evict_one_of(self, name: str) -> None:
+        """Per-metric cardinality cap: evict the least-recently-updated
+        series OF THIS METRIC so a tag explosion on one name cannot
+        LRU-thrash every other metric out of the byte budget."""
+        for key, s in self._series.items():
+            if key[0] == name:
+                del self._series[key]
+                self._drop_series(key, s)
+                self.cap_evictions += 1
+                try:
+                    from ray_tpu.util import telemetry
+
+                    telemetry.inc(
+                        "ray_tpu_metrics_history_series_capped_total", 1)
+                except Exception:  # lint: allow-silent(cap accounting is best-effort; the cap itself already held)
+                    pass
+                return
 
     def _append(self, s: _Series, ts: float, value) -> None:
         rotated = len(s.recent) == s.recent.maxlen
@@ -243,8 +279,8 @@ class MetricsHistoryStore:
         """Drop least-recently-updated series until under the budget."""
         dropped = 0
         while self.bytes_used > self.max_bytes and len(self._series) > 1:
-            _key, s = self._series.popitem(last=False)
-            self.bytes_used -= s.cost()
+            key, s = self._series.popitem(last=False)
+            self._drop_series(key, s)
             dropped += 1
         if not dropped:
             return
@@ -425,6 +461,7 @@ class MetricsHistoryStore:
                 "bytes": self.bytes_used,
                 "max_bytes": self.max_bytes,
                 "evictions": self.evictions,
+                "cap_evictions": self.cap_evictions,
                 "series": series,
             }
 

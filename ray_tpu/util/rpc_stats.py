@@ -1,0 +1,481 @@
+"""Control-plane load observatory: server-side RPC accounting, event-
+loop lag probes, and pubsub/KV fan-out amplification stats.
+
+Reference: Ray instruments exactly this layer — per-handler gRPC server
+metrics plus asio event-loop stats (src/ray/common/asio/) — because a
+centralized GCS is the scaling bottleneck by construction
+(arXiv:1712.05889). This module is the Python analog, shared by every
+process:
+
+- :class:`ServerStats` — a bounded in-process table of inbound-call
+  accounting keyed per handler and per (handler x caller-kind): call
+  counts, queue wait (frame read -> handler start), handler time,
+  payload/reply bytes, errors. ``core/rpc.py`` records every dispatched
+  frame here; the head's ``HeadClient`` local path records the
+  in-process driver calls that never cross a socket. The talker table
+  has a HARD entry cap — overflow folds into one ``__other__`` row, so
+  cardinality cannot grow without bound (and nothing per-caller is ever
+  pushed through the KV metrics plane; only the bounded per-method
+  histograms are).
+- :class:`LoopLagProbe` — a self-scheduling callback on an event loop
+  that measures scheduled-vs-actual delay into the
+  ``ray_tpu_event_loop_lag_seconds`` histogram (tagged per process +
+  loop), so "the head stalled" becomes a per-process, per-window fact.
+  Lag past the stall threshold leaves an ``rpc/loop_stall`` flight
+  event as the evidence trail.
+- :class:`AmplificationStats` — head-side per-channel pubsub fan-out
+  (messages/bytes out, dead-subscriber drops) and per-namespace KV
+  write amplification (value bytes x downstream fan-out).
+
+Hot-path contract: ``ServerStats.record`` is a dict upsert under one
+lock plus (when the metrics plane is on) two histogram observes and a
+counter inc; everything imports telemetry lazily so bootstrap order is
+unchanged, and every snapshot/summary path is JSONable for the
+``rpc_stats`` head handler, the hotrpc CLI, ``GET /rpc``, and the debug
+bundle ``rpc/`` section.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import os
+import threading
+import time
+from typing import Any, Dict, List, Optional, Tuple
+
+#: Hard cap on distinct (method, caller) talker rows per process.
+DEFAULT_ENTRY_CAP = 512
+#: Overflow fold key once the talker cap is hit.
+OVERFLOW_KEY = ("__other__", "__other__")
+
+#: Known caller kinds (anything else folds to "peer").
+CALLER_KINDS = ("worker", "agent", "driver", "head", "peer")
+
+
+def _boundaries() -> List[float]:
+    from ray_tpu.util.telemetry import LATENCY_BOUNDARIES
+
+    return LATENCY_BOUNDARIES
+
+
+def caller_kind(conn: Any) -> str:
+    """Classify the far side of a connection for accounting.
+
+    Registration handlers stamp ``conn.state["caller_kind"]`` (worker /
+    agent / driver); before registration — or on connections that never
+    register, like a worker's own link *to* the head — fall back to the
+    connection name (dialed head links are named ``*-head``)."""
+    state = getattr(conn, "state", None)
+    if isinstance(state, dict):
+        kind = state.get("caller_kind")
+        if kind:
+            return kind
+    name = getattr(conn, "name", "") or ""
+    if "head" in name:
+        return "head"
+    return "peer"
+
+
+class _MethodRow:
+    __slots__ = ("calls", "errors", "queue_s", "queue_max", "handler_s",
+                 "handler_max", "recv_bytes", "reply_bytes",
+                 "handler_hist", "queue_hist")
+
+    def __init__(self, nbuckets: int):
+        self.calls = 0
+        self.errors = 0
+        self.queue_s = 0.0
+        self.queue_max = 0.0
+        self.handler_s = 0.0
+        self.handler_max = 0.0
+        self.recv_bytes = 0
+        self.reply_bytes = 0
+        # len(boundaries)+1 buckets, last = +Inf (matches the telemetry
+        # histogram layout so percentiles agree across surfaces).
+        self.handler_hist = [0] * nbuckets
+        self.queue_hist = [0] * nbuckets
+
+
+class ServerStats:
+    """Bounded per-process inbound-RPC accounting table."""
+
+    def __init__(self, entry_cap: int = DEFAULT_ENTRY_CAP):
+        from ray_tpu.util.locks import make_lock
+
+        self._lock = make_lock("rpc_stats.ServerStats._lock")
+        self.entry_cap = int(entry_cap)
+        self.started_at = time.time()
+        self._bounds = list(_boundaries())
+        self._nbuckets = len(self._bounds) + 1
+        #: method -> _MethodRow (methods are code-bounded, no cap needed).
+        self._methods: Dict[str, _MethodRow] = {}
+        #: (method, caller) -> [calls, handler_s, recv_bytes] — capped.
+        self._talkers: Dict[Tuple[str, str], list] = {}
+        self.overflow = 0
+
+    def _bucket(self, v: float) -> int:
+        for i, b in enumerate(self._bounds):
+            if v <= b:
+                return i
+        return self._nbuckets - 1
+
+    def register_methods(self, names) -> None:
+        """Preregister handler names so the accounting table covers the
+        full dispatch dict even before traffic (parity guarantee: a
+        newly added ``h_*`` cannot dodge instrumentation)."""
+        with self._lock:
+            for name in names:
+                if name not in self._methods:
+                    self._methods[name] = _MethodRow(self._nbuckets)
+
+    def methods(self) -> List[str]:
+        with self._lock:
+            return sorted(self._methods)
+
+    def record(self, method: str, caller: str, queue_wait_s: float,
+               handler_s: float, recv_bytes: int = 0,
+               reply_bytes: int = 0, ok: bool = True) -> None:
+        with self._lock:
+            row = self._methods.get(method)
+            if row is None:
+                row = self._methods[method] = _MethodRow(self._nbuckets)
+            row.calls += 1
+            if not ok:
+                row.errors += 1
+            row.queue_s += queue_wait_s
+            if queue_wait_s > row.queue_max:
+                row.queue_max = queue_wait_s
+            row.handler_s += handler_s
+            if handler_s > row.handler_max:
+                row.handler_max = handler_s
+            row.recv_bytes += recv_bytes
+            row.reply_bytes += reply_bytes
+            row.handler_hist[self._bucket(handler_s)] += 1
+            row.queue_hist[self._bucket(queue_wait_s)] += 1
+            key = (method, caller)
+            talker = self._talkers.get(key)
+            if talker is None:
+                if len(self._talkers) >= self.entry_cap:
+                    self.overflow += 1
+                    key = OVERFLOW_KEY
+                    talker = self._talkers.get(key)
+                if talker is None:
+                    talker = self._talkers[key] = [0, 0.0, 0]
+            talker[0] += 1
+            talker[1] += handler_s
+            talker[2] += recv_bytes
+        from ray_tpu.util import telemetry
+
+        telemetry.observe("ray_tpu_rpc_server_handler_seconds",
+                          handler_s, {"method": method})
+        telemetry.observe("ray_tpu_rpc_server_queue_wait_seconds",
+                          queue_wait_s, {"method": method})
+        telemetry.inc("ray_tpu_rpc_server_calls_total", 1,
+                      {"method": method, "caller": caller})
+        if not ok:
+            telemetry.inc("ray_tpu_rpc_server_errors_total", 1,
+                          {"method": method})
+
+    def snapshot(self, top: int = 0) -> dict:
+        """JSONable accounting snapshot: per-method rows (with p50/p99
+        from the in-process buckets) plus the top-talkers table."""
+        from ray_tpu.util.metrics_history import _bucket_percentile
+
+        with self._lock:
+            methods = []
+            for name, r in self._methods.items():
+                hist = [float(c) for c in r.handler_hist]
+                qist = [float(c) for c in r.queue_hist]
+                methods.append({
+                    "method": name,
+                    "calls": r.calls,
+                    "errors": r.errors,
+                    "handler_s": round(r.handler_s, 6),
+                    "handler_max_s": round(r.handler_max, 6),
+                    "handler_p50_s": _bucket_percentile(
+                        self._bounds, hist, 0.50),
+                    "handler_p99_s": _bucket_percentile(
+                        self._bounds, hist, 0.99),
+                    "queue_wait_s": round(r.queue_s, 6),
+                    "queue_wait_max_s": round(r.queue_max, 6),
+                    "queue_wait_p99_s": _bucket_percentile(
+                        self._bounds, qist, 0.99),
+                    "recv_bytes": r.recv_bytes,
+                    "reply_bytes": r.reply_bytes,
+                })
+            talkers = [
+                {"method": m, "caller": c, "calls": t[0],
+                 "handler_s": round(t[1], 6), "recv_bytes": t[2]}
+                for (m, c), t in self._talkers.items()]
+            overflow = self.overflow
+        methods.sort(key=lambda r: (-r["handler_s"], r["method"]))
+        talkers.sort(key=lambda r: (-r["calls"], r["method"]))
+        if top:
+            talkers = talkers[:top]
+        return {
+            "proc": f"{os.getpid()}",
+            "since_s": round(time.time() - self.started_at, 3),
+            "entry_cap": self.entry_cap,
+            "overflow": overflow,
+            "methods": methods,
+            "talkers": talkers,
+        }
+
+
+class LoopLagProbe:
+    """Self-scheduling event-loop lag probe (asio-stats analog).
+
+    ``call_later(interval)`` records ``actual - scheduled`` each tick:
+    a healthy loop shows sub-millisecond lag; a loop starved by a
+    blocking handler shows the block's full duration on the next tick.
+    """
+
+    def __init__(self, loop: asyncio.AbstractEventLoop, name: str,
+                 interval_s: float = 0.25,
+                 stall_threshold_s: float = 0.5):
+        self.loop = loop
+        self.name = name
+        self.interval_s = float(interval_s)
+        self.stall_threshold_s = float(stall_threshold_s)
+        self.tag = f"{os.getpid()}/{name}"
+        self._stopped = False
+        self._handle: Optional[asyncio.TimerHandle] = None
+        self._expected = 0.0
+        self._bounds = list(_boundaries())
+        self._hist = [0] * (len(self._bounds) + 1)
+        self.count = 0
+        self.lag_sum = 0.0
+        self.lag_max = 0.0
+        self.stalls = 0
+
+    def start(self) -> "LoopLagProbe":
+        self.loop.call_soon_threadsafe(self._arm)
+        return self
+
+    def _arm(self) -> None:
+        if self._stopped or self.loop.is_closed():
+            return
+        self._expected = self.loop.time() + self.interval_s
+        self._handle = self.loop.call_later(self.interval_s, self._tick)
+
+    def _tick(self) -> None:
+        lag = max(0.0, self.loop.time() - self._expected)
+        self.count += 1
+        self.lag_sum += lag
+        if lag > self.lag_max:
+            self.lag_max = lag
+        i = 0
+        for i, b in enumerate(self._bounds):
+            if lag <= b:
+                break
+        else:
+            i = len(self._bounds)
+        self._hist[i] += 1
+        from ray_tpu.util import telemetry
+
+        telemetry.observe("ray_tpu_event_loop_lag_seconds", lag,
+                          {"proc": self.tag})
+        if lag >= self.stall_threshold_s:
+            self.stalls += 1
+            from ray_tpu.util import flight_recorder
+
+            flight_recorder.record(
+                "rpc", "loop_stall", severity=flight_recorder.WARN,
+                loop=self.name, lag_s=round(lag, 4))
+        self._arm()
+
+    def stop(self) -> None:
+        self._stopped = True
+        handle, self._handle = self._handle, None
+        if handle is not None:
+            try:
+                self.loop.call_soon_threadsafe(handle.cancel)
+            except RuntimeError:  # lint: allow-silent(loop already closed; nothing left to cancel)
+                pass
+
+    def summary(self) -> dict:
+        from ray_tpu.util.metrics_history import _bucket_percentile
+
+        hist = [float(c) for c in self._hist]
+        return {
+            "loop": self.name,
+            "proc": self.tag,
+            "interval_s": self.interval_s,
+            "ticks": self.count,
+            "lag_avg_s": (round(self.lag_sum / self.count, 6)
+                          if self.count else 0.0),
+            "lag_max_s": round(self.lag_max, 6),
+            "lag_p50_s": _bucket_percentile(self._bounds, hist, 0.50),
+            "lag_p99_s": _bucket_percentile(self._bounds, hist, 0.99),
+            "stalls": self.stalls,
+        }
+
+
+class AmplificationStats:
+    """Head-side pubsub / KV fan-out amplification accounting.
+
+    One instance per head service. A publish to ``n`` subscribers costs
+    ``n`` messages and ``n x payload`` bytes; a KV put with downstream
+    deliveries costs ``bytes x fan-out``. The per-channel /
+    per-namespace tables are code-bounded (channel names and KV
+    namespaces are finite in this runtime), so no cap logic is needed —
+    the per-caller explosion lives in :class:`ServerStats` where the
+    cap is.
+    """
+
+    def __init__(self):
+        from ray_tpu.util.locks import make_lock
+
+        self._lock = make_lock("rpc_stats.AmplificationStats._lock")
+        #: channel -> [publishes, messages, bytes, drops, last_fanout]
+        self._channels: Dict[str, list] = {}
+        #: ns -> [puts, bytes, amplified_bytes]
+        self._kv: Dict[str, list] = {}
+        self.pruned_total = 0
+
+    def record_publish(self, channel: str, fanout: int, nbytes: int,
+                       pruned: int = 0) -> None:
+        with self._lock:
+            row = self._channels.setdefault(channel, [0, 0, 0, 0, 0])
+            row[0] += 1
+            row[1] += fanout
+            row[2] += nbytes * fanout
+            row[3] += pruned
+            row[4] = fanout
+            self.pruned_total += pruned
+        from ray_tpu.util import telemetry
+
+        if fanout:
+            telemetry.inc("ray_tpu_pubsub_messages_total", fanout,
+                          {"channel": channel})
+            telemetry.inc("ray_tpu_pubsub_bytes_total", nbytes * fanout,
+                          {"channel": channel})
+        telemetry.set_gauge("ray_tpu_pubsub_fanout", fanout,
+                            {"channel": channel})
+        if pruned:
+            telemetry.inc(
+                "ray_tpu_pubsub_dead_subscribers_pruned_total", pruned)
+
+    def record_prune(self, channel: str, pruned: int) -> None:
+        """Prunes outside a publish (worker death / conn close)."""
+        if pruned <= 0:
+            return
+        with self._lock:
+            row = self._channels.setdefault(channel, [0, 0, 0, 0, 0])
+            row[3] += pruned
+            self.pruned_total += pruned
+        from ray_tpu.util import telemetry
+
+        telemetry.inc("ray_tpu_pubsub_dead_subscribers_pruned_total",
+                      pruned)
+
+    def record_kv_put(self, ns: str, nbytes: int, fanout: int) -> None:
+        """``fanout`` counts downstream deliveries beyond the store
+        write itself (history ingest, watchers); amplification is
+        ``bytes x (1 + fanout)``."""
+        amplified = nbytes * (1 + max(0, fanout))
+        with self._lock:
+            row = self._kv.setdefault(ns, [0, 0, 0])
+            row[0] += 1
+            row[1] += nbytes
+            row[2] += amplified
+        from ray_tpu.util import telemetry
+
+        telemetry.inc("ray_tpu_kv_write_bytes_total", nbytes,
+                      {"ns": ns})
+        telemetry.inc("ray_tpu_kv_write_amplified_bytes_total",
+                      amplified, {"ns": ns})
+
+    def snapshot(self) -> dict:
+        with self._lock:
+            channels = [
+                {"channel": ch, "publishes": r[0], "messages": r[1],
+                 "bytes": r[2], "drops_pruned": r[3],
+                 "fanout": r[4],
+                 "fanout_avg": round(r[1] / r[0], 3) if r[0] else 0.0}
+                for ch, r in self._channels.items()]
+            kv = [
+                {"ns": ns, "puts": r[0], "bytes": r[1],
+                 "amplified_bytes": r[2],
+                 "amplification": (round(r[2] / r[1], 3)
+                                   if r[1] else 1.0)}
+                for ns, r in self._kv.items()]
+        channels.sort(key=lambda r: (-r["messages"], r["channel"]))
+        kv.sort(key=lambda r: (-r["amplified_bytes"], r["ns"]))
+        return {"pubsub": channels, "kv": kv,
+                "pruned_total": self.pruned_total}
+
+
+# -- process-global registries ------------------------------------------
+
+_server_stats: Optional[ServerStats] = None
+_stats_lock = threading.Lock()
+_probes: Dict[str, LoopLagProbe] = {}
+_probes_lock = threading.Lock()
+
+
+def server_stats() -> ServerStats:
+    """The process-global inbound-call accounting table."""
+    global _server_stats
+    s = _server_stats
+    if s is None:
+        with _stats_lock:
+            s = _server_stats
+            if s is None:
+                s = _server_stats = ServerStats()
+    return s
+
+
+def install_probe(loop: asyncio.AbstractEventLoop, name: str,
+                  interval_s: Optional[float] = None,
+                  stall_threshold_s: Optional[float] = None
+                  ) -> Optional[LoopLagProbe]:
+    """Install (idempotently, by loop name) a lag probe on ``loop``.
+
+    Returns None when the metrics plane is disabled — the probe's only
+    output rides telemetry, so a disabled plane should not pay the
+    wakeups either."""
+    from ray_tpu.util import telemetry
+
+    if not telemetry.enabled():
+        return None
+    if interval_s is None or stall_threshold_s is None:
+        try:
+            from ray_tpu.core.config import get_config
+
+            cfg = get_config()
+            if interval_s is None:
+                interval_s = cfg.event_loop_probe_interval_s
+            if stall_threshold_s is None:
+                stall_threshold_s = cfg.event_loop_stall_threshold_s
+        except Exception:  # lint: allow-silent(config not bootstrapped yet; probe defaults are safe)
+            interval_s = interval_s or 0.25
+            stall_threshold_s = stall_threshold_s or 0.5
+    with _probes_lock:
+        probe = _probes.get(name)
+        if probe is not None:
+            if not probe.loop.is_closed() and probe.loop.is_running():
+                return probe
+            # Stale probe from a stopped loop (init/shutdown churn):
+            # mark it dead and take over the name.
+            probe._stopped = True
+        probe = LoopLagProbe(loop, name, interval_s=interval_s,
+                             stall_threshold_s=stall_threshold_s)
+        _probes[name] = probe
+    return probe.start()
+
+
+def probe_summaries() -> List[dict]:
+    with _probes_lock:
+        probes = list(_probes.values())
+    return [p.summary() for p in probes]
+
+
+def reset_for_testing() -> None:
+    global _server_stats
+    with _stats_lock:
+        _server_stats = None
+    with _probes_lock:
+        for probe in _probes.values():
+            probe.stop()
+        _probes.clear()

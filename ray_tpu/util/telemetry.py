@@ -262,6 +262,54 @@ CATALOG: Dict[str, tuple] = {
         HISTOGRAM, "Device time attributed to one train step by the "
         "device-trace parser, split by phase (compile / execute) and "
         "rank.", ("rank", "phase"), SLOW_BOUNDARIES),
+    # --- control-plane load observatory (util/rpc_stats.py,
+    # core/rpc.py server side, core/gcs.py pubsub/KV fan-out) ---
+    "ray_tpu_rpc_server_handler_seconds": (
+        HISTOGRAM, "Server-side handler execution time of inbound RPC "
+        "calls (handler start to handler return), per method.",
+        ("method",), LATENCY_BOUNDARIES),
+    "ray_tpu_rpc_server_queue_wait_seconds": (
+        HISTOGRAM, "Server-side queue wait of inbound RPC calls (frame "
+        "read to handler start — event-loop backlog), per method.",
+        ("method",), LATENCY_BOUNDARIES),
+    "ray_tpu_rpc_server_calls_total": (
+        COUNTER, "Inbound RPC calls dispatched server-side, per method "
+        "and caller kind (worker / agent / driver / head / peer).",
+        ("method", "caller"), None),
+    "ray_tpu_rpc_server_errors_total": (
+        COUNTER, "Inbound RPC calls whose handler raised, per method.",
+        ("method",), None),
+    # Per-process loop-lag histogram: the Python analog of Ray's asio
+    # event-loop stats. A self-scheduling callback measures scheduled-
+    # vs-actual delay; sustained lag means the loop is starved.
+    "ray_tpu_event_loop_lag_seconds": (
+        HISTOGRAM, "Scheduled-vs-actual delay of a self-scheduling "
+        "probe callback on each process event loop (head / agent / "
+        "worker / driver).", ("proc",), LATENCY_BOUNDARIES),
+    "ray_tpu_pubsub_messages_total": (
+        COUNTER, "Pubsub notifications fanned out by the head, per "
+        "channel (one per subscriber per publish).",
+        ("channel",), None),
+    "ray_tpu_pubsub_bytes_total": (
+        COUNTER, "Approximate payload bytes fanned out by head pubsub, "
+        "per channel (payload size x live subscribers).",
+        ("channel",), None),
+    "ray_tpu_pubsub_fanout": (
+        GAUGE, "Live subscriber count per pubsub channel (the fan-out "
+        "factor every publish pays).", ("channel",), None),
+    "ray_tpu_pubsub_dead_subscribers_pruned_total": (
+        COUNTER, "Dead subscriber connections pruned from pubsub "
+        "channels (connection loss / worker death).", (), None),
+    "ray_tpu_kv_write_bytes_total": (
+        COUNTER, "Raw value bytes written through h_kv_put, per "
+        "namespace.", ("ns",), None),
+    "ray_tpu_kv_write_amplified_bytes_total": (
+        COUNTER, "Amplified KV write bytes: value bytes x downstream "
+        "fan-out (store write + watcher/subscriber deliveries), per "
+        "namespace.", ("ns",), None),
+    "ray_tpu_metrics_history_series_capped_total": (
+        COUNTER, "Series evicted by the per-metric series-count cap "
+        "(high-cardinality tag explosion guard).", (), None),
 }
 
 _KIND_TO_CLS = {

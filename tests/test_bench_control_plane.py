@@ -1,0 +1,79 @@
+"""Tier-1 gate for the control-plane load lane's committed artifact
+(BENCH_CONTROL_PLANE.json, written by ``bench.py control-plane``): the
+newest artifact must parse and carry every schema key with a sane
+value — a stale or hand-mangled JSON can't green the lane silently
+(same pattern as the TSan artifact gate)."""
+
+import glob
+import json
+import os
+import re
+
+
+_REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def _churn(required_rate_key):
+    def ok(v):
+        return (isinstance(v, dict) and v.get("seconds", 0) > 0
+                and v.get(required_rate_key, 0) > 0)
+    return ok
+
+
+def _handler_rows(v):
+    if not isinstance(v, list) or not v:
+        return False
+    keys = {"method", "calls", "errors", "p50_ms", "p99_ms",
+            "queue_p99_ms"}
+    return all(keys <= set(row) and row["calls"] > 0
+               and row["p99_ms"] >= row["p50_ms"] >= 0
+               for row in v)
+
+
+#: every key the artifact must carry, with a validity predicate.
+_ARTIFACT_SCHEMA = {
+    # The issue floor: a 25-50 logical-node fake cluster.
+    "nodes": lambda v: isinstance(v, int) and v >= 25,
+    "task_churn": _churn("tasks_per_second"),
+    "actor_churn": _churn("actors_per_second"),
+    "pubsub_churn": _churn("publishes_per_second"),
+    "kv_churn": _churn("puts_per_second"),
+    "handlers": _handler_rows,
+    "handlers_tracked": lambda v: isinstance(v, int) and v >= 20,
+    "rpc_calls_total": lambda v: isinstance(v, int) and v > 100,
+    "loop_lag_p50_ms": lambda v: isinstance(v, (int, float)) and v >= 0,
+    "loop_lag_p99_ms": lambda v: isinstance(v, (int, float)) and v >= 0,
+    "loop_stalls": lambda v: isinstance(v, int) and v >= 0,
+    "pubsub_fanout_max": lambda v: isinstance(v, int) and v >= 1,
+    "kv_amplification_max": lambda v: isinstance(v, (int, float))
+    and v >= 1.0,
+    "fanout": lambda v: isinstance(v, dict)
+    and {"pubsub", "kv", "pruned_subscribers"} <= set(v)
+    and any(ns["ns"] == "metrics" and ns["amplification"] >= 2.0
+            for ns in v["kv"]),
+    "wall_s": lambda v: isinstance(v, (int, float)) and v > 0,
+    "run_date": lambda v: isinstance(v, str)
+    and re.fullmatch(r"\d{4}-\d{2}-\d{2}", v) is not None,
+}
+
+
+def _latest_artifact() -> str:
+    paths = sorted(glob.glob(os.path.join(_REPO,
+                                          "BENCH_CONTROL_PLANE*.json")))
+    assert paths, "no BENCH_CONTROL_PLANE*.json artifact committed"
+    return paths[-1]
+
+
+def test_control_plane_artifact_schema():
+    """Tier-1: the newest committed control-plane bench artifact parses
+    and proves a real >=25-node run — every schema key present and
+    valid, no unknown keys."""
+    path = _latest_artifact()
+    with open(path) as f:
+        data = json.load(f)
+    for key, ok in _ARTIFACT_SCHEMA.items():
+        assert key in data, f"{os.path.basename(path)} missing {key!r}"
+        assert ok(data[key]), (
+            f"{os.path.basename(path)}: bad {key!r}: {data[key]!r}")
+    extra = set(data) - set(_ARTIFACT_SCHEMA)
+    assert not extra, f"unknown artifact keys (update the schema): {extra}"

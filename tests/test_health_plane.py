@@ -156,6 +156,33 @@ def test_memory_hard_cap_evicts_instead_of_growing():
     assert "299" in names and "0" not in names
 
 
+def test_per_metric_series_cap_evicts_oldest():
+    """High-cardinality protection: one metric flooding distinct tag
+    sets evicts its own oldest series at the per-metric cap instead of
+    crowding every other metric out of the byte budget."""
+    from ray_tpu.util import telemetry
+
+    st = MetricsHistoryStore(max_series_per_metric=8)
+    st.ingest("p1", {"innocent": _gauge(1.0)}, ts=999.0)
+    for i in range(40):
+        st.ingest("p1", {"hot": {
+            "type": "gauge", "description": "",
+            "values": [[[["i", str(i)]], float(i)]],
+        }}, ts=1000.0 + i)
+    hot = [s for s in st.index() if s["name"] == "hot"]
+    assert len(hot) == 8
+    assert st.cap_evictions == 40 - 8
+    # Survivors are the newest tag sets; the flood victimized only its
+    # own metric.
+    tags = {s["tags"]["i"] for s in hot}
+    assert "39" in tags and "0" not in tags
+    assert any(s["name"] == "innocent" for s in st.index())
+    # The eviction pressure is observable.
+    assert st.snapshot()["cap_evictions"] == 32
+    m = telemetry.metric("ray_tpu_metrics_history_series_capped_total")
+    assert m._values.get((), 0) >= 32
+
+
 def test_eviction_keeps_proc_baselines():
     """Diff baselines survive series eviction, so a re-created series
     resumes correct deltas instead of re-counting history."""

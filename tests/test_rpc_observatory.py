@@ -1,0 +1,405 @@
+"""Control-plane load observatory coverage: the bounded server-side
+RPC accounting table (per-handler + per-caller with a hard talker
+cap), event-loop lag probes (blocked-loop detection feeding the
+``event_loop_lag`` default alert through the history store, fire ->
+resolve), pubsub/KV amplification accounting, the hotrpc CLI
+renderer — and the tier-1 e2e: handler-table parity against the live
+dispatch dict, dead-subscriber pruning on worker death, and the CLI /
+debug-bundle surfaces serving the same snapshot."""
+
+import asyncio
+import json
+import os
+import threading
+import time
+
+import pytest
+
+import ray_tpu
+from ray_tpu.util import rpc_stats
+from ray_tpu.util.rpc_stats import (AmplificationStats, LoopLagProbe,
+                                    OVERFLOW_KEY, ServerStats)
+
+
+# ---------------------------------------------------------------------------
+# server-side accounting units (no cluster)
+# ---------------------------------------------------------------------------
+
+
+def test_server_stats_accounting_and_percentiles():
+    st = ServerStats()
+    for _ in range(9):
+        st.record("kv_put", "worker", 0.0001, 0.002, recv_bytes=100,
+                  reply_bytes=10)
+    st.record("kv_put", "worker", 0.0001, 0.9, recv_bytes=100, ok=False)
+    st.record("ping", "driver", 0.0, 0.0001)
+    snap = st.snapshot()
+    rows = {r["method"]: r for r in snap["methods"]}
+    kv = rows["kv_put"]
+    assert kv["calls"] == 10 and kv["errors"] == 1
+    assert kv["recv_bytes"] == 1000 and kv["reply_bytes"] == 90
+    # p50 sits in the low-ms buckets; p99 reaches the slow outlier.
+    assert kv["handler_p50_s"] <= 0.01
+    assert kv["handler_p99_s"] >= 0.5
+    assert kv["handler_max_s"] == pytest.approx(0.9)
+    # Methods sort by total handler time: the hot one leads.
+    assert snap["methods"][0]["method"] == "kv_put"
+    talkers = {(t["method"], t["caller"]): t for t in snap["talkers"]}
+    assert talkers[("kv_put", "worker")]["calls"] == 10
+    assert talkers[("ping", "driver")]["calls"] == 1
+
+
+def test_server_stats_parity_preregistration():
+    """register_methods() seeds zero rows so the accounting table
+    covers the full dispatch dict before any traffic."""
+    st = ServerStats()
+    st.register_methods(["a", "b", "c"])
+    st.record("b", "worker", 0.0, 0.001)
+    assert st.methods() == ["a", "b", "c"]
+    rows = {r["method"]: r for r in st.snapshot()["methods"]}
+    assert rows["a"]["calls"] == 0 and rows["b"]["calls"] == 1
+
+
+def test_server_stats_talker_cap_overflow():
+    """The talker table has a HARD entry cap: distinct (method, caller)
+    keys beyond it fold into one __other__ row instead of growing."""
+    st = ServerStats(entry_cap=8)
+    for i in range(50):
+        st.record(f"m{i}", "worker", 0.0, 0.001)
+    snap = st.snapshot()
+    # 8 real rows + the single __other__ fold row.
+    assert len(snap["talkers"]) == 8 + 1
+    assert snap["overflow"] == 50 - 8
+    other = {(t["method"], t["caller"]): t
+             for t in snap["talkers"]}[OVERFLOW_KEY]
+    assert other["calls"] == snap["overflow"]
+    # Per-method rows are NOT capped (method names are code-bounded).
+    assert len(snap["methods"]) == 50
+
+
+def test_caller_kind_classification():
+    class FakeConn:
+        def __init__(self, name="", state=None):
+            self.name = name
+            self.state = state if state is not None else {}
+
+    assert rpc_stats.caller_kind(
+        FakeConn(state={"caller_kind": "worker"})) == "worker"
+    assert rpc_stats.caller_kind(FakeConn(name="worker-head")) == "head"
+    assert rpc_stats.caller_kind(FakeConn(name="peer-1234")) == "peer"
+    assert rpc_stats.caller_kind(object()) == "peer"
+
+
+def test_amplification_stats_snapshot():
+    amp = AmplificationStats()
+    amp.record_publish("actor_state", fanout=3, nbytes=100)
+    amp.record_publish("actor_state", fanout=5, nbytes=100, pruned=2)
+    amp.record_prune("actor_state", 1)
+    amp.record_kv_put("metrics", nbytes=1000, fanout=1)
+    amp.record_kv_put("functions", nbytes=500, fanout=0)
+    snap = amp.snapshot()
+    (ch,) = snap["pubsub"]
+    assert ch["channel"] == "actor_state" and ch["publishes"] == 2
+    assert ch["messages"] == 8 and ch["bytes"] == 800
+    assert ch["drops_pruned"] == 3 and ch["fanout"] == 5
+    assert ch["fanout_avg"] == pytest.approx(4.0)
+    kv = {r["ns"]: r for r in snap["kv"]}
+    # metrics ns: every byte is written once and delivered once more.
+    assert kv["metrics"]["amplification"] == pytest.approx(2.0)
+    assert kv["functions"]["amplification"] == pytest.approx(1.0)
+    assert snap["pruned_total"] == 3
+
+
+# ---------------------------------------------------------------------------
+# event-loop lag probe (own loop, no cluster)
+# ---------------------------------------------------------------------------
+
+
+def _loop_in_thread():
+    loop = asyncio.new_event_loop()
+    thread = threading.Thread(target=loop.run_forever, daemon=True)
+    thread.start()
+    return loop, thread
+
+
+def test_loop_lag_probe_detects_blocked_loop():
+    from ray_tpu.util import flight_recorder, telemetry
+
+    assert telemetry.enabled()
+    loop, _thread = _loop_in_thread()
+    probe = LoopLagProbe(loop, "obs-unit-loop", interval_s=0.02,
+                         stall_threshold_s=0.2).start()
+    try:
+        time.sleep(0.15)  # healthy ticks first
+        healthy = probe.summary()
+        assert healthy["ticks"] >= 2 and healthy["stalls"] == 0
+        loop.call_soon_threadsafe(time.sleep, 0.5)  # starve the loop
+        time.sleep(0.8)
+        s = probe.summary()
+        assert s["lag_max_s"] >= 0.3, s
+        assert s["stalls"] >= 1
+        assert s["lag_p99_s"] > s["lag_p50_s"]
+        # The stall left its flight-recorder evidence trail.
+        events = [e for e in flight_recorder.snapshot()
+                  if e["subsystem"] == "rpc"
+                  and e["event"] == "loop_stall"
+                  and e["tags"].get("loop") == "obs-unit-loop"]
+        assert events, "loop stall must land in the flight ring"
+        # And the telemetry histogram carries the observation.
+        m = telemetry.metric("ray_tpu_event_loop_lag_seconds")
+        key = (("proc", probe.tag),)
+        vec = m._hists.get(key)
+        assert vec is not None and vec[-1] >= s["ticks"] - 1
+    finally:
+        probe.stop()
+        loop.call_soon_threadsafe(loop.stop)
+
+
+def test_install_probe_idempotent_and_replaces_dead_loop():
+    loop, _thread = _loop_in_thread()
+    try:
+        p1 = rpc_stats.install_probe(loop, "obs-idem", interval_s=0.05)
+        p2 = rpc_stats.install_probe(loop, "obs-idem", interval_s=0.05)
+        assert p1 is p2, "same live loop: one probe"
+        assert any(s["loop"] == "obs-idem"
+                   for s in rpc_stats.probe_summaries())
+    finally:
+        loop.call_soon_threadsafe(loop.stop)
+    # Old loop stopped (init/shutdown churn): a new loop under the same
+    # name takes over instead of leaking a dead probe.
+    time.sleep(0.1)
+    loop2, _t2 = _loop_in_thread()
+    try:
+        p3 = rpc_stats.install_probe(loop2, "obs-idem",
+                                     interval_s=0.05)
+        assert p3 is not p1 and p3.loop is loop2
+    finally:
+        p3.stop()
+        loop2.call_soon_threadsafe(loop2.stop)
+
+
+def test_loop_lag_alert_fires_and_resolves():
+    """The satellite e2e: a blocked loop's probe observations flow
+    through the (push-shaped) history store and trip the shipped
+    ``event_loop_lag`` default rule, then resolve once the stall ages
+    out of the rule's window."""
+    from ray_tpu.util import alerts
+    from ray_tpu.util import metrics as um
+    from ray_tpu.util.alerts import AlertEngine
+    from ray_tpu.util.metrics_history import MetricsHistoryStore
+
+    rule = next(r for r in alerts.default_rules()
+                if r.name == "event_loop_lag")
+    assert rule.metric == "ray_tpu_event_loop_lag_seconds"
+
+    loop, _thread = _loop_in_thread()
+    probe = rpc_stats.install_probe(loop, "obs-alert-loop",
+                                    interval_s=0.02,
+                                    stall_threshold_s=0.2)
+    assert probe is not None, "metrics plane must be live in tests"
+    st = MetricsHistoryStore()
+    engine = AlertEngine(st, rules=[rule], clock=lambda: 0.0)
+
+    def push(ts):
+        snap = {k: v for k, v in um.local_snapshot().items()
+                if k == rule.metric}
+        st.ingest("p1", snap, ts=ts)
+
+    try:
+        time.sleep(0.1)
+        push(1000.0)  # seeds the cumulative baseline
+        loop.call_soon_threadsafe(time.sleep, 0.6)  # wedge the loop
+        time.sleep(1.0)
+        push(1010.0)  # the stall tick lands as a window delta
+        assert engine.evaluate(now=1011.0) == []   # breach -> pending
+        trans = engine.evaluate(now=1017.0)        # for_s elapsed
+        fired = [t for t in trans if t["event"] == "fired"
+                 and t["episode"]["rule"] == "event_loop_lag"]
+        assert fired, f"lag alert never fired: {trans}"
+        assert fired[0]["episode"]["tags"]["proc"] == probe.tag
+        assert fired[0]["episode"]["evidence"]
+        # Healthy again: the stall delta ages out of the 60 s window
+        # and the rule resolves by absence (histograms do not carry
+        # forward).
+        trans = engine.evaluate(now=1100.0)
+        assert [t["event"] for t in trans
+                if t["episode"]["rule"] == "event_loop_lag"] \
+            == ["resolved"]
+    finally:
+        probe.stop()
+        loop.call_soon_threadsafe(loop.stop)
+
+
+# ---------------------------------------------------------------------------
+# CLI renderer (pure, no cluster)
+# ---------------------------------------------------------------------------
+
+
+def test_render_hotrpc_lines():
+    from ray_tpu.scripts.cli import _render_hotrpc
+
+    snap = {
+        "since_s": 12.0, "entry_cap": 512, "overflow": 0,
+        "methods": [
+            {"method": "kv_put", "calls": 40, "errors": 1,
+             "handler_s": 0.4, "handler_p50_s": 0.002,
+             "handler_p99_s": 0.09, "handler_max_s": 0.12,
+             "queue_wait_p99_s": 0.001, "recv_bytes": 4096,
+             "reply_bytes": 512},
+            {"method": "idle_handler", "calls": 0, "errors": 0,
+             "handler_s": 0.0},
+        ],
+        "talkers": [{"method": "kv_put", "caller": "worker",
+                     "calls": 40, "handler_s": 0.4,
+                     "recv_bytes": 4096}],
+        "loops": [{"loop": "ray-tpu-head", "proc": "1/ray-tpu-head",
+                   "interval_s": 0.25, "ticks": 100,
+                   "lag_avg_s": 0.001, "lag_max_s": 0.4,
+                   "lag_p50_s": 0.001, "lag_p99_s": 0.3,
+                   "stalls": 2}],
+        "loop_lag_cluster": [{"tags": {"proc": "9/worker-loop"},
+                              "p50_s": 0.001, "p99_s": 0.25}],
+        "amplification": {
+            "pubsub": [{"channel": "actor_state", "publishes": 10,
+                        "messages": 30, "bytes": 3000,
+                        "drops_pruned": 2, "fanout": 3,
+                        "fanout_avg": 3.0}],
+            "kv": [{"ns": "metrics", "puts": 5, "bytes": 5000,
+                    "amplified_bytes": 10000, "amplification": 2.0}],
+            "pruned_total": 2,
+        },
+    }
+    text = "\n".join(_render_hotrpc(snap))
+    assert "handlers: 2 tracked, 1 active" in text
+    assert "kv_put" in text and "90.0ms" in text  # p99
+    assert "1 registered handler(s) with no calls yet" in text
+    assert "worker" in text
+    assert "ray-tpu-head" in text and "stalls=2" in text
+    assert "9/worker-loop" in text
+    assert "fanout=3" in text and "drops=2" in text
+    assert "x2.0" in text  # kv amplification factor
+    assert "2 dead subscriber(s)" in text
+    # Empty snapshot renders a hint, not a crash.
+    empty = "\n".join(_render_hotrpc(
+        {"methods": [], "talkers": [], "loops": []}))
+    assert "no RPC traffic recorded yet" in empty
+
+
+# ---------------------------------------------------------------------------
+# e2e: parity, pruning, and the surfaces (cluster)
+# ---------------------------------------------------------------------------
+
+
+def _poll(predicate, timeout_s=30.0, interval_s=0.3):
+    deadline = time.monotonic() + timeout_s
+    while True:
+        value = predicate()
+        if value:
+            return value
+        if time.monotonic() > deadline:
+            return predicate()
+        time.sleep(interval_s)
+
+
+def test_handler_table_parity_e2e(ray_start_isolated):
+    """Every handler in the live GCS dispatch dict appears in the
+    accounting table (register_methods parity): a newly added ``h_*``
+    cannot dodge instrumentation."""
+    from ray_tpu import api
+    from ray_tpu.util.state import _call
+
+    handlers = set(api._global_node.service.handlers())
+    assert "rpc_stats" in handlers
+    snap = _call("rpc_stats", {})
+    tracked = {m["method"] for m in snap["methods"]}
+    missing = handlers - tracked
+    assert not missing, f"handlers missing from accounting: {missing}"
+
+
+def test_rpc_accounting_and_surfaces_e2e(ray_start_isolated, tmp_path):
+    """Drive real traffic, then assert the hotrpc CLI and the debug
+    bundle ``rpc/`` section render the SAME snapshot data."""
+    from ray_tpu.scripts.cli import _render_hotrpc
+    from ray_tpu.util.debug import write_debug_bundle
+    from ray_tpu.util.state import _call
+
+    @ray_tpu.remote(num_cpus=1)
+    def nop(i):
+        return i
+
+    assert ray_tpu.get([nop.remote(i) for i in range(20)],
+                       timeout=300) == list(range(20))
+
+    # The head's loop probe arms 0.5 s after loop start and ticks every
+    # probe interval — poll until it has at least one observation.
+    def probe_ticking():
+        snap = _call("rpc_stats", {"top": 10})
+        if any(lp["loop"] == "ray-tpu-head" and lp["ticks"] > 0
+               for lp in snap["loops"]):
+            return snap
+        return None
+
+    snap = _poll(probe_ticking, timeout_s=15.0)
+    assert snap, "head loop-lag probe never ticked"
+    rows = {m["method"]: m for m in snap["methods"]}
+    assert rows["task_done"]["calls"] >= 20
+    assert rows["task_done"]["handler_p99_s"] > 0.0
+    assert rows["task_done"]["recv_bytes"] > 0
+    callers = {t["caller"] for t in snap["talkers"]}
+    assert "worker" in callers, snap["talkers"]
+    # Queue wait is accounted separately from handler time.
+    assert rows["task_done"]["queue_wait_p99_s"] >= 0.0
+
+    # The CLI renderer accepts the live snapshot.
+    text = "\n".join(_render_hotrpc(snap, top=10))
+    assert "task_done" in text and "handlers:" in text
+
+    # The debug bundle's rpc/ section carries the same data shape.
+    out = str(tmp_path / "bundle")
+    manifest = write_debug_bundle(out, profile_duration_s=0,
+                                  trace_duration_s=0)
+    assert "rpc" in manifest, manifest.get("errors")
+    assert manifest["rpc"]["methods"] >= len(snap["methods"])
+    with open(os.path.join(out, "rpc", "stats.json")) as f:
+        dumped = json.load(f)
+    assert {m["method"] for m in snap["methods"]} \
+        <= {m["method"] for m in dumped["methods"]}
+    assert dumped["talkers"] and dumped["loops"]
+    assert "amplification" in dumped
+
+
+def test_dead_subscriber_pruned_e2e(ray_start_isolated):
+    """A subscriber whose worker dies is PRUNED from the fan-out set
+    (and counted), instead of being notified forever."""
+    from ray_tpu.util.state import _call
+
+    @ray_tpu.remote(num_cpus=0.01)
+    class Sub:
+        def subscribe(self):
+            from ray_tpu.util.state import _call as call
+
+            call("subscribe", {"channel": "obs-prune"})
+            return 1
+
+    s = Sub.remote()
+    assert ray_tpu.get(s.subscribe.remote(), timeout=120) == 1
+    snap = _call("rpc_stats", {})
+    before = snap["amplification"]["pruned_total"]
+    ray_tpu.kill(s)
+
+    def pruned():
+        _call("publish", {"channel": "obs-prune", "data": {"x": 1}})
+        snap = _call("rpc_stats", {})
+        amp = snap["amplification"]
+        return amp if amp["pruned_total"] > before else None
+
+    amp = _poll(pruned, timeout_s=30.0)
+    assert amp, "dead subscriber never pruned"
+    # After the prune the channel fans out to nobody. (If the
+    # worker-death path pruned before any publish, the channel row may
+    # not exist at all — publishes to an empty set early-return.)
+    _call("publish", {"channel": "obs-prune", "data": {"x": 2}})
+    snap = _call("rpc_stats", {})
+    ch = {c["channel"]: c
+          for c in snap["amplification"]["pubsub"]}.get("obs-prune")
+    assert ch is None or ch["fanout"] == 0

@@ -217,6 +217,50 @@ def test_device_trace_metrics_in_catalog():
     assert fr.CATALOG.get("trace") == ("captured", "capture_failed")
 
 
+def test_control_plane_metrics_in_catalog():
+    """The control-plane load-observatory metrics stay declared —
+    the per-handler server accounting, the event-loop lag probes, the
+    pubsub/KV amplification counters, and the history store's
+    per-metric series cap all emit through these names; a
+    rename/removal would blind the observatory. The ``loop_stall`` and
+    ``subscriber_pruned`` flight events are pinned alongside: they are
+    the stall/prune audit trail."""
+    expected = {
+        "ray_tpu_rpc_server_handler_seconds": (
+            telemetry.HISTOGRAM, ("method",)),
+        "ray_tpu_rpc_server_queue_wait_seconds": (
+            telemetry.HISTOGRAM, ("method",)),
+        "ray_tpu_rpc_server_calls_total": (
+            telemetry.COUNTER, ("method", "caller")),
+        "ray_tpu_rpc_server_errors_total": (
+            telemetry.COUNTER, ("method",)),
+        "ray_tpu_event_loop_lag_seconds": (
+            telemetry.HISTOGRAM, ("proc",)),
+        "ray_tpu_pubsub_messages_total": (
+            telemetry.COUNTER, ("channel",)),
+        "ray_tpu_pubsub_bytes_total": (
+            telemetry.COUNTER, ("channel",)),
+        "ray_tpu_pubsub_fanout": (telemetry.GAUGE, ("channel",)),
+        "ray_tpu_pubsub_dead_subscribers_pruned_total": (
+            telemetry.COUNTER, ()),
+        "ray_tpu_kv_write_bytes_total": (telemetry.COUNTER, ("ns",)),
+        "ray_tpu_kv_write_amplified_bytes_total": (
+            telemetry.COUNTER, ("ns",)),
+        "ray_tpu_metrics_history_series_capped_total": (
+            telemetry.COUNTER, ()),
+    }
+    for name, (kind, tag_keys) in expected.items():
+        assert name in telemetry.CATALOG, name
+        got_kind, _desc, got_tags, _bounds = telemetry.CATALOG[name]
+        assert got_kind == kind, name
+        assert tuple(got_tags) == tag_keys, name
+
+    from ray_tpu.util import flight_recorder as fr
+
+    assert "loop_stall" in fr.CATALOG.get("rpc", ())
+    assert "subscriber_pruned" in fr.CATALOG.get("gcs", ())
+
+
 def test_alert_rules_reference_only_catalog_metrics():
     """Catalog lint extension: every alert rule — the shipped defaults
     and anything constructed through AlertRule/validate_rule — may only
@@ -246,6 +290,8 @@ def test_alert_rules_reference_only_catalog_metrics():
         "ray_tpu_gcs_nodes",
         "ray_tpu_object_spilled_bytes_total",
         "ray_tpu_profiler_overhead_ratio",
+        "ray_tpu_event_loop_lag_seconds",
+        "ray_tpu_rpc_server_handler_seconds",
     ):
         assert metric in covered, f"default rules lost {metric}"
     # And the lint itself has teeth: typo'd metric, undeclared tag,
